@@ -1,0 +1,183 @@
+"""Booster: user-facing trained-model handle.
+
+Mirrors the reference Python package's Booster
+(reference: python-package/lightgbm/basic.py ``Booster`` — train/eval/
+predict/save surface; the ctypes C-API indirection collapses because the
+boosting driver is in-process).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .config import Config
+from .dataset import Dataset
+from .models.boosting import create_boosting
+from .models.gbdt import GBDT
+from .objective import create_objective
+from .utils.log import log_info, log_warning
+
+__all__ = ["Booster"]
+
+
+class Booster:
+    """Trained-model handle (reference basic.py Booster; C-side
+    src/c_api.cpp:108 Booster wrapper)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None,
+                 silent: bool = False) -> None:
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._train_data_name = "training"
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("train_set must be a Dataset")
+            self.config = Config(self.params)
+            train_set.construct(self.config)
+            self._gbdt = create_boosting(self.config, train_set)
+        elif model_file is not None:
+            with open(model_file) as fh:
+                self._load_from_string(fh.read())
+        elif model_str is not None:
+            self._load_from_string(model_str)
+        else:
+            raise ValueError("Booster needs train_set, model_file or model_str")
+
+    def _load_from_string(self, model_str: str) -> None:
+        from .models.model_text import string_to_model
+        self.config = Config(self.params)
+        self._gbdt = string_to_model(model_str, self.config)
+
+    # -- training ------------------------------------------------------------
+    def update(self, train_set: Optional[Dataset] = None,
+               fobj=None) -> bool:
+        """One boosting iteration (reference LGBM_BoosterUpdateOneIter /
+        basic.py Booster.update).  ``fobj(preds, train_set) -> (grad, hess)``
+        enables custom objectives."""
+        if train_set is not None:
+            raise NotImplementedError("resetting train data is not supported yet")
+        if fobj is not None:
+            preds = np.asarray(self._gbdt.score)
+            grad, hess = fobj(preds, self._gbdt.train_set)
+            return self._gbdt.train_one_iter(np.asarray(grad), np.asarray(hess))
+        return self._gbdt.train_one_iter()
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        return self._gbdt.current_iteration
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees()
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        return self._gbdt.num_features
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        self._gbdt.add_valid(data, name)
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """reference basic.py reset_parameter -> LGBM_BoosterResetParameter;
+        supports learning-rate style schedule changes."""
+        self.params.update(params)
+        self.config = self.config.update(params)
+        self._gbdt.config = self.config
+        return self
+
+    # -- evaluation ----------------------------------------------------------
+    def eval_train(self, feval=None) -> List[Tuple[str, str, float, bool]]:
+        out = self._gbdt.eval_train()
+        if feval is not None:
+            out = out + self._run_feval(feval, "training",
+                                        np.asarray(self._gbdt.score),
+                                        self._gbdt.train_set)
+        return out
+
+    def eval_valid(self, feval=None) -> List[Tuple[str, str, float, bool]]:
+        out = self._gbdt.eval_valid()
+        if feval is not None:
+            for vi, (vname, vset) in enumerate(self._gbdt.valid_sets):
+                out = out + self._run_feval(
+                    feval, vname, np.asarray(self._gbdt.valid_scores[vi]), vset)
+        return out
+
+    def _run_feval(self, feval, name, score, dset):
+        res = feval(score, dset)
+        if isinstance(res, tuple):
+            res = [res]
+        return [(name, r[0], float(r[1]), bool(r[2])) for r in res]
+
+    # -- prediction ----------------------------------------------------------
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else None
+        if hasattr(data, "to_numpy"):
+            data = data.to_numpy(dtype=np.float64, na_value=np.nan)
+        if hasattr(data, "todense"):
+            data = np.asarray(data.todense())
+        return self._gbdt.predict(np.asarray(data, dtype=np.float64),
+                                  raw_score=raw_score,
+                                  start_iteration=start_iteration,
+                                  num_iteration=num_iteration,
+                                  pred_leaf=pred_leaf,
+                                  pred_contrib=pred_contrib)
+
+    # -- model IO ------------------------------------------------------------
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        return self._gbdt.save_model_to_string(
+            start_iteration, -1 if num_iteration is None else num_iteration)
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration, start_iteration,
+                                          importance_type))
+        return self
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> Dict[str, Any]:
+        from .models.model_text import model_to_dict
+        return model_to_dict(self._gbdt, start_iteration,
+                             -1 if num_iteration is None else num_iteration)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        return self._gbdt.feature_importance(importance_type)
+
+    def feature_name(self) -> List[str]:
+        ts = self._gbdt.train_set
+        if ts is not None:
+            return ts.feature_names
+        return getattr(self._gbdt, "feature_names_", None) or \
+            [f"Column_{i}" for i in range(self._gbdt.num_features)]
+
+    # network emulation (reference basic.py:2178 set_network) ---------------
+    def set_network(self, machines, local_listen_port: int = 12400,
+                    listen_time_out: int = 120, num_machines: int = 1) -> "Booster":
+        log_warning("set_network is a no-op: distribution uses the JAX mesh "
+                    "(see lightgbm_tpu.parallel); kept for API compatibility")
+        return self
+
+    def free_network(self) -> "Booster":
+        return self

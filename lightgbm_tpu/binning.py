@@ -1,0 +1,303 @@
+"""Feature quantization: value -> integer bin codes.
+
+TPU-native re-implementation of the reference BinMapper
+(reference: include/LightGBM/bin.h:61 ``BinMapper``, src/io/bin.cpp:150
+``GreedyFindBin`` / ``FindBinWithZeroAsOneBin`` / ``BinMapper::FindBin``).
+
+Runs host-side (numpy) once at ingest; the result is a dense integer matrix
+(uint8 for <=256 bins) that is ``device_put`` / mesh-sharded once and stays
+on device for the whole training run.  Bin semantics follow the reference:
+
+* zero gets its own bin (kZeroThreshold band), negatives/positives binned
+  separately around it with greedy equal-frequency boundaries;
+* missing handling is None / Zero / NaN (bin.h:26 ``MissingType``): with
+  ``MissingType.NaN`` an extra trailing bin holds the NaNs;
+* categorical features map category ids to bins by descending frequency,
+  keeping categories that cover 99% of the sample (src/io/bin.cpp categorical
+  branch of FindBin).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["MissingType", "BinMapper", "find_bin", "bin_matrix"]
+
+# reference include/LightGBM/bin.h:29 kZeroThreshold
+ZERO_THRESHOLD = 1e-35
+# reference include/LightGBM/bin.h:27 kSparseThreshold unused here (dense device layout)
+
+
+class MissingType(enum.Enum):
+    NONE = 0
+    ZERO = 1
+    NAN = 2
+
+
+def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                     max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
+    """Greedy equal-frequency bin boundary search over distinct sample values
+    (behavioral equivalent of src/io/bin.cpp:150 GreedyFindBin).
+
+    Returns upper bin boundaries; the last boundary is +inf.
+    """
+    num_distinct = len(distinct_values)
+    bin_upper: List[float] = []
+    if num_distinct == 0:
+        return [np.inf]
+    if num_distinct <= max_bin:
+        # one bin per distinct value, merging forward until min_data_in_bin
+        cur_cnt = 0
+        for i in range(num_distinct - 1):
+            cur_cnt += int(counts[i])
+            if cur_cnt >= min_data_in_bin:
+                bin_upper.append((float(distinct_values[i]) + float(distinct_values[i + 1])) / 2.0)
+                cur_cnt = 0
+        bin_upper.append(np.inf)
+        return bin_upper
+
+    # more distinct values than bins: greedy packing with "big" value handling
+    if min_data_in_bin > 0:
+        max_bin = min(max_bin, max(1, total_cnt // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+    is_big = counts >= mean_bin_size
+    rest_cnt = int(total_cnt - counts[is_big].sum())
+    rest_bins = int(max_bin - is_big.sum())
+    if rest_bins > 0:
+        mean_bin_size = rest_cnt / rest_bins
+
+    uppers: List[float] = []
+    lowers: List[float] = [float(distinct_values[0])]
+    cur_cnt = 0
+    for i in range(num_distinct - 1):
+        if not is_big[i]:
+            rest_cnt -= int(counts[i])
+        cur_cnt += int(counts[i])
+        # close the bin at a big value, before a big value, or when full
+        if is_big[i] or cur_cnt >= mean_bin_size or \
+           (is_big[i + 1] and cur_cnt >= max(1.0, mean_bin_size * 0.5)):
+            uppers.append(float(distinct_values[i]))
+            lowers.append(float(distinct_values[i + 1]))
+            cur_cnt = 0
+            if not is_big[i]:
+                rest_bins -= 1
+                if rest_bins > 0:
+                    mean_bin_size = rest_cnt / rest_bins
+            if len(uppers) >= max_bin - 1:
+                break
+    # convert (upper[i], lower[i+1]) pairs to midpoint boundaries
+    bin_upper = [(uppers[i] + lowers[i + 1]) / 2.0 for i in range(len(uppers))]
+    bin_upper.append(np.inf)
+    return bin_upper
+
+
+@dataclass
+class BinMapper:
+    """Per-feature value->bin quantizer (reference bin.h:61)."""
+
+    num_bin: int = 1
+    is_categorical: bool = False
+    missing_type: MissingType = MissingType.NONE
+    # numerical: ascending upper boundaries, len == num_bin (minus NaN bin)
+    bin_upper_bound: Optional[np.ndarray] = None
+    # categorical: category id (int) -> bin
+    cat_to_bin: Dict[int, int] = field(default_factory=dict)
+    bin_to_cat: Optional[np.ndarray] = None
+    default_bin: int = 0          # bin containing value 0.0 (bin.h GetDefaultBin)
+    most_freq_bin: int = 0
+    min_value: float = 0.0
+    max_value: float = 0.0
+
+    @property
+    def is_trivial(self) -> bool:
+        """True when the feature carries no split information (num_bin <= 1)."""
+        return self.num_bin <= 1
+
+    # -- quantization --------------------------------------------------------
+    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized value -> bin (reference bin.h:464 ValueToBin)."""
+        values = np.asarray(values, dtype=np.float64)
+        if self.is_categorical:
+            out = np.zeros(values.shape, dtype=np.int32)
+            nan_mask = ~np.isfinite(values)
+            ivals = np.where(nan_mask, -1, np.nan_to_num(values, nan=-1)).astype(np.int64)
+            # vectorized dict lookup through a dense table when ids are small
+            if self.bin_to_cat is not None and len(self.cat_to_bin):
+                max_cat = max(self.cat_to_bin)
+                table = np.zeros(max_cat + 2, dtype=np.int32)  # unseen -> bin 0
+                for cat, b in self.cat_to_bin.items():
+                    table[cat] = b
+                ivals = np.clip(ivals, -1, max_cat)
+                out = np.where(ivals < 0, 0, table[np.clip(ivals, 0, max_cat)])
+            return out.astype(np.int32)
+
+        nan_mask = np.isnan(values)
+        if self.missing_type == MissingType.ZERO:
+            values = np.where(nan_mask, 0.0, values)
+        elif self.missing_type != MissingType.NAN:
+            values = np.where(nan_mask, 0.0, values)
+        bins = np.searchsorted(self.bin_upper_bound, values, side="left").astype(np.int32)
+        nbins = len(self.bin_upper_bound)
+        bins = np.minimum(bins, nbins - 1)
+        if self.missing_type == MissingType.NAN:
+            bins = np.where(nan_mask, self.num_bin - 1, bins)
+        return bins
+
+    def bin_to_value(self, b: int) -> float:
+        """Representative threshold value for a bin upper edge (used when
+        serializing split thresholds as raw doubles, reference
+        bin.h BinToValue)."""
+        if self.is_categorical:
+            return float(self.bin_to_cat[b]) if self.bin_to_cat is not None else float(b)
+        ub = self.bin_upper_bound
+        if b >= len(ub):
+            b = len(ub) - 1
+        v = ub[b]
+        if not np.isfinite(v):
+            v = self.max_value + 1.0
+        return float(v)
+
+
+def find_bin(sample_values: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
+             *, total_cnt: Optional[int] = None, is_categorical: bool = False,
+             use_missing: bool = True, zero_as_missing: bool = False) -> BinMapper:
+    """Construct a BinMapper from a sample of one feature's values
+    (reference src/io/bin.cpp BinMapper::FindBin).
+
+    ``sample_values`` may contain NaN.  ``total_cnt`` is the full dataset row
+    count when the sample is a subsample (affects zero-count accounting).
+    """
+    sample_values = np.asarray(sample_values, dtype=np.float64).ravel()
+    n_sample = len(sample_values)
+    if total_cnt is None:
+        total_cnt = n_sample
+    na_cnt = int(np.isnan(sample_values).sum())
+    finite = sample_values[~np.isnan(sample_values)]
+
+    if is_categorical:
+        return _find_bin_categorical(finite, max_bin, na_cnt, use_missing)
+
+    if zero_as_missing:
+        missing_type = MissingType.ZERO
+    elif use_missing and na_cnt > 0:
+        missing_type = MissingType.NAN
+    else:
+        missing_type = MissingType.NONE
+        # without use_missing NaNs are folded into zero (bin.cpp FindBin)
+
+    zero_cnt = int(((finite > -ZERO_THRESHOLD) & (finite < ZERO_THRESHOLD)).sum())
+    # rows absent from a feature's sample are zeros in the reference's sparse
+    # sample representation; here the sample is dense so only count sample zeros
+    neg = finite[finite <= -ZERO_THRESHOLD]
+    pos = finite[finite >= ZERO_THRESHOLD]
+
+    boundaries: List[float] = []
+    n_non_missing = len(neg) + len(pos) + zero_cnt
+    if n_non_missing == 0:
+        boundaries = [np.inf]
+    else:
+        # distribute bins proportionally around the dedicated zero bin
+        # (bin.cpp FindBinWithZeroAsOneBin)
+        budget = max_bin - 1 if missing_type == MissingType.NAN else max_bin
+        budget = max(budget, 2)
+        left_budget = int(round(budget * len(neg) / max(1, n_non_missing)))
+        left_budget = min(max(left_budget, 1 if len(neg) else 0), budget - 1)
+        right_budget = budget - left_budget - 1  # -1 for the zero bin
+        if len(pos) == 0:
+            right_budget = 0
+        left_b: List[float] = []
+        right_b: List[float] = []
+        if len(neg):
+            dv, cnt = np.unique(neg, return_counts=True)
+            left_b = _greedy_find_bin(dv, cnt, left_budget, len(neg), min_data_in_bin)
+            left_b = [b for b in left_b if b < -ZERO_THRESHOLD]
+            left_b.append(-ZERO_THRESHOLD)
+        if len(pos):
+            dv, cnt = np.unique(pos, return_counts=True)
+            right_b = _greedy_find_bin(dv, cnt, max(right_budget, 1), len(pos),
+                                       min_data_in_bin)
+        boundaries = sorted(set(left_b)) + [ZERO_THRESHOLD] + sorted(
+            b for b in right_b if b > ZERO_THRESHOLD)
+        if not np.isinf(boundaries[-1]):
+            boundaries.append(np.inf)
+        # drop the zero boundary if there is nothing on one side and no zeros
+        if zero_cnt == 0 and (len(neg) == 0 or len(pos) == 0):
+            boundaries = [b for b in boundaries
+                          if not (-ZERO_THRESHOLD <= b <= ZERO_THRESHOLD)] or [np.inf]
+
+    ub = np.asarray(sorted(set(boundaries)), dtype=np.float64)
+    num_bin = len(ub)
+    if missing_type == MissingType.NAN:
+        num_bin += 1  # trailing NaN bin
+
+    mapper = BinMapper(
+        num_bin=num_bin,
+        is_categorical=False,
+        missing_type=missing_type,
+        bin_upper_bound=ub,
+        min_value=float(finite.min()) if len(finite) else 0.0,
+        max_value=float(finite.max()) if len(finite) else 0.0,
+    )
+    mapper.default_bin = int(np.searchsorted(ub, 0.0, side="left"))
+    if len(finite):
+        binned = mapper.value_to_bin(sample_values)
+        mapper.most_freq_bin = int(np.bincount(binned, minlength=num_bin).argmax())
+    return mapper
+
+
+def _find_bin_categorical(finite: np.ndarray, max_bin: int, na_cnt: int,
+                          use_missing: bool) -> BinMapper:
+    ivals = finite.astype(np.int64)
+    if len(ivals) and ivals.min() < 0:
+        raise ValueError("categorical features must be non-negative integers")
+    cats, counts = (np.unique(ivals, return_counts=True) if len(ivals)
+                    else (np.array([], np.int64), np.array([], np.int64)))
+    order = np.argsort(-counts, kind="stable")
+    cats, counts = cats[order], counts[order]
+    # keep categories covering 99% of samples, capped at max_bin
+    # (reference bin.cpp categorical FindBin: cut_cnt = 99%)
+    total = counts.sum()
+    if len(cats) > max_bin - 1:
+        keep = max_bin - 1
+    else:
+        keep = len(cats)
+    if total > 0 and keep < len(cats):
+        pass  # cap dominates
+    elif total > 0:
+        cum = np.cumsum(counts)
+        keep = int(np.searchsorted(cum, 0.99 * total) + 1)
+        keep = min(keep, len(cats))
+    cats = cats[:keep]
+    cat_to_bin = {int(c): i for i, c in enumerate(cats)}
+    num_bin = max(len(cats), 1)
+    # NaN categoricals map to the most frequent category (bin 0) at both
+    # train and inference (tree.py stores default_left = (split category ==
+    # most frequent) on cat nodes), so no NaN bin is allocated and
+    # missing_type stays NONE — mirrors reference CategoricalDecision
+    # semantics for missing values.
+    mapper = BinMapper(
+        num_bin=num_bin,
+        is_categorical=True,
+        missing_type=MissingType.NONE,
+        cat_to_bin=cat_to_bin,
+        bin_to_cat=cats.copy(),
+        most_freq_bin=0,
+    )
+    return mapper
+
+
+def bin_matrix(X: np.ndarray, mappers: Sequence[BinMapper]) -> np.ndarray:
+    """Quantize a raw (N, F) float matrix into bin codes using per-feature
+    mappers.  Returns uint8 when every feature fits in 256 bins else uint16."""
+    n, f = X.shape
+    assert f == len(mappers)
+    max_bins = max(m.num_bin for m in mappers)
+    dtype = np.uint8 if max_bins <= 256 else np.uint16
+    out = np.empty((n, f), dtype=dtype)
+    for j, m in enumerate(mappers):
+        out[:, j] = m.value_to_bin(X[:, j]).astype(dtype)
+    return out

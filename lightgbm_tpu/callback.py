@@ -1,0 +1,145 @@
+"""Training callbacks (reference python-package/lightgbm/callback.py:
+``print_evaluation``:52, ``record_evaluation``:78, ``reset_parameter``:106,
+``early_stopping``:147-242 raising EarlyStopException)."""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List
+
+from .utils.log import log_info, log_warning
+
+__all__ = ["EarlyStopException", "CallbackEnv", "print_evaluation",
+           "log_evaluation", "record_evaluation", "reset_parameter",
+           "early_stopping"]
+
+
+class EarlyStopException(Exception):
+    def __init__(self, best_iteration: int, best_score) -> None:
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+def _fmt_eval(res) -> str:
+    data_name, eval_name, value, _ = res
+    return f"{data_name}'s {eval_name}: {value:g}"
+
+
+def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list and \
+                (env.iteration + 1) % period == 0:
+            result = "\t".join(_fmt_eval(x) for x in env.evaluation_result_list)
+            log_info(f"[{env.iteration + 1}]\t{result}")
+    _callback.order = 10
+    return _callback
+
+
+log_evaluation = print_evaluation
+
+
+def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callable:
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result must be a dict")
+    eval_result.clear()
+
+    def _callback(env: CallbackEnv) -> None:
+        for data_name, eval_name, value, _ in env.evaluation_result_list:
+            eval_result.setdefault(data_name, collections.OrderedDict())
+            eval_result[data_name].setdefault(eval_name, [])
+            eval_result[data_name][eval_name].append(value)
+    _callback.order = 20
+    return _callback
+
+
+def reset_parameter(**kwargs: Any) -> Callable:
+    """Reset parameters on a schedule: value is a list (per iteration) or a
+    function iteration -> value (reference callback.py:106)."""
+
+    def _callback(env: CallbackEnv) -> None:
+        new_params = {}
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(f"list length of {key} must match "
+                                     "num_boost_round")
+                new_params[key] = value[env.iteration - env.begin_iteration]
+            elif callable(value):
+                new_params[key] = value(env.iteration - env.begin_iteration)
+            else:
+                raise ValueError("reset_parameter values must be list or callable")
+        if new_params:
+            env.model.reset_parameter(new_params)
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True) -> Callable:
+    """Early stopping on validation metrics (reference callback.py:147)."""
+    best_score: List[float] = []
+    best_iter: List[int] = []
+    best_score_list: List[Any] = []
+    cmp_op: List[Callable] = []
+    enabled = [True]
+    first_metric = [""]
+
+    def _init(env: CallbackEnv) -> None:
+        enabled[0] = bool(env.evaluation_result_list)
+        if not enabled[0]:
+            log_warning("Early stopping is not available in dart mode"
+                        if env.params.get("boosting") == "dart"
+                        else "For early stopping, at least one dataset and "
+                             "eval metric is required for evaluation")
+            return
+        if verbose:
+            log_info(f"Training until validation scores don't improve for "
+                     f"{stopping_rounds} rounds")
+        first_metric[0] = env.evaluation_result_list[0][1].split(" ")[-1]
+        for res in env.evaluation_result_list:
+            best_iter.append(0)
+            best_score_list.append(None)
+            if res[3]:  # higher is better
+                best_score.append(float("-inf"))
+                cmp_op.append(lambda x, y: x > y)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(lambda x, y: x < y)
+
+    def _callback(env: CallbackEnv) -> None:
+        if not best_score:
+            _init(env)
+        if not enabled[0]:
+            return
+        for i, res in enumerate(env.evaluation_result_list):
+            data_name, eval_name, score, _ = res
+            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
+                best_score[i] = score
+                best_iter[i] = env.iteration
+                best_score_list[i] = env.evaluation_result_list
+            if data_name == "training":
+                continue  # training metric never triggers stopping
+            if first_metric_only and eval_name.split(" ")[-1] != first_metric[0]:
+                continue
+            if env.iteration - best_iter[i] >= stopping_rounds:
+                if verbose:
+                    log_info(f"Early stopping, best iteration is:\n"
+                             f"[{best_iter[i] + 1}]\t" + "\t".join(
+                                 _fmt_eval(x) for x in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if env.iteration == env.end_iteration - 1:
+                if verbose:
+                    log_info(f"Did not meet early stopping. Best iteration is:"
+                             f"\n[{best_iter[i] + 1}]\t" + "\t".join(
+                                 _fmt_eval(x) for x in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+    _callback.order = 30
+    return _callback

@@ -1,0 +1,383 @@
+"""Dataset: binned feature matrix + metadata, resident on device.
+
+TPU-native re-implementation of the reference data layer
+(reference: include/LightGBM/dataset.h:282 ``Dataset``, dataset.h:41
+``Metadata``, src/io/dataset_loader.cpp ``DatasetLoader``).
+
+Key departures from the reference, driven by TPU/XLA:
+
+* The reference stores per-feature-group ``Bin`` objects with dense/sparse/
+  4-bit/multi-value layouts chosen per feature (src/io/dense_bin.hpp,
+  sparse_bin.hpp).  On TPU the working set is ONE dense uint8/uint16 array of
+  shape (rows, features) — static shape, MXU/VPU friendly, shardable over a
+  mesh along the row axis (data parallel) or feature axis (feature parallel).
+* Bin construction runs host-side on a row sample (numpy), mirroring
+  ``DatasetLoader::ConstructBinMappersFromTextData``; the binned matrix is
+  then device_put once.
+* Validation datasets are aligned to the training dataset's bin mappers
+  (reference dataset.h:304 alignment check / create_valid).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .binning import BinMapper, bin_matrix, find_bin
+from .config import Config
+from .utils.log import log_info, log_warning
+
+__all__ = ["Dataset", "Metadata"]
+
+_ArrayLike = Union[np.ndarray, Sequence[float], "Any"]
+
+
+class Metadata:
+    """Labels / weights / query boundaries / init scores
+    (reference dataset.h:41, src/io/metadata.cpp)."""
+
+    def __init__(self) -> None:
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.group: Optional[np.ndarray] = None            # sizes per query
+        self.query_boundaries: Optional[np.ndarray] = None  # cumulative offsets
+        self.init_score: Optional[np.ndarray] = None
+        self.position: Optional[np.ndarray] = None
+
+    def set_label(self, label: _ArrayLike) -> None:
+        self.label = np.asarray(label, dtype=np.float32).ravel()
+
+    def set_weight(self, weight: Optional[_ArrayLike]) -> None:
+        if weight is None:
+            self.weight = None
+        else:
+            w = np.asarray(weight, dtype=np.float32).ravel()
+            if (w < 0).any():
+                raise ValueError("weights must be non-negative")
+            self.weight = w
+
+    def set_group(self, group: Optional[_ArrayLike]) -> None:
+        if group is None:
+            self.group = None
+            self.query_boundaries = None
+            return
+        g = np.asarray(group, dtype=np.int64).ravel()
+        self.group = g
+        self.query_boundaries = np.concatenate([[0], np.cumsum(g)]).astype(np.int64)
+
+    def set_init_score(self, init_score: Optional[_ArrayLike]) -> None:
+        if init_score is None:
+            self.init_score = None
+        else:
+            self.init_score = np.asarray(init_score, dtype=np.float64).ravel()
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.group is None else len(self.group)
+
+
+class Dataset:
+    """User-facing dataset, lazily constructed (reference python-package
+    basic.py ``Dataset`` + C++ ``Dataset``/``DatasetLoader``).
+
+    Parameters mirror the reference Python API.  ``data`` may be a numpy
+    array, a pandas DataFrame, or a path to a CSV/TSV/LibSVM file.
+    """
+
+    def __init__(self, data: Any, label: Optional[_ArrayLike] = None,
+                 reference: Optional["Dataset"] = None,
+                 weight: Optional[_ArrayLike] = None,
+                 group: Optional[_ArrayLike] = None,
+                 init_score: Optional[_ArrayLike] = None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List[Union[int, str]]] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True) -> None:
+        self.data = data
+        self.params = dict(params or {})
+        self.reference = reference
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.free_raw_data = free_raw_data
+        self.metadata = Metadata()
+        self._label_arg = label
+        self._weight_arg = weight
+        self._group_arg = group
+        self._init_score_arg = init_score
+        # populated by construct()
+        self.constructed = False
+        self.bin_mappers: List[BinMapper] = []
+        self.X_binned: Optional[np.ndarray] = None   # (N, F) uint8/uint16, host copy
+        self.num_bins_per_feature: Optional[np.ndarray] = None
+        self.used_feature_map: Optional[np.ndarray] = None  # inner -> real index
+        self.num_total_features = 0
+        self._device_cache: Dict[Any, Any] = {}
+
+    # -- construction --------------------------------------------------------
+    def construct(self, config: Optional[Config] = None) -> "Dataset":
+        if self.constructed:
+            return self
+        cfg = config or Config(self.params)
+        raw, feature_names = self._materialize_raw()
+        n, f = raw.shape
+        self.num_total_features = f
+        self.feature_names_ = feature_names
+
+        cat_indices = self._resolve_categoricals(feature_names)
+
+        if self.reference is not None:
+            ref = self.reference
+            if not ref.constructed:
+                ref.construct(config)
+            # align bins with the reference dataset (dataset.h:304)
+            self.bin_mappers = ref.bin_mappers
+            self.used_feature_map = ref.used_feature_map
+            self.num_bins_per_feature = ref.num_bins_per_feature
+        else:
+            # sample rows for bin finding (dataset_loader.cpp:902
+            # SampleTextDataFromFile — here rows are already in memory)
+            sample_cnt = min(n, int(cfg.bin_construct_sample_cnt))
+            if sample_cnt < n:
+                rng = np.random.RandomState(cfg.data_random_seed)
+                sample_idx = rng.choice(n, size=sample_cnt, replace=False)
+                sample = raw[np.sort(sample_idx)]
+            else:
+                sample = raw
+            self.bin_mappers = []
+            for j in range(f):
+                self.bin_mappers.append(find_bin(
+                    sample[:, j], max_bin=cfg.max_bin,
+                    min_data_in_bin=cfg.min_data_in_bin,
+                    total_cnt=n,
+                    is_categorical=(j in cat_indices),
+                    use_missing=cfg.use_missing,
+                    zero_as_missing=cfg.zero_as_missing))
+            # pre-filter trivial features (config.h feature_pre_filter)
+            used = [j for j, m in enumerate(self.bin_mappers) if not m.is_trivial]
+            if len(used) == 0:
+                raise ValueError("cannot construct Dataset: all features are trivial "
+                                 "(constant); nothing to split on")
+            if len(used) < f:
+                log_info(f"Dataset: filtered {f - len(used)} trivial features, "
+                         f"{len(used)} remain")
+            self.used_feature_map = np.asarray(used, dtype=np.int32)
+            self.num_bins_per_feature = np.asarray(
+                [self.bin_mappers[j].num_bin for j in used], dtype=np.int32)
+
+        used = self.used_feature_map
+        mappers = [self.bin_mappers[j] for j in used]
+        self.X_binned = bin_matrix(raw[:, used], mappers)
+        self._set_metadata(n)
+        self.constructed = True
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def _materialize_raw(self):
+        data = self.data
+        if data is None:
+            raise ValueError("Dataset raw data was freed; pass free_raw_data=False "
+                             "to reuse it")
+        if isinstance(data, str):
+            from .io_utils import load_data_file
+            raw, names, label = load_data_file(data, self.params)
+            if label is not None and self._label_arg is None:
+                self._label_arg = label
+            return raw, names
+        try:  # pandas without a hard dependency
+            import pandas as pd  # type: ignore
+            if isinstance(data, pd.DataFrame):
+                names = [str(c) for c in data.columns]
+                raw = data.to_numpy(dtype=np.float64, na_value=np.nan)
+                return raw, names
+        except ImportError:
+            pass
+        if hasattr(data, "tocsr"):  # scipy sparse
+            raw = np.asarray(data.todense(), dtype=np.float64)
+        else:
+            raw = np.asarray(data, dtype=np.float64)
+        if raw.ndim == 1:
+            raw = raw.reshape(-1, 1)
+        if self.feature_name != "auto" and self.feature_name is not None:
+            names = list(self.feature_name)
+        else:
+            names = [f"Column_{i}" for i in range(raw.shape[1])]
+        return raw, names
+
+    def _resolve_categoricals(self, feature_names: List[str]) -> set:
+        cats = self.categorical_feature
+        if cats == "auto" or cats is None:
+            from_params = self.params.get("categorical_feature", "")
+            if isinstance(from_params, str) and from_params:
+                cats = from_params.split(",")
+            else:
+                return set()
+        out = set()
+        for c in cats:
+            if isinstance(c, str) and c in feature_names:
+                out.add(feature_names.index(c))
+            elif isinstance(c, str) and c.strip().isdigit():
+                out.add(int(c))
+            elif isinstance(c, (int, np.integer)):
+                out.add(int(c))
+        return out
+
+    def _set_metadata(self, n: int) -> None:
+        if self._label_arg is not None:
+            self.metadata.set_label(self._label_arg)
+            if len(self.metadata.label) != n:
+                raise ValueError(f"label length {len(self.metadata.label)} != rows {n}")
+        self.metadata.set_weight(self._weight_arg)
+        self.metadata.set_group(self._group_arg)
+        self.metadata.set_init_score(self._init_score_arg)
+
+    # -- reference-API surface ----------------------------------------------
+    def create_valid(self, data: Any, label: Optional[_ArrayLike] = None,
+                     weight: Optional[_ArrayLike] = None,
+                     group: Optional[_ArrayLike] = None,
+                     init_score: Optional[_ArrayLike] = None,
+                     params: Optional[Dict[str, Any]] = None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params)
+
+    def set_label(self, label: _ArrayLike) -> "Dataset":
+        self._label_arg = label
+        if self.constructed:
+            self.metadata.set_label(label)
+        return self
+
+    def set_weight(self, weight: Optional[_ArrayLike]) -> "Dataset":
+        self._weight_arg = weight
+        if self.constructed:
+            self.metadata.set_weight(weight)
+        return self
+
+    def set_group(self, group: Optional[_ArrayLike]) -> "Dataset":
+        self._group_arg = group
+        if self.constructed:
+            self.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score: Optional[_ArrayLike]) -> "Dataset":
+        self._init_score_arg = init_score
+        if self.constructed:
+            self.metadata.set_init_score(init_score)
+        return self
+
+    def get_label(self) -> Optional[np.ndarray]:
+        return self.metadata.label if self.constructed else (
+            None if self._label_arg is None else np.asarray(self._label_arg))
+
+    def get_weight(self) -> Optional[np.ndarray]:
+        return self.metadata.weight
+
+    def get_group(self) -> Optional[np.ndarray]:
+        return self.metadata.group
+
+    def get_init_score(self) -> Optional[np.ndarray]:
+        return self.metadata.init_score
+
+    def num_data(self) -> int:
+        self._check_constructed()
+        return int(self.X_binned.shape[0])
+
+    def num_feature(self) -> int:
+        self._check_constructed()
+        return int(self.X_binned.shape[1])
+
+    @property
+    def feature_names(self) -> List[str]:
+        self._check_constructed()
+        return [self.feature_names_[j] for j in self.used_feature_map]
+
+    def subset(self, used_indices: Sequence[int],
+               params: Optional[Dict[str, Any]] = None) -> "Dataset":
+        """Row subset sharing this dataset's bin mappers (reference
+        Dataset::CopySubrow, used by cv/bagging)."""
+        self._check_constructed()
+        idx = np.asarray(used_indices, dtype=np.int64)
+        sub = copy.copy(self)
+        sub._device_cache = {}
+        sub.X_binned = self.X_binned[idx]
+        sub.metadata = Metadata()
+        if self.metadata.label is not None:
+            sub.metadata.set_label(self.metadata.label[idx])
+        if self.metadata.weight is not None:
+            sub.metadata.set_weight(self.metadata.weight[idx])
+        if self.metadata.init_score is not None:
+            sub.metadata.set_init_score(self.metadata.init_score[idx])
+        if self.metadata.group is not None:
+            # remap query boundaries: the subset must consist of whole
+            # queries (reference Metadata partition re-indexing,
+            # src/io/metadata.cpp:37)
+            qb = self.metadata.query_boundaries
+            qid = np.searchsorted(qb, idx, side="right") - 1
+            sel_q, counts = np.unique(qid, return_counts=True)
+            if not np.array_equal(counts, self.metadata.group[sel_q]):
+                raise ValueError("subset() of ranking data must select whole "
+                                 "queries (use query-aware folds)")
+            if not np.all(np.diff(idx) > 0):
+                raise ValueError("subset() of ranking data requires sorted, "
+                                 "query-contiguous indices")
+            sub.metadata.set_group(self.metadata.group[sel_q])
+        return sub
+
+    # -- binary serialization (reference Dataset::SaveBinaryFile /
+    #    DatasetLoader::LoadFromBinFile) -------------------------------------
+    def save_binary(self, filename: str) -> "Dataset":
+        self._check_constructed()
+        import pickle
+        payload = {
+            "format": "lightgbm_tpu.dataset.v1",
+            "X_binned": self.X_binned,
+            "bin_mappers": self.bin_mappers,
+            "used_feature_map": self.used_feature_map,
+            "num_bins_per_feature": self.num_bins_per_feature,
+            "feature_names": self.feature_names_,
+            "label": self.metadata.label,
+            "weight": self.metadata.weight,
+            "group": self.metadata.group,
+            "init_score": self.metadata.init_score,
+        }
+        with open(filename, "wb") as fh:
+            pickle.dump(payload, fh, protocol=4)
+        return self
+
+    @staticmethod
+    def load_binary(filename: str, params: Optional[Dict[str, Any]] = None) -> "Dataset":
+        import pickle
+        with open(filename, "rb") as fh:
+            payload = pickle.load(fh)
+        if payload.get("format") != "lightgbm_tpu.dataset.v1":
+            raise ValueError(f"{filename} is not a lightgbm_tpu binary dataset")
+        ds = Dataset(None, params=params)
+        ds.X_binned = payload["X_binned"]
+        ds.bin_mappers = payload["bin_mappers"]
+        ds.used_feature_map = payload["used_feature_map"]
+        ds.num_bins_per_feature = payload["num_bins_per_feature"]
+        ds.feature_names_ = payload["feature_names"]
+        ds.num_total_features = len(ds.feature_names_)
+        if payload["label"] is not None:
+            ds.metadata.set_label(payload["label"])
+        ds.metadata.set_weight(payload["weight"])
+        ds.metadata.set_group(payload["group"])
+        ds.metadata.set_init_score(payload["init_score"])
+        ds.constructed = True
+        return ds
+
+    def _check_constructed(self) -> None:
+        if not self.constructed:
+            raise RuntimeError("Dataset not constructed yet; call construct() "
+                               "(done automatically by train())")
+
+    # -- device placement ----------------------------------------------------
+    def device_bins(self, max_bin_global: int):
+        """Return the binned matrix as a device array (cached)."""
+        import jax.numpy as jnp
+        key = ("bins", max_bin_global)
+        if key not in self._device_cache:
+            self._device_cache[key] = jnp.asarray(self.X_binned)
+        return self._device_cache[key]

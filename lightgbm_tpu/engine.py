@@ -1,0 +1,237 @@
+"""Training entry points: train() and cv()
+(reference: python-package/lightgbm/engine.py ``train``:15, ``cv``:391,
+``CVBooster``:277)."""
+
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .basic import Booster
+from .callback import (CallbackEnv, EarlyStopException, early_stopping,
+                       print_evaluation)
+from .config import Config
+from .dataset import Dataset
+from .utils.log import log_info, log_warning
+
+__all__ = ["train", "cv", "CVBooster"]
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj: Optional[Callable] = None,
+          feval: Optional[Callable] = None,
+          init_model: Optional[Union[str, Booster]] = None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None,
+          **kwargs) -> Booster:
+    """Train a boosted model (reference engine.py:15)."""
+    params = dict(params or {})
+    params.update(kwargs)
+    cfg = Config(params)
+    if "num_iterations" in Config(params).to_dict() and \
+            any(k in params for k in ("num_iterations", "num_iteration",
+                                      "n_iter", "num_boost_round", "num_round",
+                                      "num_rounds", "num_trees", "num_tree",
+                                      "n_estimators")):
+        num_boost_round = cfg.num_iterations
+    if fobj is not None:
+        params["objective"] = "none"
+
+    if init_model is not None:
+        raise NotImplementedError("continued training (init_model) lands with "
+                                  "the refit milestone")
+
+    booster = Booster(params=params, train_set=train_set)
+    if valid_sets is not None:
+        if not isinstance(valid_sets, (list, tuple)):
+            valid_sets = [valid_sets]
+        for i, vs in enumerate(valid_sets):
+            if vs is train_set:
+                booster._gbdt.config = booster.config.update(
+                    {"is_provide_training_metric": True})
+                # re-init training metrics
+                from .metric import create_metrics
+                booster._gbdt.train_metrics = create_metrics(booster._gbdt.config)
+                for m in booster._gbdt.train_metrics:
+                    m.init(train_set.metadata, train_set.num_data())
+                continue
+            name = (valid_names[i] if valid_names is not None and
+                    i < len(valid_names) else f"valid_{i}")
+            booster.add_valid(vs, name)
+
+    callbacks = list(callbacks or [])
+    cfg2 = booster.config
+    if cfg2.verbosity >= 1 and cfg2.metric_freq > 0:
+        callbacks.append(print_evaluation(cfg2.metric_freq))
+    if cfg2.early_stopping_round and cfg2.early_stopping_round > 0:
+        callbacks.append(early_stopping(cfg2.early_stopping_round,
+                                        cfg2.first_metric_only,
+                                        verbose=cfg2.verbosity >= 1))
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                      if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    for it in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(CallbackEnv(booster, params, it, 0, num_boost_round, None))
+        booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if booster._gbdt.train_metrics or booster._gbdt.valid_sets or feval:
+            evaluation_result_list = booster.eval_train(feval) + \
+                booster.eval_valid(feval)
+        try:
+            for cb in callbacks_after:
+                cb(CallbackEnv(booster, params, it, 0, num_boost_round,
+                               evaluation_result_list))
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for ds_name, eval_name, score, _ in e.best_score:
+                booster.best_score.setdefault(ds_name, {})[eval_name] = score
+            break
+    return booster
+
+
+class CVBooster:
+    """Container of per-fold boosters (reference engine.py:277)."""
+
+    def __init__(self) -> None:
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler
+
+
+def _make_n_folds(full_data: Dataset, nfold: int, params: Dict[str, Any],
+                  seed: int, stratified: bool, shuffle: bool):
+    full_data.construct(Config(params))
+    num_data = full_data.num_data()
+    rng = np.random.RandomState(seed)
+    label = full_data.get_label()
+    group = full_data.get_group()
+    if group is not None:
+        # query-aware folds for ranking (reference engine.py group_info path)
+        qb = full_data.metadata.query_boundaries
+        nq = len(group)
+        q_order = rng.permutation(nq) if shuffle else np.arange(nq)
+        q_fold = np.empty(nq, np.int32)
+        q_fold[q_order] = np.arange(nq) % nfold
+        for k in range(nfold):
+            test_idx = np.concatenate([np.arange(qb[q], qb[q + 1])
+                                       for q in range(nq) if q_fold[q] == k])
+            train_idx = np.concatenate([np.arange(qb[q], qb[q + 1])
+                                        for q in range(nq) if q_fold[q] != k])
+            yield np.sort(train_idx), np.sort(test_idx)
+        return
+    if stratified and label is not None:
+        # stratified fold assignment by label bucket
+        order = np.argsort(label, kind="stable")
+        folds_assign = np.empty(num_data, np.int32)
+        folds_assign[order] = np.arange(num_data) % nfold
+        if shuffle:
+            perm = rng.permutation(nfold)
+            folds_assign = perm[folds_assign]
+    else:
+        idx = rng.permutation(num_data) if shuffle else np.arange(num_data)
+        folds_assign = np.empty(num_data, np.int32)
+        folds_assign[idx] = np.arange(num_data) % nfold
+    for k in range(nfold):
+        test_idx = np.nonzero(folds_assign == k)[0]
+        train_idx = np.nonzero(folds_assign != k)[0]
+        yield train_idx, test_idx
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       fpreproc=None, seed: int = 0, callbacks=None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False, **kwargs) -> Dict[str, List[float]]:
+    """Cross-validation (reference engine.py:391)."""
+    params = dict(params or {})
+    params.update(kwargs)
+    if metrics is not None:
+        params["metric"] = metrics
+    cfg = Config(params)
+    if cfg.objective in ("lambdarank", "rank_xendcg"):
+        stratified = False
+
+    train_set.construct(cfg)
+    if folds is None:
+        folds = list(_make_n_folds(train_set, nfold, params, seed, stratified
+                                   and cfg.objective in ("binary", "multiclass",
+                                                         "multiclassova"),
+                                   shuffle))
+    results = collections.defaultdict(list)
+    cvbooster = CVBooster()
+    fold_data = []
+    for train_idx, test_idx in folds:
+        tr = train_set.subset(train_idx)
+        te = train_set.subset(test_idx)
+        if fpreproc is not None:
+            tr, te, fold_params = fpreproc(tr, te, copy.deepcopy(params))
+        else:
+            fold_params = params
+        bst = Booster(params=fold_params, train_set=tr)
+        bst.add_valid(te, "valid")
+        fold_data.append(bst)
+        cvbooster.append(bst)
+
+    es_round = cfg.early_stopping_round
+    best_iter = num_boost_round
+    stopped = False
+    best_scores = collections.defaultdict(lambda: float("inf"))
+    rounds_no_improve = 0
+    for it in range(num_boost_round):
+        agg = collections.defaultdict(list)
+        hib_map = {}
+        for bst in fold_data:
+            bst.update(fobj=fobj)
+            for ds, name, val, hib in bst.eval_valid(feval):
+                agg[f"{ds} {name}"].append(val)
+                hib_map[f"{ds} {name}"] = hib
+            if eval_train_metric:
+                for ds, name, val, hib in bst.eval_train(feval):
+                    agg[f"train {name}"].append(val)
+        improved = False
+        for key, vals in agg.items():
+            results[f"{key}-mean"].append(float(np.mean(vals)))
+            results[f"{key}-stdv"].append(float(np.std(vals)))
+            hib = hib_map.get(key, False)
+            cur = float(np.mean(vals))
+            signed = -cur if hib else cur
+            if signed < best_scores[key]:
+                best_scores[key] = signed
+                improved = True
+        if es_round and es_round > 0:
+            if improved:
+                rounds_no_improve = 0
+                best_iter = it + 1
+            else:
+                rounds_no_improve += 1
+                if rounds_no_improve >= es_round:
+                    stopped = True
+                    break
+    out = dict(results)
+    if stopped:
+        for k in out:
+            out[k] = out[k][:best_iter]
+        cvbooster.best_iteration = best_iter
+    if return_cvbooster:
+        out["cvbooster"] = cvbooster
+    return out

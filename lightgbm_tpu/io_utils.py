@@ -1,0 +1,92 @@
+"""Text data parsers: CSV / TSV / LibSVM with format auto-detection
+(reference: src/io/parser.cpp:235 ``Parser::CreateParser`` + parser.hpp
+CSVParser/TSVParser/LibSVMParser; label column handling per config
+label_column)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _detect_format(line: str) -> str:
+    if ":" in line.split()[1] if len(line.split()) > 1 else False:
+        return "libsvm"
+    if "\t" in line:
+        return "tsv"
+    if "," in line:
+        return "csv"
+    if ":" in line:
+        return "libsvm"
+    return "tsv"
+
+
+def load_data_file(path: str, params: Optional[Dict[str, Any]] = None
+                   ) -> Tuple[np.ndarray, List[str], Optional[np.ndarray]]:
+    """Load a CSV/TSV/LibSVM file -> (features, names, label).
+
+    Follows the reference CLI convention: first column is the label unless
+    ``label_column`` says otherwise; ``header=true`` skips/uses a header row.
+    """
+    params = params or {}
+    header = str(params.get("header", "false")).lower() in ("true", "1")
+    label_col = 0
+    lc = str(params.get("label_column", "") or params.get("label", ""))
+    if lc.startswith("column_") or lc.isdigit():
+        label_col = int(lc.replace("column_", "") or 0)
+
+    with open(path) as fh:
+        first = fh.readline()
+        if not first.strip():
+            raise ValueError(f"{path} is empty")
+    fmt = _detect_format(first.strip())
+
+    if fmt == "libsvm":
+        return _load_libsvm(path)
+
+    delim = "," if fmt == "csv" else "\t"
+    skip = 1 if header else 0
+    raw = np.genfromtxt(path, delimiter=delim, skip_header=skip,
+                        dtype=np.float64)
+    if raw.ndim == 1:
+        raw = raw.reshape(-1, 1)
+    names: List[str] = []
+    if header:
+        with open(path) as fh:
+            names = [c.strip() for c in fh.readline().strip().split(delim)]
+    label = raw[:, label_col].copy()
+    feats = np.delete(raw, label_col, axis=1)
+    if names:
+        names = names[:label_col] + names[label_col + 1:]
+    else:
+        names = [f"Column_{i}" for i in range(feats.shape[1])]
+    return feats, names, label
+
+
+def _load_libsvm(path: str) -> Tuple[np.ndarray, List[str], np.ndarray]:
+    labels: List[float] = []
+    rows: List[Dict[int, float]] = []
+    max_idx = -1
+    with open(path) as fh:
+        for line in fh:
+            parts = line.strip().split()
+            if not parts:
+                continue
+            labels.append(float(parts[0]))
+            row = {}
+            for tok in parts[1:]:
+                if ":" not in tok:
+                    continue
+                idx, val = tok.split(":", 1)
+                j = int(idx)
+                row[j] = float(val)
+                max_idx = max(max_idx, j)
+            rows.append(row)
+    n, f = len(rows), max_idx + 1
+    out = np.zeros((n, f), np.float64)
+    for i, row in enumerate(rows):
+        for j, v in row.items():
+            out[i, j] = v
+    names = [f"Column_{i}" for i in range(f)]
+    return out, names, np.asarray(labels)

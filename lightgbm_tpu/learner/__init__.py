@@ -1,0 +1,1 @@
+from .serial import SerialTreeLearner, GrownTree, make_grow_fn

@@ -1,0 +1,390 @@
+"""Leaf-wise tree grower + serial (single-device) learner.
+
+TPU-native re-implementation of the reference SerialTreeLearner
+(reference: src/treelearner/serial_tree_learner.cpp:158 ``Train`` — best-first
+growth to num_leaves with per-leaf histograms, the histogram subtraction trick
+at :311-320, split finding at :374, partition update at :564).
+
+Design (SURVEY.md §7): the whole tree grows inside ONE jitted function with a
+``lax.fori_loop`` over the num_leaves-1 splits — no host round-trips per
+split.  Static shapes throughout:
+
+* leaf membership is a per-row ``row_leaf`` int32 vector (replaces the
+  reference's DataPartition index shuffling, data_partition.hpp:170) — the
+  partition update after a split is a masked ``where``;
+* per-leaf histograms live in a (num_leaves, F, B, 3) pool when it fits the
+  memory budget, enabling the parent-minus-sibling subtraction trick; with
+  many features the learner switches to recompute mode (two masked passes per
+  split, no pool) — the analog of the reference's bounded HistogramPool
+  (feature_histogram.hpp:1095);
+* split finding is the vectorized bin scan in ops/split.py;
+* the best-leaf argmax replaces serial_tree_learner.cpp:194's ArgMax over
+  best_split_per_leaf_.
+
+After a split, the left child keeps the parent's leaf id and the right child
+takes the next fresh id (matching the reference Tree::Split leaf numbering).
+
+The grower is parameterized by a **communication strategy** — the TPU analog
+of the reference templating its parallel learners over the device learner
+(parallel_tree_learner.h:54 ``DataParallelTreeLearner<TREELEARNER_T>``):
+the serial strategy is all-identity; data-/feature-/voting-parallel
+strategies (lightgbm_tpu/parallel/) insert ``jax.lax`` collectives at the
+same points the reference calls its Network layer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..ops.histogram import build_histogram
+from ..ops.split import (NEG_INF, FeatureSplits, SplitParams,
+                         best_split_per_feature, leaf_output)
+from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
+
+__all__ = ["SerialTreeLearner", "GrownTree", "make_grow_fn", "CommStrategy",
+           "local_best_candidate"]
+
+
+class GrownTree(NamedTuple):
+    """Device-side result of growing one tree."""
+    split_feature: jnp.ndarray     # (L-1,) int32 (global feature indices)
+    threshold_bin: jnp.ndarray     # (L-1,) int32
+    nan_bin: jnp.ndarray           # (L-1,) int32
+    decision_type: jnp.ndarray     # (L-1,) int32
+    left_child: jnp.ndarray        # (L-1,) int32
+    right_child: jnp.ndarray       # (L-1,) int32
+    split_gain: jnp.ndarray        # (L-1,) float32
+    internal_value: jnp.ndarray    # (L-1,) float32
+    internal_weight: jnp.ndarray   # (L-1,) float32
+    internal_count: jnp.ndarray    # (L-1,) float32
+    leaf_value: jnp.ndarray        # (L,) float32
+    leaf_weight: jnp.ndarray       # (L,) float32
+    leaf_count: jnp.ndarray        # (L,) float32
+    num_leaves: jnp.ndarray        # () int32 — actual leaves grown
+    row_leaf: jnp.ndarray          # (N,) int32 — final leaf of every row
+
+
+def local_best_candidate(hist, leaf_sum, num_bins, is_cat, has_nan,
+                         feature_mask, params) -> Tuple[jnp.ndarray, ...]:
+    """Best split over (local) features for one leaf -> scalar candidate
+    tuple (gain, feat, bin, default_left, left_sum, right_sum)."""
+    fs: FeatureSplits = best_split_per_feature(hist, leaf_sum, num_bins,
+                                               is_cat, has_nan, params)
+    gain = jnp.where(feature_mask, fs.gain, NEG_INF)
+    f = jnp.argmax(gain)
+    return (gain[f], f.astype(jnp.int32), fs.threshold_bin[f],
+            fs.default_left[f], fs.left_sum[f], fs.right_sum[f])
+
+
+class CommStrategy:
+    """Serial (no-comm) strategy; parallel learners override the hooks.
+
+    Hook contract inside the jitted grower:
+      * ``reduce_sum(v)`` — reduce per-shard scalars/vectors over row shards
+        (root grad/hess/count sums; DP/voting: ``psum``).
+      * ``leaf_candidates(hist_local, leaf_sum, feature_mask, params)`` —
+        best split for one leaf from the (possibly shard-local) histogram;
+        must return a candidate with a GLOBAL feature index, identical on
+        every device.
+      * ``get_column(X_local, global_feat)`` — fetch the winning feature's
+        bin column for the partition update (FP: owner broadcast).
+      * ``local_meta(...)`` — slice per-feature descriptors to this shard's
+        histogram width.
+    """
+
+    def __init__(self, num_bins, is_cat, has_nan):
+        self.num_bins_full = num_bins
+        self.is_cat_full = is_cat
+        self.has_nan_full = has_nan
+
+    def reduce_sum(self, v):
+        return v
+
+    def local_meta(self, feature_mask):
+        return (self.num_bins_full, self.is_cat_full, self.has_nan_full,
+                feature_mask)
+
+    def leaf_candidates(self, hist, leaf_sum, feature_mask, params):
+        nb, ic, hn, fm = self.local_meta(feature_mask)
+        return local_best_candidate(hist, leaf_sum, nb, ic, hn, fm, params)
+
+    def get_column(self, X, feat):
+        return jnp.take(X, feat, axis=1).astype(jnp.int32)
+
+
+def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
+                 split_params: SplitParams, hist_impl: str,
+                 rows_per_chunk: int, use_hist_pool: bool,
+                 strategy: Optional[CommStrategy] = None, jit: bool = True):
+    """Build the single-tree grower for a fixed configuration.
+
+    The returned function signature is
+    ``grow(X, grad, hess, sample_mask, num_bins, is_cat, has_nan,
+    feature_mask) -> GrownTree`` where X may be the full binned matrix
+    (serial), a row shard (data/voting parallel) or a feature shard
+    (feature parallel) depending on the strategy.
+    """
+
+    hist_kwargs = dict(num_bins=max_bins, impl=hist_impl,
+                       rows_per_chunk=rows_per_chunk)
+    L = num_leaves
+
+    def grow(X: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+             sample_mask: jnp.ndarray, num_bins: jnp.ndarray,
+             is_cat: jnp.ndarray, has_nan: jnp.ndarray,
+             feature_mask: jnp.ndarray) -> GrownTree:
+        strat = strategy if strategy is not None else CommStrategy(
+            num_bins, is_cat, has_nan)
+        n, f_local = X.shape
+
+        root_hist = build_histogram(X, grad, hess, sample_mask, **hist_kwargs)
+        root_sum = strat.reduce_sum(jnp.stack([
+            jnp.sum(grad * sample_mask),
+            jnp.sum(hess * sample_mask),
+            jnp.sum(sample_mask)]))
+
+        cand = strat.leaf_candidates(root_hist, root_sum, feature_mask,
+                                     split_params)
+
+        state = {
+            "row_leaf": jnp.zeros((n,), jnp.int32),
+            "leaf_sum": jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum),
+            "leaf_depth": jnp.zeros((L,), jnp.int32),
+            "leaf_parent": jnp.full((L,), -1, jnp.int32),
+            "cand_gain": jnp.full((L,), NEG_INF, jnp.float32).at[0].set(cand[0]),
+            "cand_feat": jnp.zeros((L,), jnp.int32).at[0].set(cand[1]),
+            "cand_bin": jnp.zeros((L,), jnp.int32).at[0].set(cand[2]),
+            "cand_dleft": jnp.zeros((L,), jnp.bool_).at[0].set(cand[3]),
+            "cand_lsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[4]),
+            "cand_rsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[5]),
+            "split_feature": jnp.full((L - 1,), -1, jnp.int32),
+            "threshold_bin": jnp.zeros((L - 1,), jnp.int32),
+            "nan_bin": jnp.full((L - 1,), -1, jnp.int32),
+            "decision_type": jnp.zeros((L - 1,), jnp.int32),
+            "left_child": jnp.zeros((L - 1,), jnp.int32),
+            "right_child": jnp.zeros((L - 1,), jnp.int32),
+            "split_gain": jnp.zeros((L - 1,), jnp.float32),
+            "internal_value": jnp.zeros((L - 1,), jnp.float32),
+            "internal_weight": jnp.zeros((L - 1,), jnp.float32),
+            "internal_count": jnp.zeros((L - 1,), jnp.float32),
+            "leaf_value": jnp.zeros((L,), jnp.float32).at[0].set(
+                leaf_output(root_sum[0], root_sum[1], split_params)),
+            "leaf_weight": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[1]),
+            "leaf_count": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[2]),
+            "num_leaves": jnp.asarray(1, jnp.int32),
+            "done": jnp.asarray(False),
+        }
+        if use_hist_pool:
+            state["hists"] = jnp.zeros((L, f_local, max_bins, 3),
+                                       jnp.float32).at[0].set(root_hist)
+
+        nb_full = strat.num_bins_full
+        ic_full = strat.is_cat_full
+        hn_full = strat.has_nan_full
+
+        def body(t, s):
+            best_leaf = jnp.argmax(s["cand_gain"]).astype(jnp.int32)
+            bgain = s["cand_gain"][best_leaf]
+            do = jnp.logical_and(jnp.logical_not(s["done"]), bgain > 0)
+            dof = do.astype(jnp.float32)
+
+            feat = s["cand_feat"][best_leaf]          # GLOBAL feature index
+            thr = s["cand_bin"][best_leaf]
+            dleft = s["cand_dleft"][best_leaf]
+            lsum = s["cand_lsum"][best_leaf]
+            rsum = s["cand_rsum"][best_leaf]
+            psum_ = s["leaf_sum"][best_leaf]
+            new_id = (t + 1).astype(jnp.int32)
+
+            # ---- partition update (DataPartition::Split analog) ----
+            col = strat.get_column(X, feat)
+            fcat = ic_full[feat]
+            fnan = hn_full[feat]
+            f_nan_bin = jnp.where(fnan, nb_full[feat] - 1, -1)
+            in_leaf = s["row_leaf"] == best_leaf
+            is_nanbin = col == f_nan_bin
+            go_left = jnp.where(fcat, col == thr,
+                                jnp.where(is_nanbin, dleft, col <= thr))
+            row_leaf = jnp.where(do & in_leaf & jnp.logical_not(go_left),
+                                 new_id, s["row_leaf"])
+
+            # ---- children histograms (shard-local; reduction happens in
+            #      the candidate hook) ----
+            left_smaller = lsum[2] <= rsum[2]
+            if use_hist_pool:
+                # one masked pass for the smaller child + subtraction
+                # (serial_tree_learner.cpp:311-320)
+                small_id = jnp.where(left_smaller, best_leaf, new_id)
+                small_mask = (row_leaf == small_id).astype(jnp.float32) * \
+                    sample_mask * dof
+                hist_small = build_histogram(X, grad, hess, small_mask,
+                                             **hist_kwargs)
+                parent_hist = s["hists"][best_leaf]
+                hist_big = parent_hist - hist_small
+                hist_left = jnp.where(left_smaller, hist_small, hist_big)
+                hist_right = jnp.where(left_smaller, hist_big, hist_small)
+            else:
+                left_mask = (row_leaf == best_leaf).astype(jnp.float32) * \
+                    sample_mask * dof
+                right_mask = (row_leaf == new_id).astype(jnp.float32) * \
+                    sample_mask * dof
+                hist_left = build_histogram(X, grad, hess, left_mask,
+                                            **hist_kwargs)
+                hist_right = build_histogram(X, grad, hess, right_mask,
+                                             **hist_kwargs)
+
+            # ---- children candidates ----
+            child_depth = s["leaf_depth"][best_leaf] + 1
+            depth_ok = jnp.logical_or(max_depth <= 0, child_depth < max_depth)
+            cl = strat.leaf_candidates(hist_left, lsum, feature_mask,
+                                       split_params)
+            cr = strat.leaf_candidates(hist_right, rsum, feature_mask,
+                                       split_params)
+            gl = jnp.where(depth_ok, cl[0], NEG_INF)
+            gr = jnp.where(depth_ok, cr[0], NEG_INF)
+
+            # ---- tree arrays for node t ----
+            node = t
+            # categorical NaN rows live in bin 0 (most frequent category);
+            # record default_left so raw-feature inference routes NaN the
+            # same way the binned training partition did
+            dleft = jnp.where(fcat, thr == 0, dleft)
+            dt_bits = (jnp.where(fcat, CAT_MASK, 0) |
+                       jnp.where(dleft, DEFAULT_LEFT_MASK, 0) |
+                       jnp.where(fnan & jnp.logical_not(fcat), MISSING_NAN, 0)
+                       ).astype(jnp.int32)
+            parent_node = s["leaf_parent"][best_leaf]
+            enc_best = -(best_leaf + 1)    # ~best_leaf
+            node_idx = jnp.arange(L - 1, dtype=jnp.int32)
+            patch_l = (node_idx == parent_node) & (s["left_child"] == enc_best) & do
+            patch_r = (node_idx == parent_node) & (s["right_child"] == enc_best) & do
+            left_child = jnp.where(patch_l, node, s["left_child"])
+            right_child = jnp.where(patch_r, node, s["right_child"])
+
+            def upd(arr, idx, val):
+                return arr.at[idx].set(jnp.where(do, val, arr[idx]))
+
+            out = dict(s)
+            out["row_leaf"] = row_leaf
+            if use_hist_pool:
+                hists = s["hists"]
+                hists = hists.at[best_leaf].set(
+                    jnp.where(do, hist_left, hists[best_leaf]))
+                hists = hists.at[new_id].set(
+                    jnp.where(do, hist_right, hists[new_id]))
+                out["hists"] = hists
+            out["leaf_sum"] = upd(upd(s["leaf_sum"], best_leaf, lsum),
+                                  new_id, rsum)
+            out["leaf_depth"] = upd(upd(s["leaf_depth"], best_leaf, child_depth),
+                                    new_id, child_depth)
+            out["leaf_parent"] = upd(upd(s["leaf_parent"], best_leaf, node),
+                                     new_id, node)
+            out["cand_gain"] = upd(upd(s["cand_gain"], best_leaf, gl), new_id, gr)
+            out["cand_feat"] = upd(upd(s["cand_feat"], best_leaf, cl[1]), new_id, cr[1])
+            out["cand_bin"] = upd(upd(s["cand_bin"], best_leaf, cl[2]), new_id, cr[2])
+            out["cand_dleft"] = upd(upd(s["cand_dleft"], best_leaf, cl[3]),
+                                    new_id, cr[3])
+            out["cand_lsum"] = upd(upd(s["cand_lsum"], best_leaf, cl[4]), new_id, cr[4])
+            out["cand_rsum"] = upd(upd(s["cand_rsum"], best_leaf, cl[5]), new_id, cr[5])
+            out["split_feature"] = upd(s["split_feature"], node, feat)
+            out["threshold_bin"] = upd(s["threshold_bin"], node, thr)
+            out["nan_bin"] = upd(s["nan_bin"], node, f_nan_bin)
+            out["decision_type"] = upd(s["decision_type"], node, dt_bits)
+            out["left_child"] = upd(left_child, node, enc_best)
+            out["right_child"] = upd(right_child, node, -(new_id + 1))
+            out["split_gain"] = upd(s["split_gain"], node, bgain)
+            out["internal_value"] = upd(s["internal_value"], node,
+                                        leaf_output(psum_[0], psum_[1],
+                                                    split_params))
+            out["internal_weight"] = upd(s["internal_weight"], node, psum_[1])
+            out["internal_count"] = upd(s["internal_count"], node, psum_[2])
+            lv = upd(s["leaf_value"], best_leaf,
+                     leaf_output(lsum[0], lsum[1], split_params))
+            out["leaf_value"] = upd(lv, new_id,
+                                    leaf_output(rsum[0], rsum[1], split_params))
+            lw = upd(s["leaf_weight"], best_leaf, lsum[1])
+            out["leaf_weight"] = upd(lw, new_id, rsum[1])
+            lc = upd(s["leaf_count"], best_leaf, lsum[2])
+            out["leaf_count"] = upd(lc, new_id, rsum[2])
+            out["num_leaves"] = s["num_leaves"] + do.astype(jnp.int32)
+            out["done"] = jnp.logical_not(do)
+            return out
+
+        s = jax.lax.fori_loop(0, L - 1, body, state)
+        return GrownTree(
+            split_feature=s["split_feature"], threshold_bin=s["threshold_bin"],
+            nan_bin=s["nan_bin"], decision_type=s["decision_type"],
+            left_child=s["left_child"], right_child=s["right_child"],
+            split_gain=s["split_gain"], internal_value=s["internal_value"],
+            internal_weight=s["internal_weight"],
+            internal_count=s["internal_count"], leaf_value=s["leaf_value"],
+            leaf_weight=s["leaf_weight"], leaf_count=s["leaf_count"],
+            num_leaves=s["num_leaves"], row_leaf=s["row_leaf"])
+
+    return jax.jit(grow) if jit else grow
+
+
+def resolve_hist_impl(config: Config) -> str:
+    impl = config.tpu_histogram_impl
+    if impl == "auto":
+        impl = "onehot" if jax.default_backend() == "tpu" else "segment"
+    return impl
+
+
+def split_params_from_config(config: Config) -> SplitParams:
+    return SplitParams(
+        lambda_l1=float(config.lambda_l1),
+        lambda_l2=float(config.lambda_l2),
+        min_data_in_leaf=int(config.min_data_in_leaf),
+        min_sum_hessian_in_leaf=float(config.min_sum_hessian_in_leaf),
+        min_gain_to_split=float(config.min_gain_to_split),
+        max_delta_step=float(config.max_delta_step),
+        cat_l2=float(config.cat_l2),
+        cat_smooth=float(config.cat_smooth),
+        path_smooth=float(config.path_smooth))
+
+
+def hist_pool_fits(config: Config, num_features: int, max_bins: int) -> bool:
+    """Keep per-leaf histograms when they fit the budget (reference
+    histogram_pool_size, default -1 = a 1 GiB cap here to stay inside HBM
+    alongside the data)."""
+    pool_bytes = config.num_leaves * num_features * max_bins * 3 * 4
+    budget = (float(config.histogram_pool_size) * (1 << 20)
+              if config.histogram_pool_size > 0 else (1 << 30))
+    return pool_bytes <= budget
+
+
+class SerialTreeLearner:
+    """Host-side wrapper: owns the jitted grower and the dataset's static
+    feature descriptors (reference tree_learner.h:27 ``TreeLearner``)."""
+
+    def __init__(self, config: Config, num_features: int, max_bins: int,
+                 num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray):
+        self.config = config
+        self.max_bins = int(max_bins)
+        self.num_bins = jnp.asarray(num_bins, jnp.int32)
+        self.is_cat = jnp.asarray(is_cat, jnp.bool_)
+        self.has_nan = jnp.asarray(has_nan, jnp.bool_)
+        self.num_features = num_features
+        self.split_params = split_params_from_config(config)
+        self.use_hist_pool = hist_pool_fits(config, num_features, self.max_bins)
+        self._grow = make_grow_fn(
+            num_leaves=int(config.num_leaves), max_bins=self.max_bins,
+            max_depth=int(config.max_depth), split_params=self.split_params,
+            hist_impl=resolve_hist_impl(config),
+            rows_per_chunk=int(config.tpu_rows_per_chunk),
+            use_hist_pool=self.use_hist_pool)
+
+    def train(self, X_dev: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+              sample_mask: jnp.ndarray,
+              feature_mask: Optional[jnp.ndarray] = None) -> GrownTree:
+        if feature_mask is None:
+            feature_mask = jnp.ones((self.num_features,), jnp.bool_)
+        return self._grow(X_dev, grad, hess, sample_mask, self.num_bins,
+                          self.is_cat, self.has_nan, feature_mask)

@@ -1,0 +1,1 @@
+from .tree import Tree, TreeBatch, predict_binned, predict_raw

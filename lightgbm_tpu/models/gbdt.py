@@ -1,0 +1,492 @@
+"""GBDT boosting driver.
+
+TPU-native re-implementation of the reference boosting layer
+(reference: src/boosting/gbdt.cpp — ``Train`` loop at :264, ``TrainOneIter``
+at :369, bagging at :228, ``BoostFromAverage`` at :344 with the init score
+folded into the first tree via AddBias at :414-427, score updates via
+ScoreUpdater at :491, metric output at :517).
+
+The boosting loop is host-driven; everything per-iteration — gradients,
+sampling, tree growth, score update — runs as jitted device computations on
+device-resident arrays.  Host<->device traffic per iteration is only the
+handful of tree description arrays (O(num_leaves)) pulled back to record the
+model, plus metric scalars when evaluation runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset
+from ..learner.serial import GrownTree, SerialTreeLearner
+from ..metric import Metric, create_metrics
+from ..objective import ObjectiveFunction, create_objective
+from ..utils.log import log_info, log_warning
+from ..utils.random import host_rng
+from ..utils.timer import FunctionTimer
+from .tree import Tree, TreeBatch, predict_raw
+from ..ops.split import SplitParams, leaf_output as _leaf_output_fn
+
+EPSILON = 1e-12
+
+
+def _grown_to_tree(grown: GrownTree, shrinkage: float, dataset: Dataset,
+                   leaf_value_override: Optional[np.ndarray] = None) -> Tree:
+    """Pull one grown tree to host, attach raw-value thresholds."""
+    num_leaves = int(grown.num_leaves)
+    split_feature = np.asarray(grown.split_feature)
+    threshold_bin = np.asarray(grown.threshold_bin)
+    mappers = [dataset.bin_mappers[j] for j in dataset.used_feature_map]
+    thresh = np.zeros(len(split_feature), dtype=np.float64)
+    for i in range(num_leaves - 1):
+        f = int(split_feature[i])
+        if f >= 0:
+            thresh[i] = mappers[f].bin_to_value(int(threshold_bin[i]))
+    tree = Tree(
+        num_leaves=max(num_leaves, 1),
+        split_feature=split_feature.astype(np.int32),
+        threshold_bin=threshold_bin.astype(np.int32),
+        nan_bin=np.asarray(grown.nan_bin, dtype=np.int32),
+        threshold=thresh,
+        decision_type=np.asarray(grown.decision_type).astype(np.uint8),
+        left_child=np.asarray(grown.left_child).astype(np.int32),
+        right_child=np.asarray(grown.right_child).astype(np.int32),
+        split_gain=np.asarray(grown.split_gain),
+        internal_value=np.asarray(grown.internal_value, dtype=np.float64),
+        internal_weight=np.asarray(grown.internal_weight, dtype=np.float64),
+        internal_count=np.asarray(grown.internal_count).astype(np.int64),
+        leaf_value=(np.asarray(grown.leaf_value, dtype=np.float64)
+                    if leaf_value_override is None
+                    else np.asarray(leaf_value_override, dtype=np.float64)),
+        leaf_weight=np.asarray(grown.leaf_weight, dtype=np.float64),
+        leaf_count=np.asarray(grown.leaf_count).astype(np.int64),
+    )
+    if shrinkage != 1.0:
+        tree.shrink(shrinkage)
+    return tree
+
+
+@jax.jit
+def _update_score_by_leaf(score, row_leaf, leaf_value, shrinkage):
+    """score += shrinkage * leaf_value[row_leaf] — training-set score update
+    using the grower's final leaf assignment (replaces the reference's
+    ScoreUpdater::AddScore tree walk for train data, score_updater.hpp:54)."""
+    return score + shrinkage * leaf_value[row_leaf]
+
+
+from .tree import _walk_binned  # tree walk for validation-set score updates
+
+
+class GBDT:
+    """Boosting driver (reference include/LightGBM/boosting.h:27 ``Boosting``
+    interface + src/boosting/gbdt.h:540 ``GBDT``)."""
+
+    name = "gbdt"
+
+    def __init__(self, config: Config, train_set: Optional[Dataset],
+                 objective: Optional[ObjectiveFunction] = None) -> None:
+        self.config = config
+        self.models: List[Tree] = []
+        self.train_set: Optional[Dataset] = None
+        self.valid_sets: List[Tuple[str, Dataset]] = []
+        self.valid_scores: List[jnp.ndarray] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self.train_metrics: List[Metric] = []
+        self.objective = objective
+        self.iter_ = 0
+        self.init_scores: Optional[np.ndarray] = None
+        self.best_iteration = -1
+        if train_set is not None:
+            self._init_train(train_set)
+
+    # -- setup ---------------------------------------------------------------
+    def _init_train(self, train_set: Dataset) -> None:
+        cfg = self.config
+        train_set.construct(cfg)
+        self.train_set = train_set
+        self.num_data = train_set.num_data()
+        self.num_features = train_set.num_feature()
+        mappers = [train_set.bin_mappers[j] for j in train_set.used_feature_map]
+        from ..binning import MissingType
+        self.max_bins = int(max(m.num_bin for m in mappers))
+        num_bins = np.array([m.num_bin for m in mappers], np.int32)
+        is_cat = np.array([m.is_categorical for m in mappers], bool)
+        has_nan = np.array([m.missing_type == MissingType.NAN for m in mappers],
+                           bool)
+        self.learner = self._create_learner(num_bins, is_cat, has_nan)
+        self.X_dev = jnp.asarray(train_set.X_binned)
+
+        if self.objective is None and cfg.objective != "none":
+            self.objective = create_objective(cfg.objective, cfg)
+        if self.objective is not None:
+            self.objective.init(train_set.metadata, self.num_data)
+        self.num_tree_per_iteration = (
+            self.objective.num_model_per_iteration if self.objective else
+            max(1, cfg.num_class if cfg.num_class > 1 else 1))
+        k = self.num_tree_per_iteration
+        shape = (self.num_data,) if k == 1 else (self.num_data, k)
+
+        # initial scores: user init_score > boost_from_average > zero
+        self._pending_bias = np.zeros(k)
+        score0 = np.zeros(shape, np.float32)
+        md = train_set.metadata
+        if md.init_score is not None:
+            init = md.init_score.reshape(shape)
+            score0 = score0 + init.astype(np.float32)
+        elif cfg.boost_from_average and self.objective is not None:
+            for cid in range(k):
+                s = self.objective.boost_from_score(cid)
+                self._pending_bias[cid] = s
+                if abs(s) > EPSILON:
+                    log_info(f"Start training from score {s:.6f}")
+            if k == 1:
+                score0 = score0 + np.float32(self._pending_bias[0])
+            else:
+                score0 = score0 + self._pending_bias[None, :].astype(np.float32)
+        self.score = jnp.asarray(score0)
+
+        self.train_metrics = []
+        if cfg.is_provide_training_metric:
+            self.train_metrics = create_metrics(cfg)
+            for m in self.train_metrics:
+                m.init(md, self.num_data)
+
+    def _create_learner(self, num_bins, is_cat, has_nan):
+        cfg = self.config
+        if cfg.tree_learner == "serial" or cfg.num_machines <= 1 and \
+                cfg.tree_learner not in ("data", "feature", "voting"):
+            return SerialTreeLearner(cfg, self.num_features, self.max_bins,
+                                     num_bins, is_cat, has_nan)
+        from ..parallel import create_parallel_learner
+        return create_parallel_learner(cfg, self.num_features, self.max_bins,
+                                       num_bins, is_cat, has_nan)
+
+    def add_valid(self, valid_set: Dataset, name: str) -> None:
+        valid_set.construct(self.config)
+        if valid_set.num_feature() != self.num_features:
+            raise ValueError("validation set feature count differs from train")
+        k = self.num_tree_per_iteration
+        n = valid_set.num_data()
+        shape = (n,) if k == 1 else (n, k)
+        score0 = np.zeros(shape, np.float32)
+        if valid_set.metadata.init_score is not None:
+            score0 = score0 + valid_set.metadata.init_score.reshape(shape).astype(
+                np.float32)
+        elif self.config.boost_from_average and self.objective is not None:
+            score0 = score0 + (np.float32(self._pending_bias[0]) if k == 1 else
+                               self._pending_bias[None, :].astype(np.float32))
+        metrics = create_metrics(self.config)
+        for m in metrics:
+            m.init(valid_set.metadata, n)
+        self.valid_sets.append((name, valid_set))
+        self.valid_scores.append(jnp.asarray(score0))
+        self.valid_metrics.append(metrics)
+        valid_set._device_cache["bins"] = jnp.asarray(valid_set.X_binned)
+
+    # -- sampling (bagging / GOSS hooks) -------------------------------------
+    def _prepare_iter_sampling(self, grad: jnp.ndarray, hess: jnp.ndarray
+                               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Per-iteration row sampling: returns (grad, hess, mask).  Base GBDT
+        implements bagging (gbdt.cpp:228 Bagging, resampled every
+        bagging_freq iters); GOSS/RF override."""
+        cfg = self.config
+        n = self.num_data
+        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+            # resample every bagging_freq iterations with a deterministic
+            # per-block seed (reference bagging_seed + iteration)
+            block = self.iter_ // cfg.bagging_freq
+            rng = host_rng(cfg.bagging_seed, block)
+            k = int(n * cfg.bagging_fraction)
+            idx = rng.choice(n, size=k, replace=False)
+            mask = np.zeros(n, np.float32)
+            mask[idx] = 1.0
+            self._bag_mask = jnp.asarray(mask)
+        elif not hasattr(self, "_bag_mask") or self._bag_mask.shape[0] != n:
+            self._bag_mask = jnp.ones(n, jnp.float32)
+        return grad, hess, self._bag_mask
+
+    def _feature_mask(self) -> Optional[jnp.ndarray]:
+        cfg = self.config
+        if cfg.feature_fraction >= 1.0:
+            return None
+        rng = host_rng(cfg.feature_fraction_seed, self.iter_)
+        k = max(1, int(np.ceil(self.num_features * cfg.feature_fraction)))
+        idx = rng.choice(self.num_features, size=k, replace=False)
+        mask = np.zeros(self.num_features, bool)
+        mask[idx] = True
+        return jnp.asarray(mask)
+
+    # -- one boosting iteration (gbdt.cpp:369 TrainOneIter) ------------------
+    def train_one_iter(self, grad: Optional[jnp.ndarray] = None,
+                       hess: Optional[jnp.ndarray] = None) -> bool:
+        cfg = self.config
+        k = self.num_tree_per_iteration
+        with FunctionTimer("GBDT::train_one_iter"):
+            if grad is None or hess is None:
+                if self.objective is None:
+                    raise ValueError("no objective: pass gradients explicitly "
+                                     "(custom objective path, boosting.h:85)")
+                grad, hess = self.objective.get_gradients(self.score)
+            else:
+                grad = jnp.asarray(grad, jnp.float32).reshape(
+                    (self.num_data,) if k == 1 else (self.num_data, k))
+                hess = jnp.asarray(hess, jnp.float32).reshape(grad.shape)
+
+            finished = True
+            fmask = self._feature_mask()
+            grad, hess, mask = self._prepare_iter_sampling(grad, hess)
+            self._last_sample_mask = mask
+            for cid in range(k):
+                g = grad if k == 1 else grad[:, cid]
+                h = hess if k == 1 else hess[:, cid]
+                grown = self.learner.train(self.X_dev, g, h, mask,
+                                           feature_mask=fmask)
+                tree = self._record_tree(grown, cid)
+                if tree.num_leaves > 1:
+                    finished = False
+            self.iter_ += 1
+            if finished:
+                log_warning("Stopped training because there are no more leaves "
+                            "that meet the split requirements")
+            return finished
+
+    def _current_shrinkage(self) -> float:
+        """Per-iteration shrinkage; DART overrides with lr/(1+k_dropped)."""
+        return float(self.config.learning_rate)
+
+    def _renew_leaf_values(self, grown: GrownTree,
+                           class_id: int) -> Optional[np.ndarray]:
+        """Percentile leaf refit for L1/quantile/MAPE (reference
+        serial_tree_learner.cpp:684 RenewTreeOutput +
+        regression_objective.hpp RenewTreeOutput): each leaf's value becomes
+        the weighted alpha-percentile of the residuals of its (in-bag)
+        rows."""
+        obj = self.objective
+        if obj is None or not getattr(obj, "is_renew_tree_output", False):
+            return None
+        from ..objective.base import weighted_percentile
+        alpha = float(getattr(obj, "renew_alpha", 0.5))
+        row_leaf = np.asarray(grown.row_leaf)
+        score = np.asarray(self.score if self.num_tree_per_iteration == 1
+                           else self.score[:, class_id])
+        label = np.asarray(self.train_set.metadata.label)
+        resid = label - score
+        w = getattr(obj, "label_weight", None)  # MAPE folds weights here
+        if w is not None:
+            w = np.asarray(w)
+        elif self.train_set.metadata.weight is not None:
+            w = np.asarray(self.train_set.metadata.weight)
+        mask = np.asarray(self._last_sample_mask) > 0 \
+            if getattr(self, "_last_sample_mask", None) is not None else \
+            np.ones(len(label), bool)
+        out = np.asarray(grown.leaf_value, np.float64).copy()
+        for leaf in range(int(grown.num_leaves)):
+            sel = (row_leaf == leaf) & mask
+            if sel.any():
+                out[leaf] = weighted_percentile(
+                    resid[sel], None if w is None else w[sel], alpha)
+        return out
+
+    def _record_tree(self, grown: GrownTree, class_id: int) -> Tree:
+        cfg = self.config
+        shrinkage = self._current_shrinkage()
+        renewed = self._renew_leaf_values(grown, class_id)
+        tree = _grown_to_tree(grown, shrinkage, self.train_set,
+                              leaf_value_override=renewed)
+        # fold init score into the first iteration's trees (gbdt.cpp:414-427)
+        bias = self._pending_bias[class_id] if self.iter_ == 0 else 0.0
+        if abs(bias) > EPSILON:
+            tree.add_bias(bias)
+        self.models.append(tree)
+
+        # update train scores from the grower's leaf assignment
+        lv = (grown.leaf_value if renewed is None
+              else jnp.asarray(renewed, jnp.float32)) * shrinkage
+        if self.num_tree_per_iteration == 1:
+            self.score = _update_score_by_leaf(self.score, grown.row_leaf, lv, 1.0)
+        else:
+            col = _update_score_by_leaf(self.score[:, class_id], grown.row_leaf,
+                                        lv, 1.0)
+            self.score = self.score.at[:, class_id].set(col)
+        # update validation scores with a tree walk on their binned matrices
+        for vi, (_, vset) in enumerate(self.valid_sets):
+            vbins = vset._device_cache["bins"]
+            delta = _walk_binned(vbins, grown.split_feature, grown.threshold_bin,
+                                 grown.nan_bin, grown.decision_type,
+                                 grown.left_child, grown.right_child,
+                                 lv, grown.num_leaves)
+            if self.num_tree_per_iteration == 1:
+                self.valid_scores[vi] = self.valid_scores[vi] + delta
+            else:
+                self.valid_scores[vi] = self.valid_scores[vi].at[:, class_id].add(delta)
+        return tree
+
+    # -- evaluation (gbdt.cpp:472 EvalAndCheckEarlyStopping) -----------------
+    def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        if not self.train_metrics:
+            return out
+        score = np.asarray(self.score)
+        for m in self.train_metrics:
+            for name, val, hib in m.eval(score):
+                out.append(("training", name, val, hib))
+        return out
+
+    def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        for vi, (vname, _) in enumerate(self.valid_sets):
+            score = np.asarray(self.valid_scores[vi])
+            for m in self.valid_metrics[vi]:
+                for name, val, hib in m.eval(score):
+                    out.append((vname, name, val, hib))
+        return out
+
+    # -- prediction ----------------------------------------------------------
+    def _tree_batch(self, start: int = 0, num_iteration: Optional[int] = None
+                    ) -> Optional[TreeBatch]:
+        if not self.models:
+            return None
+        k = self.num_tree_per_iteration
+        end = len(self.models) if num_iteration is None else min(
+            len(self.models), (start + num_iteration) * k)
+        trees = self.models[start * k:end]
+        return TreeBatch(trees) if trees else None
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                start_iteration: int = 0,
+                num_iteration: Optional[int] = None,
+                pred_leaf: bool = False, pred_contrib: bool = False) -> np.ndarray:
+        X = np.asarray(X, np.float32)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        # map raw columns to inner (used) features
+        used = self.train_set.used_feature_map if self.train_set is not None \
+            else np.arange(X.shape[1])
+        Xi = X[:, used]
+        k = self.num_tree_per_iteration
+        if pred_leaf:
+            return self._predict_leaf(Xi, start_iteration, num_iteration)
+        if pred_contrib:
+            from .shap import predict_contrib
+            return predict_contrib(self, Xi)
+        batch = self._tree_batch()
+        if batch is None:
+            n_iter_trees = 0
+            raw = np.zeros((X.shape[0], k), np.float32)
+        else:
+            t0 = start_iteration * k
+            t1 = batch.num_trees if num_iteration is None else min(
+                batch.num_trees, (start_iteration + num_iteration) * k)
+            Xd = jnp.asarray(Xi)
+            if k == 1:
+                raw = np.asarray(predict_raw(batch, Xd, t0, t1 - t0))[:, None]
+            else:
+                # class c's trees are at indices i*k + c
+                cols = []
+                for c in range(k):
+                    sel = [t for t in range(t0, t1) if t % k == c]
+                    sub = TreeBatch([self.models[t] for t in sel]) if sel else None
+                    cols.append(np.asarray(predict_raw(sub, Xd)) if sub is not None
+                                else np.zeros(X.shape[0], np.float32))
+                raw = np.stack(cols, axis=1)
+        if raw_score or self.objective is None:
+            return raw[:, 0] if k == 1 else raw
+        out = self.objective.convert_output(jnp.asarray(raw if k > 1 else raw[:, 0]))
+        return np.asarray(out)
+
+    def _predict_leaf(self, Xi, start_iteration, num_iteration):
+        from .tree import _walk_raw
+        k = self.num_tree_per_iteration
+        t0 = start_iteration * k
+        t1 = len(self.models) if num_iteration is None else min(
+            len(self.models), (start_iteration + num_iteration) * k)
+        Xd = jnp.asarray(Xi)
+        leaves = []
+        for t in range(t0, t1):
+            tree = self.models[t]
+            # walk returning leaf index: reuse raw walk on leaf-index values
+            idx_tree = Tree(**{**tree.__dict__})
+            idx_tree.leaf_value = np.arange(tree.max_leaves, dtype=np.float64)
+            tb = TreeBatch([idx_tree])
+            leaves.append(np.asarray(predict_raw(tb, Xd)).astype(np.int32))
+        return np.stack(leaves, axis=1) if leaves else np.zeros(
+            (Xi.shape[0], 0), np.int32)
+
+    # -- model management ----------------------------------------------------
+    def rollback_one_iter(self) -> None:
+        """Reference gbdt.cpp:454 RollbackOneIter."""
+        if self.iter_ <= 0:
+            return
+        k = self.num_tree_per_iteration
+        for _ in range(k):
+            if self.models:
+                self.models.pop()
+        self.iter_ -= 1
+        # scores must be rebuilt from remaining trees
+        self._rebuild_scores()
+
+    def _rebuild_scores(self) -> None:
+        k = self.num_tree_per_iteration
+        n = self.num_data
+        shape = (n,) if k == 1 else (n, k)
+        score0 = np.zeros(shape, np.float32)
+        md = self.train_set.metadata
+        if md.init_score is not None:
+            score0 += md.init_score.reshape(shape).astype(np.float32)
+        elif not self.models and self.config.boost_from_average and \
+                self.objective is not None:
+            # with no trees left the bias is no longer carried by tree 0;
+            # restore it so gradients and the next first tree stay consistent
+            score0 += (np.float32(self._pending_bias[0]) if k == 1 else
+                       self._pending_bias[None, :].astype(np.float32))
+        self.score = jnp.asarray(score0)
+        if self.models:
+            from .tree import _walk_binned as wb
+            score = self.score
+            for t, tree in enumerate(self.models):
+                cid = t % k
+                delta = wb(self.X_dev, jnp.asarray(tree.split_feature),
+                           jnp.asarray(tree.threshold_bin),
+                           jnp.asarray(tree.nan_bin),
+                           jnp.asarray(tree.decision_type.astype(np.int32)),
+                           jnp.asarray(tree.left_child),
+                           jnp.asarray(tree.right_child),
+                           jnp.asarray(tree.leaf_value, dtype=jnp.float32),
+                           jnp.asarray(tree.num_leaves, dtype=jnp.int32))
+                if k == 1:
+                    score = score + delta
+                else:
+                    score = score.at[:, cid].add(delta)
+            self.score = score
+
+    @property
+    def current_iteration(self) -> int:
+        return self.iter_
+
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    # model text IO lives in model_text.py
+    def save_model_to_string(self, start_iteration: int = 0,
+                             num_iteration: int = -1,
+                             importance_type: int = 0) -> str:
+        from .model_text import model_to_string
+        return model_to_string(self, start_iteration, num_iteration)
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        """Reference Booster::FeatureImportance (gbdt.cpp)."""
+        imp = np.zeros(self.num_features, np.float64)
+        for tree in self.models:
+            for i in range(tree.num_leaves - 1):
+                f = tree.split_feature[i]
+                if f >= 0:
+                    if importance_type == "split":
+                        imp[f] += 1.0
+                    else:
+                        imp[f] += max(tree.split_gain[i], 0.0)
+        return imp

@@ -1,0 +1,143 @@
+"""SHAP feature contributions (reference: src/io/tree.cpp PredictContrib —
+the TreeSHAP recursive algorithm of Lundberg et al.; exposed via
+predict(..., pred_contrib=True), c_api predict type C_API_PREDICT_CONTRIB).
+
+Host-side recursive TreeSHAP over the flat tree arrays.  Prediction-time
+only (not on the training hot path), so a clear host implementation is
+preferred; a vectorized device path can land with the perf milestones."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .tree import CAT_MASK, DEFAULT_LEFT_MASK, Tree
+
+
+def _tree_shap(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
+    """Accumulate SHAP values of one tree for one row into phi
+    (len num_features + 1; last = expected value/bias)."""
+
+    # fractions: list of (node, zero_fraction, one_fraction, feature) path
+    def extend(path, zero_frac, one_frac, feat):
+        path = path + [[zero_frac, one_frac, feat, 0.0]]
+        l = len(path)
+        path[l - 1][3] = 1.0 if l == 1 else 0.0
+        for i in range(l - 2, -1, -1):
+            path[i + 1][3] += one_frac * path[i][3] * (i + 1) / l
+            path[i][3] = zero_frac * path[i][3] * (l - 1 - i) / l
+        return path
+
+    def unwind(path, i):
+        l = len(path)
+        one_frac = path[i][1]
+        zero_frac = path[i][0]
+        n = path[l - 1][3]
+        path = [row[:] for row in path]
+        for j in range(l - 2, -1, -1):
+            if one_frac != 0:
+                t = path[j][3]
+                path[j][3] = n * l / ((j + 1) * one_frac)
+                n = t - path[j][3] * zero_frac * (l - 1 - j) / l
+            else:
+                path[j][3] = path[j][3] * l / (zero_frac * (l - 1 - j))
+        for j in range(i, l - 1):
+            path[j][0] = path[j + 1][0]
+            path[j][1] = path[j + 1][1]
+            path[j][2] = path[j + 1][2]
+        path.pop()
+        return path
+
+    def unwound_sum(path, i):
+        l = len(path)
+        one_frac = path[i][1]
+        zero_frac = path[i][0]
+        total = 0.0
+        n = path[l - 1][3]
+        for j in range(l - 2, -1, -1):
+            if one_frac != 0:
+                t = n * l / ((j + 1) * one_frac)
+                total += t
+                n = path[j][3] - t * zero_frac * (l - 1 - j) / l
+            else:
+                total += path[j][3] * l / (zero_frac * (l - 1 - j))
+        return total
+
+    def node_count(node):
+        if node < 0:
+            return float(tree.leaf_count[~node])
+        return float(tree.internal_count[node])
+
+    def go_left(node, v):
+        dt = tree.decision_type[node]
+        if dt & CAT_MASK:
+            return (not np.isnan(v)) and int(v) == int(tree.threshold[node])
+        if np.isnan(v):
+            if (dt >> 2) & 3 == 2:
+                return bool(dt & DEFAULT_LEFT_MASK)
+            v = 0.0
+        return v <= tree.threshold[node]
+
+    def recurse(node, path, zero_frac, one_frac, feat):
+        path = extend(path, zero_frac, one_frac, feat)
+        if node < 0:
+            for i in range(1, len(path)):
+                w = unwound_sum(path, i)
+                phi[path[i][2]] += w * (path[i][1] - path[i][0]) * \
+                    tree.leaf_value[~node]
+            return
+        f = int(tree.split_feature[node])
+        hot = int(tree.left_child[node]) if go_left(node, x[f]) else \
+            int(tree.right_child[node])
+        cold = (int(tree.right_child[node]) if hot == int(tree.left_child[node])
+                else int(tree.left_child[node]))
+        incoming_zero, incoming_one = 1.0, 1.0
+        path_idx = -1
+        for i in range(1, len(path)):
+            if path[i][2] == f:
+                path_idx = i
+                break
+        if path_idx >= 0:
+            incoming_zero = path[path_idx][0]
+            incoming_one = path[path_idx][1]
+            path = unwind(path, path_idx)
+        cnt = node_count(node)
+        hot_frac = node_count(hot) / cnt if cnt > 0 else 0.0
+        cold_frac = node_count(cold) / cnt if cnt > 0 else 0.0
+        recurse(hot, path, hot_frac * incoming_zero, incoming_one, f)
+        recurse(cold, path, cold_frac * incoming_zero, 0.0, f)
+
+    if tree.num_leaves <= 1:
+        phi[-1] += tree.leaf_value[0]
+        return
+    # expected value
+    phi[-1] += _expected_value(tree, 0)
+    recurse(0, [], 1.0, 1.0, -1)
+
+
+def _expected_value(tree: Tree, node: int) -> float:
+    if node < 0:
+        return float(tree.leaf_value[~node])
+    cnt = float(tree.internal_count[node])
+    l, r = int(tree.left_child[node]), int(tree.right_child[node])
+    lc = float(tree.leaf_count[~l]) if l < 0 else float(tree.internal_count[l])
+    rc = float(tree.leaf_count[~r]) if r < 0 else float(tree.internal_count[r])
+    if cnt <= 0:
+        return 0.0
+    return (lc * _expected_value(tree, l) + rc * _expected_value(tree, r)) / cnt
+
+
+def predict_contrib(gbdt, Xi: np.ndarray) -> np.ndarray:
+    """Per-feature SHAP contributions + bias column
+    (reference predictor contrib path; output (N, num_features+1) or
+    num_class blocks thereof)."""
+    n = Xi.shape[0]
+    k = gbdt.num_tree_per_iteration
+    nf = gbdt.num_features
+    out = np.zeros((n, (nf + 1) * k), np.float64)
+    for t, tree in enumerate(gbdt.models):
+        cid = t % k
+        for i in range(n):
+            phi = np.zeros(nf + 1)
+            _tree_shap(tree, Xi[i], phi)
+            out[i, cid * (nf + 1):(cid + 1) * (nf + 1)] += phi
+    return out if k > 1 else out

@@ -1,0 +1,274 @@
+"""Flat-array decision tree model + jitted prediction.
+
+TPU-native re-implementation of the reference tree model
+(reference: include/LightGBM/tree.h:25 ``Tree`` — flat arrays
+``split_feature_``, ``threshold_``, ``left_child_``, ``right_child_``,
+``leaf_value_``; child pointers use ``~leaf_index`` for leaves, and
+prediction is a branchy walk, tree.h:133 ``Tree::Predict``).
+
+Here every tree of a model shares the same max size (num_leaves from config),
+so a whole boosted ensemble stacks into (T, ...) arrays and prediction is one
+jitted vectorized tree walk over (rows x trees) — no per-node branching, the
+walk advances all rows one level per iteration of a ``lax.while_loop``.
+
+decision_type bit layout follows the reference (tree.h decision_type):
+  bit0: categorical, bit1: default_left, bits 2-3: missing type
+  (0 none, 1 zero, 2 nan).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Tree", "TreeBatch", "predict_binned", "predict_raw"]
+
+CAT_MASK = 1
+DEFAULT_LEFT_MASK = 2
+MISSING_ZERO = 1 << 2
+MISSING_NAN = 2 << 2
+
+
+@dataclasses.dataclass
+class Tree:
+    """Host-side view of one trained tree (numpy arrays).
+
+    Internal node arrays have length num_leaves-1 (only the first
+    ``num_leaves_actual - 1`` entries are meaningful); leaf arrays have length
+    num_leaves.  Child pointers >= 0 index internal nodes; negative pointers
+    are leaves encoded as ``~leaf_index`` (reference tree.h convention).
+    """
+
+    num_leaves: int                    # actual leaves
+    split_feature: np.ndarray          # (L-1,) int32, inner feature index
+    threshold_bin: np.ndarray          # (L-1,) int32
+    nan_bin: np.ndarray                # (L-1,) int32 bin holding NaN (-1: none)
+    threshold: np.ndarray              # (L-1,) float64 raw-value threshold
+    decision_type: np.ndarray          # (L-1,) uint8
+    left_child: np.ndarray             # (L-1,) int32
+    right_child: np.ndarray            # (L-1,) int32
+    split_gain: np.ndarray             # (L-1,) float32
+    internal_value: np.ndarray         # (L-1,) float64
+    internal_weight: np.ndarray        # (L-1,) float64
+    internal_count: np.ndarray         # (L-1,) int64
+    leaf_value: np.ndarray             # (L,) float64
+    leaf_weight: np.ndarray            # (L,) float64
+    leaf_count: np.ndarray             # (L,) int64
+    shrinkage: float = 1.0
+
+    @property
+    def max_leaves(self) -> int:
+        return len(self.leaf_value)
+
+    def num_internal(self) -> int:
+        return max(self.num_leaves - 1, 0)
+
+    def shrink(self, rate: float) -> None:
+        """In-place shrinkage (reference tree.h Shrinkage)."""
+        self.leaf_value = self.leaf_value * rate
+        self.internal_value = self.internal_value * rate
+        self.shrinkage *= rate
+
+    def add_bias(self, val: float) -> None:
+        self.leaf_value = self.leaf_value + val
+        self.internal_value = self.internal_value + val
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Raw-feature prediction, host reference implementation
+        (tree.h:133 Tree::Predict).  Used for testing; batch prediction goes
+        through TreeBatch."""
+        out = np.empty(len(X), dtype=np.float64)
+        for i, row in enumerate(X):
+            node = 0
+            if self.num_leaves <= 1:
+                out[i] = self.leaf_value[0]
+                continue
+            while node >= 0:
+                f = self.split_feature[node]
+                v = row[f]
+                dt = self.decision_type[node]
+                if dt & CAT_MASK:
+                    if np.isnan(v):
+                        left = bool(dt & DEFAULT_LEFT_MASK)
+                    else:
+                        left = int(v) == int(self.threshold[node])
+                else:
+                    if np.isnan(v):
+                        if (dt >> 2) == 2:  # missing nan
+                            left = bool(dt & DEFAULT_LEFT_MASK)
+                        else:
+                            v = 0.0
+                            left = v <= self.threshold[node]
+                    else:
+                        left = v <= self.threshold[node]
+                node = self.left_child[node] if left else self.right_child[node]
+            out[i] = self.leaf_value[~node]
+        return out
+
+
+class TreeBatch:
+    """Stacked device arrays for T trees of identical max size; the ensemble
+    prediction structure (replaces the reference's per-tree virtual calls in
+    gbdt_prediction.cpp with one vectorized walk)."""
+
+    FIELDS = ("split_feature", "threshold_bin", "threshold", "decision_type",
+              "left_child", "right_child", "leaf_value")
+
+    def __init__(self, trees: List[Tree]):
+        if not trees:
+            raise ValueError("no trees")
+        self.num_trees = len(trees)
+        self.max_leaves = max(max(t.max_leaves, t.num_leaves) for t in trees)
+        ml = self.max_leaves
+
+        def stack(attr, size, dtype=None, fill=0):
+            arrs = []
+            for t in trees:
+                a = np.asarray(getattr(t, attr))
+                if len(a) < size:
+                    a = np.concatenate([a, np.full(size - len(a), fill,
+                                                   a.dtype if a.size else
+                                                   np.float64)])
+                arrs.append(a[:size])
+            out = np.stack(arrs)
+            return jnp.asarray(out if dtype is None else out.astype(dtype))
+
+        self.split_feature = stack("split_feature", ml - 1, np.int32)
+        self.threshold_bin = stack("threshold_bin", ml - 1, np.int32)
+        self.nan_bin = stack("nan_bin", ml - 1, np.int32, fill=-1)
+        self.threshold = stack("threshold", ml - 1, np.float32)
+        self.decision_type = stack("decision_type", ml - 1, np.uint8)
+        self.left_child = stack("left_child", ml - 1, np.int32)
+        self.right_child = stack("right_child", ml - 1, np.int32)
+        self.leaf_value = stack("leaf_value", ml, np.float32)
+        self.num_leaves = jnp.asarray(np.array([t.num_leaves for t in trees],
+                                               dtype=np.int32))
+
+    def as_tuple(self):
+        return (self.split_feature, self.threshold_bin, self.nan_bin,
+                self.decision_type, self.left_child, self.right_child,
+                self.leaf_value, self.num_leaves)
+
+
+@jax.jit
+def _walk_binned(bins, split_feature, threshold_bin, nan_bin, decision_type,
+                 left_child, right_child, leaf_value, num_leaves):
+    """Vectorized tree walk on BINNED data for one tree.
+
+    bins: (N, F) int; tree arrays as in TreeBatch rows.
+    Returns (N,) float32 leaf values.
+    """
+    n = bins.shape[0]
+    node = jnp.where(num_leaves <= 1, -1, 0) * jnp.ones((n,), jnp.int32)
+
+    def cond(state):
+        node, _ = state
+        return jnp.any(node >= 0)
+
+    def body(state):
+        node, out = state
+        active = node >= 0
+        nd = jnp.maximum(node, 0)
+        f = split_feature[nd]
+        thr = threshold_bin[nd]
+        dt = decision_type[nd]
+        b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        is_cat = (dt & CAT_MASK) != 0
+        dleft = (dt & DEFAULT_LEFT_MASK) != 0
+        # the NaN bin is the feature's last bin, above any real threshold, so
+        # "missing right" is automatic; "missing left" overrides via nan_bin
+        is_nanbin = b == nan_bin[nd]
+        go_left = jnp.where(is_cat, b == thr,
+                            jnp.where(is_nanbin, dleft, b <= thr))
+        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
+        new_node = jnp.where(active, nxt, node)
+        out = jnp.where(active & (new_node < 0),
+                        leaf_value[jnp.maximum(~new_node, 0)], out)
+        return new_node, out
+
+    out0 = jnp.where(num_leaves <= 1,
+                     jnp.broadcast_to(leaf_value[0], (n,)),
+                     jnp.zeros((n,), jnp.float32))
+    node, out = jax.lax.while_loop(cond, body, (node, out0))
+    return out
+
+
+def predict_binned(batch: TreeBatch, bins: jnp.ndarray,
+                   num_iteration: Optional[int] = None) -> jnp.ndarray:
+    """Sum of per-tree leaf outputs on binned rows (training-time scoring)."""
+    fields = batch.as_tuple()
+    t = batch.num_trees if num_iteration is None else min(num_iteration, batch.num_trees)
+
+    def body(carry, tree_fields):
+        return carry + _walk_binned(bins, *tree_fields), None
+
+    sliced = tuple(a[:t] for a in fields)
+    out, _ = jax.lax.scan(body, jnp.zeros((bins.shape[0],), jnp.float32), sliced)
+    return out
+
+
+@jax.jit
+def _walk_raw(X, split_feature, threshold, decision_type,
+              left_child, right_child, leaf_value, num_leaves):
+    """Vectorized walk on RAW float features for one tree (inference path)."""
+    n = X.shape[0]
+    node = jnp.where(num_leaves <= 1, -1, 0) * jnp.ones((n,), jnp.int32)
+
+    def cond(state):
+        node, _ = state
+        return jnp.any(node >= 0)
+
+    def body(state):
+        node, out = state
+        active = node >= 0
+        nd = jnp.maximum(node, 0)
+        f = split_feature[nd]
+        thr = threshold[nd]
+        dt = decision_type[nd]
+        v = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+        is_cat = (dt & CAT_MASK) != 0
+        dleft = (dt & DEFAULT_LEFT_MASK) != 0
+        miss_nan = (dt & (3 << 2)) == MISSING_NAN
+        is_nan = jnp.isnan(v)
+        v_num = jnp.where(is_nan & ~miss_nan, 0.0, v)
+        go_left_num = jnp.where(is_nan & miss_nan, dleft, v_num <= thr)
+        # NaN categoricals follow default_left (== "is the split category the
+        # most frequent one", set by the grower)
+        go_left_cat = jnp.where(is_nan, dleft,
+                                (v.astype(jnp.int32).astype(jnp.float32) == v) &
+                                (v.astype(jnp.int32) == thr.astype(jnp.int32)))
+        go_left = jnp.where(is_cat, go_left_cat, go_left_num)
+        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
+        new_node = jnp.where(active, nxt, node)
+        out = jnp.where(active & (new_node < 0),
+                        leaf_value[jnp.maximum(~new_node, 0)], out)
+        return new_node, out
+
+    out0 = jnp.where(num_leaves <= 1,
+                     jnp.broadcast_to(leaf_value[0], (n,)),
+                     jnp.zeros((n,), jnp.float32))
+    node, out = jax.lax.while_loop(cond, body, (node, out0))
+    return out
+
+
+def predict_raw(batch: TreeBatch, X: jnp.ndarray,
+                start_iteration: int = 0,
+                num_iteration: Optional[int] = None) -> jnp.ndarray:
+    """Ensemble raw-score prediction on raw features
+    (reference gbdt_prediction.cpp:PredictRaw)."""
+    t_end = batch.num_trees if num_iteration is None else min(
+        start_iteration + num_iteration, batch.num_trees)
+    fields = (batch.split_feature, batch.threshold, batch.decision_type,
+              batch.left_child, batch.right_child, batch.leaf_value,
+              batch.num_leaves)
+    sliced = tuple(a[start_iteration:t_end] for a in fields)
+
+    def body(carry, tree_fields):
+        return carry + _walk_raw(X, *tree_fields), None
+
+    out, _ = jax.lax.scan(body, jnp.zeros((X.shape[0],), jnp.float32), sliced)
+    return out

@@ -1,0 +1,64 @@
+"""Binary log-loss objective (reference src/objective/binary_objective.hpp:
+gradients at :105-133, unbalance label weights at :90-102, BoostFromScore at
+:139-159)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import EPS, ObjectiveFunction, weighted_mean
+
+
+class BinaryLogloss(ObjectiveFunction):
+    name = "binary"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        self.is_unbalance = bool(config.is_unbalance)
+        self.scale_pos_weight = float(config.scale_pos_weight)
+        if self.is_unbalance and self.scale_pos_weight != 1.0:
+            raise ValueError("cannot set both is_unbalance and scale_pos_weight")
+
+    def check_label(self, label):
+        u = np.unique(label)
+        if not np.all(np.isin(u, [0.0, 1.0])):
+            raise ValueError("binary objective requires labels in {0, 1}")
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = np.asarray(metadata.label)
+        cnt_pos = float((lab > 0).sum())
+        cnt_neg = float((lab <= 0).sum())
+        w0 = w1 = 1.0
+        if self.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
+            if cnt_pos > cnt_neg:
+                w0 = cnt_pos / cnt_neg
+            else:
+                w1 = cnt_neg / cnt_pos
+        w1 *= self.scale_pos_weight
+        self.label_weight = (w0, w1)
+
+    def get_gradients(self, score):
+        y = self.label
+        sig = self.sigmoid
+        w0, w1 = self.label_weight
+        p = 1.0 / (1.0 + jnp.exp(-sig * score))
+        lw = jnp.where(y > 0, w1, w0)
+        grad = sig * (p - y) * lw
+        hess = sig * sig * p * (1.0 - p) * lw
+        if self.weight is not None:
+            grad = grad * self.weight
+            hess = hess * self.weight
+        return grad.astype(jnp.float32), hess.astype(jnp.float32)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        lab = np.asarray(self.label)
+        w = None if self.weight is None else np.asarray(self.weight)
+        pavg = weighted_mean(lab, w)
+        pavg = min(max(pavg, EPS), 1.0 - EPS)
+        return float(np.log(pavg / (1.0 - pavg)) / self.sigmoid)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + jnp.exp(-self.sigmoid * score))

@@ -1,0 +1,108 @@
+"""Multiclass objectives (reference src/objective/multiclass_objective.hpp:
+softmax gradients at :86-126 with hessian factor num_class/(num_class-1) at
+:31, OVA wrapper at :228, BoostFromScore log(class prob) at :155)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import EPS, ObjectiveFunction
+from .binary import BinaryLogloss
+
+
+class MulticlassSoftmax(ObjectiveFunction):
+    name = "multiclass"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        self.factor = self.num_class / (self.num_class - 1.0)
+
+    def check_label(self, label):
+        if (label < 0).any() or (label >= self.num_class).any():
+            raise ValueError(f"multiclass labels must be in [0, {self.num_class})")
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = np.asarray(metadata.label).astype(np.int32)
+        w = metadata.weight
+        probs = np.zeros(self.num_class)
+        for k in range(self.num_class):
+            sel = lab == k
+            probs[k] = (w[sel].sum() / w.sum()) if w is not None else sel.mean()
+        self.class_init_probs = probs
+        self.onehot = jnp.asarray(
+            np.eye(self.num_class, dtype=np.float32)[lab])
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        return self.num_class
+
+    def get_gradients(self, score):
+        # score: (N, K)
+        p = jnp.exp(score - jnp.max(score, axis=1, keepdims=True))
+        p = p / jnp.sum(p, axis=1, keepdims=True)
+        grad = p - self.onehot
+        hess = self.factor * p * (1.0 - p)
+        if self.weight is not None:
+            grad = grad * self.weight[:, None]
+            hess = hess * self.weight[:, None]
+        return grad.astype(jnp.float32), hess.astype(jnp.float32)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return float(np.log(max(EPS, self.class_init_probs[class_id])))
+
+    def convert_output(self, score):
+        p = jnp.exp(score - jnp.max(score, axis=-1, keepdims=True))
+        return p / jnp.sum(p, axis=-1, keepdims=True)
+
+
+class MulticlassOVA(ObjectiveFunction):
+    name = "multiclassova"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        self.sigmoid = float(config.sigmoid)
+        self._binary = [BinaryLogloss(config) for _ in range(self.num_class)]
+
+    def check_label(self, label):
+        if (label < 0).any() or (label >= self.num_class).any():
+            raise ValueError(f"multiclassova labels must be in [0, {self.num_class})")
+
+    def init(self, metadata, num_data):
+        if metadata.label is None:
+            raise ValueError("multiclassova requires labels")
+        self.check_label(metadata.label)
+        lab = np.asarray(metadata.label).astype(np.int32)
+        self.label = jnp.asarray(lab, jnp.float32)
+        self.weight = (jnp.asarray(metadata.weight, jnp.float32)
+                       if metadata.weight is not None else None)
+        self.num_data = num_data
+        import copy
+        from ..dataset import Metadata
+        for k, b in enumerate(self._binary):
+            md = Metadata()
+            md.set_label((lab == k).astype(np.float32))
+            if metadata.weight is not None:
+                md.set_weight(metadata.weight)
+            b.init(md, num_data)
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        return self.num_class
+
+    def get_gradients(self, score):
+        grads, hesss = [], []
+        for k, b in enumerate(self._binary):
+            g, h = b.get_gradients(score[:, k])
+            grads.append(g)
+            hesss.append(h)
+        return jnp.stack(grads, axis=1), jnp.stack(hesss, axis=1)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return self._binary[class_id].boost_from_score(0)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + jnp.exp(-self.sigmoid * score))

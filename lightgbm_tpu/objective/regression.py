@@ -1,0 +1,205 @@
+"""Regression objective family (reference src/objective/
+regression_objective.hpp — L2:132, L1:223, Huber:320, Fair:368, Poisson:445,
+Quantile:497, MAPE:616, Gamma:692, Tweedie:728, with BoostFromScore and
+percentile leaf renewal hooks)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import ObjectiveFunction, weighted_mean, weighted_percentile
+
+
+class RegressionL2(ObjectiveFunction):
+    name = "regression"
+    is_constant_hessian = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = bool(config.reg_sqrt)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.sqrt:
+            lab = np.asarray(metadata.label, np.float64)
+            self._raw_label = self.label
+            self.label = jnp.asarray(np.sign(lab) * np.sqrt(np.abs(lab)), jnp.float32)
+
+    def _grad_hess(self, score):
+        return score - self.label, jnp.ones_like(score)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return weighted_mean(np.asarray(self.label), self._np_weight())
+
+    def convert_output(self, score):
+        if self.sqrt:
+            return jnp.sign(score) * score * score
+        return score
+
+    def _np_weight(self):
+        return None if self.weight is None else np.asarray(self.weight)
+
+
+class RegressionL1(RegressionL2):
+    name = "regression_l1"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = False
+
+    def _grad_hess(self, score):
+        diff = score - self.label
+        return jnp.sign(diff), jnp.ones_like(score)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return weighted_percentile(np.asarray(self.label), self._np_weight(), 0.5)
+
+    # reference IsRenewTreeOutput: leaf values are refit to the residual
+    # median (RenewTreeOutput) — see boosting/gbdt renew step
+    is_renew_tree_output = True
+    renew_alpha = 0.5
+
+
+class Huber(RegressionL2):
+    name = "huber"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = False
+        self.alpha = float(config.alpha)
+
+    def _grad_hess(self, score):
+        diff = score - self.label
+        grad = jnp.where(jnp.abs(diff) <= self.alpha, diff,
+                         jnp.sign(diff) * self.alpha)
+        return grad, jnp.ones_like(score)
+
+
+class Fair(RegressionL2):
+    name = "fair"
+    is_constant_hessian = False
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = False
+        self.c = float(config.fair_c)
+
+    def _grad_hess(self, score):
+        x = score - self.label
+        denom = jnp.abs(x) + self.c
+        return self.c * x / denom, self.c * self.c / (denom * denom)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return weighted_percentile(np.asarray(self.label), self._np_weight(), 0.5)
+
+
+class Poisson(RegressionL2):
+    name = "poisson"
+    is_constant_hessian = False
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = False
+        self.max_delta_step = float(config.poisson_max_delta_step)
+
+    def check_label(self, label):
+        if (label < 0).any():
+            raise ValueError("poisson objective requires non-negative labels")
+
+    def _grad_hess(self, score):
+        ex = jnp.exp(score)
+        return ex - self.label, jnp.exp(score + self.max_delta_step)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        mean = weighted_mean(np.asarray(self.label), self._np_weight())
+        return float(np.log(max(mean, 1e-15)))
+
+    def convert_output(self, score):
+        return jnp.exp(score)
+
+
+class Quantile(RegressionL2):
+    name = "quantile"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = False
+        self.alpha = float(config.alpha)
+
+    def _grad_hess(self, score):
+        diff = score - self.label
+        grad = jnp.where(diff >= 0, self.alpha, self.alpha - 1.0)
+        return grad, jnp.ones_like(score)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return weighted_percentile(np.asarray(self.label), self._np_weight(),
+                                   self.alpha)
+
+    is_renew_tree_output = True
+
+    @property
+    def renew_alpha(self):
+        return self.alpha
+
+
+class Mape(RegressionL2):
+    name = "mape"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = False
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = np.abs(np.asarray(metadata.label, np.float64))
+        lw = 1.0 / np.maximum(1.0, lab)
+        if metadata.weight is not None:
+            lw = lw * metadata.weight
+        self.label_weight = jnp.asarray(lw, jnp.float32)
+
+    def get_gradients(self, score):
+        # label_weight already folds user weights (regression_objective.hpp:616)
+        diff = score - self.label
+        grad = jnp.sign(diff) * self.label_weight
+        hess = (jnp.ones_like(score) if self.weight is None else
+                jnp.broadcast_to(self.weight, score.shape))
+        return grad.astype(jnp.float32), hess.astype(jnp.float32)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return weighted_percentile(np.asarray(self.label),
+                                   np.asarray(self.label_weight), 0.5)
+
+    is_renew_tree_output = True
+    renew_alpha = 0.5
+
+
+class Gamma(Poisson):
+    name = "gamma"
+
+    def check_label(self, label):
+        if (label <= 0).any():
+            raise ValueError("gamma objective requires positive labels")
+
+    def _grad_hess(self, score):
+        enx = jnp.exp(-score)
+        return 1.0 - self.label * enx, self.label * enx
+
+
+class Tweedie(Poisson):
+    name = "tweedie"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.rho = float(config.tweedie_variance_power)
+
+    def check_label(self, label):
+        if (label < 0).any():
+            raise ValueError("tweedie objective requires non-negative labels")
+
+    def _grad_hess(self, score):
+        e1 = jnp.exp((1.0 - self.rho) * score)
+        e2 = jnp.exp((2.0 - self.rho) * score)
+        grad = -self.label * e1 + e2
+        hess = -self.label * (1.0 - self.rho) * e1 + (2.0 - self.rho) * e2
+        return grad, hess

@@ -1,0 +1,75 @@
+"""Cross-entropy objectives for probabilistic labels in [0, 1]
+(reference src/objective/xentropy_objective.hpp: CrossEntropy gradients at
+:82-92, CrossEntropyLambda weighted parameterization at :195-216, init scores
+at :134/:262)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import EPS, ObjectiveFunction, weighted_mean
+
+
+class CrossEntropy(ObjectiveFunction):
+    name = "cross_entropy"
+
+    def check_label(self, label):
+        if (label < 0).any() or (label > 1).any():
+            raise ValueError("cross_entropy labels must be in [0, 1]")
+
+    def get_gradients(self, score):
+        z = 1.0 / (1.0 + jnp.exp(-score))
+        grad = z - self.label
+        hess = z * (1.0 - z)
+        if self.weight is not None:
+            grad = grad * self.weight
+            hess = hess * self.weight
+        return grad.astype(jnp.float32), hess.astype(jnp.float32)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        pavg = weighted_mean(np.asarray(self.label), self._np_weight())
+        pavg = min(max(pavg, EPS), 1.0 - EPS)
+        return float(np.log(pavg / (1.0 - pavg)))
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + jnp.exp(-score))
+
+
+class CrossEntropyLambda(ObjectiveFunction):
+    name = "cross_entropy_lambda"
+
+    def check_label(self, label):
+        if (label < 0).any() or (label > 1).any():
+            raise ValueError("cross_entropy_lambda labels must be in [0, 1]")
+
+    def get_gradients(self, score):
+        y = self.label
+        if self.weight is None:
+            z = 1.0 / (1.0 + jnp.exp(-score))
+            grad = z - y
+            hess = z * (1.0 - z)
+        else:
+            w = self.weight
+            epf = jnp.exp(score)
+            hhat = jnp.log1p(epf)
+            z = 1.0 - jnp.exp(-w * hhat)
+            enf = 1.0 / epf
+            grad = (1.0 - y / jnp.maximum(z, EPS)) * w / (1.0 + enf)
+            c = 1.0 / jnp.maximum(1.0 - z, EPS)
+            d = 1.0 + epf
+            a = w * epf / (d * d)
+            d2 = jnp.maximum(c - 1.0, EPS)
+            b = (c / (d2 * d2)) * (1.0 + w * epf - c)
+            hess = a * (1.0 + y * b)
+        return grad.astype(jnp.float32), hess.astype(jnp.float32)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        # havg = weighted mean label; initscore = log(exp(havg) - 1)
+        # (xentropy_objective.hpp:262)
+        havg = weighted_mean(np.asarray(self.label), self._np_weight())
+        return float(np.log(max(np.exp(havg) - 1.0, EPS)))
+
+    def convert_output(self, score):
+        # output is the exponential parameter lambda (xentropy_objective.hpp:234)
+        return jnp.log1p(jnp.exp(score))

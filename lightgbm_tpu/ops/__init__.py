@@ -1,0 +1,2 @@
+from .histogram import build_histogram, histogram_subtract
+from .split import best_split_per_feature, leaf_output

@@ -1,0 +1,32 @@
+"""Distributed tree learners over a JAX device mesh.
+
+TPU-native replacement for the reference's network layer + parallel learners
+(reference: src/network/ socket/MPI collectives + src/treelearner/
+parallel_tree_learner.h — see SURVEY.md §2.5's mapping note): the Bruck
+allgather / recursive-halving reduce-scatter over TCP/MPI collapse into
+``jax.lax`` collectives (psum / all_gather / reduce_scatter semantics) over
+ICI/DCN inside ``shard_map``; ``jax.distributed.initialize`` replaces the
+machine-list bootstrap.
+"""
+
+from __future__ import annotations
+
+from .data_parallel import DataParallelTreeLearner
+from .feature_parallel import FeatureParallelTreeLearner
+from .voting_parallel import VotingParallelTreeLearner
+from .mesh import get_mesh
+
+
+def create_parallel_learner(config, num_features, max_bins, num_bins, is_cat,
+                            has_nan):
+    """Factory (reference tree_learner.h:104 TreeLearner::CreateTreeLearner
+    dispatching on tree_learner type)."""
+    kind = config.tree_learner
+    cls = {
+        "data": DataParallelTreeLearner,
+        "feature": FeatureParallelTreeLearner,
+        "voting": VotingParallelTreeLearner,
+    }.get(kind)
+    if cls is None:
+        raise ValueError(f"Unknown tree_learner: {kind}")
+    return cls(config, num_features, max_bins, num_bins, is_cat, has_nan)

@@ -1,0 +1,61 @@
+"""Logging with levels and a redirectable callback
+(reference include/LightGBM/utils/log.h:71 ``LogLevel``/``Log``; the
+callback redirect is what the reference Python package uses to route C++ log
+lines to Python, log.h:90 ``ResetCallBack``)."""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Callable, Optional
+
+_state = threading.local()
+
+LEVEL_FATAL = -1
+LEVEL_WARNING = 0
+LEVEL_INFO = 1
+LEVEL_DEBUG = 2
+
+_verbosity = LEVEL_INFO
+_callback: Optional[Callable[[str], None]] = None
+
+
+def set_verbosity(level: int) -> None:
+    global _verbosity
+    _verbosity = level
+
+
+def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:
+    """Reference c_api.h:54 LGBM_RegisterLogCallback."""
+    global _callback
+    _callback = cb
+
+
+def _emit(msg: str) -> None:
+    if _callback is not None:
+        _callback(msg + "\n")
+    else:
+        sys.stdout.write(msg + "\n")
+
+
+def log_debug(msg: str) -> None:
+    if _verbosity >= LEVEL_DEBUG:
+        _emit(f"[LightGBM-TPU] [Debug] {msg}")
+
+
+def log_info(msg: str) -> None:
+    if _verbosity >= LEVEL_INFO:
+        _emit(f"[LightGBM-TPU] [Info] {msg}")
+
+
+def log_warning(msg: str) -> None:
+    if _verbosity >= LEVEL_WARNING:
+        _emit(f"[LightGBM-TPU] [Warning] {msg}")
+
+
+class LightGBMError(Exception):
+    pass
+
+
+def log_fatal(msg: str) -> None:
+    raise LightGBMError(msg)

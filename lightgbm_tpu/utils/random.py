@@ -1,0 +1,21 @@
+"""Deterministic RNG helpers (reference include/LightGBM/utils/random.h —
+a seeded LCG used for bagging/feature sampling).  Host-side sampling uses
+numpy Generators seeded per (seed, iteration) so results are reproducible
+regardless of call order; device-side sampling uses jax.random keys."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def host_rng(seed: int, stream: int = 0) -> np.random.Generator:
+    return np.random.Generator(np.random.Philox(key=(seed & 0xFFFFFFFF) + (stream << 32)))
+
+
+def sample_indices(n: int, k: int, seed: int, stream: int = 0) -> np.ndarray:
+    """Sample k of n indices without replacement, sorted (reference
+    Random::Sample used by bagging/feature_fraction)."""
+    rng = host_rng(seed, stream)
+    if k >= n:
+        return np.arange(n, dtype=np.int32)
+    return np.sort(rng.choice(n, size=k, replace=False)).astype(np.int32)

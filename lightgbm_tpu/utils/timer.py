@@ -1,0 +1,73 @@
+"""Aggregate wall-time tracing (reference include/LightGBM/utils/common.h:931
+``Common::Timer`` + common.h:995 RAII ``FunctionTimer``; compiled in with
+USE_TIMETAG).  Here always available, enabled via env LGBM_TPU_TIMETAG=1 or
+``global_timer.enable()``; pairs with ``jax.profiler`` named scopes for
+device-side traces."""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import os
+import time
+from typing import Dict
+
+
+class Timer:
+    def __init__(self) -> None:
+        self._acc: Dict[str, float] = collections.defaultdict(float)
+        self._count: Dict[str, int] = collections.defaultdict(int)
+        self._start: Dict[str, float] = {}
+        self.enabled = os.environ.get("LGBM_TPU_TIMETAG", "0") == "1"
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def start(self, name: str) -> None:
+        if self.enabled:
+            self._start[name] = time.perf_counter()
+
+    def stop(self, name: str) -> None:
+        if self.enabled and name in self._start:
+            self._acc[name] += time.perf_counter() - self._start.pop(name)
+            self._count[name] += 1
+
+    def report(self) -> str:
+        lines = [f"{name} = {secs:.6f}s (n={self._count[name]})"
+                 for name, secs in sorted(self._acc.items())]
+        return "\n".join(lines)
+
+    def print_at_exit(self) -> None:
+        if self.enabled and self._acc:
+            print("[LightGBM-TPU] time tags:\n" + self.report())
+
+
+global_timer = Timer()
+atexit.register(global_timer.print_at_exit)
+
+
+class FunctionTimer:
+    """``with FunctionTimer("name"):`` — RAII scope timer, optionally also
+    emitting a jax.profiler trace annotation."""
+
+    def __init__(self, name: str, use_jax_scope: bool = False) -> None:
+        self.name = name
+        self._scope = None
+        if use_jax_scope:
+            try:
+                import jax.profiler
+                self._scope = jax.profiler.TraceAnnotation(name)
+            except Exception:
+                self._scope = None
+
+    def __enter__(self):
+        global_timer.start(self.name)
+        if self._scope is not None:
+            self._scope.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._scope is not None:
+            self._scope.__exit__(*exc)
+        global_timer.stop(self.name)
+        return False
