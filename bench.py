@@ -1,0 +1,72 @@
+"""Benchmark: boosting iterations/sec on Higgs-shaped data.
+
+Reproduces the reference's headline config (docs/Experiments.rst:110 —
+Higgs 10.5M x 28, 500 trees, 255 leaves, 255 bins, lr 0.1; reference CPU:
+130.094 s => 3.84 iters/s on 2x E5-2690v4; see BASELINE.md) on synthetic
+Higgs-like data, on whatever single device JAX provides (the driver runs
+this on one real TPU chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: BENCH_ROWS (default 1_000_000), BENCH_TREES (default 20),
+BENCH_LEAVES (255), BENCH_BINS (255).  iters/sec is steady-state (compile
+and first-tree warmup excluded).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_ITERS_PER_SEC = 500.0 / 130.094  # reference Higgs CPU number
+
+
+def main() -> None:
+    rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    trees = int(os.environ.get("BENCH_TREES", 20))
+    leaves = int(os.environ.get("BENCH_LEAVES", 255))
+    bins = int(os.environ.get("BENCH_BINS", 255))
+
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.log import set_verbosity
+
+    set_verbosity(-1)
+    rng = np.random.RandomState(0)
+    f = 28
+    # Higgs-like: dense floats, binary label with learnable structure
+    X = rng.randn(rows, f).astype(np.float32)
+    w = rng.randn(f) / np.sqrt(f)
+    logit = X @ w + 0.3 * np.sin(2 * X[:, 0]) * X[:, 1]
+    y = (logit + rng.randn(rows) * 0.5 > 0).astype(np.float64)
+
+    params = {
+        "objective": "binary", "num_leaves": leaves, "max_bin": bins,
+        "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1,
+    }
+    ds = lgb.Dataset(X, y, params=params)
+    booster = lgb.Booster(params=params, train_set=ds)
+
+    # warmup: compile + first tree
+    booster.update()
+    t0 = time.perf_counter()
+    for _ in range(trees):
+        booster.update()
+    # force completion of async dispatch
+    float(np.asarray(booster._gbdt.score).sum())
+    dt = time.perf_counter() - t0
+
+    iters_per_sec = trees / dt
+    print(json.dumps({
+        "metric": f"boosting_iters_per_sec (binary, {rows}x{f}, "
+                  f"{leaves} leaves, {bins} bins, {jax.default_backend()})",
+        "value": round(iters_per_sec, 4),
+        "unit": "iters/s",
+        "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -106,6 +106,13 @@ class CommStrategy:
     def reduce_sum(self, v):
         return v
 
+    def reduce_hist(self, hist):
+        """Reduce a freshly built histogram across row shards (DP: psum —
+        the analog of data_parallel_tree_learner.cpp:155's ReduceScatter+
+        Allgather; voting keeps local histograms and reduces only the
+        voted features inside leaf_candidates)."""
+        return hist
+
     def local_meta(self, feature_mask):
         return (self.num_bins_full, self.is_cat_full, self.has_nan_full,
                 feature_mask)
@@ -143,7 +150,8 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
             num_bins, is_cat, has_nan)
         n, f_local = X.shape
 
-        root_hist = build_histogram(X, grad, hess, sample_mask, **hist_kwargs)
+        root_hist = strat.reduce_hist(
+            build_histogram(X, grad, hess, sample_mask, **hist_kwargs))
         root_sum = strat.reduce_sum(jnp.stack([
             jnp.sum(grad * sample_mask),
             jnp.sum(hess * sample_mask),
@@ -151,6 +159,24 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
 
         cand = strat.leaf_candidates(root_hist, root_sum, feature_mask,
                                      split_params)
+
+        # Per-split child-row compaction buckets: the smaller child's rows
+        # are gathered into the smallest adequate fixed-size buffer (a
+        # power-of-4 ladder), so histogram work scales with the child's
+        # size.  The leaf membership itself stays a per-row row_leaf vector
+        # (DataPartition analog, data_partition.hpp:170) updated with masked
+        # wheres — sequential full-N passes with a tiny constant beat
+        # index-permutation bookkeeping on TPU, where random gather/scatter
+        # is the expensive primitive.
+        rows_sharded = getattr(strat, "rows_sharded", False)
+        hist_buckets = []
+        _size = (n // 2 + 1) if not rows_sharded else n
+        _top = _size
+        while _size >= 4096 and len(hist_buckets) < 4:
+            hist_buckets.append(_size)
+            _size //= 4
+        if not hist_buckets:
+            hist_buckets = [_top]
 
         state = {
             "row_leaf": jnp.zeros((n,), jnp.int32),
@@ -213,31 +239,61 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
                                 jnp.where(is_nanbin, dleft, col <= thr))
             row_leaf = jnp.where(do & in_leaf & jnp.logical_not(go_left),
                                  new_id, s["row_leaf"])
-
-            # ---- children histograms (shard-local; reduction happens in
-            #      the candidate hook) ----
+            # smaller side chosen by GLOBAL counts so every shard agrees
+            # (GetGlobalDataCountInLeaf parity, parallel_tree_learner.h:67)
             left_smaller = lsum[2] <= rsum[2]
+
             if use_hist_pool:
-                # one masked pass for the smaller child + subtraction
-                # (serial_tree_learner.cpp:311-320)
+                # one histogram pass over the SMALLER child + subtraction
+                # (serial_tree_learner.cpp:311-320).  The child's rows are
+                # compacted via cumsum + vectorized binary search (gather
+                # only — jnp.nonzero's scatter is ~6x slower on TPU) into
+                # the smallest adequate bucket.  The f32 running count is
+                # exact up to 2^24 rows per shard; larger shards would need
+                # a f64 cumsum here.
                 small_id = jnp.where(left_smaller, best_leaf, new_id)
                 small_mask = (row_leaf == small_id).astype(jnp.float32) * \
                     sample_mask * dof
-                hist_small = build_histogram(X, grad, hess, small_mask,
-                                             **hist_kwargs)
+                cs = jnp.cumsum(small_mask)
+                small_cnt = cs[-1]
+
+                def hist_branch(size):
+                    def fn(cs_in):
+                        q = jnp.arange(1, size + 1, dtype=jnp.float32)
+                        idx = jnp.searchsorted(cs_in, q, side="left")
+                        idx = jnp.where(q <= small_cnt, idx, n)
+                        bsub = jnp.take(X, idx, axis=0, mode="fill",
+                                        fill_value=0)
+                        gsub = jnp.take(grad, idx, mode="fill", fill_value=0.0)
+                        hsub = jnp.take(hess, idx, mode="fill", fill_value=0.0)
+                        msub = jnp.take(small_mask, idx, mode="fill",
+                                        fill_value=0.0)
+                        return build_histogram(bsub, gsub, hsub, msub,
+                                               **hist_kwargs)
+                    return fn
+
+                if len(hist_buckets) == 1:
+                    hist_small = hist_branch(hist_buckets[0])(cs)
+                else:
+                    sel = sum((small_cnt <= b).astype(jnp.int32)
+                              for b in hist_buckets[1:])
+                    hist_small = jax.lax.switch(
+                        sel, [hist_branch(b) for b in hist_buckets], cs)
+                hist_small = strat.reduce_hist(hist_small)
                 parent_hist = s["hists"][best_leaf]
                 hist_big = parent_hist - hist_small
                 hist_left = jnp.where(left_smaller, hist_small, hist_big)
                 hist_right = jnp.where(left_smaller, hist_big, hist_small)
             else:
+                # no histogram pool (huge feature count): masked full passes
                 left_mask = (row_leaf == best_leaf).astype(jnp.float32) * \
                     sample_mask * dof
                 right_mask = (row_leaf == new_id).astype(jnp.float32) * \
                     sample_mask * dof
-                hist_left = build_histogram(X, grad, hess, left_mask,
-                                            **hist_kwargs)
-                hist_right = build_histogram(X, grad, hess, right_mask,
-                                             **hist_kwargs)
+                hist_left = strat.reduce_hist(build_histogram(
+                    X, grad, hess, left_mask, **hist_kwargs))
+                hist_right = strat.reduce_hist(build_histogram(
+                    X, grad, hess, right_mask, **hist_kwargs))
 
             # ---- children candidates ----
             child_depth = s["leaf_depth"][best_leaf] + 1
@@ -360,6 +416,11 @@ def hist_pool_fits(config: Config, num_features: int, max_bins: int) -> bool:
     return pool_bytes <= budget
 
 
+# jitted growers cached by their full static configuration so repeated
+# train() calls (tests, cv folds, sklearn fits) reuse compiled code
+_GROW_FN_CACHE: dict = {}
+
+
 class SerialTreeLearner:
     """Host-side wrapper: owns the jitted grower and the dataset's static
     feature descriptors (reference tree_learner.h:27 ``TreeLearner``)."""
@@ -374,12 +435,18 @@ class SerialTreeLearner:
         self.num_features = num_features
         self.split_params = split_params_from_config(config)
         self.use_hist_pool = hist_pool_fits(config, num_features, self.max_bins)
-        self._grow = make_grow_fn(
-            num_leaves=int(config.num_leaves), max_bins=self.max_bins,
-            max_depth=int(config.max_depth), split_params=self.split_params,
-            hist_impl=resolve_hist_impl(config),
-            rows_per_chunk=int(config.tpu_rows_per_chunk),
-            use_hist_pool=self.use_hist_pool)
+        key = ("serial", int(config.num_leaves), self.max_bins,
+               int(config.max_depth), self.split_params,
+               resolve_hist_impl(config), int(config.tpu_rows_per_chunk),
+               self.use_hist_pool)
+        if key not in _GROW_FN_CACHE:
+            _GROW_FN_CACHE[key] = make_grow_fn(
+                num_leaves=int(config.num_leaves), max_bins=self.max_bins,
+                max_depth=int(config.max_depth), split_params=self.split_params,
+                hist_impl=resolve_hist_impl(config),
+                rows_per_chunk=int(config.tpu_rows_per_chunk),
+                use_hist_pool=self.use_hist_pool)
+        self._grow = _GROW_FN_CACHE[key]
 
     def train(self, X_dev: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               sample_mask: jnp.ndarray,

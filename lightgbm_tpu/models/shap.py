@@ -1,10 +1,13 @@
 """SHAP feature contributions (reference: src/io/tree.cpp PredictContrib —
-the TreeSHAP recursive algorithm of Lundberg et al.; exposed via
+the TreeSHAP algorithm of Lundberg, Erion & Lee, "Consistent Individualized
+Feature Attribution for Tree Ensembles" (Algorithm 2); exposed via
 predict(..., pred_contrib=True), c_api predict type C_API_PREDICT_CONTRIB).
 
 Host-side recursive TreeSHAP over the flat tree arrays.  Prediction-time
 only (not on the training hot path), so a clear host implementation is
-preferred; a vectorized device path can land with the perf milestones."""
+preferred; a vectorized device path can land with the perf milestones.
+
+Path entries are [feature, zero_fraction, one_fraction, pweight]."""
 
 from __future__ import annotations
 
@@ -13,54 +16,52 @@ import numpy as np
 from .tree import CAT_MASK, DEFAULT_LEFT_MASK, Tree
 
 
+def _extend(m, pz, po, pi):
+    l = len(m)
+    m = [row[:] for row in m]
+    m.append([pi, pz, po, 1.0 if l == 0 else 0.0])
+    for i in range(l - 1, -1, -1):
+        m[i + 1][3] += po * m[i][3] * (i + 1) / (l + 1)
+        m[i][3] = pz * m[i][3] * (l - i) / (l + 1)
+    return m
+
+
+def _unwind(m, i):
+    l = len(m) - 1
+    o, z = m[i][2], m[i][1]
+    m = [row[:] for row in m]
+    n = m[l][3]
+    for j in range(l - 1, -1, -1):
+        if o != 0:
+            t = m[j][3]
+            m[j][3] = n * (l + 1) / ((j + 1) * o)
+            n = t - m[j][3] * z * (l - j) / (l + 1)
+        else:
+            m[j][3] = m[j][3] * (l + 1) / (z * (l - j))
+    for j in range(i, l):
+        m[j][0], m[j][1], m[j][2] = m[j + 1][0], m[j + 1][1], m[j + 1][2]
+    m.pop()
+    return m
+
+
+def _unwound_sum(m, i):
+    l = len(m) - 1
+    o, z = m[i][2], m[i][1]
+    n = m[l][3]
+    total = 0.0
+    for j in range(l - 1, -1, -1):
+        if o != 0:
+            t = n * (l + 1) / ((j + 1) * o)
+            total += t
+            n = m[j][3] - t * z * (l - j) / (l + 1)
+        else:
+            total += m[j][3] * (l + 1) / (z * (l - j))
+    return total
+
+
 def _tree_shap(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
     """Accumulate SHAP values of one tree for one row into phi
-    (len num_features + 1; last = expected value/bias)."""
-
-    # fractions: list of (node, zero_fraction, one_fraction, feature) path
-    def extend(path, zero_frac, one_frac, feat):
-        path = path + [[zero_frac, one_frac, feat, 0.0]]
-        l = len(path)
-        path[l - 1][3] = 1.0 if l == 1 else 0.0
-        for i in range(l - 2, -1, -1):
-            path[i + 1][3] += one_frac * path[i][3] * (i + 1) / l
-            path[i][3] = zero_frac * path[i][3] * (l - 1 - i) / l
-        return path
-
-    def unwind(path, i):
-        l = len(path)
-        one_frac = path[i][1]
-        zero_frac = path[i][0]
-        n = path[l - 1][3]
-        path = [row[:] for row in path]
-        for j in range(l - 2, -1, -1):
-            if one_frac != 0:
-                t = path[j][3]
-                path[j][3] = n * l / ((j + 1) * one_frac)
-                n = t - path[j][3] * zero_frac * (l - 1 - j) / l
-            else:
-                path[j][3] = path[j][3] * l / (zero_frac * (l - 1 - j))
-        for j in range(i, l - 1):
-            path[j][0] = path[j + 1][0]
-            path[j][1] = path[j + 1][1]
-            path[j][2] = path[j + 1][2]
-        path.pop()
-        return path
-
-    def unwound_sum(path, i):
-        l = len(path)
-        one_frac = path[i][1]
-        zero_frac = path[i][0]
-        total = 0.0
-        n = path[l - 1][3]
-        for j in range(l - 2, -1, -1):
-            if one_frac != 0:
-                t = n * l / ((j + 1) * one_frac)
-                total += t
-                n = path[j][3] - t * zero_frac * (l - 1 - j) / l
-            else:
-                total += path[j][3] * l / (zero_frac * (l - 1 - j))
-        return total
+    (len num_features + 1; last slot = expected value/bias)."""
 
     def node_count(node):
         if node < 0:
@@ -70,46 +71,44 @@ def _tree_shap(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
     def go_left(node, v):
         dt = tree.decision_type[node]
         if dt & CAT_MASK:
-            return (not np.isnan(v)) and int(v) == int(tree.threshold[node])
+            if np.isnan(v):
+                return bool(dt & DEFAULT_LEFT_MASK)
+            return int(v) == int(tree.threshold[node])
         if np.isnan(v):
             if (dt >> 2) & 3 == 2:
                 return bool(dt & DEFAULT_LEFT_MASK)
             v = 0.0
         return v <= tree.threshold[node]
 
-    def recurse(node, path, zero_frac, one_frac, feat):
-        path = extend(path, zero_frac, one_frac, feat)
+    def recurse(node, m, pz, po, pi):
+        m = _extend(m, pz, po, pi)
         if node < 0:
-            for i in range(1, len(path)):
-                w = unwound_sum(path, i)
-                phi[path[i][2]] += w * (path[i][1] - path[i][0]) * \
-                    tree.leaf_value[~node]
+            v = tree.leaf_value[~node]
+            for i in range(1, len(m)):
+                w = _unwound_sum(m, i)
+                phi[m[i][0]] += w * (m[i][2] - m[i][1]) * v
             return
         f = int(tree.split_feature[node])
-        hot = int(tree.left_child[node]) if go_left(node, x[f]) else \
-            int(tree.right_child[node])
-        cold = (int(tree.right_child[node]) if hot == int(tree.left_child[node])
-                else int(tree.left_child[node]))
-        incoming_zero, incoming_one = 1.0, 1.0
-        path_idx = -1
-        for i in range(1, len(path)):
-            if path[i][2] == f:
-                path_idx = i
+        l, r = int(tree.left_child[node]), int(tree.right_child[node])
+        hot, cold = (l, r) if go_left(node, x[f]) else (r, l)
+        iz, io = 1.0, 1.0
+        k = -1
+        for i in range(1, len(m)):
+            if m[i][0] == f:
+                k = i
                 break
-        if path_idx >= 0:
-            incoming_zero = path[path_idx][0]
-            incoming_one = path[path_idx][1]
-            path = unwind(path, path_idx)
+        if k >= 0:
+            iz, io = m[k][1], m[k][2]
+            m = _unwind(m, k)
         cnt = node_count(node)
-        hot_frac = node_count(hot) / cnt if cnt > 0 else 0.0
-        cold_frac = node_count(cold) / cnt if cnt > 0 else 0.0
-        recurse(hot, path, hot_frac * incoming_zero, incoming_one, f)
-        recurse(cold, path, cold_frac * incoming_zero, 0.0, f)
+        hf = node_count(hot) / cnt if cnt > 0 else 0.0
+        cf = node_count(cold) / cnt if cnt > 0 else 0.0
+        recurse(hot, m, iz * hf, io, f)
+        recurse(cold, m, iz * cf, 0.0, f)
 
     if tree.num_leaves <= 1:
         phi[-1] += tree.leaf_value[0]
         return
-    # expected value
     phi[-1] += _expected_value(tree, 0)
     recurse(0, [], 1.0, 1.0, -1)
 
@@ -128,8 +127,8 @@ def _expected_value(tree: Tree, node: int) -> float:
 
 def predict_contrib(gbdt, Xi: np.ndarray) -> np.ndarray:
     """Per-feature SHAP contributions + bias column
-    (reference predictor contrib path; output (N, num_features+1) or
-    num_class blocks thereof)."""
+    (reference predictor contrib path; output (N, num_features+1), or
+    num_class stacked blocks for multiclass)."""
     n = Xi.shape[0]
     k = gbdt.num_tree_per_iteration
     nf = gbdt.num_features
@@ -140,4 +139,4 @@ def predict_contrib(gbdt, Xi: np.ndarray) -> np.ndarray:
             phi = np.zeros(nf + 1)
             _tree_shap(tree, Xi[i], phi)
             out[i, cid * (nf + 1):(cid + 1) * (nf + 1)] += phi
-    return out if k > 1 else out
+    return out

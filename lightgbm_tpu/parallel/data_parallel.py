@@ -1,0 +1,108 @@
+"""Data-parallel tree learner: rows sharded over the mesh.
+
+TPU-native re-implementation of the reference DataParallelTreeLearner
+(reference: src/treelearner/data_parallel_tree_learner.cpp — rows partitioned
+across machines, local histograms ReduceScatter'd so each machine reduces a
+disjoint feature block :155-173, local best splits, allreduce-max of the best
+SplitInfo :244, global leaf counts via parallel_tree_learner.h:67).
+
+Here the learner is the shared grower wrapped in ``shard_map`` over a 1-D
+mesh: the binned matrix, gradients and row_leaf partition live row-sharded;
+per-leaf histograms are ``psum``'d across shards after each masked build (one
+allreduce per split — the reduce-scatter + per-feature-block split-finding
+refinement is a bandwidth optimization tracked for the perf milestones); all
+tree state is computed redundantly and identically on every device, so no
+split broadcast is needed.  Global leaf counts fall out of the psum'd count
+channel — the analog of GetGlobalDataCountInLeaf.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..config import Config
+from ..learner.serial import (CommStrategy, GrownTree, make_grow_fn,
+                              hist_pool_fits, resolve_hist_impl,
+                              split_params_from_config)
+from .mesh import get_mesh
+
+__all__ = ["DataParallelTreeLearner", "DataParallelStrategy"]
+
+
+class DataParallelStrategy(CommStrategy):
+    rows_sharded = True
+    """psum histograms + sums across row shards (SURVEY.md §2.5 mapping)."""
+
+    def __init__(self, axis_name, num_bins, is_cat, has_nan):
+        super().__init__(num_bins, is_cat, has_nan)
+        self.axis_name = axis_name
+
+    def reduce_sum(self, v):
+        return jax.lax.psum(v, self.axis_name)
+
+    def reduce_hist(self, hist):
+        return jax.lax.psum(hist, self.axis_name)
+
+
+class DataParallelTreeLearner:
+    """Host-side wrapper building the shard_map'd grower."""
+
+    name = "data"
+
+    def __init__(self, config: Config, num_features: int, max_bins: int,
+                 num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray):
+        self.config = config
+        self.max_bins = int(max_bins)
+        self.num_features = num_features
+        self.mesh = get_mesh(int(config.num_devices))
+        self.ndev = self.mesh.devices.size
+        self.axis = self.mesh.axis_names[0]
+        self.num_bins = jnp.asarray(num_bins, jnp.int32)
+        self.is_cat = jnp.asarray(is_cat, jnp.bool_)
+        self.has_nan = jnp.asarray(has_nan, jnp.bool_)
+        strategy = DataParallelStrategy(self.axis, self.num_bins, self.is_cat,
+                                        self.has_nan)
+        grow = make_grow_fn(
+            num_leaves=int(config.num_leaves), max_bins=self.max_bins,
+            max_depth=int(config.max_depth),
+            split_params=split_params_from_config(config),
+            hist_impl=resolve_hist_impl(config),
+            rows_per_chunk=int(config.tpu_rows_per_chunk),
+            use_hist_pool=hist_pool_fits(config, num_features, self.max_bins),
+            strategy=strategy, jit=False)
+        tree_specs = GrownTree(
+            split_feature=P(), threshold_bin=P(), nan_bin=P(),
+            decision_type=P(), left_child=P(), right_child=P(),
+            split_gain=P(), internal_value=P(), internal_weight=P(),
+            internal_count=P(), leaf_value=P(), leaf_weight=P(),
+            leaf_count=P(), num_leaves=P(), row_leaf=P(self.axis))
+        self._grow = jax.jit(jax.shard_map(
+            grow, mesh=self.mesh,
+            in_specs=(P(self.axis), P(self.axis), P(self.axis), P(self.axis),
+                      P(), P(), P(), P()),
+            out_specs=tree_specs,
+            check_vma=False))
+
+    def train(self, X_dev: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+              sample_mask: jnp.ndarray,
+              feature_mask: Optional[jnp.ndarray] = None) -> GrownTree:
+        if feature_mask is None:
+            feature_mask = jnp.ones((self.num_features,), jnp.bool_)
+        n = X_dev.shape[0]
+        pad = (-n) % self.ndev
+        if pad:
+            X_dev = jnp.pad(X_dev, ((0, pad), (0, 0)))
+            grad = jnp.pad(grad, (0, pad))
+            hess = jnp.pad(hess, (0, pad))
+            sample_mask = jnp.pad(sample_mask, (0, pad))
+        grown = self._grow(X_dev, grad, hess, sample_mask, self.num_bins,
+                           self.is_cat, self.has_nan, feature_mask)
+        if pad:
+            grown = grown._replace(row_leaf=grown.row_leaf[:n])
+        return grown

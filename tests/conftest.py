@@ -1,0 +1,62 @@
+"""Test configuration: force an 8-device CPU mesh (SURVEY.md §4's
+"multi-host simulated by multi-process/mesh-sharding on a single host" —
+the reference's analog is test_dask.py's in-process multi-worker cluster).
+
+Must run before any jax client is created.  The container's sitecustomize
+registers the axon TPU backend eagerly, so we switch platforms via
+jax.config (which wins over the registered plugin) and raise the CPU device
+count for shard_map tests."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def binary_data():
+    rng = np.random.RandomState(42)
+    n = 600
+    X = rng.randn(n, 6)
+    logit = X[:, 0] * 2 + X[:, 1] - 0.5 * X[:, 2]
+    y = (logit + 0.5 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="session")
+def regression_data():
+    rng = np.random.RandomState(17)
+    n = 600
+    X = rng.randn(n, 6)
+    y = X[:, 0] * 3 + np.sin(2 * X[:, 1]) + 0.1 * rng.randn(n)
+    return X, y
+
+
+@pytest.fixture(scope="session")
+def multiclass_data():
+    rng = np.random.RandomState(7)
+    n = 600
+    X = rng.randn(n, 6)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.3).astype(int)).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="session")
+def rank_data():
+    rng = np.random.RandomState(3)
+    nq, qs = 40, 12
+    y = rng.randint(0, 4, nq * qs).astype(np.float64)
+    X = rng.randn(nq * qs, 5) + y[:, None] * 0.4
+    group = np.full(nq, qs)
+    return X, y, group
+
+
+SMALL = {"num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1}

@@ -1,0 +1,101 @@
+"""BinMapper tests (reference src/io/bin.cpp FindBin semantics)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import BinMapper, MissingType, bin_matrix, find_bin
+
+
+def test_simple_numeric():
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0] * 20)
+    m = find_bin(vals, max_bin=255, min_data_in_bin=1)
+    b = m.value_to_bin(np.array([1.0, 2.0, 3.0, 4.0, 5.0]))
+    # distinct values -> distinct bins, monotone
+    assert len(set(b.tolist())) == 5
+    assert all(b[i] < b[i + 1] for i in range(4))
+
+
+def test_monotone_mapping():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(5000)
+    m = find_bin(vals, max_bin=63, min_data_in_bin=3)
+    xs = np.sort(rng.randn(100))
+    bs = m.value_to_bin(xs)
+    assert (np.diff(bs) >= 0).all()
+    assert bs.max() < m.num_bin
+
+
+def test_max_bin_respected():
+    rng = np.random.RandomState(1)
+    vals = rng.randn(10000)
+    for mb in (15, 63, 255):
+        m = find_bin(vals, max_bin=mb, min_data_in_bin=1)
+        assert 1 < m.num_bin <= mb
+
+
+def test_zero_gets_own_bin():
+    vals = np.concatenate([np.zeros(50), np.linspace(-3, 3, 100)])
+    m = find_bin(vals, max_bin=32, min_data_in_bin=1)
+    zb = m.value_to_bin(np.array([0.0]))[0]
+    nonzero = m.value_to_bin(np.array([-3.0, -0.1, 0.1, 3.0]))
+    assert zb not in nonzero.tolist()
+    assert m.default_bin == zb
+
+
+def test_nan_bin():
+    vals = np.array([1.0, 2.0, np.nan, 3.0, np.nan] * 10)
+    m = find_bin(vals, max_bin=16, min_data_in_bin=1, use_missing=True)
+    assert m.missing_type == MissingType.NAN
+    b = m.value_to_bin(np.array([np.nan, 1.0]))
+    assert b[0] == m.num_bin - 1  # trailing NaN bin
+    assert b[1] != b[0]
+
+
+def test_no_use_missing():
+    vals = np.array([1.0, 2.0, np.nan, 3.0] * 10)
+    m = find_bin(vals, max_bin=16, min_data_in_bin=1, use_missing=False)
+    assert m.missing_type == MissingType.NONE
+    # NaN folds into the zero bin
+    assert m.value_to_bin(np.array([np.nan]))[0] == m.value_to_bin(
+        np.array([0.0]))[0]
+
+
+def test_categorical():
+    vals = np.array([0, 1, 1, 2, 2, 2, 5, 5, 5, 5] * 10, dtype=np.float64)
+    m = find_bin(vals, max_bin=32, min_data_in_bin=1, is_categorical=True)
+    assert m.is_categorical
+    b = m.value_to_bin(np.array([5.0, 2.0, 1.0, 0.0]))
+    # bins ordered by descending frequency: 5 -> 0, 2 -> 1, 1 -> 2, 0 -> 3
+    assert b.tolist() == [0, 1, 2, 3]
+    # unseen category -> bin 0 (most frequent)
+    assert m.value_to_bin(np.array([99.0]))[0] == 0
+    # NaN -> most frequent bin
+    assert m.value_to_bin(np.array([np.nan]))[0] == 0
+
+
+def test_trivial_feature():
+    m = find_bin(np.ones(100), max_bin=32)
+    assert m.is_trivial
+
+
+def test_bin_matrix_dtype():
+    rng = np.random.RandomState(2)
+    X = rng.randn(100, 3)
+    mappers = [find_bin(X[:, j], max_bin=255, min_data_in_bin=1)
+               for j in range(3)]
+    binned = bin_matrix(X, mappers)
+    assert binned.dtype == np.uint8
+    assert binned.shape == (100, 3)
+
+
+def test_bin_to_value_roundtrip():
+    rng = np.random.RandomState(3)
+    vals = rng.randn(1000)
+    m = find_bin(vals, max_bin=63, min_data_in_bin=1)
+    # threshold semantics: value <= bin_to_value(b) <=> bin(value) <= b
+    for b in range(0, m.num_bin - 1, 7):
+        thr = m.bin_to_value(b)
+        xs = rng.randn(200)
+        lhs = xs <= thr
+        rhs = m.value_to_bin(xs) <= b
+        assert (lhs == rhs).all()

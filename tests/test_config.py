@@ -1,0 +1,87 @@
+"""Config system tests (reference param surface: config.h + config_auto.cpp
+alias table; analog of parts of tests/python_package_test/test_basic.py)."""
+
+import pytest
+
+from lightgbm_tpu.config import Config, parse_config_file, resolve_param_aliases
+
+
+def test_defaults():
+    c = Config()
+    assert c.num_leaves == 31
+    assert c.learning_rate == 0.1
+    assert c.max_bin == 255
+    assert c.objective == "regression"
+    assert c.boosting == "gbdt"
+    assert c.tree_learner == "serial"
+
+
+def test_aliases():
+    c = Config({"num_leaf": 64, "eta": 0.3, "application": "binary",
+                "sub_row": 0.5, "min_child_samples": 7, "nthread": 4})
+    assert c.num_leaves == 64
+    assert c.learning_rate == 0.3
+    assert c.objective == "binary"
+    assert c.bagging_fraction == 0.5
+    assert c.min_data_in_leaf == 7
+    assert c.num_threads == 4
+
+
+def test_objective_aliases():
+    assert Config({"objective": "mse"}).objective == "regression"
+    assert Config({"objective": "mae"}).objective == "regression_l1"
+    assert Config({"objective": "softmax", "num_class": 3}).objective == "multiclass"
+    assert Config({"objective": "xentropy"}).objective == "cross_entropy"
+    assert Config({"objective": "xendcg"}).objective == "rank_xendcg"
+    assert Config({"boosting": "gbrt"}).boosting == "gbdt"
+    assert Config({"tree_learner": "data_parallel"}).tree_learner == "data"
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        Config({"num_leaves": 1})
+    with pytest.raises(ValueError):
+        Config({"bagging_fraction": 0.0})
+    with pytest.raises(ValueError):
+        Config({"force_col_wise": True, "force_row_wise": True})
+    with pytest.raises(ValueError):
+        Config({"objective": "multiclass", "num_class": 1})
+    with pytest.raises(ValueError):
+        Config({"top_rate": 0.8, "other_rate": 0.5})
+
+
+def test_string_coercion():
+    c = Config({"num_leaves": "15", "learning_rate": "0.05",
+                "feature_pre_filter": "false", "metric": "l2,auc"})
+    assert c.num_leaves == 15
+    assert c.learning_rate == 0.05
+    assert c.feature_pre_filter is False
+    assert c.metric == ["l2", "auc"]
+
+
+def test_unknown_params_kept():
+    c = Config({"my_custom_thing": 5})
+    assert c.extra["my_custom_thing"] == 5
+
+
+def test_update_returns_new():
+    c = Config({"num_leaves": 15})
+    c2 = c.update({"num_leaves": 31})
+    assert c.num_leaves == 15 and c2.num_leaves == 31
+
+
+def test_seed_cascade():
+    c = Config({"seed": 77})
+    c2 = Config({"seed": 77})
+    assert c.bagging_seed == c2.bagging_seed
+    assert c.bagging_seed != Config({"seed": 78}).bagging_seed
+
+
+def test_config_file(tmp_path):
+    p = tmp_path / "train.conf"
+    p.write_text("# comment\ntask = train\nnum_leaves = 63\n"
+                 "metric = binary_logloss,auc\n")
+    params = parse_config_file(str(p))
+    c = Config(params)
+    assert c.num_leaves == 63
+    assert c.metric == ["binary_logloss", "auc"]

@@ -1,0 +1,262 @@
+"""Behavioral training tests (analog of reference
+tests/python_package_test/test_engine.py — per-objective quality thresholds,
+early stopping, cv, boosting variants, missing/categorical semantics)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+SMALL = {"num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1}
+
+
+def _auc(y, p):
+    order = np.argsort(-p)
+    y = y[order]
+    tp = np.cumsum(y)
+    fp = np.cumsum(1 - y)
+    if tp[-1] == 0 or fp[-1] == 0:
+        return 0.5
+    return float(np.trapz(tp, fp) / (tp[-1] * fp[-1]))
+
+
+def test_binary(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary"}, lgb.Dataset(X, y), 20)
+    p = bst.predict(X)
+    assert _auc(y, p) > 0.95
+    assert ((p >= 0) & (p <= 1)).all()
+
+
+def test_regression(regression_data):
+    X, y = regression_data
+    bst = lgb.train({**SMALL, "objective": "regression"}, lgb.Dataset(X, y), 25)
+    p = bst.predict(X)
+    assert np.mean((p - y) ** 2) < 0.3 * np.var(y)
+
+
+def test_regression_l1(regression_data):
+    X, y = regression_data
+    bst = lgb.train({**SMALL, "objective": "regression_l1",
+                     "learning_rate": 0.2}, lgb.Dataset(X, y), 25)
+    p = bst.predict(X)
+    assert np.mean(np.abs(p - y)) < 0.6 * np.mean(np.abs(y - np.median(y)))
+
+
+@pytest.mark.parametrize("objective", ["huber", "fair", "quantile", "mape"])
+def test_robust_regression_objectives(objective, regression_data):
+    X, y = regression_data
+    y_pos = y - y.min() + 1.0
+    bst = lgb.train({**SMALL, "objective": objective, "learning_rate": 0.2},
+                    lgb.Dataset(X, y_pos), 15)
+    p = bst.predict(X)
+    assert np.isfinite(p).all()
+    assert np.mean((p - y_pos) ** 2) < np.var(y_pos)
+
+
+@pytest.mark.parametrize("objective", ["poisson", "gamma", "tweedie"])
+def test_positive_regression_objectives(objective, regression_data):
+    X, y = regression_data
+    y_pos = np.exp(y / max(1.0, np.abs(y).max()) * 2)  # positive target
+    bst = lgb.train({**SMALL, "objective": objective, "learning_rate": 0.2},
+                    lgb.Dataset(X, y_pos), 15)
+    p = bst.predict(X)
+    assert np.isfinite(p).all()
+    assert (p > 0).all()  # log-link: outputs are means
+
+
+def test_multiclass(multiclass_data):
+    X, y = multiclass_data
+    bst = lgb.train({**SMALL, "objective": "multiclass", "num_class": 3},
+                    lgb.Dataset(X, y), 15)
+    p = bst.predict(X)
+    assert p.shape == (len(y), 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+    assert (p.argmax(axis=1) == y).mean() > 0.9
+
+
+def test_multiclassova(multiclass_data):
+    X, y = multiclass_data
+    bst = lgb.train({**SMALL, "objective": "multiclassova", "num_class": 3},
+                    lgb.Dataset(X, y), 15)
+    p = bst.predict(X)
+    assert p.shape == (len(y), 3)
+    assert (p.argmax(axis=1) == y).mean() > 0.9
+
+
+def test_cross_entropy(binary_data):
+    X, y = binary_data
+    # probabilistic labels
+    yl = np.clip(y * 0.9 + 0.05, 0, 1)
+    bst = lgb.train({**SMALL, "objective": "cross_entropy"},
+                    lgb.Dataset(X, yl), 15)
+    p = bst.predict(X)
+    assert ((p >= 0) & (p <= 1)).all()
+    assert _auc(y, p) > 0.9
+
+
+def test_lambdarank(rank_data):
+    X, y, group = rank_data
+    bst = lgb.train({**SMALL, "objective": "lambdarank", "metric": "ndcg",
+                     "eval_at": [5], "learning_rate": 0.2},
+                    lgb.Dataset(X, y, group=group), 15)
+    p = bst.predict(X)
+    # predicted order should correlate with labels
+    assert np.corrcoef(p, y)[0, 1] > 0.5
+
+
+def test_rank_xendcg(rank_data):
+    X, y, group = rank_data
+    bst = lgb.train({**SMALL, "objective": "rank_xendcg",
+                     "learning_rate": 0.2}, lgb.Dataset(X, y, group=group), 15)
+    p = bst.predict(X)
+    assert np.corrcoef(p, y)[0, 1] > 0.4
+
+
+def test_early_stopping():
+    rng = np.random.RandomState(0)
+    # small, noisy data + aggressive lr -> certain overfit on the valid set
+    X = rng.randn(200, 5)
+    y = X[:, 0] + 1.5 * rng.randn(200)
+    ds = lgb.Dataset(X[:120], y[:120])
+    vs = ds.create_valid(X[120:], y[120:])
+    bst = lgb.train({**SMALL, "objective": "regression", "metric": "l2",
+                     "learning_rate": 0.5, "min_data_in_leaf": 2,
+                     "early_stopping_round": 5}, ds, 100, valid_sets=[vs])
+    assert 0 < bst.best_iteration < 100
+
+
+def test_eval_result_recording(regression_data):
+    X, y = regression_data
+    ds = lgb.Dataset(X[:400], y[:400])
+    vs = ds.create_valid(X[400:], y[400:])
+    hist = {}
+    bst = lgb.train({**SMALL, "objective": "regression", "metric": ["l2", "l1"]},
+                    ds, 8, valid_sets=[vs],
+                    callbacks=[lgb.record_evaluation(hist)])
+    assert "valid_0" in hist
+    assert len(hist["valid_0"]["l2"]) == 8
+    assert hist["valid_0"]["l2"][-1] <= hist["valid_0"]["l2"][0]
+
+
+def test_weights(binary_data):
+    X, y = binary_data
+    w = np.where(y > 0, 2.0, 1.0)
+    bst = lgb.train({**SMALL, "objective": "binary"},
+                    lgb.Dataset(X, y, weight=w), 10)
+    p = bst.predict(X)
+    assert _auc(y, p) > 0.9
+
+
+def test_bagging(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary", "bagging_freq": 1,
+                     "bagging_fraction": 0.6}, lgb.Dataset(X, y), 15)
+    assert _auc(y, bst.predict(X)) > 0.9
+
+
+def test_feature_fraction(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary", "feature_fraction": 0.5},
+                    lgb.Dataset(X, y), 15)
+    assert _auc(y, bst.predict(X)) > 0.9
+
+
+def test_goss(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary", "boosting": "goss",
+                     "learning_rate": 0.3}, lgb.Dataset(X, y), 15)
+    assert _auc(y, bst.predict(X)) > 0.9
+
+
+def test_dart(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary", "boosting": "dart",
+                     "drop_rate": 0.3}, lgb.Dataset(X, y), 15)
+    assert _auc(y, bst.predict(X)) > 0.9
+
+
+def test_rf(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary", "boosting": "rf",
+                     "bagging_freq": 1, "bagging_fraction": 0.7},
+                    lgb.Dataset(X, y), 10)
+    p = bst.predict(X)
+    assert _auc(y, p) > 0.85
+    assert ((p >= 0) & (p <= 1)).all()
+
+
+def test_custom_objective(regression_data):
+    X, y = regression_data
+
+    def fobj(preds, dataset):
+        label = dataset.get_label()
+        return preds - label, np.ones_like(preds)
+
+    def feval(preds, dataset):
+        label = dataset.get_label()
+        return ("custom_mse", float(np.mean((preds - label) ** 2)), False)
+
+    ds = lgb.Dataset(X, y)
+    bst = lgb.train({**SMALL}, ds, 15, fobj=fobj, feval=feval,
+                    valid_sets=[ds.create_valid(X, y)])
+    p = bst.predict(X, raw_score=True)
+    assert np.mean((p - y) ** 2) < 0.5 * np.var(y)
+
+
+def test_missing_values(binary_data):
+    X, y = binary_data
+    Xn = X.copy()
+    Xn[::5, 0] = np.nan
+    bst = lgb.train({**SMALL, "objective": "binary"}, lgb.Dataset(Xn, y), 10)
+    p = bst.predict(Xn)
+    assert np.isfinite(p).all()
+    # NaN rows route deterministically: same rows, same preds
+    np.testing.assert_allclose(bst.predict(Xn), p)
+
+
+def test_categorical_feature():
+    rng = np.random.RandomState(5)
+    n = 600
+    cat = rng.randint(0, 5, n).astype(np.float64)
+    Xo = rng.randn(n, 2)
+    X = np.column_stack([cat, Xo])
+    y = (np.isin(cat, [1, 3]).astype(np.float64) + 0.1 * rng.randn(n) > 0.5
+         ).astype(np.float64)
+    bst = lgb.train({**SMALL, "objective": "binary"},
+                    lgb.Dataset(X, y, categorical_feature=[0]), 15)
+    assert _auc(y, bst.predict(X)) > 0.95
+
+
+def test_cv(regression_data):
+    X, y = regression_data
+    res = lgb.cv({**SMALL, "objective": "regression", "metric": "l2"},
+                 lgb.Dataset(X, y), 8, nfold=3, stratified=False)
+    assert "valid l2-mean" in res
+    assert len(res["valid l2-mean"]) == 8
+    assert res["valid l2-mean"][-1] < res["valid l2-mean"][0]
+
+
+def test_max_depth(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary", "max_depth": 2,
+                     "num_leaves": 31}, lgb.Dataset(X, y), 5)
+    d = bst.dump_model()
+
+    def depth(node, cur=0):
+        if "leaf_value" in node and "split_feature" not in node:
+            return cur
+        return max(depth(node["left_child"], cur + 1),
+                   depth(node["right_child"], cur + 1))
+
+    for ti in d["tree_info"]:
+        if "split_feature" in ti["tree_structure"]:
+            assert depth(ti["tree_structure"]) <= 2
+
+
+def test_reset_parameter(regression_data):
+    X, y = regression_data
+    lrs = [0.3] * 4 + [0.05] * 4
+    bst = lgb.train({**SMALL, "objective": "regression"}, lgb.Dataset(X, y), 8,
+                    callbacks=[lgb.reset_parameter(learning_rate=lrs)])
+    assert bst.num_trees() == 8

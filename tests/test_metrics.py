@@ -1,0 +1,122 @@
+"""Metric correctness tests against independent references."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Metadata
+from lightgbm_tpu.metric import create_metrics
+
+
+def _eval_one(name, label, score, weight=None, group=None, extra=None):
+    cfg = Config({"metric": [name], **(extra or {})})
+    ms = create_metrics(cfg)
+    assert len(ms) == 1
+    md = Metadata()
+    md.set_label(label)
+    md.set_weight(weight)
+    md.set_group(group)
+    ms[0].init(md, len(label))
+    return ms[0].eval(score)
+
+
+def test_l2_rmse_l1():
+    y = np.array([1.0, 2.0, 3.0])
+    s = np.array([1.5, 2.0, 2.0])
+    assert _eval_one("l2", y, s)[0][1] == pytest.approx((0.25 + 0 + 1) / 3)
+    assert _eval_one("rmse", y, s)[0][1] == pytest.approx(
+        np.sqrt((0.25 + 0 + 1) / 3))
+    assert _eval_one("l1", y, s)[0][1] == pytest.approx(0.5)
+
+
+def test_binary_logloss():
+    y = np.array([1.0, 0.0, 1.0])
+    p = np.array([0.9, 0.1, 0.8])
+    s = np.log(p / (1 - p))  # raw scores
+    want = -np.mean(y * np.log(p) + (1 - y) * np.log(1 - p))
+    assert _eval_one("binary_logloss", y, s)[0][1] == pytest.approx(want, rel=1e-5)
+
+
+def test_auc_matches_sklearn():
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.RandomState(0)
+    y = (rng.rand(300) > 0.6).astype(np.float64)
+    s = rng.randn(300) + y
+    got = _eval_one("auc", y, s)[0][1]
+    assert got == pytest.approx(roc_auc_score(y, s), rel=1e-9)
+    # weighted
+    w = rng.rand(300) + 0.1
+    got_w = _eval_one("auc", y, s, weight=w)[0][1]
+    # weights are stored float32 internally -> small tolerance
+    assert got_w == pytest.approx(roc_auc_score(y, s, sample_weight=w), rel=1e-6)
+
+
+def test_auc_ties():
+    y = np.array([1.0, 0, 1, 0])
+    s = np.array([0.5, 0.5, 0.5, 0.5])
+    assert _eval_one("auc", y, s)[0][1] == pytest.approx(0.5)
+
+
+def test_average_precision():
+    from sklearn.metrics import average_precision_score
+    rng = np.random.RandomState(1)
+    y = (rng.rand(200) > 0.7).astype(np.float64)
+    s = rng.randn(200) + 2 * y
+    got = _eval_one("average_precision", y, s)[0][1]
+    assert got == pytest.approx(average_precision_score(y, s), rel=1e-6)
+
+
+def test_multi_logloss():
+    from sklearn.metrics import log_loss
+    rng = np.random.RandomState(2)
+    y = rng.randint(0, 3, 200).astype(np.float64)
+    raw = rng.randn(200, 3)
+    p = np.exp(raw) / np.exp(raw).sum(axis=1, keepdims=True)
+    got = _eval_one("multi_logloss", y, raw, extra={"num_class": 3,
+                                                    "objective": "multiclass"})[0][1]
+    assert got == pytest.approx(log_loss(y, p, labels=[0, 1, 2]), rel=1e-5)
+
+
+def test_multi_error():
+    y = np.array([0.0, 1, 2, 1])
+    raw = np.array([[3.0, 1, 1], [1, 3, 1], [1, 3, 1], [1, 3, 1]])
+    got = _eval_one("multi_error", y, raw, extra={"num_class": 3,
+                                                  "objective": "multiclass"})[0][1]
+    assert got == pytest.approx(0.25)
+
+
+def test_ndcg():
+    # one query, perfect ranking -> 1.0
+    y = np.array([3.0, 2, 1, 0])
+    s = np.array([4.0, 3, 2, 1])
+    res = _eval_one("ndcg", y, s, group=np.array([4]),
+                    extra={"objective": "lambdarank", "eval_at": "2"})
+    assert res[0][0] == "ndcg@2"
+    assert res[0][1] == pytest.approx(1.0)
+    # reversed ranking < 1
+    res2 = _eval_one("ndcg", y, -s, group=np.array([4]),
+                     extra={"objective": "lambdarank", "eval_at": "2"})
+    assert res2[0][1] < 0.6
+
+
+def test_map():
+    y = np.array([1.0, 0, 1, 0])
+    s = np.array([4.0, 3, 2, 1])
+    res = _eval_one("map", y, s, group=np.array([4]),
+                    extra={"objective": "lambdarank", "eval_at": "4"})
+    # AP = (1/1 + 2/3)/2
+    assert res[0][1] == pytest.approx((1.0 + 2.0 / 3.0) / 2)
+
+
+def test_default_metric_for_objective():
+    cfg = Config({"objective": "binary"})
+    ms = create_metrics(cfg)
+    assert ms[0].name == "binary_logloss"
+    cfg = Config({"objective": "lambdarank"})
+    assert create_metrics(cfg)[0].name == "ndcg"
+
+
+def test_metric_aliases():
+    cfg = Config({"objective": "regression", "metric": ["mse", "mae"]})
+    names = [m.name for m in create_metrics(cfg)]
+    assert names == ["l2", "l1"]

@@ -1,0 +1,127 @@
+"""Model serialization tests (reference gbdt_model_text.cpp format;
+analog of parts of test_engine.py save/load and test_basic.py)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+SMALL = {"num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1}
+
+
+def test_roundtrip_exact(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary"}, lgb.Dataset(X, y), 8)
+    p = bst.predict(X)
+    s = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst2.predict(X), p, rtol=1e-6)
+    # and via file
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.txt")
+        bst.save_model(path)
+        bst3 = lgb.Booster(model_file=path)
+        np.testing.assert_allclose(bst3.predict(X), p, rtol=1e-6)
+
+
+def test_model_format_headers(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary"}, lgb.Dataset(X, y), 3)
+    s = bst.model_to_string()
+    lines = s.splitlines()
+    assert lines[0] == "tree"
+    assert lines[1] == "version=v3"
+    assert any(l.startswith("objective=binary") for l in lines)
+    assert any(l.startswith("feature_names=") for l in lines)
+    assert any(l.startswith("tree_sizes=") for l in lines)
+    assert any(l.startswith("Tree=0") for l in lines)
+    assert "end of trees" in s
+    assert "feature_importances:" in s
+    # per-tree blocks carry the reference keys
+    for key in ("num_leaves=", "split_feature=", "threshold=",
+                "decision_type=", "left_child=", "right_child=",
+                "leaf_value=", "internal_count=", "shrinkage="):
+        assert key in s
+
+
+def test_multiclass_roundtrip(multiclass_data):
+    X, y = multiclass_data
+    bst = lgb.train({**SMALL, "objective": "multiclass", "num_class": 3},
+                    lgb.Dataset(X, y), 5)
+    p = bst.predict(X)
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst2.predict(X), p, rtol=1e-5)
+
+
+def test_dump_model(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary"}, lgb.Dataset(X, y), 3)
+    d = bst.dump_model()
+    assert d["version"] == "v3"
+    assert d["num_class"] == 1
+    assert len(d["tree_info"]) == 3
+    t0 = d["tree_info"][0]["tree_structure"]
+    assert "split_feature" in t0
+    assert "left_child" in t0
+    # leaf count reachable from structure equals num_leaves
+    def count_leaves(node):
+        if "split_feature" not in node:
+            return 1
+        return count_leaves(node["left_child"]) + count_leaves(node["right_child"])
+    assert count_leaves(t0) == d["tree_info"][0]["num_leaves"]
+
+
+def test_pred_leaf(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary"}, lgb.Dataset(X, y), 4)
+    leaves = bst.predict(X[:50], pred_leaf=True)
+    assert leaves.shape == (50, 4)
+    assert (leaves >= 0).all()
+    assert (leaves < 7).all()
+
+
+def test_pred_contrib(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary"}, lgb.Dataset(X, y), 3)
+    contrib = bst.predict(X[:20], pred_contrib=True)
+    assert contrib.shape == (20, X.shape[1] + 1)
+    raw = bst.predict(X[:20], raw_score=True)
+    # SHAP sums to the raw prediction
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4, atol=1e-4)
+
+
+def test_feature_importance(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary"}, lgb.Dataset(X, y), 5)
+    imp_split = bst.feature_importance("split")
+    imp_gain = bst.feature_importance("gain")
+    assert imp_split.shape == (X.shape[1],)
+    assert imp_split.sum() > 0
+    assert imp_gain.sum() > 0
+    # the truly predictive feature (0) should matter
+    assert imp_split[0] > 0
+
+
+def test_save_binary_dataset(tmp_path, binary_data):
+    X, y = binary_data
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    path = str(tmp_path / "data.bin")
+    ds.save_binary(path)
+    ds2 = lgb.Dataset.load_binary(path)
+    assert ds2.num_data() == ds.num_data()
+    assert ds2.num_feature() == ds.num_feature()
+    np.testing.assert_array_equal(ds2.X_binned, ds.X_binned)
+    np.testing.assert_array_equal(ds2.get_label(), ds.get_label())
+    # trainable
+    bst = lgb.train({**SMALL, "objective": "binary"}, ds2, 3)
+    assert bst.num_trees() == 3
+
+
+def test_num_iteration_predict(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary"}, lgb.Dataset(X, y), 10)
+    p5 = bst.predict(X, num_iteration=5, raw_score=True)
+    p10 = bst.predict(X, raw_score=True)
+    assert not np.allclose(p5, p10)

@@ -1,0 +1,58 @@
+"""Distributed learner tests on the 8-device CPU mesh (SURVEY.md §4: the
+reference's test_dask.py pattern — N workers on localhost, compare to
+serial — becomes mesh-sharded training compared to the serial learner)."""
+
+import jax
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+SMALL = {"num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1}
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(11)
+    n = 700  # deliberately not divisible by 8 to exercise padding
+    X = rng.randn(n, 6)
+    y = ((X[:, 0] + 0.5 * X[:, 1] - X[:, 2] ** 2 * 0.2) > 0).astype(np.float64)
+    return X, y
+
+
+def test_mesh_available():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("tree_learner", ["data", "feature", "voting"])
+def test_parallel_matches_serial(tree_learner, data):
+    X, y = data
+    p = {}
+    for tl in ("serial", tree_learner):
+        bst = lgb.train({**SMALL, "objective": "binary", "tree_learner": tl},
+                        lgb.Dataset(X, y), 5)
+        p[tl] = bst.predict(X)
+    np.testing.assert_allclose(p[tree_learner], p["serial"], atol=2e-5)
+
+
+def test_data_parallel_regression(data):
+    X, y = data
+    yr = X[:, 0] * 2 + np.sin(X[:, 1])
+    serial = lgb.train({**SMALL, "objective": "regression"},
+                       lgb.Dataset(X, yr), 5).predict(X)
+    dp = lgb.train({**SMALL, "objective": "regression",
+                    "tree_learner": "data"}, lgb.Dataset(X, yr), 5).predict(X)
+    np.testing.assert_allclose(dp, serial, atol=1e-4)
+
+
+def test_voting_with_many_features():
+    rng = np.random.RandomState(1)
+    n, f = 640, 24
+    X = rng.randn(n, f)
+    y = (X[:, :4].sum(axis=1) > 0).astype(np.float64)
+    bst = lgb.train({**SMALL, "objective": "binary", "tree_learner": "voting",
+                     "top_k": 5}, lgb.Dataset(X, y), 5)
+    p = bst.predict(X)
+    # voting restricts aggregated features but must still learn the signal
+    order = np.argsort(-p)
+    assert y[order[: n // 4]].mean() > 0.8

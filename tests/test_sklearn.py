@@ -1,0 +1,84 @@
+"""sklearn API tests (analog of reference test_sklearn.py)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import LGBMClassifier, LGBMRanker, LGBMRegressor
+
+KW = dict(num_leaves=7, min_child_samples=5, n_estimators=10)
+
+
+def test_regressor(regression_data):
+    X, y = regression_data
+    m = LGBMRegressor(**KW).fit(X, y)
+    p = m.predict(X)
+    assert np.mean((p - y) ** 2) < 0.5 * np.var(y)
+    assert m.n_features_ == X.shape[1]
+    assert m.feature_importances_.shape == (X.shape[1],)
+
+
+def test_classifier_binary(binary_data):
+    X, y = binary_data
+    m = LGBMClassifier(**KW).fit(X, y)
+    pred = m.predict(X)
+    assert set(np.unique(pred)) <= set(np.unique(y))
+    assert (pred == y).mean() > 0.9
+    proba = m.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_classifier_multiclass(multiclass_data):
+    X, y = multiclass_data
+    m = LGBMClassifier(**KW).fit(X, y)
+    assert m.n_classes_ == 3
+    proba = m.predict_proba(X)
+    assert proba.shape == (len(y), 3)
+    assert (m.predict(X) == y).mean() > 0.85
+
+
+def test_classifier_string_labels(binary_data):
+    X, y = binary_data
+    ys = np.where(y > 0, "pos", "neg")
+    m = LGBMClassifier(**KW).fit(X, ys)
+    pred = m.predict(X)
+    assert set(np.unique(pred)) <= {"pos", "neg"}
+    assert (pred == ys).mean() > 0.9
+
+
+def test_ranker(rank_data):
+    X, y, group = rank_data
+    m = LGBMRanker(**KW, learning_rate=0.2).fit(X, y, group=group)
+    p = m.predict(X)
+    assert np.corrcoef(p, y)[0, 1] > 0.4
+
+
+def test_eval_set_early_stopping():
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 5)
+    y = X[:, 0] + 1.5 * rng.randn(200)
+    m = LGBMRegressor(**dict(KW, n_estimators=100, learning_rate=0.5,
+                             min_child_samples=2))
+    m.fit(X[:120], y[:120], eval_set=[(X[120:], y[120:])], eval_metric="l2",
+          early_stopping_rounds=5)
+    assert 0 < m.best_iteration_ < 100
+    assert "valid_0" in m.evals_result_
+
+
+def test_get_set_params():
+    m = LGBMRegressor(num_leaves=15, learning_rate=0.2)
+    p = m.get_params()
+    assert p["num_leaves"] == 15
+    m.set_params(num_leaves=31)
+    assert m.num_leaves == 31
+
+
+def test_custom_objective(regression_data):
+    X, y = regression_data
+
+    def l2_obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_pred)
+
+    m = LGBMRegressor(**KW, objective=l2_obj).fit(X, y)
+    p = m.predict(X, raw_score=True)
+    assert np.mean((p - y) ** 2) < 0.6 * np.var(y)

@@ -1,0 +1,80 @@
+"""Tree learner structural tests: partition/count consistency, determinism,
+and agreement between the binned device walk, the raw device walk, and the
+host reference predictor."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.tree import TreeBatch, predict_binned, predict_raw
+
+SMALL = {"num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1}
+
+
+def test_tree_counts_consistent(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary"}, lgb.Dataset(X, y), 3)
+    for tree in bst._gbdt.models:
+        nl = tree.num_leaves
+        # leaf counts sum to total rows
+        assert tree.leaf_count[:nl].sum() == len(y)
+        # each internal node's count equals its children's counts
+        for i in range(nl - 1):
+            def cnt(c):
+                return (tree.leaf_count[~c] if c < 0
+                        else tree.internal_count[c])
+            assert tree.internal_count[i] == cnt(tree.left_child[i]) + \
+                cnt(tree.right_child[i])
+
+
+def test_determinism(binary_data):
+    X, y = binary_data
+    p1 = lgb.train({**SMALL, "objective": "binary"},
+                   lgb.Dataset(X, y), 5).predict(X)
+    p2 = lgb.train({**SMALL, "objective": "binary"},
+                   lgb.Dataset(X, y), 5).predict(X)
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_walks_agree(binary_data):
+    """Binned walk (training) == raw walk (inference) == host predictor."""
+    import jax.numpy as jnp
+    X, y = binary_data
+    ds = lgb.Dataset(X, y)
+    bst = lgb.train({**SMALL, "objective": "binary"}, ds, 4)
+    gbdt = bst._gbdt
+    batch = TreeBatch(gbdt.models)
+    raw_dev = np.asarray(predict_raw(
+        batch, jnp.asarray(X[:, gbdt.train_set.used_feature_map], jnp.float32)))
+    binned_dev = np.asarray(predict_binned(
+        batch, jnp.asarray(gbdt.train_set.X_binned)))
+    host = sum(t.predict(X[:, gbdt.train_set.used_feature_map])
+               for t in gbdt.models)
+    np.testing.assert_allclose(raw_dev, binned_dev, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(raw_dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_min_data_in_leaf_respected(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary", "min_data_in_leaf": 50},
+                    lgb.Dataset(X, y), 3)
+    for tree in bst._gbdt.models:
+        assert (tree.leaf_count[:tree.num_leaves] >= 50).all()
+
+
+def test_num_leaves_limit(binary_data):
+    X, y = binary_data
+    bst = lgb.train({**SMALL, "objective": "binary", "num_leaves": 4},
+                    lgb.Dataset(X, y), 3)
+    for tree in bst._gbdt.models:
+        assert tree.num_leaves <= 4
+
+
+def test_stops_when_no_gain():
+    # constant-ish labels: after a couple of trees no split improves
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 3)
+    y = np.ones(200)
+    bst = lgb.train({**SMALL, "objective": "regression"}, lgb.Dataset(X, y), 5)
+    p = bst.predict(X)
+    np.testing.assert_allclose(p, 1.0, atol=1e-5)
