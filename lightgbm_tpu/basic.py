@@ -85,7 +85,9 @@ class Booster:
         return self._gbdt.num_tree_per_iteration
 
     def num_feature(self) -> int:
-        return self._gbdt.num_features
+        # reference reports the ORIGINAL column count (num_total_features),
+        # not the post-trivial-filter inner count
+        return self._gbdt.feature_mapping()[1]
 
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         self._gbdt.add_valid(data, name)
@@ -166,11 +168,9 @@ class Booster:
         return self._gbdt.feature_importance(importance_type)
 
     def feature_name(self) -> List[str]:
-        ts = self._gbdt.train_set
-        if ts is not None:
-            return ts.feature_names
-        return getattr(self._gbdt, "feature_names_", None) or \
-            [f"Column_{i}" for i in range(self._gbdt.num_features)]
+        # full ORIGINAL column names (reference returns num_total_features
+        # names, matching num_feature()/feature_importance() lengths)
+        return self._gbdt.feature_mapping()[2]
 
     # network emulation (reference basic.py:2178 set_network) ---------------
     def set_network(self, machines, local_listen_port: int = 12400,

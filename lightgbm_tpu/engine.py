@@ -34,11 +34,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
     params = dict(params or {})
     params.update(kwargs)
     cfg = Config(params)
-    if "num_iterations" in Config(params).to_dict() and \
-            any(k in params for k in ("num_iterations", "num_iteration",
-                                      "n_iter", "num_boost_round", "num_round",
-                                      "num_rounds", "num_trees", "num_tree",
-                                      "n_estimators")):
+    if any(k in params for k in ("num_iterations", "num_iteration",
+                                 "n_iter", "num_boost_round", "num_round",
+                                 "num_rounds", "num_trees", "num_tree",
+                                 "n_estimators")):
         num_boost_round = cfg.num_iterations
     if fobj is not None:
         params["objective"] = "none"
@@ -195,8 +194,8 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     es_round = cfg.early_stopping_round
     best_iter = num_boost_round
     stopped = False
-    best_scores = collections.defaultdict(lambda: float("inf"))
-    rounds_no_improve = 0
+    best_signed: Dict[str, float] = {}
+    best_it_per_key: Dict[str, int] = {}
     for it in range(num_boost_round):
         agg = collections.defaultdict(list)
         hib_map = {}
@@ -208,25 +207,33 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
             if eval_train_metric:
                 for ds, name, val, hib in bst.eval_train(feval):
                     agg[f"train {name}"].append(val)
-        improved = False
+        # early stopping tracks VALIDATION metrics only (reference cv
+        # semantics; train metrics are reported but never gate stopping);
+        # first_metric_only restricts to the first validation metric key.
+        # Stop as soon as ANY tracked metric stalls es_round rounds
+        # (reference early_stopping callback semantics, callback.py:147).
+        es_keys = [k for k in agg if not k.startswith("train ")]
+        if cfg.first_metric_only and es_keys:
+            es_keys = es_keys[:1]
         for key, vals in agg.items():
             results[f"{key}-mean"].append(float(np.mean(vals)))
             results[f"{key}-stdv"].append(float(np.std(vals)))
+            if key not in es_keys:
+                continue
             hib = hib_map.get(key, False)
             cur = float(np.mean(vals))
             signed = -cur if hib else cur
-            if signed < best_scores[key]:
-                best_scores[key] = signed
-                improved = True
+            if key not in best_signed or signed < best_signed[key]:
+                best_signed[key] = signed
+                best_it_per_key[key] = it + 1
         if es_round and es_round > 0:
-            if improved:
-                rounds_no_improve = 0
-                best_iter = it + 1
-            else:
-                rounds_no_improve += 1
-                if rounds_no_improve >= es_round:
+            for key in es_keys:
+                if it + 1 - best_it_per_key.get(key, it + 1) >= es_round:
                     stopped = True
+                    best_iter = best_it_per_key[key]
                     break
+            if stopped:
+                break
     out = dict(results)
     if stopped:
         for k in out:

@@ -233,9 +233,25 @@ class GBDT:
                                      "(custom objective path, boosting.h:85)")
                 grad, hess = self.objective.get_gradients(self.score)
             else:
-                grad = jnp.asarray(grad, jnp.float32).reshape(
-                    (self.num_data,) if k == 1 else (self.num_data, k))
-                hess = jnp.asarray(hess, jnp.float32).reshape(grad.shape)
+                def _coerce(a):
+                    a = jnp.asarray(a, jnp.float32)
+                    if k == 1:
+                        return a.reshape((self.num_data,))
+                    if a.ndim == 2:
+                        if a.shape == (self.num_data, k):
+                            return a
+                        if a.shape == (k, self.num_data):
+                            return a.T
+                        raise ValueError(
+                            f"custom objective gradients have shape {a.shape}; "
+                            f"expected ({self.num_data}, {k}) or flat "
+                            f"class-major length {self.num_data * k}")
+                    # flat custom-fobj output is CLASS-MAJOR in the reference
+                    # API (grouped by class_id then row_id, c_api.cpp
+                    # UpdateOneIterCustom convention)
+                    return a.reshape((k, self.num_data)).T
+                grad = _coerce(grad)
+                hess = _coerce(hess)
 
             finished = True
             fmask = self._feature_mask()
@@ -479,7 +495,11 @@ class GBDT:
         return model_to_string(self, start_iteration, num_iteration)
 
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
-        """Reference Booster::FeatureImportance (gbdt.cpp)."""
+        """Reference Booster::FeatureImportance (gbdt.cpp).
+
+        Returns a full-length array over the ORIGINAL columns (the reference
+        reports num_total_features entries; trivially-filtered columns get
+        zero), so ``zip(X.columns, importances)`` works."""
         imp = np.zeros(self.num_features, np.float64)
         for tree in self.models:
             for i in range(tree.num_leaves - 1):
@@ -489,4 +509,23 @@ class GBDT:
                         imp[f] += 1.0
                     else:
                         imp[f] += max(tree.split_gain[i], 0.0)
-        return imp
+        real_map, num_total, _ = self.feature_mapping()
+        full = np.zeros(num_total, np.float64)
+        full[real_map] = imp
+        return full
+
+    def feature_mapping(self):
+        """(inner->original index map, num original columns, original names) —
+        the single source for mapping tree-internal feature indices back to
+        the user's columns (trained models: Dataset's trivial-filter map;
+        loaded models: identity over max_feature_idx+1)."""
+        ts = self.train_set
+        if ts is not None and ts.used_feature_map is not None:
+            return (np.asarray(ts.used_feature_map),
+                    int(ts.num_total_features), list(ts.feature_names_))
+        num_total = int(getattr(self, "loaded_num_total", self.num_features))
+        real_map = np.asarray(getattr(self, "loaded_real_map",
+                                      np.arange(self.num_features)))
+        names = getattr(self, "loaded_feature_names", None) or \
+            [f"Column_{i}" for i in range(num_total)]
+        return real_map, num_total, names

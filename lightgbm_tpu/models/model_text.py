@@ -104,10 +104,8 @@ def _tree_to_string(tree: Tree, real_feature_map: np.ndarray, index: int) -> str
 def model_to_string(gbdt, start_iteration: int = 0,
                     num_iteration: int = -1) -> str:
     ds = gbdt.train_set
+    real_map, num_total, feature_names = gbdt.feature_mapping()
     if ds is not None:
-        real_map = np.asarray(ds.used_feature_map)
-        num_total = ds.num_total_features
-        feature_names = list(ds.feature_names_)
         infos = []
         for j in range(num_total):
             m = ds.bin_mappers[j]
@@ -118,11 +116,6 @@ def model_to_string(gbdt, start_iteration: int = 0,
             else:
                 infos.append(f"[{_fmt(m.min_value)}:{_fmt(m.max_value)}]")
     else:
-        real_map = np.asarray(getattr(gbdt, "loaded_real_map",
-                                      np.arange(gbdt.num_features)))
-        num_total = getattr(gbdt, "loaded_num_total", gbdt.num_features)
-        feature_names = getattr(gbdt, "loaded_feature_names",
-                                [f"Column_{i}" for i in range(num_total)])
         infos = getattr(gbdt, "loaded_feature_infos", ["none"] * num_total)
 
     k = gbdt.num_tree_per_iteration
@@ -150,9 +143,9 @@ def model_to_string(gbdt, start_iteration: int = 0,
 
     tail = io.StringIO()
     tail.write("end of trees\n\n")
+    # feature_importance is full-length over ORIGINAL columns already
     imp = gbdt.feature_importance("split")
-    pairs = sorted(((imp[i], feature_names[int(real_map[i])] if ds is not None
-                     else feature_names[i])
+    pairs = sorted(((imp[i], feature_names[i])
                     for i in range(len(imp)) if imp[i] > 0), reverse=True)
     tail.write("feature_importances:\n")
     for val, name in pairs:
@@ -329,12 +322,7 @@ def _tree_from_block(block: Dict[str, str]) -> Tree:
 def model_to_dict(gbdt, start_iteration: int = 0,
                   num_iteration: int = -1) -> Dict[str, Any]:
     """JSON model dump (reference gbdt_model_text.cpp:24 DumpModel)."""
-    ds = gbdt.train_set
-    real_map = (np.asarray(ds.used_feature_map) if ds is not None
-                else np.arange(gbdt.num_features))
-    feature_names = (list(ds.feature_names_) if ds is not None else
-                     getattr(gbdt, "loaded_feature_names",
-                             [f"Column_{i}" for i in range(gbdt.num_features)]))
+    real_map, _num_total, feature_names = gbdt.feature_mapping()
     k = gbdt.num_tree_per_iteration
     t0 = start_iteration * k
     t1 = len(gbdt.models) if num_iteration <= 0 else min(
@@ -386,7 +374,7 @@ def model_to_dict(gbdt, start_iteration: int = 0,
         "average_output": getattr(gbdt, "name", "gbdt") == "rf",
         "feature_names": feature_names,
         "feature_importances": {
-            feature_names[int(real_map[i])]: float(v)
+            feature_names[i]: float(v)
             for i, v in enumerate(gbdt.feature_importance("split")) if v > 0},
         "tree_info": tree_infos,
     }

@@ -128,8 +128,11 @@ class Quantile(RegressionL2):
         self.alpha = float(config.alpha)
 
     def _grad_hess(self, score):
+        # reference regression_objective.hpp:496-499: grad = (1-alpha) when
+        # delta >= 0 else -alpha, so gradient equilibrium targets the
+        # alpha-quantile (pinball loss d/ds)
         diff = score - self.label
-        grad = jnp.where(diff >= 0, self.alpha, self.alpha - 1.0)
+        grad = jnp.where(diff >= 0, 1.0 - self.alpha, -self.alpha)
         return grad, jnp.ones_like(score)
 
     def boost_from_score(self, class_id: int = 0) -> float:
