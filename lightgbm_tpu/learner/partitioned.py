@@ -1,0 +1,365 @@
+"""Partition-ordered leaf-wise tree grower — the fast single-chip path.
+
+TPU-native analog of the reference's DataPartition (data_partition.hpp:170):
+where the reference keeps, per leaf, a contiguous span of row indices and
+stable-partitions it on every split, this grower keeps the PACKED ROW DATA
+itself leaf-contiguous.  Every per-split operation then works on a
+``dynamic_slice`` of the split leaf's segment — there are NO full-N passes
+per split (the v1 grower in serial.py pays several: mask rebuild, cumsum,
+searchsorted compaction, full-N partition update), which is what dominated
+its runtime at 255 leaves.
+
+Packed layout ``P`` (N, W) uint8, leaf-segment ordered:
+
+    [ bin codes (F) | grad f32 (4) | hess f32 (4) | orig row idx i32 (4)
+      | bag byte (1) | zero pad to W ]
+
+grad/hess are pre-multiplied by the bagging mask; the bag byte carries the
+mask itself for the histogram count channel.  One packed row-scatter per
+split moves each row of the split leaf to its child's side (rows move ~depth
+times per tree, the same volume as the reference's index partition), and the
+smaller child's histogram reads a contiguous slice — no gather at all —
+feeding the Pallas MXU kernel (ops/histogram_pallas.py) or the portable
+scatter-add path (CPU tests).
+
+Segment slices use a power-of-two bucket ladder of static sizes (jit needs
+static shapes); slices are ~free on TPU (contiguous DMA) so the ladder is
+fine-grained, unlike serial.py's gather buckets.
+
+Leaf-wise semantics (best-first by gain, serial_tree_learner.cpp:158-209),
+histogram subtraction trick (:311-320), and the split candidate logic are
+identical to serial.py — the two growers are cross-checked by tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
+from ..ops.histogram import build_histogram
+from ..ops.split import NEG_INF, leaf_output
+from .serial import CommStrategy, GrownTree
+
+__all__ = ["make_partitioned_grow_fn", "PART_ROW_BLOCK"]
+
+PART_ROW_BLOCK = 4096  # ladder quantum; == Pallas kernel row block
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _bucket_ladder(n: int, base: int = PART_ROW_BLOCK):
+    """Static power-of-two segment sizes: base, 2*base, ..., n.
+
+    All sizes are <= n (dynamic_slice cannot exceed the array); when n is a
+    multiple of ``base`` (the Pallas path pads to this) every size is too."""
+    base = min(base, n)
+    sizes = []
+    s = base
+    while s < n:
+        sizes.append(s)
+        s *= 2
+    sizes.append(n)
+    return sizes
+
+
+def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
+                             max_bins: int, max_depth: int, split_params,
+                             hist_impl: str, interpret: bool = False,
+                             jit: bool = True):
+    """Build the partition-ordered single-tree grower.
+
+    Returned signature:
+    ``grow(X, grad, hess, bag_mask, num_bins, is_cat, has_nan, feature_mask)
+    -> GrownTree`` with X (N, F) uint8 bin codes, N a multiple of
+    PART_ROW_BLOCK (pad rows with bag_mask 0).
+    """
+    L = num_leaves
+    F = num_features
+    W = _round_up(F + 13, 8)
+    pallas = hist_impl == "pallas"
+    if pallas:
+        from ..ops.histogram_pallas import build_histogram_pallas
+
+    sp = split_params
+    strat_template = None  # serial only; parallel strategies use serial.py
+
+    def _hist_from_seg(seg, valid):
+        """(F, B, 3) histogram of one packed segment (seg: (S, W) u8)."""
+        bins_rows = seg[:, :F]
+        gm = jax.lax.bitcast_convert_type(seg[:, F:F + 4], jnp.float32)
+        hm = jax.lax.bitcast_convert_type(seg[:, F + 4:F + 8], jnp.float32)
+        bag = seg[:, F + 12].astype(jnp.float32)
+        mask = bag * valid
+        if pallas:
+            return build_histogram_pallas(
+                jnp.swapaxes(bins_rows, 0, 1), gm, hm, mask,
+                num_bins=max_bins, interpret=interpret)
+        return build_histogram(bins_rows, gm, hm, mask, num_bins=max_bins,
+                               impl=hist_impl)
+
+    def grow(X: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+             bag_mask: jnp.ndarray, num_bins: jnp.ndarray,
+             is_cat: jnp.ndarray, has_nan: jnp.ndarray,
+             feature_mask: jnp.ndarray) -> GrownTree:
+        n = X.shape[0]
+        strat = CommStrategy(num_bins, is_cat, has_nan)
+
+        # ---- pack rows: bins | grad*bag | hess*bag | orig idx | bag ----
+        gm = (grad * bag_mask).astype(jnp.float32)
+        hm = (hess * bag_mask).astype(jnp.float32)
+        P = jnp.concatenate([
+            X.astype(jnp.uint8),
+            jax.lax.bitcast_convert_type(gm, jnp.uint8),
+            jax.lax.bitcast_convert_type(hm, jnp.uint8),
+            jax.lax.bitcast_convert_type(
+                jnp.arange(n, dtype=jnp.int32), jnp.uint8),
+            (bag_mask > 0).astype(jnp.uint8)[:, None],
+            jnp.zeros((n, W - F - 13), jnp.uint8),
+        ], axis=1)
+
+        ladder = _bucket_ladder(n)
+
+        root_hist = _hist_from_seg(P, jnp.ones((n,), jnp.float32))
+        root_sum = jnp.stack([jnp.sum(gm), jnp.sum(hm), jnp.sum(bag_mask)])
+        cand = strat.leaf_candidates(root_hist, root_sum, feature_mask, sp)
+
+        state = {
+            "P": P,
+            "leaf_start": jnp.full((L,), n, jnp.int32).at[0].set(0),
+            "leaf_seg": jnp.zeros((L,), jnp.int32).at[0].set(n),
+            "leaf_sum": jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum),
+            "leaf_depth": jnp.zeros((L,), jnp.int32),
+            "leaf_parent": jnp.full((L,), -1, jnp.int32),
+            "cand_gain": jnp.full((L,), NEG_INF, jnp.float32).at[0].set(cand[0]),
+            "cand_feat": jnp.zeros((L,), jnp.int32).at[0].set(cand[1]),
+            "cand_bin": jnp.zeros((L,), jnp.int32).at[0].set(cand[2]),
+            "cand_dleft": jnp.zeros((L,), jnp.bool_).at[0].set(cand[3]),
+            "cand_lsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[4]),
+            "cand_rsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[5]),
+            "hists": jnp.zeros((L, F, max_bins, 3), jnp.float32).at[0].set(
+                root_hist),
+            "split_feature": jnp.full((L - 1,), -1, jnp.int32),
+            "threshold_bin": jnp.zeros((L - 1,), jnp.int32),
+            "nan_bin": jnp.full((L - 1,), -1, jnp.int32),
+            "decision_type": jnp.zeros((L - 1,), jnp.int32),
+            "left_child": jnp.zeros((L - 1,), jnp.int32),
+            "right_child": jnp.zeros((L - 1,), jnp.int32),
+            "split_gain": jnp.zeros((L - 1,), jnp.float32),
+            "internal_value": jnp.zeros((L - 1,), jnp.float32),
+            "internal_weight": jnp.zeros((L - 1,), jnp.float32),
+            "internal_count": jnp.zeros((L - 1,), jnp.float32),
+            "leaf_value": jnp.zeros((L,), jnp.float32).at[0].set(
+                leaf_output(root_sum[0], root_sum[1], sp)),
+            "leaf_weight": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[1]),
+            "leaf_count": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[2]),
+            "num_leaves": jnp.asarray(1, jnp.int32),
+            "done": jnp.asarray(False),
+        }
+
+        nb_full, ic_full, hn_full = num_bins, is_cat, has_nan
+
+        def partition_branch(psize):
+            """Stable-partition the split leaf's segment of (static) size
+            ``psize`` (DataPartition::Split analog) and return
+            (P_new, n_left_segment).
+
+            dynamic_slice clamps the start when start+psize > n, so the
+            segment's rows live at offset ``off = start - clamped_start``
+            within the slice; rows outside [off, off+cnt) belong to other
+            leaves and must not move."""
+            def fn(op):
+                P, start, cnt, feat, thr, dleft, fcat, fnanb = op
+                cstart = jnp.minimum(start, n - psize)
+                off = start - cstart
+                seg = jax.lax.dynamic_slice(P, (cstart, 0), (psize, W))
+                col = jax.lax.dynamic_slice(seg, (0, feat),
+                                            (psize, 1))[:, 0].astype(jnp.int32)
+                pos_idx = jnp.arange(psize, dtype=jnp.int32)
+                valid = (pos_idx >= off) & (pos_idx < off + cnt)
+                is_nanbin = col == fnanb
+                go_left = jnp.where(fcat, col == thr,
+                                    jnp.where(is_nanbin, dleft, col <= thr))
+                gl = go_left & valid
+                gr = jnp.logical_and(valid, jnp.logical_not(go_left))
+                cl = jnp.cumsum(gl.astype(jnp.int32))
+                nl = cl[-1]
+                cr = jnp.cumsum(gr.astype(jnp.int32))
+                pos = off + jnp.where(gl, cl - 1, nl + cr - 1)
+                pos = jnp.where(valid, pos, psize)  # dropped
+                seg_new = seg.at[pos].set(seg, mode="drop")
+                P = jax.lax.dynamic_update_slice(P, seg_new, (cstart, 0))
+                return P, nl
+            return fn
+
+        def hist_branch(csize):
+            def fn(op):
+                P, start, cnt = op
+                cstart = jnp.minimum(start, n - csize)
+                off = start - cstart
+                seg = jax.lax.dynamic_slice(P, (cstart, 0), (csize, W))
+                pos_idx = jnp.arange(csize, dtype=jnp.int32)
+                valid = ((pos_idx >= off) & (pos_idx < off + cnt)
+                         ).astype(jnp.float32)
+                return _hist_from_seg(seg, valid)
+            return fn
+
+        part_fns = [partition_branch(s) for s in ladder]
+        hist_fns = [hist_branch(s) for s in ladder]
+
+        def pick(cnt):
+            """Index of the smallest ladder size >= cnt."""
+            sel = jnp.zeros((), jnp.int32)
+            for i, s in enumerate(ladder[:-1]):
+                sel = sel + (cnt > s).astype(jnp.int32)
+            return sel
+
+        def body(t, s):
+            best_leaf = jnp.argmax(s["cand_gain"]).astype(jnp.int32)
+            bgain = s["cand_gain"][best_leaf]
+            do = jnp.logical_and(jnp.logical_not(s["done"]), bgain > 0)
+
+            feat = s["cand_feat"][best_leaf]
+            thr = s["cand_bin"][best_leaf]
+            dleft = s["cand_dleft"][best_leaf]
+            lsum = s["cand_lsum"][best_leaf]
+            rsum = s["cand_rsum"][best_leaf]
+            psum_ = s["leaf_sum"][best_leaf]
+            new_id = (t + 1).astype(jnp.int32)
+
+            start = s["leaf_start"][best_leaf]
+            seg_cnt = jnp.where(do, s["leaf_seg"][best_leaf], 0)
+            fcat = ic_full[feat]
+            fnan = hn_full[feat]
+            f_nan_bin = jnp.where(fnan, nb_full[feat] - 1, -1)
+
+            P_new, nl = jax.lax.switch(
+                pick(seg_cnt), part_fns,
+                (s["P"], start, seg_cnt, feat, thr, dleft, fcat, f_nan_bin))
+            nr = seg_cnt - nl
+
+            # ---- smaller-child histogram on its contiguous segment ----
+            left_smaller = lsum[2] <= rsum[2]
+            s_start = jnp.where(left_smaller, start, start + nl)
+            s_cnt = jnp.where(do, jnp.where(left_smaller, nl, nr), 0)
+            hist_small = jax.lax.switch(pick(s_cnt), hist_fns,
+                                        (P_new, s_start, s_cnt))
+            parent_hist = s["hists"][best_leaf]
+            hist_big = parent_hist - hist_small
+            hist_left = jnp.where(left_smaller, hist_small, hist_big)
+            hist_right = jnp.where(left_smaller, hist_big, hist_small)
+
+            # ---- children candidates ----
+            child_depth = s["leaf_depth"][best_leaf] + 1
+            depth_ok = jnp.logical_or(max_depth <= 0, child_depth < max_depth)
+            cl = strat.leaf_candidates(hist_left, lsum, feature_mask, sp)
+            cr = strat.leaf_candidates(hist_right, rsum, feature_mask, sp)
+            gl_ = jnp.where(depth_ok, cl[0], NEG_INF)
+            gr_ = jnp.where(depth_ok, cr[0], NEG_INF)
+
+            node = t
+            dleft_rec = jnp.where(fcat, thr == 0, dleft)
+            dt_bits = (jnp.where(fcat, CAT_MASK, 0) |
+                       jnp.where(dleft_rec, DEFAULT_LEFT_MASK, 0) |
+                       jnp.where(fnan & jnp.logical_not(fcat), MISSING_NAN, 0)
+                       ).astype(jnp.int32)
+            parent_node = s["leaf_parent"][best_leaf]
+            enc_best = -(best_leaf + 1)
+            node_idx = jnp.arange(L - 1, dtype=jnp.int32)
+            patch_l = (node_idx == parent_node) & \
+                (s["left_child"] == enc_best) & do
+            patch_r = (node_idx == parent_node) & \
+                (s["right_child"] == enc_best) & do
+            left_child = jnp.where(patch_l, node, s["left_child"])
+            right_child = jnp.where(patch_r, node, s["right_child"])
+
+            def upd(arr, idx, val):
+                return arr.at[idx].set(jnp.where(do, val, arr[idx]))
+
+            out = dict(s)
+            out["P"] = P_new
+            out["leaf_start"] = upd(upd(s["leaf_start"], best_leaf, start),
+                                    new_id, start + nl)
+            out["leaf_seg"] = upd(upd(s["leaf_seg"], best_leaf, nl),
+                                  new_id, nr)
+            hists = s["hists"]
+            hists = hists.at[best_leaf].set(
+                jnp.where(do, hist_left, hists[best_leaf]))
+            hists = hists.at[new_id].set(
+                jnp.where(do, hist_right, hists[new_id]))
+            out["hists"] = hists
+            out["leaf_sum"] = upd(upd(s["leaf_sum"], best_leaf, lsum),
+                                  new_id, rsum)
+            out["leaf_depth"] = upd(upd(s["leaf_depth"], best_leaf,
+                                        child_depth), new_id, child_depth)
+            out["leaf_parent"] = upd(upd(s["leaf_parent"], best_leaf, node),
+                                     new_id, node)
+            out["cand_gain"] = upd(upd(s["cand_gain"], best_leaf, gl_),
+                                   new_id, gr_)
+            out["cand_feat"] = upd(upd(s["cand_feat"], best_leaf, cl[1]),
+                                   new_id, cr[1])
+            out["cand_bin"] = upd(upd(s["cand_bin"], best_leaf, cl[2]),
+                                  new_id, cr[2])
+            out["cand_dleft"] = upd(upd(s["cand_dleft"], best_leaf, cl[3]),
+                                    new_id, cr[3])
+            out["cand_lsum"] = upd(upd(s["cand_lsum"], best_leaf, cl[4]),
+                                   new_id, cr[4])
+            out["cand_rsum"] = upd(upd(s["cand_rsum"], best_leaf, cl[5]),
+                                   new_id, cr[5])
+            out["split_feature"] = upd(s["split_feature"], node, feat)
+            out["threshold_bin"] = upd(s["threshold_bin"], node, thr)
+            out["nan_bin"] = upd(s["nan_bin"], node, f_nan_bin)
+            out["decision_type"] = upd(s["decision_type"], node, dt_bits)
+            out["left_child"] = upd(left_child, node, enc_best)
+            out["right_child"] = upd(right_child, node, -(new_id + 1))
+            out["split_gain"] = upd(s["split_gain"], node, bgain)
+            out["internal_value"] = upd(s["internal_value"], node,
+                                        leaf_output(psum_[0], psum_[1], sp))
+            out["internal_weight"] = upd(s["internal_weight"], node, psum_[1])
+            out["internal_count"] = upd(s["internal_count"], node, psum_[2])
+            lv = upd(s["leaf_value"], best_leaf,
+                     leaf_output(lsum[0], lsum[1], sp))
+            out["leaf_value"] = upd(lv, new_id,
+                                    leaf_output(rsum[0], rsum[1], sp))
+            lw = upd(s["leaf_weight"], best_leaf, lsum[1])
+            out["leaf_weight"] = upd(lw, new_id, rsum[1])
+            lc = upd(s["leaf_count"], best_leaf, lsum[2])
+            out["leaf_count"] = upd(lc, new_id, rsum[2])
+            out["num_leaves"] = s["num_leaves"] + do.astype(jnp.int32)
+            out["done"] = jnp.logical_not(do)
+            return out
+
+        s = jax.lax.fori_loop(0, L - 1, body, state)
+
+        # ---- reconstruct row_leaf in ORIGINAL row order ----
+        # leaf id per position: markers at segment starts, forward-filled.
+        # Empty segments (possible when all in-bag rows go one way but the
+        # out-of-bag tail doesn't) must not claim their shared start.
+        starts = jnp.where((jnp.arange(L) < s["num_leaves"]) &
+                           (s["leaf_seg"] > 0), s["leaf_start"], n)
+        marker = jnp.full((n,), -1, jnp.int32)
+        marker = marker.at[starts].set(jnp.arange(L, dtype=jnp.int32),
+                                       mode="drop")
+        leaf_of_pos = jax.lax.associative_scan(
+            lambda a, b: jnp.where(b < 0, a, b), marker)
+        orig = jax.lax.bitcast_convert_type(s["P"][:, F + 8:F + 12],
+                                            jnp.int32)
+        row_leaf = jnp.zeros((n,), jnp.int32).at[orig].set(leaf_of_pos)
+
+        return GrownTree(
+            split_feature=s["split_feature"],
+            threshold_bin=s["threshold_bin"],
+            nan_bin=s["nan_bin"], decision_type=s["decision_type"],
+            left_child=s["left_child"], right_child=s["right_child"],
+            split_gain=s["split_gain"], internal_value=s["internal_value"],
+            internal_weight=s["internal_weight"],
+            internal_count=s["internal_count"], leaf_value=s["leaf_value"],
+            leaf_weight=s["leaf_weight"], leaf_count=s["leaf_count"],
+            num_leaves=s["num_leaves"], row_leaf=row_leaf)
+
+    return jax.jit(grow) if jit else grow

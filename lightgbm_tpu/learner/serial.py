@@ -132,17 +132,28 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
     """Build the single-tree grower for a fixed configuration.
 
     The returned function signature is
-    ``grow(X, grad, hess, sample_mask, num_bins, is_cat, has_nan,
+    ``grow(X, X_T, grad, hess, sample_mask, num_bins, is_cat, has_nan,
     feature_mask) -> GrownTree`` where X may be the full binned matrix
     (serial), a row shard (data/voting parallel) or a feature shard
-    (feature parallel) depending on the strategy.
+    (feature parallel) depending on the strategy.  ``X_T`` is the
+    feature-major ``(F, N)`` copy used by the Pallas histogram kernel
+    (None for the other impls); N must be padded to the kernel's row block.
     """
 
     hist_kwargs = dict(num_bins=max_bins, impl=hist_impl,
                        rows_per_chunk=rows_per_chunk)
     L = num_leaves
+    pallas = hist_impl == "pallas"
+    if pallas:
+        from ..ops.histogram_pallas import (DEFAULT_ROW_BLOCK,
+                                            build_histogram_pallas)
 
-    def grow(X: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+    def _build_hist(X, X_T, g, h, m):
+        if pallas:
+            return build_histogram_pallas(X_T, g, h, m, num_bins=max_bins)
+        return build_histogram(X, g, h, m, **hist_kwargs)
+
+    def grow(X: jnp.ndarray, X_T, grad: jnp.ndarray, hess: jnp.ndarray,
              sample_mask: jnp.ndarray, num_bins: jnp.ndarray,
              is_cat: jnp.ndarray, has_nan: jnp.ndarray,
              feature_mask: jnp.ndarray) -> GrownTree:
@@ -151,7 +162,7 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
         n, f_local = X.shape
 
         root_hist = strat.reduce_hist(
-            build_histogram(X, grad, hess, sample_mask, **hist_kwargs))
+            _build_hist(X, X_T, grad, hess, sample_mask))
         root_sum = strat.reduce_sum(jnp.stack([
             jnp.sum(grad * sample_mask),
             jnp.sum(hess * sample_mask),
@@ -171,10 +182,20 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
         rows_sharded = getattr(strat, "rows_sharded", False)
         hist_buckets = []
         _size = (n // 2 + 1) if not rows_sharded else n
-        _top = _size
-        while _size >= 4096 and len(hist_buckets) < 4:
-            hist_buckets.append(_size)
-            _size //= 4
+        if pallas:  # bucket sizes must be row-block multiples for the kernel
+            _rb = DEFAULT_ROW_BLOCK
+            _size = -(-_size // _rb) * _rb
+            _top = _size
+            while _size >= _rb and len(hist_buckets) < 4:
+                hist_buckets.append(_size)
+                _size = -(-(_size // 4) // _rb) * _rb
+                if hist_buckets[-1] == _size:
+                    break
+        else:
+            _top = _size
+            while _size >= 4096 and len(hist_buckets) < 4:
+                hist_buckets.append(_size)
+                _size //= 4
         if not hist_buckets:
             hist_buckets = [_top]
 
@@ -268,8 +289,8 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
                         hsub = jnp.take(hess, idx, mode="fill", fill_value=0.0)
                         msub = jnp.take(small_mask, idx, mode="fill",
                                         fill_value=0.0)
-                        return build_histogram(bsub, gsub, hsub, msub,
-                                               **hist_kwargs)
+                        return _build_hist(bsub, bsub.T if pallas else None,
+                                           gsub, hsub, msub)
                     return fn
 
                 if len(hist_buckets) == 1:
@@ -290,10 +311,10 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
                     sample_mask * dof
                 right_mask = (row_leaf == new_id).astype(jnp.float32) * \
                     sample_mask * dof
-                hist_left = strat.reduce_hist(build_histogram(
-                    X, grad, hess, left_mask, **hist_kwargs))
-                hist_right = strat.reduce_hist(build_histogram(
-                    X, grad, hess, right_mask, **hist_kwargs))
+                hist_left = strat.reduce_hist(_build_hist(
+                    X, X_T, grad, hess, left_mask))
+                hist_right = strat.reduce_hist(_build_hist(
+                    X, X_T, grad, hess, right_mask))
 
             # ---- children candidates ----
             child_depth = s["leaf_depth"][best_leaf] + 1
@@ -386,10 +407,22 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
     return jax.jit(grow) if jit else grow
 
 
-def resolve_hist_impl(config: Config) -> str:
+def resolve_hist_impl(config: Config, parallel: bool = False) -> str:
+    """Pick the histogram implementation (the analog of the reference's
+    col-wise/row-wise autotune, dataset.cpp:659-670, collapsed to a static
+    choice: the Pallas MXU kernel on TPU, scatter-add elsewhere).
+
+    ``parallel`` learners run the grower inside shard_map where the Pallas
+    path's transposed layout is not wired yet — they use the XLA onehot
+    formulation on TPU."""
     impl = config.tpu_histogram_impl
     if impl == "auto":
-        impl = "onehot" if jax.default_backend() == "tpu" else "segment"
+        if jax.default_backend() == "tpu":
+            impl = "onehot" if parallel else "pallas"
+        else:
+            impl = "segment"
+    elif impl == "pallas" and parallel:
+        impl = "onehot"
     return impl
 
 
@@ -435,17 +468,36 @@ class SerialTreeLearner:
         self.num_features = num_features
         self.split_params = split_params_from_config(config)
         self.use_hist_pool = hist_pool_fits(config, num_features, self.max_bins)
-        key = ("serial", int(config.num_leaves), self.max_bins,
-               int(config.max_depth), self.split_params,
-               resolve_hist_impl(config), int(config.tpu_rows_per_chunk),
-               self.use_hist_pool)
-        if key not in _GROW_FN_CACHE:
-            _GROW_FN_CACHE[key] = make_grow_fn(
-                num_leaves=int(config.num_leaves), max_bins=self.max_bins,
-                max_depth=int(config.max_depth), split_params=self.split_params,
-                hist_impl=resolve_hist_impl(config),
-                rows_per_chunk=int(config.tpu_rows_per_chunk),
-                use_hist_pool=self.use_hist_pool)
+        impl = resolve_hist_impl(config)
+        self.pallas = impl == "pallas"
+        self._x_cache_key = None
+        # The partition-ordered grower (learner/partitioned.py) is the
+        # default serial path — no full-N work per split.  The masked
+        # grower below remains for the pool-less huge-feature fallback and
+        # as the shared body of the parallel strategies.
+        self.partitioned = self.use_hist_pool
+        if self.partitioned:
+            key = ("part", int(config.num_leaves), num_features,
+                   self.max_bins, int(config.max_depth), self.split_params,
+                   impl)
+            if key not in _GROW_FN_CACHE:
+                from .partitioned import make_partitioned_grow_fn
+                _GROW_FN_CACHE[key] = make_partitioned_grow_fn(
+                    num_leaves=int(config.num_leaves),
+                    num_features=num_features, max_bins=self.max_bins,
+                    max_depth=int(config.max_depth),
+                    split_params=self.split_params, hist_impl=impl)
+        else:
+            key = ("serial", int(config.num_leaves), self.max_bins,
+                   int(config.max_depth), self.split_params, impl,
+                   int(config.tpu_rows_per_chunk), self.use_hist_pool)
+            if key not in _GROW_FN_CACHE:
+                _GROW_FN_CACHE[key] = make_grow_fn(
+                    num_leaves=int(config.num_leaves), max_bins=self.max_bins,
+                    max_depth=int(config.max_depth),
+                    split_params=self.split_params, hist_impl=impl,
+                    rows_per_chunk=int(config.tpu_rows_per_chunk),
+                    use_hist_pool=self.use_hist_pool)
         self._grow = _GROW_FN_CACHE[key]
 
     def train(self, X_dev: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
@@ -453,5 +505,28 @@ class SerialTreeLearner:
               feature_mask: Optional[jnp.ndarray] = None) -> GrownTree:
         if feature_mask is None:
             feature_mask = jnp.ones((self.num_features,), jnp.bool_)
-        return self._grow(X_dev, grad, hess, sample_mask, self.num_bins,
-                          self.is_cat, self.has_nan, feature_mask)
+        if not self.partitioned:
+            return self._grow(X_dev, None, grad, hess, sample_mask,
+                              self.num_bins, self.is_cat, self.has_nan,
+                              feature_mask)
+        n = X_dev.shape[0]
+        if self.pallas:  # pad rows to the Pallas kernel's block
+            from ..ops.histogram_pallas import pad_rows
+            n_pad = pad_rows(n)
+        else:
+            n_pad = n
+        if self._x_cache_key != id(X_dev):
+            self._Xp = jnp.pad(X_dev, ((0, n_pad - n), (0, 0))) \
+                if n_pad != n else X_dev
+            self._x_cache_key = id(X_dev)
+        pad = n_pad - n
+        if pad:
+            grad = jnp.pad(grad, (0, pad))
+            hess = jnp.pad(hess, (0, pad))
+            sample_mask = jnp.pad(sample_mask, (0, pad))
+        grown = self._grow(self._Xp, grad, hess, sample_mask,
+                           self.num_bins, self.is_cat, self.has_nan,
+                           feature_mask)
+        if pad:
+            grown = grown._replace(row_leaf=grown.row_leaf[:n])
+        return grown

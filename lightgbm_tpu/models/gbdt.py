@@ -87,11 +87,18 @@ class GBDT:
     interface + src/boosting/gbdt.h:540 ``GBDT``)."""
 
     name = "gbdt"
+    # Deferred tree materialization: grown trees stay device-side and are
+    # pulled to host in one batched fetch only when the model is actually
+    # read (predict/save/rollback/...).  Keeps the boosting loop fully
+    # async — crucial when the accelerator link has high latency.  DART
+    # needs host trees every iteration and opts out.
+    _defer_trees = True
 
     def __init__(self, config: Config, train_set: Optional[Dataset],
                  objective: Optional[ObjectiveFunction] = None) -> None:
         self.config = config
-        self.models: List[Tree] = []
+        self._models_list: List[Tree] = []
+        self._pending: List[tuple] = []
         self.train_set: Optional[Dataset] = None
         self.valid_sets: List[Tuple[str, Dataset]] = []
         self.valid_scores: List[jnp.ndarray] = []
@@ -263,7 +270,11 @@ class GBDT:
                 grown = self.learner.train(self.X_dev, g, h, mask,
                                            feature_mask=fmask)
                 tree = self._record_tree(grown, cid)
-                if tree.num_leaves > 1:
+                if tree is None:
+                    # deferred: stay optimistic — the no-split warning fires
+                    # at flush time if it turns out nothing grew
+                    finished = False
+                elif tree.num_leaves > 1:
                     finished = False
             self.iter_ += 1
             if finished:
@@ -308,17 +319,50 @@ class GBDT:
                     resid[sel], None if w is None else w[sel], alpha)
         return out
 
-    def _record_tree(self, grown: GrownTree, class_id: int) -> Tree:
+    @property
+    def models(self) -> List[Tree]:
+        """Host-side tree list; materializes any pending device trees."""
+        self._flush_trees()
+        return self._models_list
+
+    @models.setter
+    def models(self, value: List[Tree]) -> None:
+        self._pending = []
+        self._models_list = value
+
+    def _flush_trees(self) -> None:
+        if not self._pending:
+            return
+        pend, self._pending = self._pending, []
+        host_grown = jax.device_get([p[0] for p in pend])  # one batched pull
+        for (_, shrinkage, bias), grown in zip(pend, host_grown):
+            tree = _grown_to_tree(grown, shrinkage, self.train_set)
+            if abs(bias) > EPSILON:
+                tree.add_bias(bias)
+            self._models_list.append(tree)
+
+    def _record_tree(self, grown: GrownTree, class_id: int) -> Optional[Tree]:
         cfg = self.config
         shrinkage = self._current_shrinkage()
-        renewed = self._renew_leaf_values(grown, class_id)
-        tree = _grown_to_tree(grown, shrinkage, self.train_set,
-                              leaf_value_override=renewed)
-        # fold init score into the first iteration's trees (gbdt.cpp:414-427)
+        renewed = None
+        defer = self._defer_trees and not (
+            self.objective is not None and
+            getattr(self.objective, "is_renew_tree_output", False))
+        if not defer:
+            renewed = self._renew_leaf_values(grown, class_id)
         bias = self._pending_bias[class_id] if self.iter_ == 0 else 0.0
-        if abs(bias) > EPSILON:
-            tree.add_bias(bias)
-        self.models.append(tree)
+        if defer:
+            self._pending.append((grown, shrinkage, bias))
+            tree = None
+        else:
+            tree = _grown_to_tree(grown, shrinkage, self.train_set,
+                                  leaf_value_override=renewed)
+            # fold init score into the first iteration's trees
+            # (gbdt.cpp:414-427)
+            if abs(bias) > EPSILON:
+                tree.add_bias(bias)
+            self._flush_trees()
+            self._models_list.append(tree)
 
         # update train scores from the grower's leaf assignment
         lv = (grown.leaf_value if renewed is None

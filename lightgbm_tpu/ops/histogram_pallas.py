@@ -1,0 +1,169 @@
+"""Pallas TPU histogram kernel — the hot op, on the MXU.
+
+TPU-native analog of the reference's device histogram kernels
+(reference: src/treelearner/ocl/histogram256.cl:476-505 local-memory float
+atomics; src/treelearner/kernels/histogram_16_64_256.cu:23-341; CPU inner
+loops src/io/dense_bin.hpp:18-52).  TPUs have no fast atomics, so scatter-add
+is reformulated as a one-hot contraction — but unlike the XLA ``onehot`` path
+(ops/histogram.py), the one-hot tile here never leaves VMEM:
+
+  for each row-block (sequential grid) and each feature f:
+      onehot = (bins[f, block] == iota(B))        # (B, R) bf16, in VMEM only
+      hist[f] += onehot @ w_block                  # MXU, f32 accumulation
+
+Precision: the MXU contracts bf16 operands into f32.  The 0/1 one-hot is
+exact in bf16; gradients/hessians are carried as **bf16 hi+lo pairs**
+(value = hi + lo, lo = value - f32(hi)), so each product is exact to f32
+precision and the result matches a f32 matmul — the extra channels are free
+because the MXU lane dimension is padded to 128 anyway (we use 5 of 128:
+g_hi, g_lo, h_hi, h_lo, count).  This beats the reference GPU learner's
+plain-f32 ``gpu_hist_t`` (gpu_tree_learner.h:79) in exactness per cycle.
+
+Layout contract: bins arrive **feature-major** ``(F, N)`` so each feature's
+row-block is a contiguous lane vector; N must be a multiple of the row block
+R (the Dataset pads device uploads; masked rows carry w=0 and contribute
+nothing).  Output is ``(F, B, 3)`` f32 (sum_grad, sum_hess, count).
+
+MXU cycle floor: F * ceil(B/128) * N K-slices per full build — at Higgs
+scale (10.5M x 28, B=256) ~0.1 s/full build; the tree grower's subtraction
+trick (ops/histogram.py histogram_subtract) keeps builds to ~4 full-N
+equivalents per 255-leaf tree.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["build_histogram_pallas", "DEFAULT_ROW_BLOCK", "pad_rows"]
+
+DEFAULT_ROW_BLOCK = 4096
+_C = 8  # weight channels (5 used), padded to a power of two for clean tiles
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def pad_rows(n: int, row_block: int = DEFAULT_ROW_BLOCK) -> int:
+    """Rows the caller must pad to for the pallas path."""
+    return _round_up(max(n, row_block), row_block)
+
+
+def _split_hi_lo(v: jnp.ndarray):
+    """Split f32 v into bf16 (hi, lo) with v ≈ hi + lo to ~2^-17 rel.
+
+    hi is v with the low 16 mantissa bits masked off — explicitly via
+    integer ops, because XLA's simplifier folds a bf16 round-trip
+    (``v - f32(bf16(v))``) into zero under jit.  The masked hi is exactly
+    representable in bf16 and ``v - hi`` is exact in f32.
+    """
+    bits = jax.lax.bitcast_convert_type(v, jnp.uint32)
+    hi32 = jax.lax.bitcast_convert_type(bits & jnp.uint32(0xFFFF0000),
+                                        jnp.float32)
+    return hi32.astype(jnp.bfloat16), (v - hi32).astype(jnp.bfloat16)
+
+
+def _hist_kernel(bins_ref, w_ref, out_ref, *, num_features: int,
+                 num_bins: int, group: int):
+    """Accumulate (F*B, C) histograms over one row block.
+
+    ``group`` features share one MXU contraction: their one-hot tiles are
+    stacked along M with per-feature bin offsets, so the dot is
+    (group*B, R) @ (R, C) — fewer, larger matmuls pipeline better than
+    per-feature ones."""
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    w = w_ref[...]  # (R, C) bf16
+    r = w.shape[0]
+    b = num_bins
+
+    def do(f0, g):
+        iota_gb = jax.lax.broadcasted_iota(jnp.int32, (g * b, r), 0) % b
+        cols = bins_ref[f0:f0 + g, :].astype(jnp.int32)       # (g, R)
+        colrep = jnp.repeat(cols, b, axis=0)                   # (g*B, R)
+        onehot = (colrep == iota_gb).astype(jnp.bfloat16)
+        part = jax.lax.dot_general(
+            onehot, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # (g*B, C)
+        out_ref[pl.ds(f0 * b, g * b)] += part
+
+    f0 = 0
+    while f0 + group <= num_features:
+        do(f0, group)
+        f0 += group
+    if f0 < num_features:
+        do(f0, num_features - f0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "row_block", "interpret"))
+def build_histogram_pallas(bins_t: jnp.ndarray, grad: jnp.ndarray,
+                           hess: jnp.ndarray, mask: jnp.ndarray, *,
+                           num_bins: int,
+                           row_block: int = DEFAULT_ROW_BLOCK,
+                           interpret: bool = False) -> jnp.ndarray:
+    """(F, B, 3) histogram over masked rows from feature-major bin codes.
+
+    Args:
+      bins_t: (F, N) integer bin codes, N a multiple of ``row_block``.
+      grad, hess, mask: (N,) f32; mask is 0.0 for out-of-leaf / padded rows.
+      num_bins: static global bin count B (padded to a lane-friendly size
+        internally; trailing bins stay zero).
+    """
+    f, n = bins_t.shape
+    if n % row_block != 0:
+        raise ValueError(f"pallas histogram needs N % {row_block} == 0, "
+                         f"got N={n} (use pad_rows)")
+    # Pad bins to a multiple of 64 and pack `group` features per contraction
+    # so the stacked one-hot M dim (group*b) fills whole 128-row MXU tiles:
+    # at max_bin=63 (the reference's accelerator-recommended setting,
+    # docs/GPU-Performance.rst) this doubles throughput vs padding to 128.
+    b = _round_up(num_bins, 64)
+    group = next((g for g in (2, 4, 8) if (g * b) % 128 == 0), 1)
+    while group * 2 <= f and group * 2 * b <= 512:
+        group *= 2  # bigger stacked matmuls pipeline better, bounded by VMEM
+    if group > f or (group * b) % 128 != 0:
+        b = _round_up(num_bins, 128)
+        group = 1
+
+    gm = grad * mask
+    hm = hess * mask
+    g_hi, g_lo = _split_hi_lo(gm)
+    h_hi, h_lo = _split_hi_lo(hm)
+    z = jnp.zeros_like(g_hi)
+    w8 = jnp.stack([g_hi, g_lo, h_hi, h_lo, mask.astype(jnp.bfloat16),
+                    z, z, z], axis=-1)  # (N, C) — one fused interleave
+
+    grid = (n // row_block,)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, num_features=f, num_bins=b,
+                          group=group),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((f, row_block), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_block, _C), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((f * b, _C), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f * b, _C), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * f * b * n * _C,
+            bytes_accessed=f * n + n * _C * 2 + f * b * _C * 4,
+            transcendentals=0),
+        interpret=interpret,
+    )(bins_t, w8)
+
+    out = out.reshape(f, b, _C)
+    hist = jnp.stack([out[:, :, 0] + out[:, :, 1],
+                      out[:, :, 2] + out[:, :, 3],
+                      out[:, :, 4]], axis=-1)
+    return hist[:, :num_bins, :]

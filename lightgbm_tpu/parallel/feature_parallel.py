@@ -107,14 +107,17 @@ class FeatureParallelTreeLearner:
         strategy = FeatureParallelStrategy(self.axis, self.f_local,
                                            self.num_bins, self.is_cat,
                                            self.has_nan)
-        grow = make_grow_fn(
+        grow_t = make_grow_fn(
             num_leaves=int(config.num_leaves), max_bins=self.max_bins,
             max_depth=int(config.max_depth),
             split_params=split_params_from_config(config),
-            hist_impl=resolve_hist_impl(config),
+            hist_impl=resolve_hist_impl(config, parallel=True),
             rows_per_chunk=int(config.tpu_rows_per_chunk),
             use_hist_pool=hist_pool_fits(config, self.f_local, self.max_bins),
             strategy=strategy, jit=False)
+
+        def grow(X, g, h, m, nb, ic, hn, fm):
+            return grow_t(X, None, g, h, m, nb, ic, hn, fm)
         tree_specs = GrownTree(
             split_feature=P(), threshold_bin=P(), nan_bin=P(),
             decision_type=P(), left_child=P(), right_child=P(),
