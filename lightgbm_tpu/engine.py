@@ -82,7 +82,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
     for it in range(num_boost_round):
         for cb in callbacks_before:
             cb(CallbackEnv(booster, params, it, 0, num_boost_round, None))
-        booster.update(fobj=fobj)
+        if booster.update(fobj=fobj):
+            # no leaf met the split requirements — stop like the reference
+            # CLI train loop (gbdt.cpp:264-283)
+            break
 
         evaluation_result_list = []
         if booster._gbdt.train_metrics or booster._gbdt.valid_sets or feval:

@@ -469,8 +469,13 @@ class SerialTreeLearner:
         self.split_params = split_params_from_config(config)
         self.use_hist_pool = hist_pool_fits(config, num_features, self.max_bins)
         impl = resolve_hist_impl(config)
+        if not self.use_hist_pool and impl == "pallas":
+            # the pool-less fallback grower takes no transposed X and no row
+            # padding — downgrade to the XLA onehot formulation (same MXU
+            # math, without the VMEM layout contract)
+            impl = "onehot"
         self.pallas = impl == "pallas"
-        self._x_cache_key = None
+        self._x_src = None
         # The partition-ordered grower (learner/partitioned.py) is the
         # default serial path — no full-N work per split.  The masked
         # grower below remains for the pool-less huge-feature fallback and
@@ -515,10 +520,10 @@ class SerialTreeLearner:
             n_pad = pad_rows(n)
         else:
             n_pad = n
-        if self._x_cache_key != id(X_dev):
+        if self._x_src is not X_dev:  # strong ref: ids can be recycled
             self._Xp = jnp.pad(X_dev, ((0, n_pad - n), (0, 0))) \
                 if n_pad != n else X_dev
-            self._x_cache_key = id(X_dev)
+            self._x_src = X_dev
         pad = n_pad - n
         if pad:
             grad = jnp.pad(grad, (0, pad))

@@ -72,6 +72,10 @@ class DART(GBDT):
     re-walks."""
 
     name = "dart"
+    # DART reads/rescales host trees every iteration (Normalize), so tree
+    # deferral buys nothing and would corrupt weights if _normalize ever
+    # indexed _models_list directly — opt out explicitly.
+    _defer_trees = False
 
     def __init__(self, config: Config, train_set: Optional[Dataset],
                  objective=None) -> None:

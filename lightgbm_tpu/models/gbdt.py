@@ -260,10 +260,25 @@ class GBDT:
                 grad = _coerce(grad)
                 hess = _coerce(hess)
 
+            # Lagged no-split stop for the deferred-tree path: the previous
+            # iteration's tree sizes are device-computed by now, so this host
+            # pull is a bare RTT and doesn't stall the dispatch pipeline.
+            # When the previous iteration grew only stumps, pop them (the
+            # reference pops non-splitting trees, gbdt.cpp:430-450) and stop.
+            prev = getattr(self, "_prev_iter_leaves", None)
+            if prev is not None and \
+                    all(int(x) <= 1 for x in jax.device_get(prev)):
+                self._prev_iter_leaves = None
+                self._pop_stump_iteration()
+                log_warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                return True
+
             finished = True
             fmask = self._feature_mask()
             grad, hess, mask = self._prepare_iter_sampling(grad, hess)
             self._last_sample_mask = mask
+            leaves_this_iter = []
             for cid in range(k):
                 g = grad if k == 1 else grad[:, cid]
                 h = hess if k == 1 else hess[:, cid]
@@ -271,16 +286,29 @@ class GBDT:
                                            feature_mask=fmask)
                 tree = self._record_tree(grown, cid)
                 if tree is None:
-                    # deferred: stay optimistic — the no-split warning fires
-                    # at flush time if it turns out nothing grew
+                    # deferred: the lagged check above decides next iteration
                     finished = False
+                    leaves_this_iter.append(grown.num_leaves)
                 elif tree.num_leaves > 1:
                     finished = False
+            self._prev_iter_leaves = leaves_this_iter or None
             self.iter_ += 1
             if finished:
                 log_warning("Stopped training because there are no more leaves "
                             "that meet the split requirements")
             return finished
+
+    def _pop_stump_iteration(self) -> None:
+        """Drop the previous iteration's no-split stump trees (they carry a
+        near-zero constant; their score nudge is left in place — training is
+        over and prediction reads only the model list)."""
+        k = self.num_tree_per_iteration
+        for _ in range(k):
+            if self._pending:
+                self._pending.pop()
+            elif self._models_list:
+                self._models_list.pop()
+        self.iter_ = max(0, self.iter_ - 1)
 
     def _current_shrinkage(self) -> float:
         """Per-iteration shrinkage; DART overrides with lr/(1+k_dropped)."""

@@ -36,7 +36,19 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["build_histogram", "histogram_subtract"]
+__all__ = ["build_histogram", "histogram_subtract", "split_hi_lo"]
+
+
+def split_hi_lo(v: jnp.ndarray):
+    """Split f32 v into (hi, lo) with v == hi + lo and hi exactly
+    representable in bf16.  TPU matmuls round f32 operands to bf16 at
+    DEFAULT precision; carrying (hi, lo) channels keeps the contraction
+    f32-exact at bf16 speed (same trick as the Pallas kernel).  The mask is
+    integer ops because XLA folds a bf16 round-trip to zero under jit."""
+    bits = jax.lax.bitcast_convert_type(v, jnp.uint32)
+    hi = jax.lax.bitcast_convert_type(bits & jnp.uint32(0xFFFF0000),
+                                      jnp.float32)
+    return hi, v - hi
 
 
 def _hist_onehot_chunk(bins_chunk: jnp.ndarray, w_chunk: jnp.ndarray,
@@ -50,11 +62,17 @@ def _hist_onehot_chunk(bins_chunk: jnp.ndarray, w_chunk: jnp.ndarray,
     onehot = (bins_chunk[:, :, None] ==
               jnp.arange(num_bins, dtype=bins_chunk.dtype)[None, None, :])
     onehot = onehot.reshape(n, f * num_bins).astype(jnp.float32)
-    # (3, n) @ (n, F*B) -> (3, F*B): contraction over rows rides the MXU
+    # bf16-exact hi/lo weight channels: the one-hot operand is exact 0/1,
+    # so splitting the weights recovers f32-exact sums on the TPU MXU
+    g_hi, g_lo = split_hi_lo(w_chunk[:, 0])
+    h_hi, h_lo = split_hi_lo(w_chunk[:, 1])
+    w6 = jnp.stack([g_hi, g_lo, h_hi, h_lo, w_chunk[:, 2]], axis=0)  # (5, n)
+    # (5, n) @ (n, F*B) -> (5, F*B): contraction over rows rides the MXU
     flat = jax.lax.dot_general(
-        w_chunk.T, onehot, (((1,), (0,)), ((), ())),
+        w6, onehot, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
-    return flat.T.reshape(f, num_bins, 3)
+    flat3 = jnp.stack([flat[0] + flat[1], flat[2] + flat[3], flat[4]], axis=0)
+    return flat3.T.reshape(f, num_bins, 3)
 
 
 def _hist_segment_chunk(bins_chunk: jnp.ndarray, w_chunk: jnp.ndarray,
