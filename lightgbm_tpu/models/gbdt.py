@@ -301,8 +301,13 @@ class GBDT:
     def _pop_stump_iteration(self) -> None:
         """Drop the previous iteration's no-split stump trees (they carry a
         near-zero constant; their score nudge is left in place — training is
-        over and prediction reads only the model list)."""
+        over and prediction reads only the model list).  The FIRST
+        iteration's trees are kept even when they are stumps: they carry the
+        boost-from-average constant (reference gbdt.cpp:443-450 pops only
+        when models_.size() > num_tree_per_iteration)."""
         k = self.num_tree_per_iteration
+        if len(self._models_list) + len(self._pending) <= k:
+            return
         for _ in range(k):
             if self._pending:
                 self._pending.pop()
