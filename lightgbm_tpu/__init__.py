@@ -11,6 +11,7 @@ jax collectives, and the reference's public Python surface::
     bst.predict(X)
 """
 
+from . import distributed
 from .basic import Booster
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        print_evaluation, record_evaluation, reset_parameter)
@@ -26,17 +27,16 @@ try:
 except ImportError:  # sklearn not installed
     _SKLEARN_OK = False
 
-try:
-    from .plotting import (plot_importance, plot_metric, plot_tree,
-                           create_tree_digraph)
-except ImportError:
-    pass
+from .plotting import (plot_importance, plot_metric, plot_tree,
+                       plot_split_value_histogram, create_tree_digraph)
 
 __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Booster", "Config", "train", "cv", "CVBooster",
            "early_stopping", "print_evaluation", "log_evaluation",
            "record_evaluation", "reset_parameter", "EarlyStopException",
-           "register_log_callback", "set_verbosity"]
+           "register_log_callback", "set_verbosity", "distributed",
+           "plot_importance", "plot_metric", "plot_tree",
+           "plot_split_value_histogram", "create_tree_digraph"]
 if _SKLEARN_OK:
     __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]

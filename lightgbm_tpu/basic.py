@@ -175,8 +175,23 @@ class Booster:
     # network emulation (reference basic.py:2178 set_network) ---------------
     def set_network(self, machines, local_listen_port: int = 12400,
                     listen_time_out: int = 120, num_machines: int = 1) -> "Booster":
-        log_warning("set_network is a no-op: distribution uses the JAX mesh "
-                    "(see lightgbm_tpu.parallel); kept for API compatibility")
+        """Reference socket-mesh bootstrap.  Here distribution rides the JAX
+        device mesh instead: single-host multi-chip needs only
+        ``tree_learner='data'`` (+ ``num_devices``); multi-host processes
+        must call ``lightgbm_tpu.distributed.init(...)`` before training.
+        Raises rather than silently pretending a socket mesh exists."""
+        n_machines = (len(machines.split(",")) if isinstance(machines, str)
+                      else len(machines)) if machines else num_machines
+        if n_machines > 1:
+            raise NotImplementedError(
+                "set_network(machines=...) maps to the JAX multi-process "
+                "runtime here: call lightgbm_tpu.distributed.init(coordinator"
+                "_address=..., num_processes=..., process_id=...) in every "
+                "process, then train with tree_learner='data'. A socket mesh "
+                "is never created, so returning success would be a lie.")
+        log_warning("set_network with a single machine is a no-op: set "
+                    "tree_learner='data'/'feature'/'voting' and num_devices "
+                    "to shard over the local JAX mesh instead")
         return self
 
     def free_network(self) -> "Booster":

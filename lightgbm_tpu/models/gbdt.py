@@ -114,6 +114,8 @@ class GBDT:
     # -- setup ---------------------------------------------------------------
     def _init_train(self, train_set: Dataset) -> None:
         cfg = self.config
+        from ..config import warn_unimplemented_params
+        warn_unimplemented_params(cfg)
         train_set.construct(cfg)
         self.train_set = train_set
         self.num_data = train_set.num_data()

@@ -1,0 +1,72 @@
+"""Plotting module tests (reference test pattern:
+tests/python_package_test/test_plotting.py — construct each plot object and
+assert structure, no pixel comparisons)."""
+
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.RandomState(7)
+    X = rng.randn(300, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    evals = {}
+    ds = lgb.Dataset(X, y, feature_name=[f"f{i}" for i in range(5)])
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                     "metric": "binary_logloss"}, ds, 10,
+                    valid_sets=[ds.create_valid(X, y)],
+                    callbacks=[lgb.record_evaluation(evals)])
+    return bst, evals
+
+
+def test_plot_importance(trained):
+    bst, _ = trained
+    ax = lgb.plot_importance(bst)
+    assert ax.get_title() == "Feature importance"
+    assert len(ax.patches) >= 1
+    ax2 = lgb.plot_importance(bst, importance_type="gain",
+                              max_num_features=2, title="t2")
+    assert len(ax2.patches) <= 2
+
+
+def test_plot_metric(trained):
+    _, evals = trained
+    ax = lgb.plot_metric(evals)
+    assert ax.get_ylabel() == "binary_logloss"
+    assert len(ax.get_lines()) == 1
+
+
+def test_plot_split_value_histogram(trained):
+    bst, _ = trained
+    ax = lgb.plot_split_value_histogram(bst, 0)
+    assert len(ax.patches) >= 1
+    with pytest.raises(ValueError):
+        # a feature never split on
+        lgb.plot_split_value_histogram(bst, 4)
+
+
+def test_create_tree_digraph(trained):
+    bst, _ = trained
+    graph = lgb.create_tree_digraph(
+        bst, tree_index=0,
+        show_info=["split_gain", "internal_count", "leaf_count"])
+    src = graph.source
+    assert "split0" in src and "leaf" in src
+    with pytest.raises(IndexError):
+        lgb.create_tree_digraph(bst, tree_index=99)
+
+
+def test_unimplemented_param_warns(capsys):
+    rng = np.random.RandomState(0)
+    X, y = rng.randn(120, 3), rng.randn(120)
+    lgb.train({"objective": "regression", "verbosity": 1,
+               "extra_trees": True, "metric": "l2"},
+              lgb.Dataset(X, y), 2)
+    out = capsys.readouterr().out
+    assert "extra_trees" in out and "NOT implemented" in out
