@@ -74,6 +74,23 @@ class Booster:
         self._gbdt.rollback_one_iter()
         return self
 
+    def refit(self, data, label, decay_rate: float = 0.9,
+              **kwargs) -> "Booster":
+        """Refit the existing tree structures on new data
+        (reference basic.py:2976 Booster.refit -> LGBM_BoosterRefit ->
+        GBDT::RefitTree): every tree keeps its splits; leaf values become
+        ``decay_rate * old + (1 - decay_rate) * new`` where the new value is
+        the closed-form output of the leaf's rows in ``data``."""
+        if self._gbdt.objective is None:
+            raise ValueError("Cannot refit due to null objective function.")
+        leaf_preds = self.predict(data, pred_leaf=True, **kwargs)
+        new_params = dict(self.params)
+        new_params["refit_decay_rate"] = decay_rate
+        train_set = Dataset(data, label)
+        new_booster = Booster(params=new_params, train_set=train_set)
+        new_booster._gbdt.refit_trees(self._gbdt, np.asarray(leaf_preds))
+        return new_booster
+
     @property
     def current_iteration(self) -> int:
         return self._gbdt.current_iteration

@@ -401,7 +401,6 @@ _UNIMPLEMENTED_PARAMS: Tuple[str, ...] = (
     "interaction_constraints",
     "forcedsplits_filename",
     "pred_early_stop",
-    "snapshot_freq",
     "path_smooth",
 )
 

@@ -42,11 +42,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if fobj is not None:
         params["objective"] = "none"
 
-    if init_model is not None:
-        raise NotImplementedError("continued training (init_model) lands with "
-                                  "the refit milestone")
-
     booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        # Continued training keeps the loaded trees in the model (the
+        # reference C++ CLI input_model semantics, application.cpp:87-96 +
+        # boosting.cpp:35-67 CreateBoosting-from-file): the returned booster
+        # predicts with old+new trees.  (The reference *Python* package
+        # instead bakes the old model into init scores only.)
+        if isinstance(init_model, Booster):
+            init_bst = init_model
+        else:
+            init_bst = Booster(model_file=str(init_model), params=params)
+        booster._gbdt.init_from_model(init_bst._gbdt)
     if valid_sets is not None:
         if not isinstance(valid_sets, (list, tuple)):
             valid_sets = [valid_sets]
@@ -86,6 +93,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
             # no leaf met the split requirements — stop like the reference
             # CLI train loop (gbdt.cpp:264-283)
             break
+        if cfg2.snapshot_freq > 0 and (it + 1) % cfg2.snapshot_freq == 0:
+            # periodic checkpoints (reference gbdt.cpp:277-281 Train +
+            # config snapshot_freq/save_period)
+            booster.save_model(f"{cfg2.output_model}.snapshot_iter_{it + 1}")
 
         evaluation_result_list = []
         if booster._gbdt.train_metrics or booster._gbdt.valid_sets or feval:

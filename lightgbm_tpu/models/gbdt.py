@@ -193,9 +193,23 @@ class GBDT:
         for m in metrics:
             m.init(valid_set.metadata, n)
         self.valid_sets.append((name, valid_set))
-        self.valid_scores.append(jnp.asarray(score0))
-        self.valid_metrics.append(metrics)
+        vscore = jnp.asarray(score0)
         valid_set._device_cache["bins"] = jnp.asarray(valid_set.X_binned)
+        if self.models:  # continued training: include loaded trees' scores
+            vbins = valid_set._device_cache["bins"]
+            for t, tree in enumerate(self.models):
+                cid = t % k
+                delta = _walk_binned(
+                    vbins, jnp.asarray(tree.split_feature),
+                    jnp.asarray(tree.threshold_bin), jnp.asarray(tree.nan_bin),
+                    jnp.asarray(tree.decision_type.astype(np.int32)),
+                    jnp.asarray(tree.left_child), jnp.asarray(tree.right_child),
+                    jnp.asarray(tree.leaf_value, dtype=jnp.float32),
+                    jnp.asarray(tree.num_leaves, dtype=jnp.int32))
+                vscore = (vscore + delta if k == 1
+                          else vscore.at[:, cid].add(delta))
+        self.valid_scores.append(vscore)
+        self.valid_metrics.append(metrics)
 
     # -- sampling (bagging / GOSS hooks) -------------------------------------
     def _prepare_iter_sampling(self, grad: jnp.ndarray, hess: jnp.ndarray
@@ -511,6 +525,110 @@ class GBDT:
             leaves.append(np.asarray(predict_raw(tb, Xd)).astype(np.int32))
         return np.stack(leaves, axis=1) if leaves else np.zeros(
             (Xi.shape[0], 0), np.int32)
+
+    # -- continued training / refit (reference gbdt.cpp:285 RefitTree;
+    #    CreateBoosting(type, filename) boosting.cpp:35-67; CLI input_model
+    #    path application.cpp:87-96) --------------------------------------
+    def _align_loaded_tree(self, tree: Tree) -> Tree:
+        """Re-key a loaded tree (REAL feature indices, raw thresholds, no bin
+        info) onto this training Dataset: inner feature indices plus
+        threshold_bin/nan_bin recovered through the BinMappers so the binned
+        device walks work.  Exact when the data/binning match the one the
+        model was trained on (the continued-training contract)."""
+        ds = self.train_set
+        inner_of_real = {int(r): i for i, r in enumerate(ds.used_feature_map)}
+        t = Tree(**{**tree.__dict__})
+        t.split_feature = np.array(tree.split_feature, np.int32, copy=True)
+        t.threshold_bin = np.zeros_like(t.split_feature)
+        t.nan_bin = np.full_like(t.split_feature, -1)
+        from ..binning import MissingType
+        for i in range(t.num_leaves - 1):
+            rf = int(tree.split_feature[i])
+            if rf not in inner_of_real:
+                raise ValueError(
+                    f"loaded model splits on feature {rf}, which is trivial "
+                    f"(constant) in the continued-training dataset")
+            f = inner_of_real[rf]
+            t.split_feature[i] = f
+            m = ds.bin_mappers[int(ds.used_feature_map[f])]
+            if m.is_categorical:
+                t.threshold_bin[i] = m.cat_to_bin.get(
+                    int(tree.threshold[i]), 0)
+            else:
+                t.threshold_bin[i] = int(
+                    m.value_to_bin(np.array([tree.threshold[i]]))[0])
+            if m.missing_type == MissingType.NAN:
+                t.nan_bin[i] = m.num_bin - 1
+        return t
+
+    def init_from_model(self, other: "GBDT") -> None:
+        """Prime this booster with an existing model's trees and keep
+        boosting (continued training)."""
+        k = self.num_tree_per_iteration
+        ok = getattr(other, "num_tree_per_iteration", 1)
+        if ok != k:
+            raise ValueError(f"init_model has {ok} trees/iteration, this "
+                             f"training configuration needs {k}")
+        self._pending = []
+        self._models_list = [self._align_loaded_tree(t) for t in other.models]
+        self.iter_ = len(self._models_list) // max(k, 1)
+        # the loaded first tree already carries any boost-from-average bias
+        self._pending_bias[:] = 0.0
+        self._rebuild_scores()
+
+    def refit_trees(self, source: "GBDT", leaf_preds: np.ndarray) -> None:
+        """Re-learn every loaded tree's leaf values on THIS dataset with the
+        tree structures fixed (reference gbdt.cpp:285 RefitTree +
+        serial_tree_learner.cpp:211 FitByExistingTree): scores restart from
+        the init score, gradients are recomputed per iteration, each leaf's
+        new value is the closed-form output of its (fixed) row set, mixed as
+        decay*old + (1-decay)*new."""
+        if self.objective is None:
+            raise ValueError("cannot refit without an objective")
+        k = self.num_tree_per_iteration
+        trees = [self._align_loaded_tree(t) for t in source.models]
+        n = self.num_data
+        if leaf_preds.shape != (n, len(trees)):
+            raise ValueError(f"leaf_preds shape {leaf_preds.shape} != "
+                             f"({n}, {len(trees)})")
+        decay = float(self.config.refit_decay_rate)
+        sp = self.learner.split_params
+        md = self.train_set.metadata
+        shape = (n,) if k == 1 else (n, k)
+        score = np.zeros(shape, np.float32)
+        if md.init_score is not None:
+            score = score + md.init_score.reshape(shape).astype(np.float32)
+        for it in range(len(trees) // max(k, 1)):
+            grad, hess = self.objective.get_gradients(jnp.asarray(score))
+            grad = np.asarray(grad)
+            hess = np.asarray(hess)
+            for cid in range(k):
+                ti = it * k + cid
+                tree = trees[ti]
+                g = grad if k == 1 else grad[:, cid]
+                h = hess if k == 1 else hess[:, cid]
+                lp = leaf_preds[:, ti]
+                nl = tree.num_leaves
+                sum_g = np.bincount(lp, weights=g, minlength=nl)[:nl]
+                sum_h = np.bincount(lp, weights=h, minlength=nl)[:nl] + EPSILON
+                new_out = np.asarray(_leaf_output_fn(
+                    jnp.asarray(sum_g, jnp.float32),
+                    jnp.asarray(sum_h, jnp.float32), sp), np.float64)
+                new_out *= tree.shrinkage
+                tree.leaf_value = (decay * tree.leaf_value[:len(new_out)] +
+                                   (1.0 - decay) * new_out)
+                tree.leaf_count = np.bincount(lp, minlength=nl)[:nl].astype(
+                    np.int64)
+                delta = tree.leaf_value[lp].astype(np.float32)
+                if k == 1:
+                    score += delta
+                else:
+                    score[:, cid] += delta
+        self._pending = []
+        self._models_list = trees
+        self.iter_ = len(trees) // max(k, 1)
+        self._pending_bias[:] = 0.0
+        self.score = jnp.asarray(score)
 
     # -- model management ----------------------------------------------------
     def rollback_one_iter(self) -> None:

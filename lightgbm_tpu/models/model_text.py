@@ -27,8 +27,9 @@ MODEL_VERSION = "v3"
 
 
 def _fmt(x: float) -> str:
-    return np.format_float_positional(
-        float(x), precision=17, unique=True, trim="0")
+    # %.17g round-trips doubles exactly (reference Common::DoubleToStr);
+    # positional formatting would truncate tiny magnitudes to "0"
+    return f"{float(x):.17g}"
 
 
 def _join(arr, fmt=str) -> str:
