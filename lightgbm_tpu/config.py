@@ -393,8 +393,6 @@ _UNIMPLEMENTED_PARAMS: Tuple[str, ...] = (
     "max_cat_to_onehot",
     "linear_tree",
     "linear_lambda",
-    "monotone_constraints",
-    "monotone_penalty",
     "cegb_penalty_split",
     "cegb_penalty_feature_lazy",
     "cegb_penalty_feature_coupled",

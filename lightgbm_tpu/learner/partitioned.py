@@ -41,7 +41,7 @@ import jax.numpy as jnp
 
 from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
 from ..ops.histogram import build_histogram
-from ..ops.split import NEG_INF, leaf_output
+from ..ops.split import BIG, NEG_INF, leaf_output
 from .serial import CommStrategy, GrownTree
 
 __all__ = ["make_partitioned_grow_fn", "PART_ROW_BLOCK"]
@@ -103,12 +103,14 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         return build_histogram(bins_rows, gm, hm, mask, num_bins=max_bins,
                                impl=hist_impl)
 
+    use_mc = split_params.use_monotone
+
     def grow(X: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
              bag_mask: jnp.ndarray, num_bins: jnp.ndarray,
              is_cat: jnp.ndarray, has_nan: jnp.ndarray,
-             feature_mask: jnp.ndarray) -> GrownTree:
+             monotone: jnp.ndarray, feature_mask: jnp.ndarray) -> GrownTree:
         n = X.shape[0]
-        strat = CommStrategy(num_bins, is_cat, has_nan)
+        strat = CommStrategy(num_bins, is_cat, has_nan, monotone)
 
         # ---- pack rows: bins | grad*bag | hess*bag | orig idx | bag ----
         gm = (grad * bag_mask).astype(jnp.float32)
@@ -127,7 +129,9 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
 
         root_hist = _hist_from_seg(P, jnp.ones((n,), jnp.float32))
         root_sum = jnp.stack([jnp.sum(gm), jnp.sum(hm), jnp.sum(bag_mask)])
-        cand = strat.leaf_candidates(root_hist, root_sum, feature_mask, sp)
+        root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
+        cand = strat.leaf_candidates(root_hist, root_sum, feature_mask, sp,
+                                     root_bound, jnp.asarray(0, jnp.int32))
 
         state = {
             "P": P,
@@ -161,6 +165,9 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             "num_leaves": jnp.asarray(1, jnp.int32),
             "done": jnp.asarray(False),
         }
+        if use_mc:
+            state["leaf_mn"] = jnp.full((L,), -BIG, jnp.float32)
+            state["leaf_mx"] = jnp.full((L,), BIG, jnp.float32)
 
         nb_full, ic_full, hn_full = num_bins, is_cat, has_nan
 
@@ -254,11 +261,31 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             hist_left = jnp.where(left_smaller, hist_small, hist_big)
             hist_right = jnp.where(left_smaller, hist_big, hist_small)
 
+            # ---- monotone bounds for the children (BasicLeafConstraints::
+            # Update, monotone_constraints.hpp:487-501) ----
+            if use_mc:
+                p_mn = s["leaf_mn"][best_leaf]
+                p_mx = s["leaf_mx"][best_leaf]
+                out_l = jnp.clip(leaf_output(lsum[0], lsum[1], sp), p_mn, p_mx)
+                out_r = jnp.clip(leaf_output(rsum[0], rsum[1], sp), p_mn, p_mx)
+                m = jnp.where(fcat, 0, monotone[feat])
+                mid = (out_l + out_r) / 2.0
+                mn_l = jnp.where(m < 0, jnp.maximum(p_mn, mid), p_mn)
+                mx_l = jnp.where(m > 0, jnp.minimum(p_mx, mid), p_mx)
+                mn_r = jnp.where(m > 0, jnp.maximum(p_mn, mid), p_mn)
+                mx_r = jnp.where(m < 0, jnp.minimum(p_mx, mid), p_mx)
+                bound_l = jnp.stack([mn_l, mx_l])
+                bound_r = jnp.stack([mn_r, mx_r])
+            else:
+                bound_l = bound_r = None
+
             # ---- children candidates ----
             child_depth = s["leaf_depth"][best_leaf] + 1
             depth_ok = jnp.logical_or(max_depth <= 0, child_depth < max_depth)
-            cl = strat.leaf_candidates(hist_left, lsum, feature_mask, sp)
-            cr = strat.leaf_candidates(hist_right, rsum, feature_mask, sp)
+            cl = strat.leaf_candidates(hist_left, lsum, feature_mask, sp,
+                                       bound_l, child_depth)
+            cr = strat.leaf_candidates(hist_right, rsum, feature_mask, sp,
+                                       bound_r, child_depth)
             gl_ = jnp.where(depth_ok, cl[0], NEG_INF)
             gr_ = jnp.where(depth_ok, cr[0], NEG_INF)
 
@@ -322,10 +349,18 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                                         leaf_output(psum_[0], psum_[1], sp))
             out["internal_weight"] = upd(s["internal_weight"], node, psum_[1])
             out["internal_count"] = upd(s["internal_count"], node, psum_[2])
-            lv = upd(s["leaf_value"], best_leaf,
-                     leaf_output(lsum[0], lsum[1], sp))
-            out["leaf_value"] = upd(lv, new_id,
-                                    leaf_output(rsum[0], rsum[1], sp))
+            if use_mc:
+                out["leaf_mn"] = upd(upd(s["leaf_mn"], best_leaf, mn_l),
+                                     new_id, mn_r)
+                out["leaf_mx"] = upd(upd(s["leaf_mx"], best_leaf, mx_l),
+                                     new_id, mx_r)
+                lv = upd(s["leaf_value"], best_leaf, out_l)
+                out["leaf_value"] = upd(lv, new_id, out_r)
+            else:
+                lv = upd(s["leaf_value"], best_leaf,
+                         leaf_output(lsum[0], lsum[1], sp))
+                out["leaf_value"] = upd(lv, new_id,
+                                        leaf_output(rsum[0], rsum[1], sp))
             lw = upd(s["leaf_weight"], best_leaf, lsum[1])
             out["leaf_weight"] = upd(lw, new_id, rsum[1])
             lc = upd(s["leaf_count"], best_leaf, lsum[2])

@@ -43,7 +43,7 @@ import numpy as np
 
 from ..config import Config
 from ..ops.histogram import build_histogram
-from ..ops.split import (NEG_INF, FeatureSplits, SplitParams,
+from ..ops.split import (BIG, NEG_INF, FeatureSplits, SplitParams,
                          best_split_per_feature, leaf_output)
 from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
 
@@ -71,11 +71,13 @@ class GrownTree(NamedTuple):
 
 
 def local_best_candidate(hist, leaf_sum, num_bins, is_cat, has_nan,
-                         feature_mask, params) -> Tuple[jnp.ndarray, ...]:
+                         feature_mask, params, monotone=None, bound=None,
+                         depth=None) -> Tuple[jnp.ndarray, ...]:
     """Best split over (local) features for one leaf -> scalar candidate
     tuple (gain, feat, bin, default_left, left_sum, right_sum)."""
     fs: FeatureSplits = best_split_per_feature(hist, leaf_sum, num_bins,
-                                               is_cat, has_nan, params)
+                                               is_cat, has_nan, params,
+                                               monotone, bound, depth)
     gain = jnp.where(feature_mask, fs.gain, NEG_INF)
     f = jnp.argmax(gain)
     return (gain[f], f.astype(jnp.int32), fs.threshold_bin[f],
@@ -98,10 +100,11 @@ class CommStrategy:
         histogram width.
     """
 
-    def __init__(self, num_bins, is_cat, has_nan):
+    def __init__(self, num_bins, is_cat, has_nan, monotone=None):
         self.num_bins_full = num_bins
         self.is_cat_full = is_cat
         self.has_nan_full = has_nan
+        self.monotone_full = monotone
 
     def reduce_sum(self, v):
         return v
@@ -117,9 +120,11 @@ class CommStrategy:
         return (self.num_bins_full, self.is_cat_full, self.has_nan_full,
                 feature_mask)
 
-    def leaf_candidates(self, hist, leaf_sum, feature_mask, params):
+    def leaf_candidates(self, hist, leaf_sum, feature_mask, params,
+                        bound=None, depth=None):
         nb, ic, hn, fm = self.local_meta(feature_mask)
-        return local_best_candidate(hist, leaf_sum, nb, ic, hn, fm, params)
+        return local_best_candidate(hist, leaf_sum, nb, ic, hn, fm, params,
+                                    self.monotone_full, bound, depth)
 
     def get_column(self, X, feat):
         return jnp.take(X, feat, axis=1).astype(jnp.int32)
@@ -153,12 +158,16 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
             return build_histogram_pallas(X_T, g, h, m, num_bins=max_bins)
         return build_histogram(X, g, h, m, **hist_kwargs)
 
+    use_mc = split_params.use_monotone
+
     def grow(X: jnp.ndarray, X_T, grad: jnp.ndarray, hess: jnp.ndarray,
              sample_mask: jnp.ndarray, num_bins: jnp.ndarray,
              is_cat: jnp.ndarray, has_nan: jnp.ndarray,
-             feature_mask: jnp.ndarray) -> GrownTree:
+             monotone: jnp.ndarray, feature_mask: jnp.ndarray) -> GrownTree:
         strat = strategy if strategy is not None else CommStrategy(
-            num_bins, is_cat, has_nan)
+            num_bins, is_cat, has_nan, monotone)
+        if strategy is not None:
+            strat.monotone_full = monotone
         n, f_local = X.shape
 
         root_hist = strat.reduce_hist(
@@ -168,8 +177,10 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
             jnp.sum(hess * sample_mask),
             jnp.sum(sample_mask)]))
 
+        root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
         cand = strat.leaf_candidates(root_hist, root_sum, feature_mask,
-                                     split_params)
+                                     split_params, root_bound,
+                                     jnp.asarray(0, jnp.int32))
 
         # Per-split child-row compaction buckets: the smaller child's rows
         # are gathered into the smallest adequate fixed-size buffer (a
@@ -230,6 +241,9 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
         if use_hist_pool:
             state["hists"] = jnp.zeros((L, f_local, max_bins, 3),
                                        jnp.float32).at[0].set(root_hist)
+        if use_mc:
+            state["leaf_mn"] = jnp.full((L,), -BIG, jnp.float32)
+            state["leaf_mx"] = jnp.full((L,), BIG, jnp.float32)
 
         nb_full = strat.num_bins_full
         ic_full = strat.is_cat_full
@@ -316,13 +330,35 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
                 hist_right = strat.reduce_hist(_build_hist(
                     X, X_T, grad, hess, right_mask))
 
+            # ---- monotone bounds for the children (BasicLeafConstraints::
+            # Update, monotone_constraints.hpp:487-501: split outputs are
+            # clamped to the leaf's bounds; the mid-point partitions the
+            # output range between the children) ----
+            if use_mc:
+                p_mn = s["leaf_mn"][best_leaf]
+                p_mx = s["leaf_mx"][best_leaf]
+                out_l = jnp.clip(leaf_output(lsum[0], lsum[1], split_params),
+                                 p_mn, p_mx)
+                out_r = jnp.clip(leaf_output(rsum[0], rsum[1], split_params),
+                                 p_mn, p_mx)
+                m = jnp.where(fcat, 0, monotone[feat])
+                mid = (out_l + out_r) / 2.0
+                mn_l = jnp.where(m < 0, jnp.maximum(p_mn, mid), p_mn)
+                mx_l = jnp.where(m > 0, jnp.minimum(p_mx, mid), p_mx)
+                mn_r = jnp.where(m > 0, jnp.maximum(p_mn, mid), p_mn)
+                mx_r = jnp.where(m < 0, jnp.minimum(p_mx, mid), p_mx)
+                bound_l = jnp.stack([mn_l, mx_l])
+                bound_r = jnp.stack([mn_r, mx_r])
+            else:
+                bound_l = bound_r = None
+
             # ---- children candidates ----
             child_depth = s["leaf_depth"][best_leaf] + 1
             depth_ok = jnp.logical_or(max_depth <= 0, child_depth < max_depth)
             cl = strat.leaf_candidates(hist_left, lsum, feature_mask,
-                                       split_params)
+                                       split_params, bound_l, child_depth)
             cr = strat.leaf_candidates(hist_right, rsum, feature_mask,
-                                       split_params)
+                                       split_params, bound_r, child_depth)
             gl = jnp.where(depth_ok, cl[0], NEG_INF)
             gr = jnp.where(depth_ok, cr[0], NEG_INF)
 
@@ -381,10 +417,18 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
                                                     split_params))
             out["internal_weight"] = upd(s["internal_weight"], node, psum_[1])
             out["internal_count"] = upd(s["internal_count"], node, psum_[2])
-            lv = upd(s["leaf_value"], best_leaf,
-                     leaf_output(lsum[0], lsum[1], split_params))
-            out["leaf_value"] = upd(lv, new_id,
-                                    leaf_output(rsum[0], rsum[1], split_params))
+            if use_mc:
+                out["leaf_mn"] = upd(upd(s["leaf_mn"], best_leaf, mn_l),
+                                     new_id, mn_r)
+                out["leaf_mx"] = upd(upd(s["leaf_mx"], best_leaf, mx_l),
+                                     new_id, mx_r)
+                lv = upd(s["leaf_value"], best_leaf, out_l)
+                out["leaf_value"] = upd(lv, new_id, out_r)
+            else:
+                lv = upd(s["leaf_value"], best_leaf,
+                         leaf_output(lsum[0], lsum[1], split_params))
+                out["leaf_value"] = upd(
+                    lv, new_id, leaf_output(rsum[0], rsum[1], split_params))
             lw = upd(s["leaf_weight"], best_leaf, lsum[1])
             out["leaf_weight"] = upd(lw, new_id, rsum[1])
             lc = upd(s["leaf_count"], best_leaf, lsum[2])
@@ -427,6 +471,14 @@ def resolve_hist_impl(config: Config, parallel: bool = False) -> str:
 
 
 def split_params_from_config(config: Config) -> SplitParams:
+    mc = config.monotone_constraints or []
+    use_mc = any(int(v) != 0 for v in mc)
+    if use_mc and config.monotone_constraints_method not in ("basic",):
+        from ..utils.log import log_warning
+        log_warning(f"monotone_constraints_method="
+                    f"'{config.monotone_constraints_method}' is not "
+                    f"implemented; falling back to 'basic' (safe but more "
+                    f"conservative bounds)")
     return SplitParams(
         lambda_l1=float(config.lambda_l1),
         lambda_l2=float(config.lambda_l2),
@@ -436,7 +488,9 @@ def split_params_from_config(config: Config) -> SplitParams:
         max_delta_step=float(config.max_delta_step),
         cat_l2=float(config.cat_l2),
         cat_smooth=float(config.cat_smooth),
-        path_smooth=float(config.path_smooth))
+        path_smooth=float(config.path_smooth),
+        use_monotone=use_mc,
+        monotone_penalty=float(config.monotone_penalty))
 
 
 def hist_pool_fits(config: Config, num_features: int, max_bins: int) -> bool:
@@ -459,12 +513,16 @@ class SerialTreeLearner:
     feature descriptors (reference tree_learner.h:27 ``TreeLearner``)."""
 
     def __init__(self, config: Config, num_features: int, max_bins: int,
-                 num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray):
+                 num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
+                 monotone: Optional[np.ndarray] = None):
         self.config = config
         self.max_bins = int(max_bins)
         self.num_bins = jnp.asarray(num_bins, jnp.int32)
         self.is_cat = jnp.asarray(is_cat, jnp.bool_)
         self.has_nan = jnp.asarray(has_nan, jnp.bool_)
+        self.monotone = jnp.asarray(
+            monotone if monotone is not None else np.zeros(num_features),
+            jnp.int32)
         self.num_features = num_features
         self.split_params = split_params_from_config(config)
         self.use_hist_pool = hist_pool_fits(config, num_features, self.max_bins)
@@ -513,7 +571,7 @@ class SerialTreeLearner:
         if not self.partitioned:
             return self._grow(X_dev, None, grad, hess, sample_mask,
                               self.num_bins, self.is_cat, self.has_nan,
-                              feature_mask)
+                              self.monotone, feature_mask)
         n = X_dev.shape[0]
         if self.pallas:  # pad rows to the Pallas kernel's block
             from ..ops.histogram_pallas import pad_rows
@@ -531,7 +589,7 @@ class SerialTreeLearner:
             sample_mask = jnp.pad(sample_mask, (0, pad))
         grown = self._grow(self._Xp, grad, hess, sample_mask,
                            self.num_bins, self.is_cat, self.has_nan,
-                           feature_mask)
+                           self.monotone, feature_mask)
         if pad:
             grown = grown._replace(row_leaf=grown.row_leaf[:n])
         return grown

@@ -127,7 +127,8 @@ class GBDT:
         is_cat = np.array([m.is_categorical for m in mappers], bool)
         has_nan = np.array([m.missing_type == MissingType.NAN for m in mappers],
                            bool)
-        self.learner = self._create_learner(num_bins, is_cat, has_nan)
+        self.learner = self._create_learner(num_bins, is_cat, has_nan,
+                                            self._inner_monotone())
         self.X_dev = jnp.asarray(train_set.X_binned)
 
         if self.objective is None and cfg.objective != "none":
@@ -165,15 +166,26 @@ class GBDT:
             for m in self.train_metrics:
                 m.init(md, self.num_data)
 
-    def _create_learner(self, num_bins, is_cat, has_nan):
+    def _inner_monotone(self) -> Optional[np.ndarray]:
+        """Map config.monotone_constraints (original column indexing, may be
+        shorter than the column count) onto the inner used-feature axis."""
+        mc = self.config.monotone_constraints
+        if not mc or not any(int(v) != 0 for v in mc):
+            return None
+        ts = self.train_set
+        full = np.zeros(ts.num_total_features, np.int32)
+        full[:len(mc)] = [int(v) for v in mc]
+        return full[ts.used_feature_map]
+
+    def _create_learner(self, num_bins, is_cat, has_nan, monotone=None):
         cfg = self.config
         if cfg.tree_learner == "serial" or cfg.num_machines <= 1 and \
                 cfg.tree_learner not in ("data", "feature", "voting"):
             return SerialTreeLearner(cfg, self.num_features, self.max_bins,
-                                     num_bins, is_cat, has_nan)
+                                     num_bins, is_cat, has_nan, monotone)
         from ..parallel import create_parallel_learner
         return create_parallel_learner(cfg, self.num_features, self.max_bins,
-                                       num_bins, is_cat, has_nan)
+                                       num_bins, is_cat, has_nan, monotone)
 
     def add_valid(self, valid_set: Dataset, name: str) -> None:
         valid_set.construct(self.config)

@@ -21,12 +21,13 @@ reference's ``Dataset::FixHistogram`` restore step is unnecessary.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["SplitParams", "FeatureSplits", "best_split_per_feature", "leaf_output"]
+__all__ = ["SplitParams", "FeatureSplits", "best_split_per_feature",
+           "leaf_output", "monotone_penalty_factor", "BIG"]
 
 NEG_INF = -1e30
 
@@ -42,6 +43,10 @@ class SplitParams(NamedTuple):
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
     path_smooth: float = 0.0
+    use_monotone: bool = False     # any monotone_constraints nonzero
+    monotone_penalty: float = 0.0
+
+BIG = 1e30  # "unbounded" leaf-output constraint sentinel
 
 
 class FeatureSplits(NamedTuple):
@@ -73,11 +78,34 @@ def leaf_output(g: jnp.ndarray, h: jnp.ndarray, params: SplitParams) -> jnp.ndar
     return out
 
 
+def _gain_given_output(g, h, out, l1: float, l2: float):
+    """Objective improvement of a leaf FORCED to value ``out`` (reference
+    feature_histogram.hpp ``GetLeafGainGivenOutput``) — equals the standard
+    closed-form gain when ``out`` is the unconstrained optimum."""
+    t = _threshold_l1(g, l1)
+    return -(2.0 * t * out + (h + l2) * out * out)
+
+
+def monotone_penalty_factor(depth, penalty: float):
+    """Gain multiplier for splits on monotone features
+    (reference monotone_constraints.hpp:355
+    ``ComputeMonotoneSplitGainPenalty``)."""
+    eps = 1e-15
+    d = depth.astype(jnp.float32)
+    return jnp.where(penalty >= d + 1.0, eps,
+                     jnp.where(penalty <= 1.0,
+                               1.0 - penalty / jnp.exp2(d) + eps,
+                               1.0 - jnp.exp2(penalty - 1.0 - d) + eps))
+
+
 @functools.partial(jax.jit, static_argnames=("params",))
 def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                            num_bins: jnp.ndarray, is_cat: jnp.ndarray,
                            has_nan: jnp.ndarray,
-                           params: SplitParams) -> FeatureSplits:
+                           params: SplitParams,
+                           monotone: Optional[jnp.ndarray] = None,
+                           bound: Optional[jnp.ndarray] = None,
+                           depth: Optional[jnp.ndarray] = None) -> FeatureSplits:
     """Best split per feature from one leaf's histograms.
 
     Args:
@@ -88,6 +116,9 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
       is_cat: (F,) bool — categorical features use one-vs-rest splits.
       has_nan: (F,) bool — feature's last bin holds NaN values.
       params: static hyperparameters.
+      monotone/bound/depth: only read when ``params.use_monotone`` —
+        per-feature ±1 constraint directions (F,), the leaf's (min, max)
+        output bounds (2,), and the leaf's depth (for monotone_penalty).
     Returns:
       FeatureSplits with per-feature best candidates.
     """
@@ -95,6 +126,10 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     l1, l2 = params.lambda_l1, params.lambda_l2
     min_h = params.min_sum_hessian_in_leaf
     min_cnt = float(params.min_data_in_leaf)
+    use_mc = params.use_monotone
+    if use_mc:
+        mn, mx = bound[0], bound[1]
+        mono = jnp.where(is_cat, 0, monotone)[:, None]           # (F, 1)
 
     parent_gain = _leaf_gain(parent_sum[0], parent_sum[1], l1, l2)
     min_gain_shift = parent_gain + params.min_gain_to_split
@@ -119,13 +154,36 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     cum = jnp.cumsum(hist_m, axis=1)                              # (F, B, 3)
     total = parent_sum[None, :]                                   # (1, 3)
 
+    def clamped_out(s, l2_eff):
+        t = _threshold_l1(s[..., 0], l1)
+        h_ = s[..., 1] + l2_eff
+        out = jnp.where(h_ > 0, -t / h_, 0.0)
+        if params.max_delta_step > 0.0:
+            out = jnp.clip(out, -params.max_delta_step, params.max_delta_step)
+        return jnp.clip(out, mn, mx)
+
     def dir_gain(left):
         right = total[:, None, :] - left
-        gl = _leaf_gain(left[..., 0], left[..., 1], l1, l2)
-        gr = _leaf_gain(right[..., 0], right[..., 1], l1, l2)
         ok = ((left[..., 2] >= min_cnt) & (right[..., 2] >= min_cnt) &
               (left[..., 1] >= min_h) & (right[..., 1] >= min_h) & thr_valid)
+        if use_mc:
+            # constrained outputs (GetSplitGains USE_MC branch,
+            # feature_histogram.hpp): clamp to the leaf's [min, max]; a
+            # monotone feature's split must respect the direction
+            out_l = clamped_out(left, l2)
+            out_r = clamped_out(right, l2)
+            gl = _gain_given_output(left[..., 0], left[..., 1], out_l, l1, l2)
+            gr = _gain_given_output(right[..., 0], right[..., 1], out_r, l1, l2)
+            viol = (((mono > 0) & (out_l > out_r)) |
+                    ((mono < 0) & (out_l < out_r)))
+            ok = ok & jnp.logical_not(viol)
+        else:
+            gl = _leaf_gain(left[..., 0], left[..., 1], l1, l2)
+            gr = _leaf_gain(right[..., 0], right[..., 1], l1, l2)
         g = gl + gr - min_gain_shift
+        if use_mc and params.monotone_penalty > 0.0:
+            pen = monotone_penalty_factor(depth, params.monotone_penalty)
+            g = jnp.where(mono != 0, g * pen, g)
         return jnp.where(ok & (g > 0), g, NEG_INF), left
 
     # numerical, missing->right (left = cum of real bins up to b)
@@ -139,8 +197,16 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     cat_l2 = l2 + params.cat_l2
     cat_left = hist_m
     cat_right = total[:, None, :] - cat_left
-    cgl = _leaf_gain(cat_left[..., 0], cat_left[..., 1], l1, cat_l2)
-    cgr = _leaf_gain(cat_right[..., 0], cat_right[..., 1], l1, cat_l2)
+    if use_mc:  # clamp outputs to the leaf bounds (no direction for cats)
+        c_out_l = clamped_out(cat_left, cat_l2)
+        c_out_r = clamped_out(cat_right, cat_l2)
+        cgl = _gain_given_output(cat_left[..., 0], cat_left[..., 1], c_out_l,
+                                 l1, cat_l2)
+        cgr = _gain_given_output(cat_right[..., 0], cat_right[..., 1], c_out_r,
+                                 l1, cat_l2)
+    else:
+        cgl = _leaf_gain(cat_left[..., 0], cat_left[..., 1], l1, cat_l2)
+        cgr = _leaf_gain(cat_right[..., 0], cat_right[..., 1], l1, cat_l2)
     cat_ok = ((cat_left[..., 2] >= min_cnt) & (cat_right[..., 2] >= min_cnt) &
               (cat_left[..., 1] >= min_h) & (cat_right[..., 1] >= min_h) & real_bin)
     cat_gain = cgl + cgr - min_gain_shift

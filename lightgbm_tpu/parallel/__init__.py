@@ -18,7 +18,7 @@ from .mesh import get_mesh
 
 
 def create_parallel_learner(config, num_features, max_bins, num_bins, is_cat,
-                            has_nan):
+                            has_nan, monotone=None):
     """Factory (reference tree_learner.h:104 TreeLearner::CreateTreeLearner
     dispatching on tree_learner type)."""
     kind = config.tree_learner
@@ -29,4 +29,5 @@ def create_parallel_learner(config, num_features, max_bins, num_bins, is_cat,
     }.get(kind)
     if cls is None:
         raise ValueError(f"Unknown tree_learner: {kind}")
-    return cls(config, num_features, max_bins, num_bins, is_cat, has_nan)
+    return cls(config, num_features, max_bins, num_bins, is_cat, has_nan,
+               monotone)

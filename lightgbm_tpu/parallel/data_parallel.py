@@ -56,7 +56,8 @@ class DataParallelTreeLearner:
     name = "data"
 
     def __init__(self, config: Config, num_features: int, max_bins: int,
-                 num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray):
+                 num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
+                 monotone: Optional[np.ndarray] = None):
         self.config = config
         self.max_bins = int(max_bins)
         self.num_features = num_features
@@ -66,6 +67,9 @@ class DataParallelTreeLearner:
         self.num_bins = jnp.asarray(num_bins, jnp.int32)
         self.is_cat = jnp.asarray(is_cat, jnp.bool_)
         self.has_nan = jnp.asarray(has_nan, jnp.bool_)
+        self.monotone = jnp.asarray(
+            monotone if monotone is not None else np.zeros(num_features),
+            jnp.int32)
         strategy = DataParallelStrategy(self.axis, self.num_bins, self.is_cat,
                                         self.has_nan)
         grow_t = make_grow_fn(
@@ -77,8 +81,8 @@ class DataParallelTreeLearner:
             use_hist_pool=hist_pool_fits(config, num_features, self.max_bins),
             strategy=strategy, jit=False)
 
-        def grow(X, g, h, m, nb, ic, hn, fm):
-            return grow_t(X, None, g, h, m, nb, ic, hn, fm)
+        def grow(X, g, h, m, nb, ic, hn, mono, fm):
+            return grow_t(X, None, g, h, m, nb, ic, hn, mono, fm)
         tree_specs = GrownTree(
             split_feature=P(), threshold_bin=P(), nan_bin=P(),
             decision_type=P(), left_child=P(), right_child=P(),
@@ -88,7 +92,7 @@ class DataParallelTreeLearner:
         self._grow = jax.jit(jax.shard_map(
             grow, mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis), P(self.axis), P(self.axis),
-                      P(), P(), P(), P()),
+                      P(), P(), P(), P(), P()),
             out_specs=tree_specs,
             check_vma=False))
 
@@ -105,7 +109,8 @@ class DataParallelTreeLearner:
             hess = jnp.pad(hess, (0, pad))
             sample_mask = jnp.pad(sample_mask, (0, pad))
         grown = self._grow(X_dev, grad, hess, sample_mask, self.num_bins,
-                           self.is_cat, self.has_nan, feature_mask)
+                           self.is_cat, self.has_nan, self.monotone,
+                           feature_mask)
         if pad:
             grown = grown._replace(row_leaf=grown.row_leaf[:n])
         return grown

@@ -51,13 +51,17 @@ class FeatureParallelStrategy(CommStrategy):
         return sl(self.num_bins_full), sl(self.is_cat_full), \
             sl(self.has_nan_full), start
 
-    def leaf_candidates(self, hist_local, leaf_sum, feature_mask, params):
+    def leaf_candidates(self, hist_local, leaf_sum, feature_mask, params,
+                        bound=None, depth=None):
         nb, ic, hn, start = self._local_slices()
         r = jax.lax.axis_index(self.axis_name)
         fm = jax.lax.dynamic_slice(feature_mask, (r * self.f_local,),
                                    (self.f_local,))
+        mono = jax.lax.dynamic_slice(self.monotone_full,
+                                     (r * self.f_local,), (self.f_local,)) \
+            if self.monotone_full is not None else None
         g, f_loc, b, dl, ls, rs = local_best_candidate(
-            hist_local, leaf_sum, nb, ic, hn, fm, params)
+            hist_local, leaf_sum, nb, ic, hn, fm, params, mono, bound, depth)
         # global best with deterministic tie-break on the feature index
         # (reference SyncUpGlobalBestSplit allreduce-max)
         gmax = jax.lax.pmax(g, self.axis_name)
@@ -86,7 +90,8 @@ class FeatureParallelTreeLearner:
     name = "feature"
 
     def __init__(self, config: Config, num_features: int, max_bins: int,
-                 num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray):
+                 num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
+                 monotone: Optional[np.ndarray] = None):
         self.config = config
         self.max_bins = int(max_bins)
         self.num_features = num_features
@@ -104,6 +109,9 @@ class FeatureParallelTreeLearner:
             np.concatenate([is_cat, np.zeros(self.f_pad, bool)]), jnp.bool_)
         self.has_nan = jnp.asarray(
             np.concatenate([has_nan, np.zeros(self.f_pad, bool)]), jnp.bool_)
+        mono_np = monotone if monotone is not None else np.zeros(num_features)
+        self.monotone = jnp.asarray(
+            np.concatenate([mono_np, np.zeros(self.f_pad)]), jnp.int32)
         strategy = FeatureParallelStrategy(self.axis, self.f_local,
                                            self.num_bins, self.is_cat,
                                            self.has_nan)
@@ -116,8 +124,8 @@ class FeatureParallelTreeLearner:
             use_hist_pool=hist_pool_fits(config, self.f_local, self.max_bins),
             strategy=strategy, jit=False)
 
-        def grow(X, g, h, m, nb, ic, hn, fm):
-            return grow_t(X, None, g, h, m, nb, ic, hn, fm)
+        def grow(X, g, h, m, nb, ic, hn, mono, fm):
+            return grow_t(X, None, g, h, m, nb, ic, hn, mono, fm)
         tree_specs = GrownTree(
             split_feature=P(), threshold_bin=P(), nan_bin=P(),
             decision_type=P(), left_child=P(), right_child=P(),
@@ -130,7 +138,8 @@ class FeatureParallelTreeLearner:
         # slices per shard.
         self._grow = jax.jit(jax.shard_map(
             grow, mesh=self.mesh,
-            in_specs=(P(None, self.axis), P(), P(), P(), P(), P(), P(), P()),
+            in_specs=(P(None, self.axis), P(), P(), P(), P(), P(), P(), P(),
+                      P()),
             out_specs=tree_specs,
             check_vma=False))
 
@@ -143,4 +152,5 @@ class FeatureParallelTreeLearner:
             X_dev = jnp.pad(X_dev, ((0, 0), (0, self.f_pad)))
             feature_mask = jnp.pad(feature_mask, (0, self.f_pad))
         return self._grow(X_dev, grad, hess, sample_mask, self.num_bins,
-                          self.is_cat, self.has_nan, feature_mask)
+                          self.is_cat, self.has_nan, self.monotone,
+                          feature_mask)

@@ -51,14 +51,16 @@ class VotingStrategy(CommStrategy):
     # reduce_hist stays identity: the pool keeps shard-LOCAL histograms and
     # only voted features are aggregated below.
 
-    def leaf_candidates(self, hist_local, leaf_sum, feature_mask, params):
+    def leaf_candidates(self, hist_local, leaf_sum, feature_mask, params,
+                        bound=None, depth=None):
         k = self.top_k
         # 1. local candidate gains with relaxed (1/num_machines) constraints
         #    (voting_parallel_tree_learner.cpp:62-63)
         local_sum = leaf_sum / self.ndev
         fs = best_split_per_feature(hist_local, local_sum, self.num_bins_full,
                                     self.is_cat_full, self.has_nan_full,
-                                    self.local_params)
+                                    self.local_params, self.monotone_full,
+                                    bound, depth)
         gain = jnp.where(feature_mask, fs.gain, NEG_INF)
         # 2. local top-k vote -> allgather (LightSplitInfo allgather :322)
         _, top_ids = jax.lax.top_k(gain, k)
@@ -78,8 +80,10 @@ class VotingStrategy(CommStrategy):
         ic = self.is_cat_full[selected]
         hn = self.has_nan_full[selected]
         fm = feature_mask[selected]
+        mono = self.monotone_full[selected] \
+            if self.monotone_full is not None else None
         g, f_loc, b, dl, ls, rs = local_best_candidate(
-            hist_sel, leaf_sum, nb, ic, hn, fm, params)
+            hist_sel, leaf_sum, nb, ic, hn, fm, params, mono, bound, depth)
         return (g, selected[f_loc], b, dl, ls, rs)
 
 
@@ -87,7 +91,8 @@ class VotingParallelTreeLearner:
     name = "voting"
 
     def __init__(self, config: Config, num_features: int, max_bins: int,
-                 num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray):
+                 num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
+                 monotone: Optional[np.ndarray] = None):
         self.config = config
         self.max_bins = int(max_bins)
         self.num_features = num_features
@@ -97,6 +102,9 @@ class VotingParallelTreeLearner:
         self.num_bins = jnp.asarray(num_bins, jnp.int32)
         self.is_cat = jnp.asarray(is_cat, jnp.bool_)
         self.has_nan = jnp.asarray(has_nan, jnp.bool_)
+        self.monotone = jnp.asarray(
+            monotone if monotone is not None else np.zeros(num_features),
+            jnp.int32)
         sp = split_params_from_config(config)
         local_sp = sp._replace(
             min_data_in_leaf=max(1, sp.min_data_in_leaf // self.ndev),
@@ -113,8 +121,8 @@ class VotingParallelTreeLearner:
             use_hist_pool=hist_pool_fits(config, num_features, self.max_bins),
             strategy=strategy, jit=False)
 
-        def grow(X, g, h, m, nb, ic, hn, fm):
-            return grow_t(X, None, g, h, m, nb, ic, hn, fm)
+        def grow(X, g, h, m, nb, ic, hn, mono, fm):
+            return grow_t(X, None, g, h, m, nb, ic, hn, mono, fm)
         tree_specs = GrownTree(
             split_feature=P(), threshold_bin=P(), nan_bin=P(),
             decision_type=P(), left_child=P(), right_child=P(),
@@ -124,7 +132,7 @@ class VotingParallelTreeLearner:
         self._grow = jax.jit(jax.shard_map(
             grow, mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis), P(self.axis), P(self.axis),
-                      P(), P(), P(), P()),
+                      P(), P(), P(), P(), P()),
             out_specs=tree_specs,
             check_vma=False))
 
@@ -141,7 +149,8 @@ class VotingParallelTreeLearner:
             hess = jnp.pad(hess, (0, pad))
             sample_mask = jnp.pad(sample_mask, (0, pad))
         grown = self._grow(X_dev, grad, hess, sample_mask, self.num_bins,
-                           self.is_cat, self.has_nan, feature_mask)
+                           self.is_cat, self.has_nan, self.monotone,
+                           feature_mask)
         if pad:
             grown = grown._replace(row_leaf=grown.row_leaf[:n])
         return grown
