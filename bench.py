@@ -8,9 +8,9 @@ this on one real TPU chip).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Env knobs: BENCH_ROWS (default 1_000_000), BENCH_TREES (default 20),
-BENCH_LEAVES (255), BENCH_BINS (255).  iters/sec is steady-state (compile
-and first-tree warmup excluded).
+Env knobs: BENCH_ROWS (default 10_500_000 — the BASELINE's true scale),
+BENCH_TREES (default 50), BENCH_LEAVES (255), BENCH_BINS (255).  iters/sec
+is steady-state (compile and first-tree warmup excluded).
 """
 
 import json
@@ -24,8 +24,8 @@ BASELINE_ITERS_PER_SEC = 500.0 / 130.094  # reference Higgs CPU number
 
 
 def main() -> None:
-    rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
-    trees = int(os.environ.get("BENCH_TREES", 20))
+    rows = int(os.environ.get("BENCH_ROWS", 10_500_000))
+    trees = int(os.environ.get("BENCH_TREES", 50))
     leaves = int(os.environ.get("BENCH_LEAVES", 255))
     bins = int(os.environ.get("BENCH_BINS", 255))
 

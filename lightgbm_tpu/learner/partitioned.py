@@ -146,11 +146,14 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             "cand_dleft": jnp.zeros((L,), jnp.bool_).at[0].set(cand[3]),
             "cand_lsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[4]),
             "cand_rsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[5]),
+            "cand_member": jnp.zeros((L, max_bins), jnp.bool_).at[0].set(
+                cand[6]),
             "hists": jnp.zeros((L, F, max_bins, 3), jnp.float32).at[0].set(
                 root_hist),
             "split_feature": jnp.full((L - 1,), -1, jnp.int32),
             "threshold_bin": jnp.zeros((L - 1,), jnp.int32),
             "nan_bin": jnp.full((L - 1,), -1, jnp.int32),
+            "cat_member": jnp.zeros((L - 1, max_bins), jnp.bool_),
             "decision_type": jnp.zeros((L - 1,), jnp.int32),
             "left_child": jnp.zeros((L - 1,), jnp.int32),
             "right_child": jnp.zeros((L - 1,), jnp.int32),
@@ -181,7 +184,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             within the slice; rows outside [off, off+cnt) belong to other
             leaves and must not move."""
             def fn(op):
-                P, start, cnt, feat, thr, dleft, fcat, fnanb = op
+                P, start, cnt, feat, thr, dleft, fcat, fnanb, member = op
                 cstart = jnp.minimum(start, n - psize)
                 off = start - cstart
                 seg = jax.lax.dynamic_slice(P, (cstart, 0), (psize, W))
@@ -190,7 +193,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                 pos_idx = jnp.arange(psize, dtype=jnp.int32)
                 valid = (pos_idx >= off) & (pos_idx < off + cnt)
                 is_nanbin = col == fnanb
-                go_left = jnp.where(fcat, col == thr,
+                go_left = jnp.where(fcat, member[col],
                                     jnp.where(is_nanbin, dleft, col <= thr))
                 gl = go_left & valid
                 gr = jnp.logical_and(valid, jnp.logical_not(go_left))
@@ -236,6 +239,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             dleft = s["cand_dleft"][best_leaf]
             lsum = s["cand_lsum"][best_leaf]
             rsum = s["cand_rsum"][best_leaf]
+            member = s["cand_member"][best_leaf]
             psum_ = s["leaf_sum"][best_leaf]
             new_id = (t + 1).astype(jnp.int32)
 
@@ -247,7 +251,8 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
 
             P_new, nl = jax.lax.switch(
                 pick(seg_cnt), part_fns,
-                (s["P"], start, seg_cnt, feat, thr, dleft, fcat, f_nan_bin))
+                (s["P"], start, seg_cnt, feat, thr, dleft, fcat, f_nan_bin,
+                 member))
             nr = seg_cnt - nl
 
             # ---- smaller-child histogram on its contiguous segment ----
@@ -290,7 +295,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             gr_ = jnp.where(depth_ok, cr[0], NEG_INF)
 
             node = t
-            dleft_rec = jnp.where(fcat, thr == 0, dleft)
+            dleft_rec = jnp.where(fcat, member[0], dleft)
             dt_bits = (jnp.where(fcat, CAT_MASK, 0) |
                        jnp.where(dleft_rec, DEFAULT_LEFT_MASK, 0) |
                        jnp.where(fnan & jnp.logical_not(fcat), MISSING_NAN, 0)
@@ -338,9 +343,12 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                                    new_id, cr[4])
             out["cand_rsum"] = upd(upd(s["cand_rsum"], best_leaf, cl[5]),
                                    new_id, cr[5])
+            out["cand_member"] = upd(upd(s["cand_member"], best_leaf, cl[6]),
+                                     new_id, cr[6])
             out["split_feature"] = upd(s["split_feature"], node, feat)
             out["threshold_bin"] = upd(s["threshold_bin"], node, thr)
             out["nan_bin"] = upd(s["nan_bin"], node, f_nan_bin)
+            out["cat_member"] = upd(s["cat_member"], node, member)
             out["decision_type"] = upd(s["decision_type"], node, dt_bits)
             out["left_child"] = upd(left_child, node, enc_best)
             out["right_child"] = upd(right_child, node, -(new_id + 1))
@@ -389,7 +397,8 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         return GrownTree(
             split_feature=s["split_feature"],
             threshold_bin=s["threshold_bin"],
-            nan_bin=s["nan_bin"], decision_type=s["decision_type"],
+            nan_bin=s["nan_bin"], cat_member=s["cat_member"],
+            decision_type=s["decision_type"],
             left_child=s["left_child"], right_child=s["right_child"],
             split_gain=s["split_gain"], internal_value=s["internal_value"],
             internal_weight=s["internal_weight"],

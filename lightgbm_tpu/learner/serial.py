@@ -56,6 +56,7 @@ class GrownTree(NamedTuple):
     split_feature: jnp.ndarray     # (L-1,) int32 (global feature indices)
     threshold_bin: jnp.ndarray     # (L-1,) int32
     nan_bin: jnp.ndarray           # (L-1,) int32
+    cat_member: jnp.ndarray        # (L-1, B) bool — categorical LEFT bins
     decision_type: jnp.ndarray     # (L-1,) int32
     left_child: jnp.ndarray        # (L-1,) int32
     right_child: jnp.ndarray       # (L-1,) int32
@@ -81,7 +82,8 @@ def local_best_candidate(hist, leaf_sum, num_bins, is_cat, has_nan,
     gain = jnp.where(feature_mask, fs.gain, NEG_INF)
     f = jnp.argmax(gain)
     return (gain[f], f.astype(jnp.int32), fs.threshold_bin[f],
-            fs.default_left[f], fs.left_sum[f], fs.right_sum[f])
+            fs.default_left[f], fs.left_sum[f], fs.right_sum[f],
+            fs.cat_member[f])
 
 
 class CommStrategy:
@@ -221,9 +223,12 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
             "cand_dleft": jnp.zeros((L,), jnp.bool_).at[0].set(cand[3]),
             "cand_lsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[4]),
             "cand_rsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[5]),
+            "cand_member": jnp.zeros((L, max_bins), jnp.bool_).at[0].set(
+                cand[6]),
             "split_feature": jnp.full((L - 1,), -1, jnp.int32),
             "threshold_bin": jnp.zeros((L - 1,), jnp.int32),
             "nan_bin": jnp.full((L - 1,), -1, jnp.int32),
+            "cat_member": jnp.zeros((L - 1, max_bins), jnp.bool_),
             "decision_type": jnp.zeros((L - 1,), jnp.int32),
             "left_child": jnp.zeros((L - 1,), jnp.int32),
             "right_child": jnp.zeros((L - 1,), jnp.int32),
@@ -260,6 +265,7 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
             dleft = s["cand_dleft"][best_leaf]
             lsum = s["cand_lsum"][best_leaf]
             rsum = s["cand_rsum"][best_leaf]
+            member = s["cand_member"][best_leaf]      # (B,) categorical set
             psum_ = s["leaf_sum"][best_leaf]
             new_id = (t + 1).astype(jnp.int32)
 
@@ -270,7 +276,7 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
             f_nan_bin = jnp.where(fnan, nb_full[feat] - 1, -1)
             in_leaf = s["row_leaf"] == best_leaf
             is_nanbin = col == f_nan_bin
-            go_left = jnp.where(fcat, col == thr,
+            go_left = jnp.where(fcat, member[col],
                                 jnp.where(is_nanbin, dleft, col <= thr))
             row_leaf = jnp.where(do & in_leaf & jnp.logical_not(go_left),
                                  new_id, s["row_leaf"])
@@ -367,7 +373,7 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
             # categorical NaN rows live in bin 0 (most frequent category);
             # record default_left so raw-feature inference routes NaN the
             # same way the binned training partition did
-            dleft = jnp.where(fcat, thr == 0, dleft)
+            dleft = jnp.where(fcat, member[0], dleft)
             dt_bits = (jnp.where(fcat, CAT_MASK, 0) |
                        jnp.where(dleft, DEFAULT_LEFT_MASK, 0) |
                        jnp.where(fnan & jnp.logical_not(fcat), MISSING_NAN, 0)
@@ -405,9 +411,12 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
                                     new_id, cr[3])
             out["cand_lsum"] = upd(upd(s["cand_lsum"], best_leaf, cl[4]), new_id, cr[4])
             out["cand_rsum"] = upd(upd(s["cand_rsum"], best_leaf, cl[5]), new_id, cr[5])
+            out["cand_member"] = upd(upd(s["cand_member"], best_leaf, cl[6]),
+                                     new_id, cr[6])
             out["split_feature"] = upd(s["split_feature"], node, feat)
             out["threshold_bin"] = upd(s["threshold_bin"], node, thr)
             out["nan_bin"] = upd(s["nan_bin"], node, f_nan_bin)
+            out["cat_member"] = upd(s["cat_member"], node, member)
             out["decision_type"] = upd(s["decision_type"], node, dt_bits)
             out["left_child"] = upd(left_child, node, enc_best)
             out["right_child"] = upd(right_child, node, -(new_id + 1))
@@ -440,7 +449,8 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
         s = jax.lax.fori_loop(0, L - 1, body, state)
         return GrownTree(
             split_feature=s["split_feature"], threshold_bin=s["threshold_bin"],
-            nan_bin=s["nan_bin"], decision_type=s["decision_type"],
+            nan_bin=s["nan_bin"], cat_member=s["cat_member"],
+            decision_type=s["decision_type"],
             left_child=s["left_child"], right_child=s["right_child"],
             split_gain=s["split_gain"], internal_value=s["internal_value"],
             internal_weight=s["internal_weight"],
@@ -470,7 +480,10 @@ def resolve_hist_impl(config: Config, parallel: bool = False) -> str:
     return impl
 
 
-def split_params_from_config(config: Config) -> SplitParams:
+def split_params_from_config(config: Config,
+                             num_bins: Optional[np.ndarray] = None,
+                             is_cat: Optional[np.ndarray] = None
+                             ) -> SplitParams:
     mc = config.monotone_constraints or []
     use_mc = any(int(v) != 0 for v in mc)
     if use_mc and config.monotone_constraints_method not in ("basic",):
@@ -479,6 +492,12 @@ def split_params_from_config(config: Config) -> SplitParams:
                     f"'{config.monotone_constraints_method}' is not "
                     f"implemented; falling back to 'basic' (safe but more "
                     f"conservative bounds)")
+    # the sorted-subset categorical search is traced in only when some
+    # categorical feature exceeds the one-hot threshold
+    use_cat_subset = bool(
+        num_bins is not None and is_cat is not None and
+        np.any(np.asarray(is_cat) &
+               (np.asarray(num_bins) > int(config.max_cat_to_onehot))))
     return SplitParams(
         lambda_l1=float(config.lambda_l1),
         lambda_l2=float(config.lambda_l2),
@@ -490,7 +509,11 @@ def split_params_from_config(config: Config) -> SplitParams:
         cat_smooth=float(config.cat_smooth),
         path_smooth=float(config.path_smooth),
         use_monotone=use_mc,
-        monotone_penalty=float(config.monotone_penalty))
+        monotone_penalty=float(config.monotone_penalty),
+        max_cat_to_onehot=int(config.max_cat_to_onehot),
+        max_cat_threshold=int(config.max_cat_threshold),
+        min_data_per_group=int(config.min_data_per_group),
+        use_cat_subset=use_cat_subset)
 
 
 def hist_pool_fits(config: Config, num_features: int, max_bins: int) -> bool:
@@ -524,7 +547,7 @@ class SerialTreeLearner:
             monotone if monotone is not None else np.zeros(num_features),
             jnp.int32)
         self.num_features = num_features
-        self.split_params = split_params_from_config(config)
+        self.split_params = split_params_from_config(config, num_bins, is_cat)
         self.use_hist_pool = hist_pool_fits(config, num_features, self.max_bins)
         impl = resolve_hist_impl(config)
         if not self.use_hist_pool and impl == "pallas":

@@ -250,7 +250,8 @@ class RF(GBDT):
         for vi, (_, vset) in enumerate(self.valid_sets):
             vbins = vset._device_cache["bins"]
             delta = _walk_binned(vbins, grown.split_feature, grown.threshold_bin,
-                                 grown.nan_bin, grown.decision_type,
+                                 grown.nan_bin, grown.cat_member,
+                                 grown.decision_type,
                                  grown.left_child, grown.right_child,
                                  jnp.asarray(lv, jnp.float32), grown.num_leaves)
             if self._valid_tree_sum[vi] is None:

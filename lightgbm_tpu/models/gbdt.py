@@ -37,15 +37,38 @@ EPSILON = 1e-12
 
 def _grown_to_tree(grown: GrownTree, shrinkage: float, dataset: Dataset,
                    leaf_value_override: Optional[np.ndarray] = None) -> Tree:
-    """Pull one grown tree to host, attach raw-value thresholds."""
+    """Pull one grown tree to host, attach raw-value thresholds and
+    categorical bitsets (reference tree.h:85 SplitCategorical: cat nodes
+    store a rank into cat_boundaries; cat_threshold words are a bitset over
+    raw category values)."""
     num_leaves = int(grown.num_leaves)
     split_feature = np.asarray(grown.split_feature)
     threshold_bin = np.asarray(grown.threshold_bin)
+    decision_type = np.asarray(grown.decision_type)
+    member = np.asarray(grown.cat_member)
     mappers = [dataset.bin_mappers[j] for j in dataset.used_feature_map]
     thresh = np.zeros(len(split_feature), dtype=np.float64)
+    cat_boundaries: List[int] = [0]
+    cat_words: List[int] = []
+    has_cat = False
     for i in range(num_leaves - 1):
         f = int(split_feature[i])
-        if f >= 0:
+        if f < 0:
+            continue
+        from .tree import CAT_MASK as _CM
+        if decision_type[i] & _CM:
+            has_cat = True
+            bins = np.nonzero(member[i])[0]
+            b2c = mappers[f].bin_to_cat
+            cats = [int(b2c[b]) for b in bins if b < len(b2c)] or [0]
+            nw = max(cats) // 32 + 1
+            wd = np.zeros(nw, np.uint32)
+            for c in cats:
+                wd[c // 32] |= np.uint32(1 << (c % 32))
+            thresh[i] = float(len(cat_boundaries) - 1)   # rank
+            cat_words.extend(int(w) for w in wd)
+            cat_boundaries.append(len(cat_words))
+        else:
             thresh[i] = mappers[f].bin_to_value(int(threshold_bin[i]))
     tree = Tree(
         num_leaves=max(num_leaves, 1),
@@ -65,10 +88,23 @@ def _grown_to_tree(grown: GrownTree, shrinkage: float, dataset: Dataset,
                     else np.asarray(leaf_value_override, dtype=np.float64)),
         leaf_weight=np.asarray(grown.leaf_weight, dtype=np.float64),
         leaf_count=np.asarray(grown.leaf_count).astype(np.int64),
+        cat_boundaries=(np.asarray(cat_boundaries, np.int32)
+                        if has_cat else None),
+        cat_threshold=(np.asarray(cat_words, np.uint32)
+                       if has_cat else None),
+        cat_member_bins=member[:max(num_leaves - 1, 1)] if has_cat else None,
     )
     if shrinkage != 1.0:
         tree.shrink(shrinkage)
     return tree
+
+
+def _tree_cat_member(tree: Tree) -> jnp.ndarray:
+    """Binned categorical membership for a host tree's device walk (width-1
+    zeros when the tree has no categorical nodes)."""
+    if tree.cat_member_bins is not None:
+        return jnp.asarray(tree.cat_member_bins)
+    return jnp.zeros((max(len(tree.split_feature), 1), 1), jnp.bool_)
 
 
 @jax.jit
@@ -214,6 +250,7 @@ class GBDT:
                 delta = _walk_binned(
                     vbins, jnp.asarray(tree.split_feature),
                     jnp.asarray(tree.threshold_bin), jnp.asarray(tree.nan_bin),
+                    _tree_cat_member(tree),
                     jnp.asarray(tree.decision_type.astype(np.int32)),
                     jnp.asarray(tree.left_child), jnp.asarray(tree.right_child),
                     jnp.asarray(tree.leaf_value, dtype=jnp.float32),
@@ -438,7 +475,8 @@ class GBDT:
         for vi, (_, vset) in enumerate(self.valid_sets):
             vbins = vset._device_cache["bins"]
             delta = _walk_binned(vbins, grown.split_feature, grown.threshold_bin,
-                                 grown.nan_bin, grown.decision_type,
+                                 grown.nan_bin, grown.cat_member,
+                                 grown.decision_type,
                                  grown.left_child, grown.right_child,
                                  lv, grown.num_leaves)
             if self.num_tree_per_iteration == 1:
@@ -554,6 +592,9 @@ class GBDT:
         t.threshold_bin = np.zeros_like(t.split_feature)
         t.nan_bin = np.full_like(t.split_feature, -1)
         from ..binning import MissingType
+        from .tree import CAT_MASK as _CM
+        n_int = max(t.num_leaves - 1, 1)
+        member_bins = None
         for i in range(t.num_leaves - 1):
             rf = int(tree.split_feature[i])
             if rf not in inner_of_real:
@@ -564,13 +605,30 @@ class GBDT:
             t.split_feature[i] = f
             m = ds.bin_mappers[int(ds.used_feature_map[f])]
             if m.is_categorical:
-                t.threshold_bin[i] = m.cat_to_bin.get(
-                    int(tree.threshold[i]), 0)
+                # recover the category SET (bitset over raw values) as
+                # binned membership for the training-time walks
+                if member_bins is None:
+                    member_bins = np.zeros((n_int, self.max_bins), bool)
+                if tree.cat_boundaries is not None:
+                    rank = int(tree.threshold[i])
+                    lo = int(tree.cat_boundaries[rank])
+                    hi = int(tree.cat_boundaries[rank + 1])
+                    cats = [w * 32 + b
+                            for w in range(hi - lo)
+                            for b in range(32)
+                            if int(tree.cat_threshold[lo + w]) & (1 << b)]
+                else:  # legacy single-category node
+                    cats = [int(tree.threshold[i])]
+                bins = [m.cat_to_bin[c] for c in cats if c in m.cat_to_bin]
+                for b in bins:
+                    member_bins[i, b] = True
+                t.threshold_bin[i] = bins[0] if bins else 0
             else:
                 t.threshold_bin[i] = int(
                     m.value_to_bin(np.array([tree.threshold[i]]))[0])
             if m.missing_type == MissingType.NAN:
                 t.nan_bin[i] = m.num_bin - 1
+        t.cat_member_bins = member_bins
         return t
 
     def init_from_model(self, other: "GBDT") -> None:
@@ -678,6 +736,7 @@ class GBDT:
                 delta = wb(self.X_dev, jnp.asarray(tree.split_feature),
                            jnp.asarray(tree.threshold_bin),
                            jnp.asarray(tree.nan_bin),
+                           _tree_cat_member(tree),
                            jnp.asarray(tree.decision_type.astype(np.int32)),
                            jnp.asarray(tree.left_child),
                            jnp.asarray(tree.right_child),

@@ -65,18 +65,24 @@ def _tree_to_string(tree: Tree, real_feature_map: np.ndarray, index: int) -> str
                      for i in range(n_int)]
         buf.write("split_feature=" + _join(real_feat) + "\n")
         buf.write("split_gain=" + _join(tree.split_gain[:n_int], _fmt) + "\n")
-        # categorical nodes store the index into cat_boundaries as threshold
+        # categorical nodes store the index into cat_boundaries as threshold;
+        # cat_threshold carries the full bitset words over raw category
+        # values (reference tree.cpp Tree::ToString cat fields)
         thresholds = []
         cat_boundaries = [0]
         cat_threshold: List[int] = []
-        cat_rank = {node: r for r, node in enumerate(cat_nodes)}
         for i in range(n_int):
-            if i in cat_rank:
-                thresholds.append(float(cat_rank[i]))
-                cat_val = int(tree.threshold[i])
-                nwords = cat_val // 32 + 1
-                words = [0] * nwords
-                words[cat_val // 32] |= 1 << (cat_val % 32)
+            if tree.decision_type[i] & CAT_MASK:
+                if tree.cat_boundaries is not None:
+                    rank = int(tree.threshold[i])
+                    lo = int(tree.cat_boundaries[rank])
+                    hi = int(tree.cat_boundaries[rank + 1])
+                    words = [int(w) for w in tree.cat_threshold[lo:hi]]
+                else:  # legacy single-category node
+                    cat_val = int(tree.threshold[i])
+                    words = [0] * (cat_val // 32 + 1)
+                    words[cat_val // 32] |= 1 << (cat_val % 32)
+                thresholds.append(float(len(cat_boundaries) - 1))
                 cat_threshold.extend(words)
                 cat_boundaries.append(len(cat_threshold))
             else:
@@ -278,30 +284,18 @@ def _tree_from_block(block: Dict[str, str]) -> Tree:
     decision_type = arr("decision_type", np.uint8, n_int)
     threshold = arr("threshold", np.float64, n_int)
     num_cat = int(block.get("num_cat", 0))
+    cat_boundaries = None
+    cat_threshold = None
     if num_cat > 0:
-        cat_boundaries = arr("cat_boundaries", np.int64, num_cat + 1)
-        cat_threshold = arr("cat_threshold", np.int64, 0)
-        # resolve single-category bitsets back to category values; flag
-        # multi-category sets (sorted-subset splits) for host prediction
-        for i in range(n_int):
-            if decision_type[i] & CAT_MASK:
-                rank = int(threshold[i])
-                lo, hi = int(cat_boundaries[rank]), int(cat_boundaries[rank + 1])
-                bits = []
-                for w in range(lo, hi):
-                    word = int(cat_threshold[w])
-                    for b in range(32):
-                        if word & (1 << b):
-                            bits.append((w - lo) * 32 + b)
-                if len(bits) == 1:
-                    threshold[i] = float(bits[0])
-                else:
-                    log_warning("multi-category split loaded; prediction for "
-                                "this node keeps the first category only "
-                                "(sorted-subset categorical lands later)")
-                    threshold[i] = float(bits[0]) if bits else 0.0
+        # full bitset splits survive the round trip; threshold stays the
+        # rank into cat_boundaries (reference gbdt_model_text.cpp parsing)
+        cat_boundaries = arr("cat_boundaries", np.int32, num_cat + 1)
+        cat_threshold = arr("cat_threshold", np.uint32,
+                            int(cat_boundaries[-1]) if num_cat else 0)
 
     return Tree(
+        cat_boundaries=cat_boundaries,
+        cat_threshold=cat_threshold,
         num_leaves=nl,
         split_feature=arr("split_feature", np.int32, n_int),
         threshold_bin=np.zeros(n_int, np.int32),  # unknown without a Dataset
@@ -337,11 +331,13 @@ def model_to_dict(gbdt, start_iteration: int = 0,
                     "leaf_weight": float(tree.leaf_weight[leaf]),
                     "leaf_count": int(tree.leaf_count[leaf])}
         dt = int(tree.decision_type[node])
+        thr = ("||".join(str(c) for c in tree.cat_values(node))
+               if dt & CAT_MASK else float(tree.threshold[node]))
         return {
             "split_index": int(node),
             "split_feature": int(real_map[tree.split_feature[node]]),
             "split_gain": float(tree.split_gain[node]),
-            "threshold": float(tree.threshold[node]),
+            "threshold": thr,
             "decision_type": "==" if dt & CAT_MASK else "<=",
             "default_left": bool(dt & DEFAULT_LEFT_MASK),
             "missing_type": ["None", "Zero", "NaN"][(dt >> 2) & 3],

@@ -71,9 +71,7 @@ def _tree_shap(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
     def go_left(node, v):
         dt = tree.decision_type[node]
         if dt & CAT_MASK:
-            if np.isnan(v):
-                return bool(dt & DEFAULT_LEFT_MASK)
-            return int(v) == int(tree.threshold[node])
+            return tree.cat_decision(node, v)
         if np.isnan(v):
             if (dt >> 2) & 3 == 2:
                 return bool(dt & DEFAULT_LEFT_MASK)

@@ -59,10 +59,52 @@ class Tree:
     leaf_weight: np.ndarray            # (L,) float64
     leaf_count: np.ndarray             # (L,) int64
     shrinkage: float = 1.0
+    # Categorical set splits (reference tree.h:85 SplitCategorical):
+    # cat nodes store threshold = RANK into cat_boundaries; the flat
+    # cat_threshold uint32 words are a bitset over RAW category values
+    # (cat_boundaries[rank]..cat_boundaries[rank+1] words per node).
+    cat_boundaries: Optional[np.ndarray] = None   # (num_cat+1,) int32
+    cat_threshold: Optional[np.ndarray] = None    # flat uint32 words
+    # runtime-only binned membership for training-time walks (not
+    # serialized; rebuilt from the bin mappers on load): (L-1, B) bool
+    cat_member_bins: Optional[np.ndarray] = None
 
     @property
     def max_leaves(self) -> int:
         return len(self.leaf_value)
+
+    def num_cat_nodes(self) -> int:
+        return 0 if self.cat_boundaries is None else \
+            len(self.cat_boundaries) - 1
+
+    def cat_values(self, node: int) -> List[int]:
+        """Raw category values in the node's LEFT set."""
+        if self.cat_boundaries is None:
+            return [int(self.threshold[node])]
+        rank = int(self.threshold[node])
+        lo = int(self.cat_boundaries[rank])
+        hi = int(self.cat_boundaries[rank + 1])
+        return [w * 32 + b for w in range(hi - lo) for b in range(32)
+                if int(self.cat_threshold[lo + w]) & (1 << b)]
+
+    def cat_decision(self, node: int, value: float) -> bool:
+        """Set-membership decision for a categorical node on a RAW value
+        (reference tree.h FindInBitset + Tree::CategoricalDecision).
+        True -> go left."""
+        if np.isnan(value):
+            return bool(self.decision_type[node] & DEFAULT_LEFT_MASK)
+        iv = int(value)
+        if iv < 0 or iv != value:
+            return False
+        if self.cat_boundaries is None:
+            return iv == int(self.threshold[node])  # legacy single-category
+        rank = int(self.threshold[node])
+        lo = int(self.cat_boundaries[rank])
+        hi = int(self.cat_boundaries[rank + 1])
+        word = iv // 32
+        if word >= hi - lo:
+            return False
+        return bool((int(self.cat_threshold[lo + word]) >> (iv % 32)) & 1)
 
     def num_internal(self) -> int:
         return max(self.num_leaves - 1, 0)
@@ -92,10 +134,7 @@ class Tree:
                 v = row[f]
                 dt = self.decision_type[node]
                 if dt & CAT_MASK:
-                    if np.isnan(v):
-                        left = bool(dt & DEFAULT_LEFT_MASK)
-                    else:
-                        left = int(v) == int(self.threshold[node])
+                    left = self.cat_decision(node, v)
                 else:
                     if np.isnan(v):
                         if (dt >> 2) == 2:  # missing nan
@@ -148,22 +187,62 @@ class TreeBatch:
         self.num_leaves = jnp.asarray(np.array([t.num_leaves for t in trees],
                                                dtype=np.int32))
 
+        # categorical-set arrays: binned membership (training walks) and
+        # raw-value bitset words (inference walks); width 1 when no tree
+        # has categorical nodes so the jitted walks stay uniform
+        bm = max([1] + [t.cat_member_bins.shape[1] for t in trees
+                        if t.cat_member_bins is not None])
+        member = np.zeros((len(trees), ml - 1, bm), bool)
+        for ti, t in enumerate(trees):
+            if t.cat_member_bins is not None:
+                m = t.cat_member_bins
+                member[ti, :m.shape[0], :m.shape[1]] = m
+        self.cat_member = jnp.asarray(member)
+
+        wmax = 1
+        for t in trees:
+            if t.cat_boundaries is not None:
+                for r in range(len(t.cat_boundaries) - 1):
+                    wmax = max(wmax, int(t.cat_boundaries[r + 1]) -
+                               int(t.cat_boundaries[r]))
+            else:  # legacy single-category nodes: threshold IS the category
+                for i in range(t.num_leaves - 1):
+                    if t.decision_type[i] & CAT_MASK:
+                        wmax = max(wmax, int(t.threshold[i]) // 32 + 1)
+        words = np.zeros((len(trees), ml - 1, wmax), np.uint32)
+        for ti, t in enumerate(trees):
+            for i in range(t.num_leaves - 1):
+                if not (t.decision_type[i] & CAT_MASK):
+                    continue
+                if t.cat_boundaries is not None:
+                    rank = int(t.threshold[i])
+                    lo = int(t.cat_boundaries[rank])
+                    hi = int(t.cat_boundaries[rank + 1])
+                    words[ti, i, :hi - lo] = t.cat_threshold[lo:hi]
+                else:
+                    v = int(t.threshold[i])
+                    words[ti, i, v // 32] |= np.uint32(1 << (v % 32))
+        self.cat_words = jnp.asarray(words)
+
     def as_tuple(self):
         return (self.split_feature, self.threshold_bin, self.nan_bin,
-                self.decision_type, self.left_child, self.right_child,
-                self.leaf_value, self.num_leaves)
+                self.cat_member, self.decision_type, self.left_child,
+                self.right_child, self.leaf_value, self.num_leaves)
 
 
 @jax.jit
-def _walk_binned(bins, split_feature, threshold_bin, nan_bin, decision_type,
-                 left_child, right_child, leaf_value, num_leaves):
+def _walk_binned(bins, split_feature, threshold_bin, nan_bin, cat_member,
+                 decision_type, left_child, right_child, leaf_value,
+                 num_leaves):
     """Vectorized tree walk on BINNED data for one tree.
 
-    bins: (N, F) int; tree arrays as in TreeBatch rows.
+    bins: (N, F) int; tree arrays as in TreeBatch rows; cat_member is the
+    (L-1, B) categorical LEFT-set membership over bins.
     Returns (N,) float32 leaf values.
     """
     n = bins.shape[0]
     node = jnp.where(num_leaves <= 1, -1, 0) * jnp.ones((n,), jnp.int32)
+    bm = cat_member.shape[1]
 
     def cond(state):
         node, _ = state
@@ -182,7 +261,8 @@ def _walk_binned(bins, split_feature, threshold_bin, nan_bin, decision_type,
         # the NaN bin is the feature's last bin, above any real threshold, so
         # "missing right" is automatic; "missing left" overrides via nan_bin
         is_nanbin = b == nan_bin[nd]
-        go_left = jnp.where(is_cat, b == thr,
+        cat_go = cat_member.reshape(-1)[nd * bm + jnp.minimum(b, bm - 1)]
+        go_left = jnp.where(is_cat, cat_go,
                             jnp.where(is_nanbin, dleft, b <= thr))
         nxt = jnp.where(go_left, left_child[nd], right_child[nd])
         new_node = jnp.where(active, nxt, node)
@@ -212,11 +292,15 @@ def predict_binned(batch: TreeBatch, bins: jnp.ndarray,
 
 
 @jax.jit
-def _walk_raw(X, split_feature, threshold, decision_type,
+def _walk_raw(X, split_feature, threshold, cat_words, decision_type,
               left_child, right_child, leaf_value, num_leaves):
-    """Vectorized walk on RAW float features for one tree (inference path)."""
+    """Vectorized walk on RAW float features for one tree (inference path).
+
+    cat_words: (L-1, W) uint32 bitset over raw category values per node
+    (reference tree.h FindInBitset)."""
     n = X.shape[0]
     node = jnp.where(num_leaves <= 1, -1, 0) * jnp.ones((n,), jnp.int32)
+    w = cat_words.shape[1]
 
     def cond(state):
         node, _ = state
@@ -236,11 +320,15 @@ def _walk_raw(X, split_feature, threshold, decision_type,
         is_nan = jnp.isnan(v)
         v_num = jnp.where(is_nan & ~miss_nan, 0.0, v)
         go_left_num = jnp.where(is_nan & miss_nan, dleft, v_num <= thr)
-        # NaN categoricals follow default_left (== "is the split category the
-        # most frequent one", set by the grower)
-        go_left_cat = jnp.where(is_nan, dleft,
-                                (v.astype(jnp.int32).astype(jnp.float32) == v) &
-                                (v.astype(jnp.int32) == thr.astype(jnp.int32)))
+        # categorical set membership on the raw value; NaN categoricals
+        # follow default_left ("is bin 0 / the most frequent category in
+        # the left set", recorded by the grower)
+        vi = jnp.where(is_nan, -1.0, v).astype(jnp.int32)
+        in_range = (vi >= 0) & (vi < w * 32) & \
+            (vi.astype(jnp.float32) == jnp.where(is_nan, -1.0, v))
+        word = cat_words.reshape(-1)[nd * w + jnp.clip(vi, 0, w * 32 - 1) // 32]
+        bit = (word >> (jnp.clip(vi, 0) % 32).astype(jnp.uint32)) & 1
+        go_left_cat = jnp.where(is_nan, dleft, in_range & (bit > 0))
         go_left = jnp.where(is_cat, go_left_cat, go_left_num)
         nxt = jnp.where(go_left, left_child[nd], right_child[nd])
         new_node = jnp.where(active, nxt, node)
@@ -262,9 +350,9 @@ def predict_raw(batch: TreeBatch, X: jnp.ndarray,
     (reference gbdt_prediction.cpp:PredictRaw)."""
     t_end = batch.num_trees if num_iteration is None else min(
         start_iteration + num_iteration, batch.num_trees)
-    fields = (batch.split_feature, batch.threshold, batch.decision_type,
-              batch.left_child, batch.right_child, batch.leaf_value,
-              batch.num_leaves)
+    fields = (batch.split_feature, batch.threshold, batch.cat_words,
+              batch.decision_type, batch.left_child, batch.right_child,
+              batch.leaf_value, batch.num_leaves)
     sliced = tuple(a[start_iteration:t_end] for a in fields)
 
     def body(carry, tree_fields):

@@ -45,6 +45,12 @@ class SplitParams(NamedTuple):
     path_smooth: float = 0.0
     use_monotone: bool = False     # any monotone_constraints nonzero
     monotone_penalty: float = 0.0
+    # categorical split search (feature_histogram.hpp:278)
+    max_cat_to_onehot: int = 4
+    max_cat_threshold: int = 32
+    min_data_per_group: int = 100
+    use_cat_subset: bool = False   # any categorical feature needs the
+                                   # sorted-subset search (num_bin > onehot)
 
 BIG = 1e30  # "unbounded" leaf-output constraint sentinel
 
@@ -57,6 +63,7 @@ class FeatureSplits(NamedTuple):
     default_left: jnp.ndarray  # (F,) bool — direction for missing values
     left_sum: jnp.ndarray      # (F, 3)
     right_sum: jnp.ndarray     # (F, 3)
+    cat_member: jnp.ndarray    # (F, B) bool — categorical LEFT-side bins
 
 
 def _threshold_l1(g: jnp.ndarray, l1: float) -> jnp.ndarray:
@@ -192,7 +199,10 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     gain_l, left_l = dir_gain(cum + nan_slice)
     gain_l = jnp.where(has_nan[:, None], gain_l, NEG_INF)
 
-    # categorical one-vs-rest: category bin b goes left, rest right
+    def take_bin(arr, idx):
+        return jnp.take_along_axis(arr, idx[:, None, None].repeat(3, 2), 1)[:, 0, :]
+
+    # ---- categorical one-vs-rest: category bin b goes left, rest right
     # (feature_histogram.hpp:278 one-hot branch; cat_l2 adds regularization)
     cat_l2 = l2 + params.cat_l2
     cat_left = hist_m
@@ -211,35 +221,127 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
               (cat_left[..., 1] >= min_h) & (cat_right[..., 1] >= min_h) & real_bin)
     cat_gain = cgl + cgr - min_gain_shift
     cat_gain = jnp.where(cat_ok & (cat_gain > 0), cat_gain, NEG_INF)
+    oh_bin = jnp.argmax(cat_gain, axis=1)
+    oh_gain = jnp.take_along_axis(cat_gain, oh_bin[:, None], 1)[:, 0]
+    oh_member = jax.nn.one_hot(oh_bin, b, dtype=jnp.bool_)
+    oh_left = take_bin(hist_m, oh_bin)
 
-    is_cat_b = is_cat[:, None]
-    gain_right_dir = jnp.where(is_cat_b, cat_gain, gain_r)
-    gain_left_dir = jnp.where(is_cat_b, NEG_INF, gain_l)
+    # ---- categorical sorted-subset search (feature_histogram.hpp:278
+    # non-onehot branch): categories ordered by sum_grad/(sum_hess +
+    # cat_smooth); prefix subsets scanned from BOTH ends, up to
+    # max_cat_threshold categories; the LEFT child takes the subset.
+    if params.use_cat_subset:
+        mdpg = float(params.min_data_per_group)
+        counts = hist_m[..., 2]
+        # candidate categories: count >= cat_smooth (the reference reuses
+        # cat_smooth as the per-category min count filter)
+        cat_valid = real_bin & (counts >= params.cat_smooth)
+        ratio = jnp.where(cat_valid,
+                          hist_m[..., 0] / (hist_m[..., 1] + params.cat_smooth),
+                          BIG)
+        order = jnp.argsort(ratio, axis=1, stable=True)          # (F, B)
+        rank = jnp.zeros((f, b), jnp.int32).at[
+            jnp.arange(f)[:, None], order].set(
+            jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[None, :], (f, b)))
+        used = jnp.sum(cat_valid, axis=1).astype(jnp.int32)      # (F,)
+        sh = jnp.take_along_axis(hist_m, order[:, :, None], axis=1)
+        pos = jnp.arange(b, dtype=jnp.int32)[None, :]            # (1, B)
+        sh = jnp.where(pos[:, :, None] < used[:, None, None], sh, 0.0)
+        cumf = jnp.cumsum(sh, axis=1)                            # (F, B, 3)
+        total_used = cumf[:, -1:, :]
+        # prefix of the (i+1) LARGEST ratios = total_used - cumf[used-2-i]
+        bidx = used[:, None] - 2 - pos                           # (F, B)
+        tb = jnp.take_along_axis(
+            cumf, jnp.clip(bidx, 0, b - 1)[:, :, None], axis=1)
+        cumb = total_used - jnp.where((bidx >= 0)[:, :, None], tb, 0.0)
 
-    # best over (bin, direction) per feature
-    best_r_bin = jnp.argmax(gain_right_dir, axis=1)
-    best_r_gain = jnp.take_along_axis(gain_right_dir, best_r_bin[:, None], 1)[:, 0]
-    best_l_bin = jnp.argmax(gain_left_dir, axis=1)
-    best_l_gain = jnp.take_along_axis(gain_left_dir, best_l_bin[:, None], 1)[:, 0]
+        max_pos = jnp.minimum(jnp.minimum(params.max_cat_threshold,
+                                          (used[:, None] + 1) // 2),
+                              used[:, None])                     # (F, 1)
+        pos_ok = pos < max_pos
+
+        def subset_gain(left):
+            right = total[:, None, :] - left
+            # group spacing: the reference only evaluates a position once
+            # >= min_data_per_group rows accumulated since the last
+            # evaluated one; approximated here as crossing a multiple of
+            # min_data_per_group in the prefix count
+            gcross = jnp.floor(left[..., 2] / mdpg)
+            gprev = jnp.concatenate([jnp.full((f, 1), -1.0),
+                                     gcross[:, :-1]], axis=1)
+            ok = (pos_ok & (left[..., 2] >= min_cnt) &
+                  (left[..., 1] >= min_h) &
+                  (right[..., 2] >= jnp.maximum(min_cnt, mdpg)) &
+                  (right[..., 1] >= min_h) & (gcross > gprev))
+            if use_mc:
+                o_l = clamped_out(left, cat_l2)
+                o_r = clamped_out(right, cat_l2)
+                gl_ = _gain_given_output(left[..., 0], left[..., 1], o_l,
+                                         l1, cat_l2)
+                gr_ = _gain_given_output(right[..., 0], right[..., 1], o_r,
+                                         l1, cat_l2)
+            else:
+                gl_ = _leaf_gain(left[..., 0], left[..., 1], l1, cat_l2)
+                gr_ = _leaf_gain(right[..., 0], right[..., 1], l1, cat_l2)
+            g = gl_ + gr_ - min_gain_shift
+            return jnp.where(ok & (g > 0), g, NEG_INF)
+
+        gain_f = subset_gain(cumf)
+        gain_bk = subset_gain(cumb)
+        f_pos = jnp.argmax(gain_f, axis=1)
+        f_best = jnp.take_along_axis(gain_f, f_pos[:, None], 1)[:, 0]
+        b_pos = jnp.argmax(gain_bk, axis=1)
+        b_best = jnp.take_along_axis(gain_bk, b_pos[:, None], 1)[:, 0]
+        use_bk = b_best > f_best
+        sub_gain = jnp.where(use_bk, b_best, f_best)
+        sub_pos = jnp.where(use_bk, b_pos, f_pos)
+        sub_left = jnp.where(use_bk[:, None],
+                             take_bin(cumb, b_pos), take_bin(cumf, f_pos))
+        # membership: forward -> ranks [0, pos]; backward -> the top
+        # (pos+1) ranks of the used range
+        sub_member = jnp.where(
+            use_bk[:, None],
+            (rank >= used[:, None] - 1 - sub_pos[:, None]) &
+            (rank < used[:, None]),
+            rank <= sub_pos[:, None])
+
+        use_subset = is_cat & (num_bins > params.max_cat_to_onehot)
+        cat_best_gain = jnp.where(use_subset, sub_gain, oh_gain)
+        cat_member = jnp.where(use_subset[:, None], sub_member, oh_member)
+        cat_left_sum = jnp.where(use_subset[:, None], sub_left, oh_left)
+    else:
+        cat_best_gain = oh_gain
+        cat_member = oh_member
+        cat_left_sum = oh_left
+
+    # ---- numerical best over (bin, direction); categorical by mode ----
+    best_r_bin = jnp.argmax(gain_r, axis=1)
+    best_r_gain = jnp.take_along_axis(gain_r, best_r_bin[:, None], 1)[:, 0]
+    best_l_bin = jnp.argmax(gain_l, axis=1)
+    best_l_gain = jnp.take_along_axis(gain_l, best_l_bin[:, None], 1)[:, 0]
 
     use_left = best_l_gain > best_r_gain
-    gain = jnp.where(use_left, best_l_gain, best_r_gain)
-    thr = jnp.where(use_left, best_l_bin, best_r_bin).astype(jnp.int32)
-
-    def take_bin(arr, idx):
-        return jnp.take_along_axis(arr, idx[:, None, None].repeat(3, 2), 1)[:, 0, :]
+    num_gain = jnp.where(use_left, best_l_gain, best_r_gain)
+    num_thr = jnp.where(use_left, best_l_bin, best_r_bin).astype(jnp.int32)
 
     left_num = jnp.where(use_left[:, None],
                          take_bin(cum, best_l_bin) + nan_sum,
                          take_bin(cum, best_r_bin))
-    left_cat = take_bin(hist_m, best_r_bin)
-    left_sum = jnp.where(is_cat_b, left_cat, left_num)
+    is_cat_b = is_cat[:, None]
+    gain = jnp.where(is_cat, cat_best_gain, num_gain)
+    cat_member = cat_member & is_cat_b & (gain > NEG_INF / 2)[:, None]
+    # cat threshold_bin kept as the first member bin (display/compat; the
+    # partition decision uses the membership vector)
+    cat_thr = jnp.argmax(cat_member, axis=1).astype(jnp.int32)
+    thr = jnp.where(is_cat, cat_thr, num_thr)
+    left_sum = jnp.where(is_cat_b, cat_left_sum, left_num)
     right_sum = total - left_sum
 
     return FeatureSplits(
         gain=gain,
         threshold_bin=thr,
-        default_left=use_left & has_nan,
+        default_left=use_left & has_nan & jnp.logical_not(is_cat),
         left_sum=left_sum,
         right_sum=right_sum,
+        cat_member=cat_member,
     )

@@ -75,7 +75,8 @@ class DataParallelTreeLearner:
         grow_t = make_grow_fn(
             num_leaves=int(config.num_leaves), max_bins=self.max_bins,
             max_depth=int(config.max_depth),
-            split_params=split_params_from_config(config),
+            split_params=split_params_from_config(config, num_bins,
+                                                  is_cat),
             hist_impl=resolve_hist_impl(config, parallel=True),
             rows_per_chunk=int(config.tpu_rows_per_chunk),
             use_hist_pool=hist_pool_fits(config, num_features, self.max_bins),
@@ -85,7 +86,7 @@ class DataParallelTreeLearner:
             return grow_t(X, None, g, h, m, nb, ic, hn, mono, fm)
         tree_specs = GrownTree(
             split_feature=P(), threshold_bin=P(), nan_bin=P(),
-            decision_type=P(), left_child=P(), right_child=P(),
+            cat_member=P(), decision_type=P(), left_child=P(), right_child=P(),
             split_gain=P(), internal_value=P(), internal_weight=P(),
             internal_count=P(), leaf_value=P(), leaf_weight=P(),
             leaf_count=P(), num_leaves=P(), row_leaf=P(self.axis))

@@ -60,7 +60,7 @@ class FeatureParallelStrategy(CommStrategy):
         mono = jax.lax.dynamic_slice(self.monotone_full,
                                      (r * self.f_local,), (self.f_local,)) \
             if self.monotone_full is not None else None
-        g, f_loc, b, dl, ls, rs = local_best_candidate(
+        g, f_loc, b, dl, ls, rs, member = local_best_candidate(
             hist_local, leaf_sum, nb, ic, hn, fm, params, mono, bound, depth)
         # global best with deterministic tie-break on the feature index
         # (reference SyncUpGlobalBestSplit allreduce-max)
@@ -75,7 +75,8 @@ class FeatureParallelStrategy(CommStrategy):
                 jnp.where(is_win, v, jnp.zeros_like(v)), self.axis_name)
 
         return (gmax, f_win, bcast(b), bcast(dl.astype(jnp.int32)) > 0,
-                bcast(ls), bcast(rs))
+                bcast(ls), bcast(rs),
+                bcast(member.astype(jnp.int32)) > 0)
 
     def get_column(self, X_local, feat_global):
         r = jax.lax.axis_index(self.axis_name)
@@ -118,7 +119,8 @@ class FeatureParallelTreeLearner:
         grow_t = make_grow_fn(
             num_leaves=int(config.num_leaves), max_bins=self.max_bins,
             max_depth=int(config.max_depth),
-            split_params=split_params_from_config(config),
+            split_params=split_params_from_config(config, num_bins,
+                                                  is_cat),
             hist_impl=resolve_hist_impl(config, parallel=True),
             rows_per_chunk=int(config.tpu_rows_per_chunk),
             use_hist_pool=hist_pool_fits(config, self.f_local, self.max_bins),
@@ -128,7 +130,7 @@ class FeatureParallelTreeLearner:
             return grow_t(X, None, g, h, m, nb, ic, hn, mono, fm)
         tree_specs = GrownTree(
             split_feature=P(), threshold_bin=P(), nan_bin=P(),
-            decision_type=P(), left_child=P(), right_child=P(),
+            cat_member=P(), decision_type=P(), left_child=P(), right_child=P(),
             split_gain=P(), internal_value=P(), internal_weight=P(),
             internal_count=P(), leaf_value=P(), leaf_weight=P(),
             leaf_count=P(), num_leaves=P(), row_leaf=P())

@@ -82,9 +82,9 @@ class VotingStrategy(CommStrategy):
         fm = feature_mask[selected]
         mono = self.monotone_full[selected] \
             if self.monotone_full is not None else None
-        g, f_loc, b, dl, ls, rs = local_best_candidate(
+        g, f_loc, b, dl, ls, rs, member = local_best_candidate(
             hist_sel, leaf_sum, nb, ic, hn, fm, params, mono, bound, depth)
-        return (g, selected[f_loc], b, dl, ls, rs)
+        return (g, selected[f_loc], b, dl, ls, rs, member)
 
 
 class VotingParallelTreeLearner:
@@ -105,7 +105,7 @@ class VotingParallelTreeLearner:
         self.monotone = jnp.asarray(
             monotone if monotone is not None else np.zeros(num_features),
             jnp.int32)
-        sp = split_params_from_config(config)
+        sp = split_params_from_config(config, num_bins, is_cat)
         local_sp = sp._replace(
             min_data_in_leaf=max(1, sp.min_data_in_leaf // self.ndev),
             min_sum_hessian_in_leaf=sp.min_sum_hessian_in_leaf / self.ndev)
@@ -125,7 +125,7 @@ class VotingParallelTreeLearner:
             return grow_t(X, None, g, h, m, nb, ic, hn, mono, fm)
         tree_specs = GrownTree(
             split_feature=P(), threshold_bin=P(), nan_bin=P(),
-            decision_type=P(), left_child=P(), right_child=P(),
+            cat_member=P(), decision_type=P(), left_child=P(), right_child=P(),
             split_gain=P(), internal_value=P(), internal_weight=P(),
             internal_count=P(), leaf_value=P(), leaf_weight=P(),
             leaf_count=P(), num_leaves=P(), row_leaf=P(self.axis))

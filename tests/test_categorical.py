@@ -1,0 +1,129 @@
+"""Categorical split tests: sorted-subset search, bitset model IO,
+reference-format multi-category model loading (reference patterns:
+test_engine.py:118-375 categorical semantics)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.tree import CAT_MASK
+
+
+def _cat_data(n=2000, ncat=12, seed=3):
+    rng = np.random.RandomState(seed)
+    c = rng.randint(0, ncat, n)
+    x1 = rng.randn(n)
+    # group structure: categories {0,2,4,...} push y up, odd down — a
+    # subset split can capture it in one node, one-vs-rest cannot
+    y = np.where(c % 2 == 0, 2.0, -2.0) + 0.3 * x1 + 0.1 * rng.randn(n)
+    X = np.stack([c.astype(float), x1], 1)
+    return X, y
+
+
+PARAMS = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+          "metric": "l2", "min_data_in_leaf": 5, "cat_smooth": 1.0,
+          "min_data_per_group": 1}
+
+
+def test_subset_split_learns_group_structure():
+    X, y = _cat_data()
+    ds = lgb.Dataset(X, y, categorical_feature=[0])
+    bst = lgb.train(PARAMS, ds, 20)
+    mse = np.mean((bst.predict(X) - y) ** 2)
+    assert mse < 0.1
+    # at least one node carries a multi-category set
+    multi = [t for t in bst._gbdt.models
+             for i in range(t.num_leaves - 1)
+             if t.decision_type[i] & CAT_MASK and len(t.cat_values(i)) > 1]
+    assert multi, "expected sorted-subset (multi-category) splits"
+
+
+def test_subset_beats_onehot_in_early_trees():
+    X, y = _cat_data()
+    ds1 = lgb.Dataset(X, y, categorical_feature=[0])
+    subset = lgb.train(PARAMS, ds1, 2)
+    ds2 = lgb.Dataset(X, y, categorical_feature=[0])
+    onehot = lgb.train({**PARAMS, "max_cat_to_onehot": 64}, ds2, 2)
+    mse_s = np.mean((subset.predict(X) - y) ** 2)
+    mse_o = np.mean((onehot.predict(X) - y) ** 2)
+    assert mse_s < mse_o
+
+
+def test_cat_model_roundtrip(tmp_path):
+    X, y = _cat_data()
+    ds = lgb.Dataset(X, y, categorical_feature=[0])
+    bst = lgb.train(PARAMS, ds, 10)
+    p0 = bst.predict(X)
+    path = str(tmp_path / "cat.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(loaded.predict(X), p0, rtol=1e-5, atol=1e-6)
+
+
+def test_cat_continued_training():
+    X, y = _cat_data()
+    first = lgb.train(PARAMS, lgb.Dataset(X, y, categorical_feature=[0]), 10)
+    cont = lgb.train(PARAMS, lgb.Dataset(X, y, categorical_feature=[0]), 10,
+                     init_model=first)
+    assert cont.num_trees() == 20
+    mse = np.mean((cont.predict(X) - y) ** 2)
+    assert mse <= np.mean((first.predict(X) - y) ** 2) + 1e-9
+
+
+def test_reference_format_multicat_bitset_loads():
+    """A reference-format model with a multi-category bitset node must
+    predict with FULL set membership (round-2 verdict: the old loader kept
+    only the first category)."""
+    # one tree: root splits feature 0 on categories {1, 3, 34} -> left
+    # leaf 0 (value 5.0), else right leaf 1 (value -5.0).
+    # bitset words: cats 1,3 -> word0 = 2|8 = 10; cat 34 -> word1 = 4.
+    model = """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=1
+objective=regression
+feature_names=c0 f1
+feature_infos=0:1:2:3:34 [-1:1]
+tree_sizes=400
+
+Tree=0
+num_leaves=2
+num_cat=1
+split_feature=0
+split_gain=100
+threshold=0
+decision_type=1
+left_child=-1
+right_child=-2
+leaf_value=5 -5
+leaf_weight=10 10
+leaf_count=10 10
+internal_value=0
+internal_weight=20
+internal_count=20
+cat_boundaries=0 2
+cat_threshold=10 4
+is_linear=0
+shrinkage=1
+
+end of trees
+
+parameters:
+end of parameters
+"""
+    bst = lgb.Booster(model_str=model)
+    X = np.array([[1.0, 0.0], [3.0, 0.0], [34.0, 0.0],
+                  [0.0, 0.0], [2.0, 0.0], [5.0, 0.0], [33.0, 0.0]])
+    pred = bst.predict(X)
+    np.testing.assert_allclose(pred, [5, 5, 5, -5, -5, -5, -5], atol=1e-9)
+
+
+def test_cat_shap_consistency():
+    X, y = _cat_data(n=400)
+    ds = lgb.Dataset(X, y, categorical_feature=[0])
+    bst = lgb.train(PARAMS, ds, 5)
+    contrib = bst.predict(X[:50], pred_contrib=True)
+    raw = bst.predict(X[:50], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4, atol=1e-4)
