@@ -3,17 +3,20 @@
 TPU-native re-implementation of the reference DataParallelTreeLearner
 (reference: src/treelearner/data_parallel_tree_learner.cpp — rows partitioned
 across machines, local histograms ReduceScatter'd so each machine reduces a
-disjoint feature block :155-173, local best splits, allreduce-max of the best
+disjoint feature block :155-173 with the block layout computed at :58-124,
+local best splits on owned features only :176-251, allreduce-max of the best
 SplitInfo :244, global leaf counts via parallel_tree_learner.h:67).
 
 Here the learner is the shared grower wrapped in ``shard_map`` over a 1-D
 mesh: the binned matrix, gradients and row_leaf partition live row-sharded;
-per-leaf histograms are ``psum``'d across shards after each masked build (one
-allreduce per split — the reduce-scatter + per-feature-block split-finding
-refinement is a bandwidth optimization tracked for the perf milestones); all
-tree state is computed redundantly and identically on every device, so no
-split broadcast is needed.  Global leaf counts fall out of the psum'd count
-channel — the analog of GetGlobalDataCountInLeaf.
+the per-leaf histogram pool keeps shard-LOCAL histograms (histogram
+subtraction is linear, so local parent − local child = local sibling), and
+each candidate search runs ``psum_scatter`` so every device reduces and
+scans ONE disjoint feature block — per-device communication is F·B/ndev
+instead of the F·B a full psum moves, exactly the reference's
+reduce-scatter refinement.  The winning candidate is then combined with a
+pmax + owner-broadcast (the SplitInfo allreduce-max analog); global leaf
+counts fall out of the psum'd count channel (GetGlobalDataCountInLeaf).
 """
 
 from __future__ import annotations
@@ -27,27 +30,62 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..config import Config
-from ..learner.serial import (CommStrategy, GrownTree, make_grow_fn,
-                              hist_pool_fits, resolve_hist_impl,
+from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
+                              make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
 from .mesh import get_mesh
 
 __all__ = ["DataParallelTreeLearner", "DataParallelStrategy"]
 
+BIG_FEAT = np.int32(2 ** 30)
+
 
 class DataParallelStrategy(CommStrategy):
     rows_sharded = True
-    """psum histograms + sums across row shards (SURVEY.md §2.5 mapping)."""
+    """Local histograms + per-candidate psum_scatter over feature blocks
+    (SURVEY.md §2.5 mapping; data_parallel_tree_learner.cpp:155-173)."""
 
-    def __init__(self, axis_name, num_bins, is_cat, has_nan):
+    def __init__(self, axis_name, f_local, num_bins, is_cat, has_nan):
         super().__init__(num_bins, is_cat, has_nan)
         self.axis_name = axis_name
+        self.f_local = f_local
 
     def reduce_sum(self, v):
         return jax.lax.psum(v, self.axis_name)
 
-    def reduce_hist(self, hist):
-        return jax.lax.psum(hist, self.axis_name)
+    # reduce_hist stays identity: the pool keeps shard-LOCAL histograms;
+    # cross-shard reduction happens inside leaf_candidates on disjoint
+    # feature blocks (reduce-scatter), never on the full tensor.
+
+    def leaf_candidates(self, hist_local, leaf_sum, feature_mask, params,
+                        bound=None, depth=None):
+        fb = self.f_local
+        r = jax.lax.axis_index(self.axis_name)
+        start = r * fb
+        # each device reduces + owns one contiguous feature block
+        blk = jax.lax.psum_scatter(hist_local, self.axis_name,
+                                   scatter_dimension=0, tiled=True)
+        sl = lambda a: jax.lax.dynamic_slice(a, (start,), (fb,))
+        mono = sl(self.monotone_full) if self.monotone_full is not None \
+            else None
+        g, f_loc, b, dl, ls, rs, member = local_best_candidate(
+            blk, leaf_sum, sl(self.num_bins_full), sl(self.is_cat_full),
+            sl(self.has_nan_full), sl(feature_mask), params, mono, bound,
+            depth)
+        # allreduce-max of the per-block winners with deterministic
+        # tie-break on the global feature index (SplitInfo ladder)
+        gmax = jax.lax.pmax(g, self.axis_name)
+        f_glob = start.astype(jnp.int32) + f_loc
+        cand = jnp.where(g >= gmax, f_glob, BIG_FEAT)
+        f_win = jax.lax.pmin(cand, self.axis_name)
+        is_win = (f_glob == f_win) & (g >= gmax)
+
+        def bcast(v):
+            return jax.lax.psum(
+                jnp.where(is_win, v, jnp.zeros_like(v)), self.axis_name)
+
+        return (gmax, f_win, bcast(b), bcast(dl.astype(jnp.int32)) > 0,
+                bcast(ls), bcast(rs), bcast(member.astype(jnp.int32)) > 0)
 
 
 class DataParallelTreeLearner:
@@ -64,22 +102,32 @@ class DataParallelTreeLearner:
         self.mesh = get_mesh(int(config.num_devices))
         self.ndev = self.mesh.devices.size
         self.axis = self.mesh.axis_names[0]
-        self.num_bins = jnp.asarray(num_bins, jnp.int32)
-        self.is_cat = jnp.asarray(is_cat, jnp.bool_)
-        self.has_nan = jnp.asarray(has_nan, jnp.bool_)
-        self.monotone = jnp.asarray(
-            monotone if monotone is not None else np.zeros(num_features),
+        # pad the feature axis to a multiple of the mesh so psum_scatter
+        # blocks are uniform (padded features are trivial: 1 bin, never
+        # splittable — the analog of the reference's balanced block layout)
+        self.f_pad = (-num_features) % self.ndev
+        fp = num_features + self.f_pad
+        self.f_local = fp // self.ndev
+        self.num_bins = jnp.asarray(
+            np.concatenate([num_bins, np.ones(self.f_pad, np.int32)]),
             jnp.int32)
-        strategy = DataParallelStrategy(self.axis, self.num_bins, self.is_cat,
+        self.is_cat = jnp.asarray(
+            np.concatenate([is_cat, np.zeros(self.f_pad, bool)]), jnp.bool_)
+        self.has_nan = jnp.asarray(
+            np.concatenate([has_nan, np.zeros(self.f_pad, bool)]), jnp.bool_)
+        mono_np = monotone if monotone is not None else np.zeros(num_features)
+        self.monotone = jnp.asarray(
+            np.concatenate([mono_np, np.zeros(self.f_pad)]), jnp.int32)
+        strategy = DataParallelStrategy(self.axis, self.f_local,
+                                        self.num_bins, self.is_cat,
                                         self.has_nan)
         grow_t = make_grow_fn(
             num_leaves=int(config.num_leaves), max_bins=self.max_bins,
             max_depth=int(config.max_depth),
-            split_params=split_params_from_config(config, num_bins,
-                                                  is_cat),
+            split_params=split_params_from_config(config, num_bins, is_cat),
             hist_impl=resolve_hist_impl(config, parallel=True),
             rows_per_chunk=int(config.tpu_rows_per_chunk),
-            use_hist_pool=hist_pool_fits(config, num_features, self.max_bins),
+            use_hist_pool=hist_pool_fits(config, fp, self.max_bins),
             strategy=strategy, jit=False)
 
         def grow(X, g, h, m, nb, ic, hn, mono, fm):
@@ -102,6 +150,9 @@ class DataParallelTreeLearner:
               feature_mask: Optional[jnp.ndarray] = None) -> GrownTree:
         if feature_mask is None:
             feature_mask = jnp.ones((self.num_features,), jnp.bool_)
+        if self.f_pad:
+            X_dev = jnp.pad(X_dev, ((0, 0), (0, self.f_pad)))
+            feature_mask = jnp.pad(feature_mask, (0, self.f_pad))
         n = X_dev.shape[0]
         pad = (-n) % self.ndev
         if pad:

@@ -1,0 +1,73 @@
+"""Phase-level profile of full-scale training (bench triage).
+
+Prints wall times for: data gen, Dataset construct (binning), device
+upload, learner build, first update (compile), steady-state updates.
+Env: ROWS (default 10.5M), TREES (default 5), LEAVES, BINS.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = time.perf_counter()
+
+
+def mark(msg):
+    print(f"[{time.perf_counter() - T0:8.1f}s] {msg}", flush=True)
+
+
+def main():
+    rows = int(os.environ.get("ROWS", 10_500_000))
+    trees = int(os.environ.get("TREES", 5))
+    leaves = int(os.environ.get("LEAVES", 255))
+    bins = int(os.environ.get("BINS", 255))
+
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.log import set_verbosity
+    set_verbosity(-1)
+    mark(f"imports done (backend={jax.default_backend()})")
+
+    rng = np.random.RandomState(0)
+    f = 28
+    X = rng.randn(rows, f).astype(np.float32)
+    w = rng.randn(f) / np.sqrt(f)
+    logit = X @ w + 0.3 * np.sin(2 * X[:, 0]) * X[:, 1]
+    y = (logit + rng.randn(rows) * 0.5 > 0).astype(np.float64)
+    mark("data generated")
+
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": bins,
+              "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1}
+    ds = lgb.Dataset(X, y, params=params)
+    from lightgbm_tpu.config import Config
+    ds.construct(Config(params))
+    mark("dataset constructed (binning)")
+
+    booster = lgb.Booster(params=params, train_set=ds)
+    mark("booster built (learner + upload dispatched)")
+    import jax.numpy as jnp
+    booster._gbdt.score.block_until_ready()
+    mark("initial score ready")
+
+    booster.update()
+    booster._gbdt.score.block_until_ready()
+    mark("first update (compile + run)")
+
+    booster.update()
+    booster._gbdt.score.block_until_ready()
+    mark("second update")
+
+    t = time.perf_counter()
+    for _ in range(trees):
+        booster.update()
+    booster._gbdt.score.block_until_ready()
+    dt = time.perf_counter() - t
+    mark(f"{trees} steady updates: {dt:.2f}s -> {trees / dt:.3f} iters/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -45,6 +45,54 @@ def test_data_parallel_regression(data):
     np.testing.assert_allclose(dp, serial, atol=1e-4)
 
 
+@pytest.mark.parametrize("tree_learner", ["data", "feature"])
+def test_parallel_bagging_goss_matches_serial(tree_learner, data):
+    """Sampling paths under shard_map: bagging masks and GOSS gradient
+    amplification must reproduce the serial learner exactly (the mask is
+    computed host-side and sharded with the rows)."""
+    X, y = data
+    for extra in ({"bagging_fraction": 0.6, "bagging_freq": 1},
+                  {"boosting": "goss", "top_rate": 0.3, "other_rate": 0.2,
+                   "learning_rate": 0.5}):
+        p = {}
+        for tl in ("serial", tree_learner):
+            bst = lgb.train({**SMALL, "objective": "binary",
+                             "tree_learner": tl, **extra},
+                            lgb.Dataset(X, y), 4)
+            p[tl] = bst.predict(X)
+        np.testing.assert_allclose(p[tree_learner], p["serial"], atol=2e-5)
+
+
+def test_parallel_multiclass_matches_serial(data):
+    X, _ = data
+    rng = np.random.RandomState(5)
+    y = rng.randint(0, 3, len(X)).astype(np.float64)
+    p = {}
+    for tl in ("serial", "data"):
+        bst = lgb.train({**SMALL, "objective": "multiclass", "num_class": 3,
+                         "tree_learner": tl}, lgb.Dataset(X, y), 3)
+        p[tl] = bst.predict(X)
+    np.testing.assert_allclose(p["data"], p["serial"], atol=2e-5)
+
+
+def test_parallel_categorical_nan_matches_serial():
+    rng = np.random.RandomState(9)
+    n = 640
+    c = rng.randint(0, 8, n).astype(float)
+    x1 = rng.randn(n)
+    x1[rng.rand(n) < 0.15] = np.nan  # NaN bin routing under shard_map
+    y = np.where(c % 2 == 0, 1.5, -1.5) + np.nan_to_num(x1) * 0.3
+    X = np.stack([c, x1], 1)
+    p = {}
+    for tl in ("serial", "data"):
+        bst = lgb.train({**SMALL, "objective": "regression",
+                         "tree_learner": tl, "cat_smooth": 1.0,
+                         "min_data_per_group": 1},
+                        lgb.Dataset(X, y, categorical_feature=[0]), 4)
+        p[tl] = bst.predict(X)
+    np.testing.assert_allclose(p["data"], p["serial"], atol=2e-5)
+
+
 def test_voting_with_many_features():
     rng = np.random.RandomState(1)
     n, f = 640, 24
