@@ -3,8 +3,8 @@
 TPU-native analog of the reference's DataPartition (data_partition.hpp:170):
 where the reference keeps, per leaf, a contiguous span of row indices and
 stable-partitions it on every split, this grower keeps the PACKED ROW DATA
-itself leaf-contiguous.  Every per-split operation then works on a
-``dynamic_slice`` of the split leaf's segment — there are NO full-N passes
+itself leaf-contiguous.  Every per-split operation then works on chunked
+``dynamic_slice``s of the split leaf's segment — there are NO full-N passes
 per split (the v1 grower in serial.py pays several: mask rebuild, cumsum,
 searchsorted compaction, full-N partition update), which is what dominated
 its runtime at 255 leaves.
@@ -18,13 +18,18 @@ grad/hess are pre-multiplied by the bagging mask; the bag byte carries the
 mask itself for the histogram count channel.  One packed row-scatter per
 split moves each row of the split leaf to its child's side (rows move ~depth
 times per tree, the same volume as the reference's index partition), and the
-smaller child's histogram reads a contiguous slice — no gather at all —
+smaller child's histogram reads contiguous chunks — no gather at all —
 feeding the Pallas MXU kernel (ops/histogram_pallas.py) or the portable
 scatter-add path (CPU tests).
 
-Segment slices use a power-of-two bucket ladder of static sizes (jit needs
-static shapes); slices are ~free on TPU (contiguous DMA) so the ladder is
-fine-grained, unlike serial.py's gather buckets.
+Segments are swept with ``lax.while_loop``s over exactly TWO static chunk
+shapes (bulk + tail): static shapes keep XLA happy, dynamic trip counts keep
+the work proportional to the segment, and — critically — the whole tree
+compiles only two Pallas kernel shapes regardless of N.  (The previous
+design used a power-of-two ladder of segment sizes: at 10.5M rows that
+meant ~12 distinct kernel shapes per grower and multi-minute XLA compiles;
+chunking killed the compile-time cliff and the per-split full-N work at
+the same time.)
 
 Leaf-wise semantics (best-first by gain, serial_tree_learner.cpp:158-209),
 histogram subtraction trick (:311-320), and the split candidate logic are
@@ -46,26 +51,13 @@ from .serial import CommStrategy, GrownTree
 
 __all__ = ["make_partitioned_grow_fn", "PART_ROW_BLOCK"]
 
-PART_ROW_BLOCK = 4096  # ladder quantum; == Pallas kernel row block
+PART_ROW_BLOCK = 4096   # pad quantum; == Pallas kernel row-block contract
+CHUNK_BULK = 1 << 20    # bulk sweep chunk (rows)
+CHUNK_TAIL = 1 << 15    # tail sweep chunk (rows)
 
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
-
-
-def _bucket_ladder(n: int, base: int = PART_ROW_BLOCK):
-    """Static power-of-two segment sizes: base, 2*base, ..., n.
-
-    All sizes are <= n (dynamic_slice cannot exceed the array); when n is a
-    multiple of ``base`` (the Pallas path pads to this) every size is too."""
-    base = min(base, n)
-    sizes = []
-    s = base
-    while s < n:
-        sizes.append(s)
-        s *= 2
-    sizes.append(n)
-    return sizes
 
 
 def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
@@ -75,9 +67,9 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
     """Build the partition-ordered single-tree grower.
 
     Returned signature:
-    ``grow(X, grad, hess, bag_mask, num_bins, is_cat, has_nan, feature_mask)
-    -> GrownTree`` with X (N, F) uint8 bin codes, N a multiple of
-    PART_ROW_BLOCK (pad rows with bag_mask 0).
+    ``grow(X, grad, hess, bag_mask, num_bins, is_cat, has_nan, monotone,
+    feature_mask) -> GrownTree`` with X (N, F) uint8 bin codes, N a multiple
+    of PART_ROW_BLOCK (pad rows with bag_mask 0).
     """
     L = num_leaves
     F = num_features
@@ -87,10 +79,10 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         from ..ops.histogram_pallas import build_histogram_pallas
 
     sp = split_params
-    strat_template = None  # serial only; parallel strategies use serial.py
+    use_mc = split_params.use_monotone
 
     def _hist_from_seg(seg, valid):
-        """(F, B, 3) histogram of one packed segment (seg: (S, W) u8)."""
+        """(F, B, 3) histogram of one packed chunk (seg: (C, W) u8)."""
         bins_rows = seg[:, :F]
         gm = jax.lax.bitcast_convert_type(seg[:, F:F + 4], jnp.float32)
         hm = jax.lax.bitcast_convert_type(seg[:, F + 4:F + 8], jnp.float32)
@@ -103,14 +95,14 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         return build_histogram(bins_rows, gm, hm, mask, num_bins=max_bins,
                                impl=hist_impl)
 
-    use_mc = split_params.use_monotone
-
     def grow(X: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
              bag_mask: jnp.ndarray, num_bins: jnp.ndarray,
              is_cat: jnp.ndarray, has_nan: jnp.ndarray,
              monotone: jnp.ndarray, feature_mask: jnp.ndarray) -> GrownTree:
         n = X.shape[0]
         strat = CommStrategy(num_bins, is_cat, has_nan, monotone)
+        chunk_bulk = min(CHUNK_BULK, n)
+        chunk_tail = min(CHUNK_TAIL, n)
 
         # ---- pack rows: bins | grad*bag | hess*bag | orig idx | bag ----
         gm = (grad * bag_mask).astype(jnp.float32)
@@ -125,9 +117,153 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             jnp.zeros((n, W - F - 13), jnp.uint8),
         ], axis=1)
 
-        ladder = _bucket_ladder(n)
+        def _sweep(start, cnt, fn, carry):
+            """Run ``fn(chunk_start, chunk_size(static), carry)`` over the
+            segment [start, start+cnt): bulk chunks first, then tail
+            chunks.  fn must itself mask rows outside [start, start+cnt)."""
+            nb = cnt // chunk_bulk
 
-        root_hist = _hist_from_seg(P, jnp.ones((n,), jnp.float32))
+            def bulk(i, c):
+                return fn(start + i * chunk_bulk, chunk_bulk, c)
+
+            carry = jax.lax.fori_loop(0, nb, bulk, carry)
+            t0 = start + nb * chunk_bulk
+            nt = (cnt - nb * chunk_bulk + chunk_tail - 1) // chunk_tail
+
+            def tail(i, c):
+                return fn(t0 + i * chunk_tail, chunk_tail, c)
+
+            return jax.lax.fori_loop(0, nt, tail, carry)
+
+        def _chunk_rows(cstart, csize):
+            """Load a (csize, W) slice whose row j is global row
+            ``clamped + j`` (dynamic_slice clamps near the array end)."""
+            clamped = jnp.minimum(cstart, n - csize)
+            seg = jax.lax.dynamic_slice(P_ref[0], (clamped, 0), (csize, W))
+            return seg, clamped
+
+        # P is rebound per split inside the fori_loop; the sweep helpers
+        # read it through this one-element list closure.  The two staging
+        # buffers (sized n + one bulk chunk so full-chunk stores never
+        # clamp) are scratch carried through the loop for reuse; their
+        # stale contents are never read (the combine pass only reads
+        # positions the current split wrote).
+        P_ref = [P]
+        stage_ref = [jnp.zeros((n + chunk_bulk, W), jnp.uint8),
+                     jnp.zeros((n + chunk_bulk, W), jnp.uint8)]
+
+        def hist_of_segment(start, cnt):
+            def step(cstart, csize, acc):
+                seg, clamped = _chunk_rows(cstart, csize)
+                j = jnp.arange(csize, dtype=jnp.int32)
+                gpos = clamped + j
+                valid = ((gpos >= cstart) & (gpos < start + cnt)
+                         ).astype(jnp.float32)
+                return acc + _hist_from_seg(seg, valid)
+
+            acc0 = jnp.zeros((F, max_bins, 3), jnp.float32)
+            return _sweep(start, cnt, step, acc0)
+
+        def _decide_col(col, clamped, cstart, cend, csize, feat_args):
+            feat, thr, dleft, fcat, fnanb, member = feat_args
+            j = jnp.arange(csize, dtype=jnp.int32)
+            gpos = clamped + j
+            valid = (gpos >= cstart) & (gpos < cend)
+            is_nanbin = col == fnanb
+            go_left = jnp.where(fcat, member[col],
+                                jnp.where(is_nanbin, dleft, col <= thr))
+            return go_left & valid, valid
+
+        def partition_segment(start, cnt, feat, thr, dleft, fcat, fnanb,
+                              member):
+            """Stable chunked partition of [start, start+cnt)
+            (DataPartition::Split analog), built from BANDWIDTH-friendly
+            primitives: XLA row scatter costs ~150ns/row on TPU, so instead
+            each chunk is stable-sorted lefts-first (multi-operand
+            ``lax.sort`` on a 1-bit key, ~37ns/row) and written with TWO
+            full-chunk contiguous stores into left/right staging buffers at
+            final positions (garbage tails are overwritten by the next
+            chunk or masked at combine); a final contiguous sweep selects
+            staging rows back into P by position.  Returns (P_new, n_left).
+            """
+            feat_args = (feat, thr, dleft, fcat, fnanb, member)
+            cend = start + cnt
+
+            # pass A: left count (column-only loads)
+            def count_step(cstart, csize, acc):
+                clamped = jnp.minimum(cstart, n - csize)
+                col = jax.lax.dynamic_slice(
+                    P_ref[0], (clamped, feat), (csize, 1))[:, 0].astype(
+                    jnp.int32)
+                gl, _ = _decide_col(col, clamped, cstart, cend, csize,
+                                    feat_args)
+                return acc + jnp.sum(gl.astype(jnp.int32))
+
+            nl = _sweep(start, cnt, count_step, jnp.asarray(0, jnp.int32))
+
+            # pass B: per-chunk stable sort + staged contiguous writes.
+            # Lefts land in the L staging buffer at their FINAL positions;
+            # rights land in the R buffer at theirs (one shared buffer is
+            # unsafe: left/right full-chunk writes would collide).
+            Wq = W // 4
+
+            def stage_step(cstart, csize, carry):
+                Lb, Rb, dl, dr = carry
+                seg, clamped = _chunk_rows(cstart, csize)
+                col = jax.lax.dynamic_slice(
+                    seg, (0, feat), (csize, 1))[:, 0].astype(jnp.int32)
+                gl, valid = _decide_col(col, clamped, cstart, cend, csize,
+                                        feat_args)
+                # push invalid rows to the very end (key 2) so valid
+                # lefts/rights are contiguous in the sorted chunk
+                key = jnp.where(gl, 0, jnp.where(valid, 1, 2))
+                cols = jax.lax.bitcast_convert_type(
+                    seg.reshape(csize, Wq, 4), jnp.int32)
+                ops = [key] + [cols[:, k] for k in range(Wq)]
+                out = jax.lax.sort(ops, dimension=0, is_stable=True,
+                                   num_keys=1)
+                sorted_u8 = jax.lax.bitcast_convert_type(
+                    jnp.stack(out[1:], axis=1), jnp.uint8).reshape(csize, W)
+                clt = jnp.sum(gl.astype(jnp.int32))
+                crt = jnp.sum(valid.astype(jnp.int32)) - clt
+                # full-chunk stores; only the leading valid parts matter
+                Lb = jax.lax.dynamic_update_slice(
+                    Lb, sorted_u8, (start + dl, 0))
+                # rights begin at local row clt; place them at their final
+                # position start+nl+dr by writing the whole chunk at
+                # (start+nl+dr-clt); the left part before it is garbage
+                # that the combine pass never reads from Rb
+                Rb = jax.lax.dynamic_update_slice(
+                    Rb, sorted_u8, (jnp.maximum(start + nl + dr - clt, 0), 0))
+                return Lb, Rb, dl + clt, dr + crt
+
+            Lb, Rb, _, _ = _sweep(start, cnt, stage_step,
+                                  (stage_ref[0], stage_ref[1],
+                                   jnp.asarray(0, jnp.int32),
+                                   jnp.asarray(0, jnp.int32)))
+            stage_ref[0] = Lb
+            stage_ref[1] = Rb
+
+            # combine: contiguous sweep selecting Lb below start+nl, Rb above
+            def combine_step(cstart, csize, P_out):
+                clamped = jnp.minimum(cstart, n - csize)
+                lrow = jax.lax.dynamic_slice(Lb, (clamped, 0), (csize, W))
+                rrow = jax.lax.dynamic_slice(Rb, (clamped, 0), (csize, W))
+                cur = jax.lax.dynamic_slice(P_out, (clamped, 0), (csize, W))
+                j = jnp.arange(csize, dtype=jnp.int32)
+                gpos = clamped + j
+                inseg = (gpos >= start) & (gpos < cend)
+                use_l = gpos < start + nl
+                rows = jnp.where(
+                    inseg[:, None],
+                    jnp.where(use_l[:, None], lrow, rrow), cur)
+                return jax.lax.dynamic_update_slice(P_out, rows, (clamped, 0))
+
+            P_out = _sweep(start, cnt, combine_step, P_ref[0])
+            return P_out, nl, Lb, Rb
+
+        root_hist = hist_of_segment(jnp.asarray(0, jnp.int32),
+                                    jnp.asarray(n, jnp.int32))
         root_sum = jnp.stack([jnp.sum(gm), jnp.sum(hm), jnp.sum(bag_mask)])
         root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
         cand = strat.leaf_candidates(root_hist, root_sum, feature_mask, sp,
@@ -135,6 +271,8 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
 
         state = {
             "P": P,
+            "stageL": stage_ref[0],
+            "stageR": stage_ref[1],
             "leaf_start": jnp.full((L,), n, jnp.int32).at[0].set(0),
             "leaf_seg": jnp.zeros((L,), jnp.int32).at[0].set(n),
             "leaf_sum": jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum),
@@ -174,62 +312,10 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
 
         nb_full, ic_full, hn_full = num_bins, is_cat, has_nan
 
-        def partition_branch(psize):
-            """Stable-partition the split leaf's segment of (static) size
-            ``psize`` (DataPartition::Split analog) and return
-            (P_new, n_left_segment).
-
-            dynamic_slice clamps the start when start+psize > n, so the
-            segment's rows live at offset ``off = start - clamped_start``
-            within the slice; rows outside [off, off+cnt) belong to other
-            leaves and must not move."""
-            def fn(op):
-                P, start, cnt, feat, thr, dleft, fcat, fnanb, member = op
-                cstart = jnp.minimum(start, n - psize)
-                off = start - cstart
-                seg = jax.lax.dynamic_slice(P, (cstart, 0), (psize, W))
-                col = jax.lax.dynamic_slice(seg, (0, feat),
-                                            (psize, 1))[:, 0].astype(jnp.int32)
-                pos_idx = jnp.arange(psize, dtype=jnp.int32)
-                valid = (pos_idx >= off) & (pos_idx < off + cnt)
-                is_nanbin = col == fnanb
-                go_left = jnp.where(fcat, member[col],
-                                    jnp.where(is_nanbin, dleft, col <= thr))
-                gl = go_left & valid
-                gr = jnp.logical_and(valid, jnp.logical_not(go_left))
-                cl = jnp.cumsum(gl.astype(jnp.int32))
-                nl = cl[-1]
-                cr = jnp.cumsum(gr.astype(jnp.int32))
-                pos = off + jnp.where(gl, cl - 1, nl + cr - 1)
-                pos = jnp.where(valid, pos, psize)  # dropped
-                seg_new = seg.at[pos].set(seg, mode="drop")
-                P = jax.lax.dynamic_update_slice(P, seg_new, (cstart, 0))
-                return P, nl
-            return fn
-
-        def hist_branch(csize):
-            def fn(op):
-                P, start, cnt = op
-                cstart = jnp.minimum(start, n - csize)
-                off = start - cstart
-                seg = jax.lax.dynamic_slice(P, (cstart, 0), (csize, W))
-                pos_idx = jnp.arange(csize, dtype=jnp.int32)
-                valid = ((pos_idx >= off) & (pos_idx < off + cnt)
-                         ).astype(jnp.float32)
-                return _hist_from_seg(seg, valid)
-            return fn
-
-        part_fns = [partition_branch(s) for s in ladder]
-        hist_fns = [hist_branch(s) for s in ladder]
-
-        def pick(cnt):
-            """Index of the smallest ladder size >= cnt."""
-            sel = jnp.zeros((), jnp.int32)
-            for i, s in enumerate(ladder[:-1]):
-                sel = sel + (cnt > s).astype(jnp.int32)
-            return sel
-
         def body(t, s):
+            P_ref[0] = s["P"]
+            stage_ref[0] = s["stageL"]
+            stage_ref[1] = s["stageR"]
             best_leaf = jnp.argmax(s["cand_gain"]).astype(jnp.int32)
             bgain = s["cand_gain"][best_leaf]
             do = jnp.logical_and(jnp.logical_not(s["done"]), bgain > 0)
@@ -249,18 +335,16 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             fnan = hn_full[feat]
             f_nan_bin = jnp.where(fnan, nb_full[feat] - 1, -1)
 
-            P_new, nl = jax.lax.switch(
-                pick(seg_cnt), part_fns,
-                (s["P"], start, seg_cnt, feat, thr, dleft, fcat, f_nan_bin,
-                 member))
+            P_new, nl, stage_l, stage_r = partition_segment(
+                start, seg_cnt, feat, thr, dleft, fcat, f_nan_bin, member)
             nr = seg_cnt - nl
+            P_ref[0] = P_new
 
             # ---- smaller-child histogram on its contiguous segment ----
             left_smaller = lsum[2] <= rsum[2]
             s_start = jnp.where(left_smaller, start, start + nl)
             s_cnt = jnp.where(do, jnp.where(left_smaller, nl, nr), 0)
-            hist_small = jax.lax.switch(pick(s_cnt), hist_fns,
-                                        (P_new, s_start, s_cnt))
+            hist_small = hist_of_segment(s_start, s_cnt)
             parent_hist = s["hists"][best_leaf]
             hist_big = parent_hist - hist_small
             hist_left = jnp.where(left_smaller, hist_small, hist_big)
@@ -284,13 +368,12 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             else:
                 bound_l = bound_r = None
 
-            # ---- children candidates ----
+            # ---- children candidates (one vmapped scan for the pair) ----
             child_depth = s["leaf_depth"][best_leaf] + 1
             depth_ok = jnp.logical_or(max_depth <= 0, child_depth < max_depth)
-            cl = strat.leaf_candidates(hist_left, lsum, feature_mask, sp,
-                                       bound_l, child_depth)
-            cr = strat.leaf_candidates(hist_right, rsum, feature_mask, sp,
-                                       bound_r, child_depth)
+            cl, cr = strat.pair_candidates(hist_left, hist_right, lsum, rsum,
+                                           feature_mask, sp, bound_l, bound_r,
+                                           child_depth)
             gl_ = jnp.where(depth_ok, cl[0], NEG_INF)
             gr_ = jnp.where(depth_ok, cr[0], NEG_INF)
 
@@ -315,6 +398,8 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
 
             out = dict(s)
             out["P"] = P_new
+            out["stageL"] = stage_l
+            out["stageR"] = stage_r
             out["leaf_start"] = upd(upd(s["leaf_start"], best_leaf, start),
                                     new_id, start + nl)
             out["leaf_seg"] = upd(upd(s["leaf_seg"], best_leaf, nl),
@@ -380,16 +465,21 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         s = jax.lax.fori_loop(0, L - 1, body, state)
 
         # ---- reconstruct row_leaf in ORIGINAL row order ----
-        # leaf id per position: markers at segment starts, forward-filled.
+        # leaf id per position via binary search over the sorted segment
+        # starts (an associative_scan forward-fill here took XLA 30+ min to
+        # compile at 10.5M rows — searchsorted over the L-element starts
+        # compiles in seconds and is one gather per row at runtime).
         # Empty segments (possible when all in-bag rows go one way but the
-        # out-of-bag tail doesn't) must not claim their shared start.
+        # out-of-bag tail doesn't) are parked at start=n so they never
+        # cover a position.
         starts = jnp.where((jnp.arange(L) < s["num_leaves"]) &
                            (s["leaf_seg"] > 0), s["leaf_start"], n)
-        marker = jnp.full((n,), -1, jnp.int32)
-        marker = marker.at[starts].set(jnp.arange(L, dtype=jnp.int32),
-                                       mode="drop")
-        leaf_of_pos = jax.lax.associative_scan(
-            lambda a, b: jnp.where(b < 0, a, b), marker)
+        order = jnp.argsort(starts)
+        starts_sorted = starts[order]
+        pos = jnp.arange(n, dtype=jnp.int32)
+        leaf_of_pos = order[
+            jnp.searchsorted(starts_sorted, pos, side="right") - 1
+        ].astype(jnp.int32)
         orig = jax.lax.bitcast_convert_type(s["P"][:, F + 8:F + 12],
                                             jnp.int32)
         row_leaf = jnp.zeros((n,), jnp.int32).at[orig].set(leaf_of_pos)

@@ -87,6 +87,14 @@ class DataParallelStrategy(CommStrategy):
         return (gmax, f_win, bcast(b), bcast(dl.astype(jnp.int32)) > 0,
                 bcast(ls), bcast(rs), bcast(member.astype(jnp.int32)) > 0)
 
+    def pair_candidates(self, hist_l, hist_r, lsum, rsum, feature_mask,
+                        params, bound_l, bound_r, depth):
+        # collectives are not vmap-batched: two sequential candidate calls
+        return (self.leaf_candidates(hist_l, lsum, feature_mask, params,
+                                     bound_l, depth),
+                self.leaf_candidates(hist_r, rsum, feature_mask, params,
+                                     bound_r, depth))
+
 
 class DataParallelTreeLearner:
     """Host-side wrapper building the shard_map'd grower."""

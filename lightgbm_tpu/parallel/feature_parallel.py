@@ -78,6 +78,14 @@ class FeatureParallelStrategy(CommStrategy):
                 bcast(ls), bcast(rs),
                 bcast(member.astype(jnp.int32)) > 0)
 
+    def pair_candidates(self, hist_l, hist_r, lsum, rsum, feature_mask,
+                        params, bound_l, bound_r, depth):
+        # collectives are not vmap-batched: two sequential candidate calls
+        return (self.leaf_candidates(hist_l, lsum, feature_mask, params,
+                                     bound_l, depth),
+                self.leaf_candidates(hist_r, rsum, feature_mask, params,
+                                     bound_r, depth))
+
     def get_column(self, X_local, feat_global):
         r = jax.lax.axis_index(self.axis_name)
         owner = feat_global // self.f_local

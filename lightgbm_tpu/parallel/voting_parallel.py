@@ -86,6 +86,14 @@ class VotingStrategy(CommStrategy):
             hist_sel, leaf_sum, nb, ic, hn, fm, params, mono, bound, depth)
         return (g, selected[f_loc], b, dl, ls, rs, member)
 
+    def pair_candidates(self, hist_l, hist_r, lsum, rsum, feature_mask,
+                        params, bound_l, bound_r, depth):
+        # collectives are not vmap-batched: two sequential candidate calls
+        return (self.leaf_candidates(hist_l, lsum, feature_mask, params,
+                                     bound_l, depth),
+                self.leaf_candidates(hist_r, rsum, feature_mask, params,
+                                     bound_r, depth))
+
 
 class VotingParallelTreeLearner:
     name = "voting"

@@ -1,0 +1,67 @@
+"""Standalone compile-time bisect for the 10.5M-row grower (perf triage)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N, W, F, B = 10_502_144, 48, 28, 256
+CH = 1 << 20
+
+
+def mark(s, t0):
+    print(f"{s}: {time.perf_counter() - t0:.1f}s", flush=True)
+
+
+P = jnp.zeros((N, W), jnp.uint8)
+
+
+def scat(P, pos, seg):
+    return P.at[pos].set(seg, mode="drop")
+
+
+t0 = time.perf_counter()
+f1 = jax.jit(scat).lower(P, jnp.zeros((CH,), jnp.int32),
+                         jnp.zeros((CH, W), jnp.uint8)).compile()
+mark("1. scatter (1M,48)u8 -> (N,48)", t0)
+
+from lightgbm_tpu.ops.histogram_pallas import build_histogram_pallas
+
+t0 = time.perf_counter()
+f2 = jax.jit(lambda x, g, h, m: build_histogram_pallas(
+    x, g, h, m, num_bins=B)).lower(
+    jnp.zeros((F, CH), jnp.uint8), jnp.zeros((CH,), jnp.float32),
+    jnp.zeros((CH,), jnp.float32), jnp.zeros((CH,), jnp.float32)).compile()
+mark("2. pallas hist (28,1M)", t0)
+
+
+def part(P, start):
+    seg = jax.lax.dynamic_slice(P, (start, 0), (CH, W))
+    col = seg[:, 0].astype(jnp.int32)
+    gl = col <= 3
+    cl = jnp.cumsum(gl.astype(jnp.int32))
+    pos = jnp.where(gl, cl - 1, N)
+    return P.at[pos].set(seg, mode="drop")
+
+
+t0 = time.perf_counter()
+f3 = jax.jit(part).lower(P, jnp.asarray(5, jnp.int32)).compile()
+mark("3. slice+cumsum+scatter chunk", t0)
+
+
+def sweep(P, start, cnt):
+    def body(i, acc):
+        seg = jax.lax.dynamic_slice(P, (start + i * CH, 0), (CH, W))
+        return acc + jnp.sum(seg[:, :F].astype(jnp.float32))
+
+    return jax.lax.fori_loop(0, cnt // CH, body, 0.0)
+
+
+t0 = time.perf_counter()
+f4 = jax.jit(sweep).lower(P, jnp.asarray(0, jnp.int32),
+                          jnp.asarray(N, jnp.int32)).compile()
+mark("4. fori sweep of slices", t0)

@@ -1,0 +1,34 @@
+"""Compile-time probes: scan ops at 10.5M (perf triage)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+N = 10_502_144
+
+
+def mark(s, t0):
+    print(f"{s}: {time.perf_counter() - t0:.1f}s", flush=True)
+
+
+def ff(marker):
+    return jax.lax.associative_scan(lambda a, b: jnp.where(b < 0, a, b),
+                                    marker)
+
+
+t0 = time.perf_counter()
+jax.jit(ff).lower(jnp.zeros((N,), jnp.int32)).compile()
+mark("associative_scan fwd-fill N=10.5M", t0)
+
+
+def cm(x):
+    return jnp.cumsum(x)
+
+
+t0 = time.perf_counter()
+jax.jit(cm).lower(jnp.zeros((N,), jnp.int32)).compile()
+mark("cumsum N=10.5M", t0)

@@ -1,0 +1,35 @@
+"""Compile-time scaling of the partitioned grower in (num_leaves, N)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.learner.partitioned import make_partitioned_grow_fn
+from lightgbm_tpu.ops.split import SplitParams
+
+F, B = 28, 256
+cases = [(int(a), int(b)) for a, b in
+         (pair.split(":") for pair in sys.argv[1].split(","))]
+
+for L, N in cases:
+    sp = SplitParams(min_data_in_leaf=20)
+    grow = make_partitioned_grow_fn(
+        num_leaves=L, num_features=F, max_bins=B, max_depth=-1,
+        split_params=sp, hist_impl="pallas", jit=False)
+    args = (jnp.zeros((N, F), jnp.uint8), jnp.zeros((N,), jnp.float32),
+            jnp.ones((N,), jnp.float32), jnp.ones((N,), jnp.float32),
+            jnp.full((F,), B, jnp.int32), jnp.zeros((F,), jnp.bool_),
+            jnp.zeros((F,), jnp.bool_), jnp.zeros((F,), jnp.int32),
+            jnp.ones((F,), jnp.bool_))
+    t0 = time.perf_counter()
+    lowered = jax.jit(grow).lower(*args)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    print(f"L={L} N={N}: trace+lower {t1 - t0:.1f}s, compile {t2 - t1:.1f}s",
+          flush=True)

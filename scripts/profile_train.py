@@ -5,6 +5,7 @@ upload, learner build, first update (compile), steady-state updates.
 Env: ROWS (default 10.5M), TREES (default 5), LEAVES, BINS.
 """
 
+import faulthandler
 import os
 import sys
 import time
@@ -12,6 +13,7 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+faulthandler.dump_traceback_later(120, repeat=True, file=sys.stderr)
 
 T0 = time.perf_counter()
 

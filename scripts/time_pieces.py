@@ -1,0 +1,122 @@
+"""Runtime of the partitioned grower's per-split pieces at Higgs scale."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N, W, F, B = 10_502_144, 48, 28, 256
+CH = 1 << 20
+rng = np.random.RandomState(0)
+P = jnp.asarray(rng.randint(0, 255, (N, W)).astype(np.uint8))
+
+
+def _force(out):
+    """Host-read a scalar derived from out (block_until_ready appears to
+    return early through the axon tunnel)."""
+    leaves = jax.tree_util.tree_leaves(out)
+    return float(jnp.asarray(leaves[0]).ravel()[0])
+
+
+def timeit(name, fn, *args, reps=3):
+    _force(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _force(out)
+    print(f"{name}: {(time.perf_counter() - t0) / reps * 1000:.1f} ms",
+          flush=True)
+
+
+# 1. full-N hist via chunk sweep (the root build)
+from lightgbm_tpu.ops.histogram_pallas import build_histogram_pallas
+
+
+@jax.jit
+def hist_sweep(P, start, cnt):
+    def body(i, acc):
+        cstart = start + i * CH
+        clamped = jnp.minimum(cstart, N - CH)
+        seg = jax.lax.dynamic_slice(P, (clamped, 0), (CH, W))
+        bins_rows = seg[:, :F]
+        gm = jax.lax.bitcast_convert_type(seg[:, F:F + 4], jnp.float32)
+        hm = jax.lax.bitcast_convert_type(seg[:, F + 4:F + 8], jnp.float32)
+        bag = seg[:, F + 12].astype(jnp.float32)
+        return acc + build_histogram_pallas(
+            jnp.swapaxes(bins_rows, 0, 1), gm, hm, bag, num_bins=B)
+
+    return jax.lax.fori_loop(0, cnt // CH, body,
+                             jnp.zeros((F, B, 3), jnp.float32))
+
+
+timeit("hist sweep full N (10 chunks)", hist_sweep, P,
+       jnp.asarray(0, jnp.int32), jnp.asarray(N // CH * CH, jnp.int32))
+
+
+# 2. count pass full N
+@jax.jit
+def count_sweep(P, start, cnt, feat):
+    def body(i, acc):
+        cstart = start + i * CH
+        clamped = jnp.minimum(cstart, N - CH)
+        seg = jax.lax.dynamic_slice(P, (clamped, 0), (CH, W))
+        col = jax.lax.dynamic_slice(seg, (0, feat), (CH, 1))[:, 0]
+        return acc + jnp.sum((col <= 100).astype(jnp.int32))
+
+    return jax.lax.fori_loop(0, cnt // CH, body, jnp.asarray(0, jnp.int32))
+
+
+timeit("count sweep full N", count_sweep, P, jnp.asarray(0, jnp.int32),
+       jnp.asarray(N // CH * CH, jnp.int32), jnp.asarray(3, jnp.int32))
+
+
+# 3. scatter pass full N
+@jax.jit
+def scatter_sweep(P, start, cnt, feat, nl):
+    def body(i, carry):
+        P_out, dl, dr = carry
+        cstart = start + i * CH
+        clamped = jnp.minimum(cstart, N - CH)
+        seg = jax.lax.dynamic_slice(P, (clamped, 0), (CH, W))
+        col = jax.lax.dynamic_slice(seg, (0, feat), (CH, 1))[:, 0].astype(
+            jnp.int32)
+        gl = col <= 100
+        cl = jnp.cumsum(gl.astype(jnp.int32))
+        cr = jnp.cumsum((~gl).astype(jnp.int32))
+        pos = jnp.where(gl, start + dl + cl - 1, start + nl + dr + cr - 1)
+        P_out = P_out.at[pos].set(seg, mode="drop")
+        return P_out, dl + cl[-1], dr + cr[-1]
+
+    out, _, _ = jax.lax.fori_loop(0, cnt // CH, body,
+                                  (P, jnp.asarray(0, jnp.int32),
+                                   jnp.asarray(0, jnp.int32)))
+    return out
+
+
+timeit("scatter sweep full N", scatter_sweep, P, jnp.asarray(0, jnp.int32),
+       jnp.asarray(N // CH * CH, jnp.int32), jnp.asarray(3, jnp.int32),
+       jnp.asarray(N // 2, jnp.int32))
+
+# 4. candidate scan
+from lightgbm_tpu.ops.split import SplitParams, best_split_per_feature
+
+sp = SplitParams()
+hist = jnp.asarray(rng.rand(F, B, 3).astype(np.float32))
+psum = jnp.asarray(np.array([10.0, 1000.0, 10000.0], np.float32))
+nb = jnp.full((F,), B, jnp.int32)
+ic = jnp.zeros((F,), jnp.bool_)
+hn = jnp.zeros((F,), jnp.bool_)
+
+
+@jax.jit
+def scan2(hist, psum):
+    a = best_split_per_feature(hist, psum, nb, ic, hn, sp)
+    b = best_split_per_feature(hist * 0.5, psum, nb, ic, hn, sp)
+    return a.gain[0] + b.gain[0]
+
+
+timeit("2x candidate scans", scan2, hist, psum, reps=10)

@@ -1,0 +1,64 @@
+"""Partition data-movement strategies microbench at 1M-row chunks."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N, W = 10_502_144, 48
+CH = 1 << 20
+rng = np.random.RandomState(0)
+P8 = jnp.asarray(rng.randint(0, 255, (N, W)).astype(np.uint8))
+P32 = jax.lax.bitcast_convert_type(P8.reshape(N, W // 4, 4), jnp.int32)
+pos = jnp.asarray(rng.permutation(N)[:CH].astype(np.int32))
+perm = jnp.asarray(rng.permutation(N)[:CH].astype(np.int32))
+seg8 = jnp.asarray(rng.randint(0, 255, (CH, W)).astype(np.uint8))
+seg32 = jax.lax.bitcast_convert_type(seg8.reshape(CH, W // 4, 4), jnp.int32)
+key = jnp.asarray((rng.rand(CH) < 0.5).astype(np.uint8))
+
+
+def force(out):
+    return float(jnp.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+
+
+def timeit(name, fn, *args, reps=3):
+    f = jax.jit(fn)
+    force(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+    force(out)
+    print(f"{name}: {(time.perf_counter() - t0) / reps * 1000:.1f} ms",
+          flush=True)
+
+
+timeit("scatter rows u8 (CH,48)", lambda P, p, s: P.at[p].set(s, mode="drop"),
+       P8, pos, seg8)
+timeit("scatter rows i32 (CH,12)", lambda P, p, s: P.at[p].set(s, mode="drop"),
+       P32, pos, seg32)
+timeit("gather rows u8", lambda P, p: P[p], P8, perm)
+timeit("gather rows i32", lambda P, p: P[p], P32, perm)
+timeit("scatter idx i32 (CH,)",
+       lambda P, p, v: P.at[p].set(v, mode="drop"),
+       jnp.zeros((N,), jnp.int32), pos, perm)
+
+
+def sort_rows(key, seg):
+    ops = [key.astype(jnp.int32)] + [seg[:, i] for i in range(seg.shape[1])]
+    out = jax.lax.sort(ops, dimension=0, is_stable=True, num_keys=1)
+    return out[1]
+
+
+timeit("stable sort 12xi32 by 1-bit key", sort_rows, key, seg32)
+
+
+def local_gather(seg, p):
+    return seg[p]
+
+
+timeit("local gather (CH,12) i32", local_gather, seg32,
+       jnp.asarray(rng.permutation(CH).astype(np.int32)))

@@ -1,0 +1,60 @@
+"""Movement microbench round 2: realistic two-run partition patterns."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N, W = 10_502_144, 48
+CH = 1 << 20
+rng = np.random.RandomState(0)
+P8 = jnp.asarray(rng.randint(0, 255, (N, W)).astype(np.uint8))
+
+# two-run gather indices: sources of the left-then-right stable partition
+gl = rng.rand(CH) < 0.5
+src = np.concatenate([np.nonzero(gl)[0], np.nonzero(~gl)[0]]).astype(np.int32)
+perm2run = jnp.asarray(src)
+permrand = jnp.asarray(rng.permutation(CH).astype(np.int32))
+permid = jnp.asarray(np.arange(CH, dtype=np.int32))
+
+
+def force(out):
+    return float(jnp.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+
+
+def timeit(name, fn, *args, reps=3):
+    f = jax.jit(fn)
+    force(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+    force(out)
+    print(f"{name}: {(time.perf_counter() - t0) / reps * 1000:.1f} ms",
+          flush=True)
+
+
+timeit("gather u8 rows, identity idx", lambda P, p: P[p], P8, permid)
+timeit("gather u8 rows, two-run idx", lambda P, p: P[p], P8, perm2run)
+timeit("gather u8 rows, random idx", lambda P, p: P[p], P8, permrand)
+
+
+# take with take_along/indexing variants
+def take_dyn(P, p):
+    return jnp.take(P, p, axis=0, mode="fill", fill_value=0)
+
+
+timeit("jnp.take fill two-run", take_dyn, P8, perm2run)
+
+# wider rows: same bytes as (CH/4, 192) — is cost per ROW or per BYTE?
+P192 = P8.reshape(N // 4, W * 4)
+timeit("gather 192B rows (CH/4), random",
+       lambda P, p: P[p], P192,
+       jnp.asarray(rng.permutation(N // 4)[:CH // 4].astype(np.int32)))
+P768 = P8.reshape(N // 16, W * 16)
+timeit("gather 768B rows (CH/16), random",
+       lambda P, p: P[p], P768,
+       jnp.asarray(rng.permutation(N // 16)[:CH // 16].astype(np.int32)))
