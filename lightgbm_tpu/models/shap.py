@@ -3,93 +3,119 @@ the TreeSHAP algorithm of Lundberg, Erion & Lee, "Consistent Individualized
 Feature Attribution for Tree Ensembles" (Algorithm 2); exposed via
 predict(..., pred_contrib=True), c_api predict type C_API_PREDICT_CONTRIB).
 
-Host-side recursive TreeSHAP over the flat tree arrays.  Prediction-time
-only (not on the training hot path), so a clear host implementation is
-preferred; a vectorized device path can land with the perf milestones.
-
-Path entries are [feature, zero_fraction, one_fraction, pweight]."""
+ROW-VECTORIZED TreeSHAP: the recursion's control structure (which nodes are
+visited, in which order, and which feature sits at each path level) is
+row-independent — only the hot/cold weight assignment differs per row — so
+one traversal per tree carries the whole batch: every path-state scalar of
+Algorithm 2 (zero fraction, one fraction, pweight) becomes an (N,) vector
+and the extend/unwind algebra becomes elementwise numpy.  Rows are chunked
+to bound the path-state working set.  (The round-2 implementation recursed
+per row in Python: ~rows× slower.)
+"""
 
 from __future__ import annotations
 
 import numpy as np
 
-from .tree import CAT_MASK, DEFAULT_LEFT_MASK, Tree
+from .tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN, Tree
+
+_CHUNK = 4096
 
 
-def _extend(m, pz, po, pi):
-    l = len(m)
-    m = [row[:] for row in m]
-    m.append([pi, pz, po, 1.0 if l == 0 else 0.0])
-    for i in range(l - 1, -1, -1):
-        m[i + 1][3] += po * m[i][3] * (i + 1) / (l + 1)
-        m[i][3] = pz * m[i][3] * (l - i) / (l + 1)
-    return m
+def _go_left_vec(tree: Tree, node: int, v: np.ndarray) -> np.ndarray:
+    """Vectorized per-row decision at one node (matches tree walks)."""
+    dt = tree.decision_type[node]
+    nanmask = np.isnan(v)
+    if dt & CAT_MASK:
+        dleft = bool(dt & DEFAULT_LEFT_MASK)
+        iv = np.where(nanmask, -1.0, v)
+        ivi = iv.astype(np.int64)
+        exact = (ivi >= 0) & (ivi.astype(np.float64) == iv)
+        cats = np.asarray(tree.cat_values(node), dtype=np.int64)
+        member = np.isin(ivi, cats) & exact
+        return np.where(nanmask, dleft, member)
+    thr = tree.threshold[node]
+    if (dt >> 2) & 3 == 2:  # missing nan
+        dleft = bool(dt & DEFAULT_LEFT_MASK)
+        return np.where(nanmask, dleft, v <= thr)
+    return np.where(nanmask, 0.0 <= thr, v <= thr)
 
 
-def _unwind(m, i):
-    l = len(m) - 1
-    o, z = m[i][2], m[i][1]
-    m = [row[:] for row in m]
-    n = m[l][3]
-    for j in range(l - 1, -1, -1):
-        if o != 0:
-            t = m[j][3]
-            m[j][3] = n * (l + 1) / ((j + 1) * o)
-            n = t - m[j][3] * z * (l - j) / (l + 1)
-        else:
-            m[j][3] = m[j][3] * (l + 1) / (z * (l - j))
-    for j in range(i, l):
-        m[j][0], m[j][1], m[j][2] = m[j + 1][0], m[j + 1][1], m[j + 1][2]
-    m.pop()
-    return m
-
-
-def _unwound_sum(m, i):
-    l = len(m) - 1
-    o, z = m[i][2], m[i][1]
-    n = m[l][3]
-    total = 0.0
-    for j in range(l - 1, -1, -1):
-        if o != 0:
-            t = n * (l + 1) / ((j + 1) * o)
-            total += t
-            n = m[j][3] - t * z * (l - j) / (l + 1)
-        else:
-            total += m[j][3] * (l + 1) / (z * (l - j))
-    return total
-
-
-def _tree_shap(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
-    """Accumulate SHAP values of one tree for one row into phi
-    (len num_features + 1; last slot = expected value/bias)."""
+def _tree_shap_batch(tree: Tree, X: np.ndarray, phi: np.ndarray) -> None:
+    """Accumulate one tree's SHAP values for a row chunk into phi
+    (shape (n, num_features + 1); last slot = expected value)."""
+    n = X.shape[0]
+    if tree.num_leaves <= 1:
+        phi[:, -1] += tree.leaf_value[0]
+        return
+    phi[:, -1] += _expected_value(tree, 0)
 
     def node_count(node):
         if node < 0:
             return float(tree.leaf_count[~node])
         return float(tree.internal_count[node])
 
-    def go_left(node, v):
-        dt = tree.decision_type[node]
-        if dt & CAT_MASK:
-            return tree.cat_decision(node, v)
-        if np.isnan(v):
-            if (dt >> 2) & 3 == 2:
-                return bool(dt & DEFAULT_LEFT_MASK)
-            v = 0.0
-        return v <= tree.threshold[node]
+    ones = np.ones(n)
+
+    # path state: parallel lists of (feature index, z (n,), o (n,), pw (n,))
+    def extend(m, pz, po, pi):
+        l = len(m)
+        m = [(f, z, o, w.copy()) for f, z, o, w in m]
+        m.append((pi, pz, po, ones.copy() if l == 0 else np.zeros(n)))
+        for i in range(l - 1, -1, -1):
+            f_i1, z_i1, o_i1, w_i1 = m[i + 1]
+            f_i, z_i, o_i, w_i = m[i]
+            w_i1 += po * w_i * (i + 1) / (l + 1)
+            m[i] = (f_i, z_i, o_i, pz * w_i * (l - i) / (l + 1))
+        return m
+
+    def unwound_sum(m, i):
+        l = len(m) - 1
+        o, z = m[i][2], m[i][1]
+        nn = m[l][3].copy()
+        total = np.zeros(n)
+        o_nz = o != 0
+        o_safe = np.where(o_nz, o, 1.0)
+        z_safe = np.where(z != 0, z, 1.0)
+        for j in range(l - 1, -1, -1):
+            t = nn * (l + 1) / ((j + 1) * o_safe)
+            total += np.where(o_nz, t,
+                              m[j][3] * (l + 1) / (z_safe * (l - j)))
+            nn = np.where(o_nz, m[j][3] - t * z * (l - j) / (l + 1), nn)
+        return total
+
+    def unwind(m, i):
+        l = len(m) - 1
+        o, z = m[i][2], m[i][1]
+        nn = m[l][3].copy()
+        m = [(f, zz, oo, w.copy()) for f, zz, oo, w in m]
+        o_nz = o != 0
+        o_safe = np.where(o_nz, o, 1.0)
+        z_safe = np.where(z != 0, z, 1.0)
+        for j in range(l - 1, -1, -1):
+            f_j, z_j, o_j, w_j = m[j]
+            t = nn * (l + 1) / ((j + 1) * o_safe)
+            nn = np.where(o_nz, w_j - t * z * (l - j) / (l + 1), nn)
+            new_w = np.where(o_nz, t, w_j * (l + 1) / (z_safe * (l - j)))
+            m[j] = (f_j, z_j, o_j, new_w)
+        for j in range(i, l):
+            # shift feature/z/o down, KEEP this slot's pweight (Algorithm 2)
+            m[j] = (m[j + 1][0], m[j + 1][1], m[j + 1][2], m[j][3])
+        m.pop()
+        return m
 
     def recurse(node, m, pz, po, pi):
-        m = _extend(m, pz, po, pi)
+        m = extend(m, pz, po, pi)
         if node < 0:
-            v = tree.leaf_value[~node]
+            v = float(tree.leaf_value[~node])
             for i in range(1, len(m)):
-                w = _unwound_sum(m, i)
-                phi[m[i][0]] += w * (m[i][2] - m[i][1]) * v
+                w = unwound_sum(m, i)
+                phi[:, m[i][0]] += w * (m[i][2] - m[i][1]) * v
             return
         f = int(tree.split_feature[node])
-        l, r = int(tree.left_child[node]), int(tree.right_child[node])
-        hot, cold = (l, r) if go_left(node, x[f]) else (r, l)
-        iz, io = 1.0, 1.0
+        l_, r_ = int(tree.left_child[node]), int(tree.right_child[node])
+        hot_left = _go_left_vec(tree, node, X[:, f]).astype(bool)
+        iz, io = ones, ones
         k = -1
         for i in range(1, len(m)):
             if m[i][0] == f:
@@ -97,18 +123,16 @@ def _tree_shap(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
                 break
         if k >= 0:
             iz, io = m[k][1], m[k][2]
-            m = _unwind(m, k)
+            m = unwind(m, k)
         cnt = node_count(node)
-        hf = node_count(hot) / cnt if cnt > 0 else 0.0
-        cf = node_count(cold) / cnt if cnt > 0 else 0.0
-        recurse(hot, m, iz * hf, io, f)
-        recurse(cold, m, iz * cf, 0.0, f)
+        lf = node_count(l_) / cnt if cnt > 0 else 0.0
+        rf = node_count(r_) / cnt if cnt > 0 else 0.0
+        # the zero fraction of a child is its count share either way; the
+        # one fraction is io where the child is the row's hot side, else 0
+        recurse(l_, m, iz * lf, np.where(hot_left, io, 0.0), f)
+        recurse(r_, m, iz * rf, np.where(hot_left, 0.0, io), f)
 
-    if tree.num_leaves <= 1:
-        phi[-1] += tree.leaf_value[0]
-        return
-    phi[-1] += _expected_value(tree, 0)
-    recurse(0, [], 1.0, 1.0, -1)
+    recurse(0, [], ones, ones, -1)
 
 
 def _expected_value(tree: Tree, node: int) -> float:
@@ -131,10 +155,11 @@ def predict_contrib(gbdt, Xi: np.ndarray) -> np.ndarray:
     k = gbdt.num_tree_per_iteration
     nf = gbdt.num_features
     out = np.zeros((n, (nf + 1) * k), np.float64)
-    for t, tree in enumerate(gbdt.models):
-        cid = t % k
-        for i in range(n):
-            phi = np.zeros(nf + 1)
-            _tree_shap(tree, Xi[i], phi)
-            out[i, cid * (nf + 1):(cid + 1) * (nf + 1)] += phi
+    for lo in range(0, n, _CHUNK):
+        hi = min(lo + _CHUNK, n)
+        chunk = Xi[lo:hi]
+        for t, tree in enumerate(gbdt.models):
+            cid = t % k
+            _tree_shap_batch(tree, chunk,
+                             out[lo:hi, cid * (nf + 1):(cid + 1) * (nf + 1)])
     return out
