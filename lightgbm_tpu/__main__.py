@@ -1,0 +1,9 @@
+"""``python -m lightgbm_tpu`` — the CLI entry point (reference
+src/main.cpp:11)."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,15 +11,34 @@ import numpy as np
 
 
 def _detect_format(line: str) -> str:
-    if ":" in line.split()[1] if len(line.split()) > 1 else False:
-        return "libsvm"
+    """LibSVM iff a post-label token looks like ``<int>:<number>`` (a
+    headered CSV whose second column name contains ':' must NOT be
+    misrouted); otherwise by delimiter."""
+    tokens = line.split()
+    for tok in tokens[1:3]:
+        head, _, tail = tok.partition(":")
+        if _ and head.isdigit():
+            try:
+                float(tail)
+                return "libsvm"
+            except ValueError:
+                pass
     if "\t" in line:
         return "tsv"
     if "," in line:
         return "csv"
-    if ":" in line:
-        return "libsvm"
     return "tsv"
+
+
+def load_sidecar(path: str, kind: str) -> Optional[np.ndarray]:
+    """Load a ``<data>.weight`` / ``<data>.query`` sidecar file if present
+    (reference dataset_loader.cpp Metadata::Init weight/query file
+    convention: one value per line)."""
+    import os
+    side = f"{path}.{kind}"
+    if not os.path.exists(side):
+        return None
+    return np.loadtxt(side, dtype=np.float64).ravel()
 
 
 def load_data_file(path: str, params: Optional[Dict[str, Any]] = None

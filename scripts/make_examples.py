@@ -1,0 +1,129 @@
+"""Generate the checked-in examples/ datasets + CLI config files
+(reference examples/ layout: TSV data with label first, train.conf /
+predict.conf, .weight sidecars; data here is synthetic)."""
+import os
+import sys
+
+import numpy as np
+
+ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "examples")
+
+
+def write_tsv(path, y, X):
+    with open(path, "w") as fh:
+        for i in range(len(y)):
+            fh.write("\t".join([f"{y[i]:g}"] +
+                               [f"{v:.6g}" for v in X[i]]) + "\n")
+
+
+def binary():
+    d = os.path.join(ROOT, "binary_classification")
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.RandomState(7)
+    n, f = 2000, 28
+    X = rng.randn(n, f)
+    w = rng.randn(f) / np.sqrt(f)
+    logit = X @ w + 0.4 * X[:, 0] * X[:, 1]
+    y = (logit + rng.randn(n) * 0.4 > 0).astype(int)
+    write_tsv(os.path.join(d, "binary.train"), y[:1600], X[:1600])
+    write_tsv(os.path.join(d, "binary.test"), y[1600:], X[1600:])
+    np.savetxt(os.path.join(d, "binary.train.weight"),
+               np.where(y[:1600] > 0, 1.2, 1.0), fmt="%g")
+    with open(os.path.join(d, "train.conf"), "w") as fh:
+        fh.write("""# binary classification example (synthetic data)
+task = train
+boosting_type = gbdt
+objective = binary
+metric = binary_logloss,auc
+metric_freq = 5
+is_training_metric = true
+max_bin = 255
+data = binary.train
+valid_data = binary.test
+num_trees = 50
+learning_rate = 0.1
+num_leaves = 31
+output_model = LightGBM_model.txt
+""")
+    with open(os.path.join(d, "predict.conf"), "w") as fh:
+        fh.write("""task = predict
+data = binary.test
+input_model = LightGBM_model.txt
+output_result = LightGBM_predict_result.txt
+""")
+
+
+def regression():
+    d = os.path.join(ROOT, "regression")
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.RandomState(11)
+    n, f = 1500, 10
+    X = rng.rand(n, f)
+    y = (10 * np.sin(np.pi * X[:, 0] * X[:, 1]) + 20 * (X[:, 2] - 0.5) ** 2 +
+         10 * X[:, 3] + 5 * X[:, 4] + rng.randn(n))
+    write_tsv(os.path.join(d, "regression.train"), y[:1200], X[:1200])
+    write_tsv(os.path.join(d, "regression.test"), y[1200:], X[1200:])
+    with open(os.path.join(d, "train.conf"), "w") as fh:
+        fh.write("""# regression example (synthetic friedman1-style data)
+task = train
+objective = regression
+metric = l2
+data = regression.train
+valid_data = regression.test
+num_trees = 60
+learning_rate = 0.1
+num_leaves = 31
+is_training_metric = true
+output_model = LightGBM_model.txt
+""")
+    with open(os.path.join(d, "predict.conf"), "w") as fh:
+        fh.write("""task = predict
+data = regression.test
+input_model = LightGBM_model.txt
+output_result = LightGBM_predict_result.txt
+""")
+
+
+def lambdarank():
+    d = os.path.join(ROOT, "lambdarank")
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.RandomState(3)
+    nq, per_q, f = 80, 12, 12
+    rows, labels, groups = [], [], []
+    for q in range(nq):
+        Xq = rng.rand(per_q, f)
+        score = Xq[:, 0] * 2 + Xq[:, 1] - Xq[:, 2] + rng.randn(per_q) * 0.3
+        rel = np.clip(np.digitize(score, np.quantile(score, [0.5, 0.75, 0.9])),
+                      0, 4)
+        rows.append(Xq)
+        labels.append(rel)
+        groups.append(per_q)
+    X = np.concatenate(rows)
+    y = np.concatenate(labels)
+    ntr = 60 * per_q
+    write_tsv(os.path.join(d, "rank.train"), y[:ntr], X[:ntr])
+    write_tsv(os.path.join(d, "rank.test"), y[ntr:], X[ntr:])
+    np.savetxt(os.path.join(d, "rank.train.query"), [per_q] * 60, fmt="%d")
+    np.savetxt(os.path.join(d, "rank.test.query"), [per_q] * 20, fmt="%d")
+    with open(os.path.join(d, "train.conf"), "w") as fh:
+        fh.write("""# lambdarank example (synthetic queries)
+task = train
+objective = lambdarank
+metric = ndcg
+ndcg_eval_at = 1,3,5
+data = rank.train
+valid_data = rank.test
+num_trees = 40
+learning_rate = 0.1
+num_leaves = 15
+min_data_in_leaf = 3
+output_model = LightGBM_model.txt
+""")
+
+
+if __name__ == "__main__":
+    binary()
+    regression()
+    lambdarank()
+    print(f"examples written under {ROOT}")

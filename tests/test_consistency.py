@@ -1,0 +1,128 @@
+"""CLI <-> Python API consistency suite (reference pattern:
+tests/python_package_test/test_consistency.py — run the examples'
+train.conf through the CLI and assert the Python API produces the same
+model/predictions on the same data)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import main as cli_main, parse_cli_args
+from lightgbm_tpu.config import Config, parse_config_file
+from lightgbm_tpu.io_utils import load_data_file, load_sidecar
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def _run_cli_train(example, tmp_path, extra=()):
+    conf = os.path.join(EXAMPLES, example, "train.conf")
+    model_out = str(tmp_path / "model.txt")
+    cwd = os.getcwd()
+    os.chdir(os.path.join(EXAMPLES, example))
+    try:
+        cli_main([f"config={conf}", f"output_model={model_out}",
+                  "verbosity=-1", *extra])
+    finally:
+        os.chdir(cwd)
+    return model_out
+
+
+def _python_train(example):
+    d = os.path.join(EXAMPLES, example)
+    params = parse_config_file(os.path.join(d, "train.conf"))
+    cfg = Config(params)
+    data_path = os.path.join(d, cfg.data)
+    X, _, y = load_data_file(data_path, params)
+    ds = lgb.Dataset(X, y, params={**params, "verbosity": -1})
+    w = load_sidecar(data_path, "weight")
+    if w is not None:
+        ds.set_weight(w)
+    g = load_sidecar(data_path, "query")
+    if g is not None:
+        ds.set_group(g.astype(np.int64))
+    bst = lgb.train({**params, "verbosity": -1}, ds,
+                    num_boost_round=cfg.num_iterations)
+    return bst, X
+
+
+@pytest.mark.parametrize("example", ["binary_classification", "regression",
+                                     "lambdarank"])
+def test_cli_matches_python_api(example, tmp_path):
+    model_path = _run_cli_train(example, tmp_path)
+    cli_bst = lgb.Booster(model_file=model_path)
+    py_bst, X = _python_train(example)
+    np.testing.assert_allclose(cli_bst.predict(X), py_bst.predict(X),
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_cli_predict_writes_results(tmp_path):
+    model_path = _run_cli_train("regression", tmp_path)
+    d = os.path.join(EXAMPLES, "regression")
+    out = str(tmp_path / "preds.txt")
+    cli_main([f"config={os.path.join(d, 'predict.conf')}",
+              f"data={os.path.join(d, 'regression.test')}",
+              f"input_model={model_path}", f"output_result={out}",
+              "verbosity=-1"])
+    preds = np.loadtxt(out)
+    bst = lgb.Booster(model_file=model_path)
+    X, _, _ = load_data_file(os.path.join(d, "regression.test"), {})
+    np.testing.assert_allclose(preds, bst.predict(X), rtol=1e-6)
+
+
+def test_cli_refit(tmp_path):
+    model_path = _run_cli_train("regression", tmp_path)
+    d = os.path.join(EXAMPLES, "regression")
+    out_model = str(tmp_path / "refit.txt")
+    cli_main(["task=refit",
+              f"data={os.path.join(d, 'regression.train')}",
+              f"input_model={model_path}", f"output_model={out_model}",
+              "refit_decay_rate=0.5", "verbosity=-1"])
+    refit_bst = lgb.Booster(model_file=out_model)
+    base_bst = lgb.Booster(model_file=model_path)
+    assert refit_bst.num_trees() == base_bst.num_trees()
+
+
+def test_cli_convert_model_compiles_and_matches(tmp_path):
+    import ctypes
+    import shutil
+    model_path = _run_cli_train("regression", tmp_path)
+    src = str(tmp_path / "model.cpp")
+    cli_main(["task=convert_model", f"input_model={model_path}",
+              f"convert_model={src}", "verbosity=-1"])
+    assert "PredictRaw" in open(src).read()
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++")
+    lib = str(tmp_path / "model.so")
+    subprocess.check_call([gxx, "-O1", "-shared", "-fPIC", src, "-o", lib])
+    cdll = ctypes.CDLL(lib)
+    cdll.PredictRaw.restype = ctypes.c_double
+    cdll.PredictRaw.argtypes = [ctypes.POINTER(ctypes.c_double)]
+    bst = lgb.Booster(model_file=model_path)
+    d = os.path.join(EXAMPLES, "regression")
+    X, _, _ = load_data_file(os.path.join(d, "regression.test"), {})
+    expect = bst.predict(X[:50], raw_score=True)
+    got = np.array([cdll.PredictRaw(
+        np.ascontiguousarray(row).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double))) for row in X[:50]])
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-7)
+
+
+def test_cli_subprocess_entrypoint(tmp_path):
+    """python -m lightgbm_tpu end-to-end in a real subprocess."""
+    d = os.path.join(EXAMPLES, "regression")
+    model_out = str(tmp_path / "m.txt")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    subprocess.check_call(
+        [sys.executable, "-m", "lightgbm_tpu", "config=train.conf",
+         f"output_model={model_out}", "num_trees=5", "verbosity=-1"],
+        cwd=d, env=env)
+    assert os.path.exists(model_out)
+    assert lgb.Booster(model_file=model_out).num_trees() == 5
