@@ -391,8 +391,6 @@ _UNIMPLEMENTED_PARAMS: Tuple[str, ...] = (
     "deterministic",       # training is deterministic by construction, but
                            # the reference's flag also forces col-wise
     "max_cat_to_onehot",
-    "linear_tree",
-    "linear_lambda",
     "cegb_penalty_split",
     "cegb_penalty_feature_lazy",
     "cegb_penalty_feature_coupled",

@@ -169,6 +169,12 @@ class Dataset:
         used = self.used_feature_map
         mappers = [self.bin_mappers[j] for j in used]
         self.X_binned = bin_matrix(raw[:, used], mappers)
+        if cfg.linear_tree:
+            # linear trees fit on RAW feature values (reference
+            # linear_tree_learner.cpp raw_index); keep the used columns
+            self.raw_used = raw[:, used].astype(np.float32)
+        else:
+            self.raw_used = None
         self._set_metadata(n)
         self.constructed = True
         if self.free_raw_data:

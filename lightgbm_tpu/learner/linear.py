@@ -1,0 +1,172 @@
+"""Linear trees: per-leaf linear models on the leaf's branch features.
+
+TPU-native re-implementation of the reference LinearTreeLearner
+(reference: src/treelearner/linear_tree_learner.cpp:175 ``CalculateLinear``
+— per leaf, solve coef = -(Xᵀ H X + λ)⁻¹ Xᵀ g over the leaf's rows where X
+is [branch numerical features | 1]; rows with NaN in any leaf feature are
+excluded from the fit and fall back to the plain leaf output at predict
+time; near-zero coefficients are pruned; Eq. 3 of "Gradient Boosting With
+Piece-Wise Linear Regression Trees", Shi et al.).
+
+TPU design: the per-leaf normal-equation MOMENTS are accumulated on device
+with one chunked (L, C) × (C, (K+1)²) MXU contraction over the leaf one-hot
+(no per-leaf row gathering); the (K+1)-dim solves are batched on host
+(K ≤ tree depth, L ≤ num_leaves — microscopic next to the moment pass).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_ZERO_THRESHOLD = 1e-35
+_CHUNK = 1 << 14
+
+
+def branch_features(split_feature: np.ndarray, left_child: np.ndarray,
+                    right_child: np.ndarray, num_leaves: int,
+                    is_cat: np.ndarray) -> List[List[int]]:
+    """Unique NUMERICAL features on each leaf's root path (reference
+    tree.h branch_features with track_branch_features)."""
+    feats: List[List[int]] = [[] for _ in range(num_leaves)]
+    if num_leaves <= 1:
+        return feats
+
+    def walk(node: int, path: List[int]) -> None:
+        f = int(split_feature[node])
+        path2 = path + ([f] if not bool(is_cat[f]) else [])
+        for child in (int(left_child[node]), int(right_child[node])):
+            if child < 0:
+                leaf = ~child
+                if leaf < num_leaves:
+                    feats[leaf] = sorted(set(path2))
+            else:
+                walk(child, path2)
+
+    walk(0, [])
+    return feats
+
+
+@functools.partial(jax.jit, static_argnames=("k1",))
+def _moments(Xr, grad, hess, bag, row_leaf, leaf_feat, leaf_fmask, k1):
+    """Per-leaf XᵀHX (L,K+1,K+1), Xᵀg (L,K+1), and fit-row counts (L,).
+
+    leaf_feat: (L, K) int32 feature ids (0 padded); leaf_fmask: (L, K)
+    float32 validity.  Rows whose own leaf features contain NaN are
+    excluded entirely (reference HAS_NAN path)."""
+    n = Xr.shape[0]
+    L = leaf_feat.shape[0]
+
+    def chunk_body(start, acc):
+        M, b, cnt = acc
+        sl = lambda a: jax.lax.dynamic_slice_in_dim(a, start, _CHUNK, 0)
+        xc = sl(Xr)
+        rl = sl(row_leaf)
+        rf = leaf_feat[rl]                      # (C, K)
+        rm = leaf_fmask[rl]                     # (C, K)
+        vals = jnp.take_along_axis(xc, rf, axis=1)  # (C, K)
+        nan_row = jnp.any(jnp.isnan(vals) & (rm > 0), axis=1)
+        w = sl(bag) * jnp.logical_not(nan_row).astype(jnp.float32)
+        vals = jnp.where(rm > 0, jnp.nan_to_num(vals), 0.0)
+        A = jnp.concatenate([vals, jnp.ones((_CHUNK, 1), jnp.float32)],
+                            axis=1)             # (C, K+1)
+        onehot = (rl[:, None] == jnp.arange(L)[None, :]).astype(jnp.float32)
+        hw = sl(hess) * w
+        gw = sl(grad) * w
+        A2 = (A[:, :, None] * A[:, None, :]).reshape(_CHUNK, k1 * k1)
+        M = M + jax.lax.dot_general(
+            (onehot * hw[:, None]).T, A2, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).reshape(L, k1, k1)
+        b = b + jax.lax.dot_general(
+            (onehot * gw[:, None]).T, A, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        cnt = cnt + jnp.sum(onehot * w[:, None], axis=0)
+        return M, b, cnt
+
+    nchunks = n // _CHUNK  # caller pads rows to _CHUNK (bag 0)
+    acc0 = (jnp.zeros((L, k1, k1), jnp.float32),
+            jnp.zeros((L, k1), jnp.float32), jnp.zeros((L,), jnp.float32))
+    return jax.lax.fori_loop(
+        0, nchunks, lambda i, a: chunk_body(i * _CHUNK, a), acc0)
+
+
+def fit_linear_leaves(Xr_dev, grad, hess, bag, row_leaf, split_feature,
+                      left_child, right_child, num_leaves, is_cat,
+                      linear_lambda: float, leaf_value: np.ndarray
+                      ) -> Tuple[List[List[int]], List[List[float]],
+                                 np.ndarray]:
+    """Fit all leaves' linear models for one grown tree.
+
+    Returns (leaf_features per leaf, coefficients per leaf, leaf_const).
+    leaf_value is the plain closed-form output (NaN fallback + fallback for
+    under-determined leaves, linear_tree_learner.cpp:330-340)."""
+    feats = branch_features(split_feature, left_child, right_child,
+                            num_leaves, is_cat)
+    L = max(num_leaves, 1)
+    K = max(1, max((len(f) for f in feats), default=1))
+    leaf_feat = np.zeros((L, K), np.int32)
+    leaf_fmask = np.zeros((L, K), np.float32)
+    for i, f in enumerate(feats):
+        leaf_feat[i, :len(f)] = f
+        leaf_fmask[i, :len(f)] = 1.0
+
+    n = Xr_dev.shape[0]
+    pad = (-n) % _CHUNK
+    if pad:
+        Xr_dev = jnp.pad(Xr_dev, ((0, pad), (0, 0)))
+        grad = jnp.pad(grad, (0, pad))
+        hess = jnp.pad(hess, (0, pad))
+        bag = jnp.pad(bag, (0, pad))
+        row_leaf = jnp.pad(row_leaf, (0, pad))
+    M, b, cnt = _moments(Xr_dev, grad, hess, bag, row_leaf,
+                         jnp.asarray(leaf_feat), jnp.asarray(leaf_fmask),
+                         K + 1)
+    M = np.asarray(M, np.float64)
+    b = np.asarray(b, np.float64)
+    cnt = np.asarray(cnt)
+
+    out_feats: List[List[int]] = []
+    out_coefs: List[List[float]] = []
+    out_const = np.asarray(leaf_value, np.float64).copy()
+    for i in range(L):
+        k = len(feats[i]) if i < len(feats) else 0
+        if i >= num_leaves or cnt[i] < k + 1:
+            out_feats.append([])
+            out_coefs.append([])
+            continue
+        Mi = M[i, :k + 1, :k + 1].copy()
+        Mi[np.arange(k), np.arange(k)] += linear_lambda  # not the intercept
+        try:
+            coef = -np.linalg.solve(Mi, b[i, :k + 1])
+        except np.linalg.LinAlgError:
+            out_feats.append([])
+            out_coefs.append([])
+            continue
+        if not np.all(np.isfinite(coef)):
+            out_feats.append([])
+            out_coefs.append([])
+            continue
+        keep = [j for j in range(k) if abs(coef[j]) > _ZERO_THRESHOLD]
+        out_feats.append([feats[i][j] for j in keep])
+        out_coefs.append([float(coef[j]) for j in keep])
+        out_const[i] = float(coef[k])
+    return out_feats, out_coefs, out_const
+
+
+@jax.jit
+def linear_score_delta(Xr, row_leaf, leaf_feat, leaf_fmask, leaf_coef,
+                       leaf_const, leaf_value, shrinkage):
+    """Per-row training-score delta for a linear tree: const + Σ coef·x,
+    falling back to the plain leaf output when any leaf feature is NaN
+    (reference tree.cpp PredictionFunLinear)."""
+    rf = leaf_feat[row_leaf]
+    rm = leaf_fmask[row_leaf]
+    vals = jnp.take_along_axis(Xr, rf, axis=1)
+    nan_row = jnp.any(jnp.isnan(vals) & (rm > 0), axis=1)
+    vals = jnp.where(rm > 0, jnp.nan_to_num(vals), 0.0)
+    lin = leaf_const[row_leaf] + jnp.sum(leaf_coef[row_leaf] * vals, axis=1)
+    return shrinkage * jnp.where(nan_row, leaf_value[row_leaf], lin)

@@ -166,6 +166,17 @@ class GBDT:
         self.learner = self._create_learner(num_bins, is_cat, has_nan,
                                             self._inner_monotone())
         self.X_dev = jnp.asarray(train_set.X_binned)
+        self._is_cat_np = is_cat
+        self._linear = bool(cfg.linear_tree)
+        if self._linear and self.name != "gbdt":
+            log_warning(f"linear_tree is not supported with "
+                        f"boosting={self.name}; training plain trees")
+            self._linear = False
+        if self._linear:
+            # linear leaves re-fit on raw values each iteration; tree
+            # deferral buys nothing here
+            self._defer_trees = False
+            self.X_raw_dev = jnp.asarray(train_set.raw_used)
 
         if self.objective is None and cfg.objective != "none":
             self.objective = create_objective(cfg.objective, cfg)
@@ -347,6 +358,7 @@ class GBDT:
             for cid in range(k):
                 g = grad if k == 1 else grad[:, cid]
                 h = hess if k == 1 else hess[:, cid]
+                self._cur_gh = (g, h)
                 grown = self.learner.train(self.X_dev, g, h, mask,
                                            feature_mask=fmask)
                 tree = self._record_tree(grown, cid)
@@ -440,6 +452,8 @@ class GBDT:
             self._models_list.append(tree)
 
     def _record_tree(self, grown: GrownTree, class_id: int) -> Optional[Tree]:
+        if getattr(self, "_linear", False):
+            return self._record_tree_linear(grown, class_id)
         cfg = self.config
         shrinkage = self._current_shrinkage()
         renewed = None
@@ -483,6 +497,84 @@ class GBDT:
                 self.valid_scores[vi] = self.valid_scores[vi] + delta
             else:
                 self.valid_scores[vi] = self.valid_scores[vi].at[:, class_id].add(delta)
+        return tree
+
+    def _linear_device_arrays(self, tree: Tree):
+        """Pad the tree's per-leaf linear models into device arrays for
+        vectorized evaluation."""
+        L = tree.max_leaves
+        feats = tree.leaf_features_inner
+        K = max(1, max((len(f) for f in feats), default=1))
+        lf = np.zeros((L, K), np.int32)
+        fm = np.zeros((L, K), np.float32)
+        co = np.zeros((L, K), np.float32)
+        for i, (fs, cs) in enumerate(zip(feats, tree.leaf_coeff)):
+            lf[i, :len(fs)] = fs
+            fm[i, :len(fs)] = 1.0
+            co[i, :len(cs)] = cs
+        return (jnp.asarray(lf), jnp.asarray(fm), jnp.asarray(co),
+                jnp.asarray(tree.leaf_const, jnp.float32),
+                jnp.asarray(tree.leaf_value, jnp.float32))
+
+    def _record_tree_linear(self, grown: GrownTree, class_id: int
+                            ) -> Optional[Tree]:
+        """Linear-tree variant of _record_tree: fit per-leaf linear models
+        on the raw branch features (learner/linear.py) before recording."""
+        from ..learner.linear import fit_linear_leaves, linear_score_delta
+        cfg = self.config
+        shrinkage = self._current_shrinkage()
+        g, h = self._cur_gh
+        mask = self._last_sample_mask
+        sf, lc, rc, nl, lv = jax.device_get(
+            (grown.split_feature, grown.left_child, grown.right_child,
+             grown.num_leaves, grown.leaf_value))
+        feats_i, coefs, const = fit_linear_leaves(
+            self.X_raw_dev, g, h, mask, grown.row_leaf, sf, lc, rc,
+            max(int(nl), 1), self._is_cat_np, float(cfg.linear_lambda), lv)
+        tree = _grown_to_tree(grown, 1.0, self.train_set)
+        real_map, _, _ = self.feature_mapping()
+        tree.is_linear = True
+        tree.leaf_const = np.asarray(const, np.float64)
+        tree.leaf_coeff = coefs
+        tree.leaf_features_inner = feats_i
+        tree.leaf_features = [[int(real_map[f]) for f in fs]
+                              for fs in feats_i]
+        if shrinkage != 1.0:
+            tree.shrink(shrinkage)
+        # device score update with POST-shrink, PRE-bias values (scores
+        # already carry the boost-from-average bias)
+        lf, fm, co, lconst, lval = self._linear_device_arrays(tree)
+        delta = linear_score_delta(self.X_raw_dev, grown.row_leaf, lf, fm,
+                                   co, lconst, lval, 1.0)
+        if self.num_tree_per_iteration == 1:
+            self.score = self.score + delta
+        else:
+            self.score = self.score.at[:, class_id].add(delta)
+        for vi, (_, vset) in enumerate(self.valid_sets):
+            vbins = vset._device_cache["bins"]
+            idx_f = _walk_binned(
+                vbins, grown.split_feature, grown.threshold_bin,
+                grown.nan_bin, grown.cat_member, grown.decision_type,
+                grown.left_child, grown.right_child,
+                jnp.arange(tree.max_leaves, dtype=jnp.float32),
+                grown.num_leaves)
+            vleaf = idx_f.astype(jnp.int32)
+            vraw = vset._device_cache.get("raw")
+            if vraw is None:
+                vraw = jnp.asarray(vset.raw_used)
+                vset._device_cache["raw"] = vraw
+            vdelta = linear_score_delta(vraw, vleaf, lf, fm, co, lconst,
+                                        lval, 1.0)
+            if self.num_tree_per_iteration == 1:
+                self.valid_scores[vi] = self.valid_scores[vi] + vdelta
+            else:
+                self.valid_scores[vi] = \
+                    self.valid_scores[vi].at[:, class_id].add(vdelta)
+        bias = self._pending_bias[class_id] if self.iter_ == 0 else 0.0
+        if abs(bias) > EPSILON:
+            tree.add_bias(bias)
+        self._flush_trees()
+        self._models_list.append(tree)
         return tree
 
     # -- evaluation (gbdt.cpp:472 EvalAndCheckEarlyStopping) -----------------
@@ -571,6 +663,7 @@ class GBDT:
             # walk returning leaf index: reuse raw walk on leaf-index values
             idx_tree = Tree(**{**tree.__dict__})
             idx_tree.leaf_value = np.arange(tree.max_leaves, dtype=np.float64)
+            idx_tree.is_linear = False  # leaf INDEX lookup, not outputs
             tb = TreeBatch([idx_tree])
             leaves.append(np.asarray(predict_raw(tb, Xd)).astype(np.int32))
         return np.stack(leaves, axis=1) if leaves else np.zeros(
@@ -656,6 +749,9 @@ class GBDT:
         if self.objective is None:
             raise ValueError("cannot refit without an objective")
         k = self.num_tree_per_iteration
+        if any(t.is_linear for t in source.models):
+            raise NotImplementedError(
+                "refit of linear-tree models is not supported yet")
         trees = [self._align_loaded_tree(t) for t in source.models]
         n = self.num_data
         if leaf_preds.shape != (n, len(trees)):
@@ -733,15 +829,31 @@ class GBDT:
             score = self.score
             for t, tree in enumerate(self.models):
                 cid = t % k
-                delta = wb(self.X_dev, jnp.asarray(tree.split_feature),
-                           jnp.asarray(tree.threshold_bin),
-                           jnp.asarray(tree.nan_bin),
-                           _tree_cat_member(tree),
-                           jnp.asarray(tree.decision_type.astype(np.int32)),
-                           jnp.asarray(tree.left_child),
-                           jnp.asarray(tree.right_child),
-                           jnp.asarray(tree.leaf_value, dtype=jnp.float32),
-                           jnp.asarray(tree.num_leaves, dtype=jnp.int32))
+                if tree.is_linear:
+                    from ..learner.linear import linear_score_delta
+                    idx_f = wb(self.X_dev, jnp.asarray(tree.split_feature),
+                               jnp.asarray(tree.threshold_bin),
+                               jnp.asarray(tree.nan_bin),
+                               _tree_cat_member(tree),
+                               jnp.asarray(tree.decision_type.astype(np.int32)),
+                               jnp.asarray(tree.left_child),
+                               jnp.asarray(tree.right_child),
+                               jnp.arange(tree.max_leaves, dtype=jnp.float32),
+                               jnp.asarray(tree.num_leaves, dtype=jnp.int32))
+                    lf, fm, co, lconst, lval = self._linear_device_arrays(tree)
+                    delta = linear_score_delta(
+                        self.X_raw_dev, idx_f.astype(jnp.int32), lf, fm, co,
+                        lconst, lval, 1.0)
+                else:
+                    delta = wb(self.X_dev, jnp.asarray(tree.split_feature),
+                               jnp.asarray(tree.threshold_bin),
+                               jnp.asarray(tree.nan_bin),
+                               _tree_cat_member(tree),
+                               jnp.asarray(tree.decision_type.astype(np.int32)),
+                               jnp.asarray(tree.left_child),
+                               jnp.asarray(tree.right_child),
+                               jnp.asarray(tree.leaf_value, dtype=jnp.float32),
+                               jnp.asarray(tree.num_leaves, dtype=jnp.int32))
                 if k == 1:
                     score = score + delta
                 else:

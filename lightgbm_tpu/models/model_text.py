@@ -102,7 +102,19 @@ def _tree_to_string(tree: Tree, real_feature_map: np.ndarray, index: int) -> str
             buf.write("cat_threshold=" + _join(cat_threshold) + "\n")
     else:
         buf.write("leaf_value=" + _fmt(tree.leaf_value[0]) + "\n")
-    buf.write("is_linear=0\n")
+    if tree.is_linear:
+        # per-leaf linear models (reference tree.cpp:378-399 linear fields:
+        # leaf_const + per-leaf feature lists/coefficients, flattened)
+        buf.write("is_linear=1\n")
+        buf.write("leaf_const=" + _join(tree.leaf_const[:nl], _fmt) + "\n")
+        buf.write("num_features=" +
+                  _join(len(f) for f in tree.leaf_features[:nl]) + "\n")
+        buf.write("leaf_features=" + _join(
+            f for fs in tree.leaf_features[:nl] for f in fs) + "\n")
+        buf.write("leaf_coeff=" + _join(
+            (c for cs in tree.leaf_coeff[:nl] for c in cs), _fmt) + "\n")
+    else:
+        buf.write("is_linear=0\n")
     buf.write(f"shrinkage={_fmt(tree.shrinkage)}\n")
     buf.write("\n")
     return buf.getvalue()
@@ -293,9 +305,32 @@ def _tree_from_block(block: Dict[str, str]) -> Tree:
         cat_threshold = arr("cat_threshold", np.uint32,
                             int(cat_boundaries[-1]) if num_cat else 0)
 
+    is_linear = int(block.get("is_linear", 0)) != 0
+    leaf_const = None
+    leaf_coeff = None
+    leaf_features = None
+    if is_linear:
+        leaf_const = arr("leaf_const", np.float64, nl)
+        nfeat = arr("num_features", np.int64, nl)
+        flat_f = [int(v) for v in block.get("leaf_features", "").split()]
+        flat_c = [float(v) for v in block.get("leaf_coeff", "").split()]
+        leaf_features = []
+        leaf_coeff = []
+        pos = 0
+        for i in range(nl):
+            k = int(nfeat[i])
+            leaf_features.append(flat_f[pos:pos + k])
+            leaf_coeff.append(flat_c[pos:pos + k])
+            pos += k
+
     return Tree(
         cat_boundaries=cat_boundaries,
         cat_threshold=cat_threshold,
+        is_linear=is_linear,
+        leaf_const=leaf_const,
+        leaf_coeff=leaf_coeff,
+        leaf_features=leaf_features,
+        leaf_features_inner=leaf_features,  # loaded models: identity map
         num_leaves=nl,
         split_feature=arr("split_feature", np.int32, n_int),
         threshold_bin=np.zeros(n_int, np.int32),  # unknown without a Dataset

@@ -151,6 +151,10 @@ def predict_contrib(gbdt, Xi: np.ndarray) -> np.ndarray:
     """Per-feature SHAP contributions + bias column
     (reference predictor contrib path; output (N, num_features+1), or
     num_class stacked blocks for multiclass)."""
+    if any(t.is_linear for t in gbdt.models):
+        from ..utils.log import log_warning
+        log_warning("pred_contrib on linear trees attributes each leaf's "
+                    "PLAIN output (per-leaf linear terms are not decomposed)")
     n = Xi.shape[0]
     k = gbdt.num_tree_per_iteration
     nf = gbdt.num_features

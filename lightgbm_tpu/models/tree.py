@@ -68,6 +68,16 @@ class Tree:
     # runtime-only binned membership for training-time walks (not
     # serialized; rebuilt from the bin mappers on load): (L-1, B) bool
     cat_member_bins: Optional[np.ndarray] = None
+    # Linear-tree fields (reference tree.h is_linear_/leaf_const_/
+    # leaf_coeff_/leaf_features_): per-leaf linear models on branch
+    # features; leaf_features holds REAL column indices; prediction is
+    # leaf_const + sum(coef * x), falling back to leaf_value when any
+    # leaf feature is NaN.
+    is_linear: bool = False
+    leaf_const: Optional[np.ndarray] = None       # (L,) float64
+    leaf_coeff: Optional[List[List[float]]] = None
+    leaf_features: Optional[List[List[int]]] = None        # REAL indices
+    leaf_features_inner: Optional[List[List[int]]] = None  # inner indices
 
     @property
     def max_leaves(self) -> int:
@@ -113,11 +123,30 @@ class Tree:
         """In-place shrinkage (reference tree.h Shrinkage)."""
         self.leaf_value = self.leaf_value * rate
         self.internal_value = self.internal_value * rate
+        if self.is_linear:
+            self.leaf_const = self.leaf_const * rate
+            self.leaf_coeff = [[c * rate for c in cs]
+                               for cs in self.leaf_coeff]
         self.shrinkage *= rate
 
     def add_bias(self, val: float) -> None:
         self.leaf_value = self.leaf_value + val
         self.internal_value = self.internal_value + val
+        if self.is_linear:
+            self.leaf_const = self.leaf_const + val
+
+    def linear_predict_row(self, leaf: int, row: np.ndarray) -> float:
+        """Host reference linear-leaf evaluation (tree.cpp
+        PredictionFunLinear): NaN in any leaf feature -> plain output."""
+        feats = (self.leaf_features_inner if self.leaf_features_inner
+                 is not None else self.leaf_features)[leaf]
+        total = float(self.leaf_const[leaf])
+        for f, c in zip(feats, self.leaf_coeff[leaf]):
+            v = row[f]
+            if np.isnan(v):
+                return float(self.leaf_value[leaf])
+            total += c * v
+        return total
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         """Raw-feature prediction, host reference implementation
@@ -145,7 +174,8 @@ class Tree:
                     else:
                         left = v <= self.threshold[node]
                 node = self.left_child[node] if left else self.right_child[node]
-            out[i] = self.leaf_value[~node]
+            out[i] = (self.linear_predict_row(~node, row) if self.is_linear
+                      else self.leaf_value[~node])
         return out
 
 
@@ -223,6 +253,37 @@ class TreeBatch:
                     v = int(t.threshold[i])
                     words[ti, i, v // 32] |= np.uint32(1 << (v % 32))
         self.cat_words = jnp.asarray(words)
+
+        # linear-tree leaf models (tree.h leaf_coeff_/leaf_const_)
+        self.has_linear = any(t.is_linear for t in trees)
+        lk = 1
+        if self.has_linear:
+            for t in trees:
+                if t.is_linear:
+                    lk = max(lk, max((len(f) for f in
+                                      (t.leaf_features_inner or
+                                       t.leaf_features)), default=1))
+        lconst = np.zeros((len(trees), ml), np.float32)
+        lcoef = np.zeros((len(trees), ml, lk), np.float32)
+        lfeat = np.zeros((len(trees), ml, lk), np.int32)
+        lfmask = np.zeros((len(trees), ml, lk), np.float32)
+        lflag = np.zeros((len(trees),), np.float32)
+        for ti, t in enumerate(trees):
+            if not t.is_linear:
+                continue
+            lflag[ti] = 1.0
+            lconst[ti, :len(t.leaf_const)] = t.leaf_const
+            feats = t.leaf_features_inner if t.leaf_features_inner \
+                is not None else t.leaf_features
+            for leaf, (fs, cs) in enumerate(zip(feats, t.leaf_coeff)):
+                lfeat[ti, leaf, :len(fs)] = fs
+                lfmask[ti, leaf, :len(fs)] = 1.0
+                lcoef[ti, leaf, :len(cs)] = cs
+        self.leaf_const = jnp.asarray(lconst)
+        self.leaf_coef = jnp.asarray(lcoef)
+        self.leaf_feat = jnp.asarray(lfeat)
+        self.leaf_fmask = jnp.asarray(lfmask)
+        self.linear_flag = jnp.asarray(lflag)
 
     def as_tuple(self):
         return (self.split_feature, self.threshold_bin, self.nan_bin,
@@ -303,11 +364,10 @@ def _walk_raw(X, split_feature, threshold, cat_words, decision_type,
     w = cat_words.shape[1]
 
     def cond(state):
-        node, _ = state
-        return jnp.any(node >= 0)
+        return jnp.any(state[0] >= 0)
 
     def body(state):
-        node, out = state
+        node, out, leaf = state
         active = node >= 0
         nd = jnp.maximum(node, 0)
         f = split_feature[nd]
@@ -334,20 +394,24 @@ def _walk_raw(X, split_feature, threshold, cat_words, decision_type,
         new_node = jnp.where(active, nxt, node)
         out = jnp.where(active & (new_node < 0),
                         leaf_value[jnp.maximum(~new_node, 0)], out)
-        return new_node, out
+        leaf = jnp.where(active & (new_node < 0),
+                         jnp.maximum(~new_node, 0), leaf)
+        return new_node, out, leaf
 
     out0 = jnp.where(num_leaves <= 1,
                      jnp.broadcast_to(leaf_value[0], (n,)),
                      jnp.zeros((n,), jnp.float32))
-    node, out = jax.lax.while_loop(cond, body, (node, out0))
-    return out
+    leaf0 = jnp.zeros((n,), jnp.int32)
+    node, out, leaf = jax.lax.while_loop(cond, body, (node, out0, leaf0))
+    return out, leaf
 
 
 def predict_raw(batch: TreeBatch, X: jnp.ndarray,
                 start_iteration: int = 0,
                 num_iteration: Optional[int] = None) -> jnp.ndarray:
     """Ensemble raw-score prediction on raw features
-    (reference gbdt_prediction.cpp:PredictRaw)."""
+    (reference gbdt_prediction.cpp:PredictRaw; linear-leaf evaluation per
+    tree.cpp PredictionFunLinear with NaN fallback)."""
     t_end = batch.num_trees if num_iteration is None else min(
         start_iteration + num_iteration, batch.num_trees)
     fields = (batch.split_feature, batch.threshold, batch.cat_words,
@@ -355,8 +419,30 @@ def predict_raw(batch: TreeBatch, X: jnp.ndarray,
               batch.leaf_value, batch.num_leaves)
     sliced = tuple(a[start_iteration:t_end] for a in fields)
 
-    def body(carry, tree_fields):
-        return carry + _walk_raw(X, *tree_fields), None
+    if not batch.has_linear:
+        def body(carry, tree_fields):
+            return carry + _walk_raw(X, *tree_fields)[0], None
 
-    out, _ = jax.lax.scan(body, jnp.zeros((X.shape[0],), jnp.float32), sliced)
+        out, _ = jax.lax.scan(body, jnp.zeros((X.shape[0],), jnp.float32),
+                              sliced)
+        return out
+
+    lin_fields = tuple(a[start_iteration:t_end] for a in
+                       (batch.leaf_const, batch.leaf_coef, batch.leaf_feat,
+                        batch.leaf_fmask, batch.linear_flag))
+
+    def body_lin(carry, tf):
+        tree_fields, (lconst, lcoef, lfeat, lfmask, lflag) = tf
+        val, leaf = _walk_raw(X, *tree_fields)
+        rf = lfeat[leaf]
+        rm = lfmask[leaf]
+        vals = jnp.take_along_axis(X, rf, axis=1)
+        nan_row = jnp.any(jnp.isnan(vals) & (rm > 0), axis=1)
+        vals = jnp.where(rm > 0, jnp.nan_to_num(vals), 0.0)
+        lin = lconst[leaf] + jnp.sum(lcoef[leaf] * vals, axis=1)
+        use_lin = (lflag > 0) & jnp.logical_not(nan_row)
+        return carry + jnp.where(use_lin, lin, val), None
+
+    out, _ = jax.lax.scan(body_lin, jnp.zeros((X.shape[0],), jnp.float32),
+                          (sliced, lin_fields))
     return out
