@@ -145,7 +145,11 @@ class Booster:
     def predict(self, data, start_iteration: int = 0,
                 num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
-                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+                pred_contrib: bool = False,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: Optional[int] = None,
+                pred_early_stop_margin: Optional[float] = None,
+                **kwargs) -> np.ndarray:
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else None
         if hasattr(data, "to_numpy"):
@@ -157,7 +161,10 @@ class Booster:
                                   start_iteration=start_iteration,
                                   num_iteration=num_iteration,
                                   pred_leaf=pred_leaf,
-                                  pred_contrib=pred_contrib)
+                                  pred_contrib=pred_contrib,
+                                  pred_early_stop=pred_early_stop,
+                                  pred_early_stop_freq=pred_early_stop_freq,
+                                  pred_early_stop_margin=pred_early_stop_margin)
 
     # -- model IO ------------------------------------------------------------
     def model_to_string(self, num_iteration: Optional[int] = None,

@@ -384,19 +384,14 @@ _UNIMPLEMENTED_PARAMS: Tuple[str, ...] = (
     "feature_contri",
     "pos_bagging_fraction",
     "neg_bagging_fraction",
-    "feature_fraction_bynode",
     "forcedbins_filename",
     "two_round",
     "pre_partition",
     "deterministic",       # training is deterministic by construction, but
                            # the reference's flag also forces col-wise
     "max_cat_to_onehot",
-    "cegb_penalty_split",
     "cegb_penalty_feature_lazy",
-    "cegb_penalty_feature_coupled",
     "interaction_constraints",
-    "forcedsplits_filename",
-    "pred_early_stop",
     "path_smooth",
 )
 

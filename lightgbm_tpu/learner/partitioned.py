@@ -63,7 +63,7 @@ def _round_up(x: int, m: int) -> int:
 def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                              max_bins: int, max_depth: int, split_params,
                              hist_impl: str, interpret: bool = False,
-                             jit: bool = True):
+                             jit: bool = True, forced_splits: tuple = ()):
     """Build the partition-ordered single-tree grower.
 
     Returned signature:
@@ -80,6 +80,21 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
 
     sp = split_params
     use_mc = split_params.use_monotone
+    bynode = split_params.feature_fraction_bynode < 1.0
+    import math as _math
+    kcnt = max(1, int(_math.ceil(F * split_params.feature_fraction_bynode))) \
+        if bynode else F
+    # forced splits (serial_tree_learner.cpp:450 ForceSplits): BFS-ordered
+    # (leaf, inner feature, threshold bin) triples applied before best-gain
+    # growth; static per grower (they come from a config file)
+    n_forced = min(len(forced_splits), L - 1)
+    if n_forced:
+        f_leaf_c = jnp.asarray([f[0] for f in forced_splits[:n_forced]],
+                               jnp.int32)
+        f_feat_c = jnp.asarray([f[1] for f in forced_splits[:n_forced]],
+                               jnp.int32)
+        f_bin_c = jnp.asarray([f[2] for f in forced_splits[:n_forced]],
+                              jnp.int32)
 
     def _hist_from_seg(seg, valid):
         """(F, B, 3) histogram of one packed chunk (seg: (C, W) u8)."""
@@ -98,11 +113,20 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
     def grow(X: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
              bag_mask: jnp.ndarray, num_bins: jnp.ndarray,
              is_cat: jnp.ndarray, has_nan: jnp.ndarray,
-             monotone: jnp.ndarray, feature_mask: jnp.ndarray) -> GrownTree:
+             monotone: jnp.ndarray, cegb_penalty: jnp.ndarray,
+             node_key: jnp.ndarray, feature_mask: jnp.ndarray) -> GrownTree:
         n = X.shape[0]
         strat = CommStrategy(num_bins, is_cat, has_nan, monotone)
+        strat.cegb_full = cegb_penalty if split_params.use_cegb else None
         chunk_bulk = min(CHUNK_BULK, n)
         chunk_tail = min(CHUNK_TAIL, n)
+
+        def node_mask(idx):
+            """Exact-count per-node feature sample (ColSampler bynode,
+            reference col_sampler.hpp)."""
+            r = jax.random.uniform(jax.random.fold_in(node_key, idx), (F,))
+            kth = jax.lax.top_k(r, kcnt)[0][-1]
+            return r >= kth
 
         # ---- pack rows: bins | grad*bag | hess*bag | orig idx | bag ----
         gm = (grad * bag_mask).astype(jnp.float32)
@@ -266,7 +290,8 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                                     jnp.asarray(n, jnp.int32))
         root_sum = jnp.stack([jnp.sum(gm), jnp.sum(hm), jnp.sum(bag_mask)])
         root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
-        cand = strat.leaf_candidates(root_hist, root_sum, feature_mask, sp,
+        fm_root = feature_mask & node_mask(2 * L) if bynode else feature_mask
+        cand = strat.leaf_candidates(root_hist, root_sum, fm_root, sp,
                                      root_bound, jnp.asarray(0, jnp.int32))
 
         state = {
@@ -326,6 +351,27 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             lsum = s["cand_lsum"][best_leaf]
             rsum = s["cand_rsum"][best_leaf]
             member = s["cand_member"][best_leaf]
+
+            if n_forced:
+                # ForceSplits override: fixed (leaf, feature, bin) applied
+                # regardless of gain; child sums read from the leaf's
+                # pooled histogram
+                fi = jnp.minimum(t, n_forced - 1)
+                is_forced = t < n_forced
+                best_leaf = jnp.where(is_forced, f_leaf_c[fi], best_leaf)
+                feat = jnp.where(is_forced, f_feat_c[fi], feat)
+                thr = jnp.where(is_forced, f_bin_c[fi], thr)
+                dleft = jnp.where(is_forced, False, dleft)
+                member = jnp.where(is_forced, jnp.zeros_like(member), member)
+                fh = s["hists"][best_leaf, feat]          # (B, 3)
+                csum = jnp.cumsum(fh, axis=0)
+                lsum_f = csum[jnp.clip(thr, 0, max_bins - 1)]
+                rsum_f = s["leaf_sum"][best_leaf] - lsum_f
+                lsum = jnp.where(is_forced, lsum_f, lsum)
+                rsum = jnp.where(is_forced, rsum_f, rsum)
+                bgain = jnp.where(is_forced, 0.0, bgain)
+                do = jnp.where(is_forced,
+                               s["leaf_seg"][best_leaf] > 0, do)
             psum_ = s["leaf_sum"][best_leaf]
             new_id = (t + 1).astype(jnp.int32)
 
@@ -371,9 +417,14 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             # ---- children candidates (one vmapped scan for the pair) ----
             child_depth = s["leaf_depth"][best_leaf] + 1
             depth_ok = jnp.logical_or(max_depth <= 0, child_depth < max_depth)
+            if bynode:
+                fm_l = feature_mask & node_mask(2 * t)
+                fm_r = feature_mask & node_mask(2 * t + 1)
+            else:
+                fm_l = fm_r = None
             cl, cr = strat.pair_candidates(hist_left, hist_right, lsum, rsum,
                                            feature_mask, sp, bound_l, bound_r,
-                                           child_depth)
+                                           child_depth, fm_l, fm_r)
             gl_ = jnp.where(depth_ok, cl[0], NEG_INF)
             gr_ = jnp.where(depth_ok, cr[0], NEG_INF)
 
@@ -459,7 +510,9 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             lc = upd(s["leaf_count"], best_leaf, lsum[2])
             out["leaf_count"] = upd(lc, new_id, rsum[2])
             out["num_leaves"] = s["num_leaves"] + do.astype(jnp.int32)
-            out["done"] = jnp.logical_not(do)
+            # a skipped FORCED split (empty leaf) must not end growth
+            out["done"] = jnp.logical_not(do) & (t >= n_forced) \
+                if n_forced else jnp.logical_not(do)
             return out
 
         s = jax.lax.fori_loop(0, L - 1, body, state)

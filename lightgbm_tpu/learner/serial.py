@@ -73,12 +73,12 @@ class GrownTree(NamedTuple):
 
 def local_best_candidate(hist, leaf_sum, num_bins, is_cat, has_nan,
                          feature_mask, params, monotone=None, bound=None,
-                         depth=None) -> Tuple[jnp.ndarray, ...]:
+                         depth=None, cegb=None) -> Tuple[jnp.ndarray, ...]:
     """Best split over (local) features for one leaf -> scalar candidate
     tuple (gain, feat, bin, default_left, left_sum, right_sum)."""
     fs: FeatureSplits = best_split_per_feature(hist, leaf_sum, num_bins,
                                                is_cat, has_nan, params,
-                                               monotone, bound, depth)
+                                               monotone, bound, depth, cegb)
     gain = jnp.where(feature_mask, fs.gain, NEG_INF)
     f = jnp.argmax(gain)
     return (gain[f], f.astype(jnp.int32), fs.threshold_bin[f],
@@ -126,27 +126,33 @@ class CommStrategy:
                         bound=None, depth=None):
         nb, ic, hn, fm = self.local_meta(feature_mask)
         return local_best_candidate(hist, leaf_sum, nb, ic, hn, fm, params,
-                                    self.monotone_full, bound, depth)
+                                    self.monotone_full, bound, depth,
+                                    getattr(self, "cegb_full", None))
 
     def pair_candidates(self, hist_l, hist_r, lsum, rsum, feature_mask,
-                        params, bound_l, bound_r, depth):
+                        params, bound_l, bound_r, depth, fm_l=None,
+                        fm_r=None):
         """Both children's candidates in ONE vmapped scan (halves the
         per-split fixed cost of the dozens of small ops in the bin scan).
+        fm_l/fm_r are optional per-child feature masks (bynode sampling).
         Parallel strategies override with two sequential calls — their
         collectives are not vmap-batched."""
         hists = jnp.stack([hist_l, hist_r])
         sums = jnp.stack([lsum, rsum])
         nb, ic, hn, fm = self.local_meta(feature_mask)
+        fms = jnp.stack([fm if fm_l is None else fm_l,
+                         fm if fm_r is None else fm_r])
         if bound_l is None:
             bounds = jnp.zeros((2, 2), jnp.float32)
         else:
             bounds = jnp.stack([bound_l, bound_r])
+        cegb = getattr(self, "cegb_full", None)
 
-        def one(h, s, b):
-            return local_best_candidate(h, s, nb, ic, hn, fm, params,
-                                        self.monotone_full, b, depth)
+        def one(h, s, b, f_m):
+            return local_best_candidate(h, s, nb, ic, hn, f_m, params,
+                                        self.monotone_full, b, depth, cegb)
 
-        out = jax.vmap(one)(hists, sums, bounds)
+        out = jax.vmap(one)(hists, sums, bounds, fms)
         cl = tuple(o[0] for o in out)
         cr = tuple(o[1] for o in out)
         return cl, cr
@@ -520,6 +526,12 @@ def split_params_from_config(config: Config,
         num_bins is not None and is_cat is not None and
         np.any(np.asarray(is_cat) &
                (np.asarray(num_bins) > int(config.max_cat_to_onehot))))
+    use_cegb = bool(config.cegb_penalty_split > 0.0 or
+                    config.cegb_penalty_feature_coupled)
+    if config.cegb_penalty_feature_lazy:
+        from ..utils.log import log_warning
+        log_warning("cegb_penalty_feature_lazy is not implemented (split "
+                    "and coupled penalties are); ignoring")
     return SplitParams(
         lambda_l1=float(config.lambda_l1),
         lambda_l2=float(config.lambda_l2),
@@ -535,7 +547,11 @@ def split_params_from_config(config: Config,
         max_cat_to_onehot=int(config.max_cat_to_onehot),
         max_cat_threshold=int(config.max_cat_threshold),
         min_data_per_group=int(config.min_data_per_group),
-        use_cat_subset=use_cat_subset)
+        use_cat_subset=use_cat_subset,
+        use_cegb=use_cegb,
+        cegb_tradeoff=float(config.cegb_tradeoff),
+        cegb_penalty_split=float(config.cegb_penalty_split),
+        feature_fraction_bynode=float(config.feature_fraction_bynode))
 
 
 def hist_pool_fits(config: Config, num_features: int, max_bins: int) -> bool:
@@ -559,7 +575,8 @@ class SerialTreeLearner:
 
     def __init__(self, config: Config, num_features: int, max_bins: int,
                  num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
-                 monotone: Optional[np.ndarray] = None):
+                 monotone: Optional[np.ndarray] = None,
+                 forced_splits: tuple = ()):
         self.config = config
         self.max_bins = int(max_bins)
         self.num_bins = jnp.asarray(num_bins, jnp.int32)
@@ -584,17 +601,19 @@ class SerialTreeLearner:
         # grower below remains for the pool-less huge-feature fallback and
         # as the shared body of the parallel strategies.
         self.partitioned = self.use_hist_pool
+        forced_splits = tuple(tuple(f) for f in forced_splits)
         if self.partitioned:
             key = ("part", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
-                   impl)
+                   impl, forced_splits)
             if key not in _GROW_FN_CACHE:
                 from .partitioned import make_partitioned_grow_fn
                 _GROW_FN_CACHE[key] = make_partitioned_grow_fn(
                     num_leaves=int(config.num_leaves),
                     num_features=num_features, max_bins=self.max_bins,
                     max_depth=int(config.max_depth),
-                    split_params=self.split_params, hist_impl=impl)
+                    split_params=self.split_params, hist_impl=impl,
+                    forced_splits=forced_splits)
         else:
             key = ("serial", int(config.num_leaves), self.max_bins,
                    int(config.max_depth), self.split_params, impl,
@@ -608,12 +627,25 @@ class SerialTreeLearner:
                     use_hist_pool=self.use_hist_pool)
         self._grow = _GROW_FN_CACHE[key]
 
+    supports_extras = True  # cegb_penalty / node_key keyword args
+
     def train(self, X_dev: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               sample_mask: jnp.ndarray,
-              feature_mask: Optional[jnp.ndarray] = None) -> GrownTree:
+              feature_mask: Optional[jnp.ndarray] = None,
+              cegb_penalty: Optional[jnp.ndarray] = None,
+              node_key: Optional[jnp.ndarray] = None) -> GrownTree:
         if feature_mask is None:
             feature_mask = jnp.ones((self.num_features,), jnp.bool_)
+        if cegb_penalty is None:
+            cegb_penalty = jnp.zeros((self.num_features,), jnp.float32)
+        if node_key is None:
+            node_key = jnp.zeros((2,), jnp.uint32)
         if not self.partitioned:
+            if self.split_params.use_cegb or \
+                    self.split_params.feature_fraction_bynode < 1.0:
+                from ..utils.log import log_warning
+                log_warning("cegb / feature_fraction_bynode are not applied "
+                            "on the pool-less fallback grower")
             return self._grow(X_dev, None, grad, hess, sample_mask,
                               self.num_bins, self.is_cat, self.has_nan,
                               self.monotone, feature_mask)
@@ -634,7 +666,8 @@ class SerialTreeLearner:
             sample_mask = jnp.pad(sample_mask, (0, pad))
         grown = self._grow(self._Xp, grad, hess, sample_mask,
                            self.num_bins, self.is_cat, self.has_nan,
-                           self.monotone, feature_mask)
+                           self.monotone, cegb_penalty, node_key,
+                           feature_mask)
         if pad:
             grown = grown._replace(row_leaf=grown.row_leaf[:n])
         return grown

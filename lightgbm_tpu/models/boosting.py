@@ -267,7 +267,8 @@ class RF(GBDT):
         return tree
 
     def predict(self, X, raw_score=False, start_iteration=0,
-                num_iteration=None, pred_leaf=False, pred_contrib=False):
+                num_iteration=None, pred_leaf=False, pred_contrib=False,
+                **kwargs):
         out = super().predict(X, raw_score=True,
                               start_iteration=start_iteration,
                               num_iteration=num_iteration,

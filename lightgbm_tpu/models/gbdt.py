@@ -167,6 +167,27 @@ class GBDT:
                                             self._inner_monotone())
         self.X_dev = jnp.asarray(train_set.X_binned)
         self._is_cat_np = is_cat
+        # CEGB (cost_effective_gradient_boosting.hpp): coupled per-feature
+        # penalties charge once until the feature is first used; tracked
+        # host-side across trees (per-tree granularity)
+        self._cegb_coupled = None
+        serial = isinstance(self.learner, SerialTreeLearner)
+        if cfg.cegb_penalty_feature_coupled or cfg.cegb_penalty_split > 0:
+            if not serial:
+                log_warning("CEGB penalties are applied by the serial "
+                            "learner only; this parallel learner ignores "
+                            "them")
+            elif cfg.cegb_penalty_feature_coupled:
+                full = np.zeros(train_set.num_total_features, np.float64)
+                cpl = cfg.cegb_penalty_feature_coupled
+                full[:len(cpl)] = [float(v) for v in cpl]
+                self._cegb_coupled = (full[train_set.used_feature_map] *
+                                      float(cfg.cegb_tradeoff))
+                self._cegb_used = np.zeros(self.num_features, bool)
+                self._defer_trees = False  # used-set updates per tree
+        if cfg.feature_fraction_bynode < 1.0 and not serial:
+            log_warning("feature_fraction_bynode is applied by the serial "
+                        "learner only; this parallel learner ignores it")
         self._linear = bool(cfg.linear_tree)
         if self._linear and self.name != "gbdt":
             log_warning(f"linear_tree is not supported with "
@@ -224,12 +245,54 @@ class GBDT:
         full[:len(mc)] = [int(v) for v in mc]
         return full[ts.used_feature_map]
 
+    def _parse_forced_splits(self) -> tuple:
+        """forcedsplits_filename JSON -> BFS-ordered (leaf, inner feature,
+        threshold bin) triples (reference serial_tree_learner.cpp:450
+        ForceSplits + application-level json load)."""
+        fn = self.config.forcedsplits_filename
+        if not fn:
+            return ()
+        import json
+        from collections import deque
+        with open(fn) as fh:
+            root = json.load(fh)
+        ts = self.train_set
+        inner_of_real = {int(r): i for i, r in enumerate(ts.used_feature_map)}
+        mappers = [ts.bin_mappers[j] for j in ts.used_feature_map]
+        out = []
+        q = deque([(root, 0)])
+        next_id = 1
+        while q and len(out) < self.config.num_leaves - 1:
+            node, leaf = q.popleft()
+            if not node or "feature" not in node:
+                continue
+            rf = int(node["feature"])
+            if rf not in inner_of_real:
+                log_warning(f"forced split on trivial/unknown feature {rf} "
+                            f"skipped (with its subtree)")
+                continue
+            f = inner_of_real[rf]
+            b = int(mappers[f].value_to_bin(
+                np.array([float(node["threshold"])]))[0])
+            out.append((leaf, f, b))
+            new_id = next_id
+            next_id += 1
+            if "left" in node:
+                q.append((node["left"], leaf))
+            if "right" in node:
+                q.append((node["right"], new_id))
+        return tuple(out)
+
     def _create_learner(self, num_bins, is_cat, has_nan, monotone=None):
         cfg = self.config
         if cfg.tree_learner == "serial" or cfg.num_machines <= 1 and \
                 cfg.tree_learner not in ("data", "feature", "voting"):
             return SerialTreeLearner(cfg, self.num_features, self.max_bins,
-                                     num_bins, is_cat, has_nan, monotone)
+                                     num_bins, is_cat, has_nan, monotone,
+                                     self._parse_forced_splits())
+        if cfg.forcedsplits_filename:
+            log_warning("forcedsplits_filename is applied by the serial "
+                        "learner only; this parallel learner ignores it")
         from ..parallel import create_parallel_learner
         return create_parallel_learner(cfg, self.num_features, self.max_bins,
                                        num_bins, is_cat, has_nan, monotone)
@@ -359,9 +422,22 @@ class GBDT:
                 g = grad if k == 1 else grad[:, cid]
                 h = hess if k == 1 else hess[:, cid]
                 self._cur_gh = (g, h)
+                extra = {}
+                if getattr(self.learner, "supports_extras", False):
+                    if self._cegb_coupled is not None:
+                        extra["cegb_penalty"] = jnp.asarray(
+                            np.where(self._cegb_used, 0.0,
+                                     self._cegb_coupled), jnp.float32)
+                    if cfg.feature_fraction_bynode < 1.0:
+                        extra["node_key"] = jax.random.fold_in(
+                            jax.random.PRNGKey(cfg.feature_fraction_seed),
+                            self.iter_ * k + cid)
                 grown = self.learner.train(self.X_dev, g, h, mask,
-                                           feature_mask=fmask)
+                                           feature_mask=fmask, **extra)
                 tree = self._record_tree(grown, cid)
+                if tree is not None and self._cegb_coupled is not None:
+                    sf = tree.split_feature[:tree.num_leaves - 1]
+                    self._cegb_used[sf[sf >= 0]] = True
                 if tree is None:
                     # deferred: the lagged check above decides next iteration
                     finished = False
@@ -611,7 +687,10 @@ class GBDT:
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 start_iteration: int = 0,
                 num_iteration: Optional[int] = None,
-                pred_leaf: bool = False, pred_contrib: bool = False) -> np.ndarray:
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: Optional[int] = None,
+                pred_early_stop_margin: Optional[float] = None) -> np.ndarray:
         X = np.asarray(X, np.float32)
         if X.ndim == 1:
             X = X.reshape(1, -1)
@@ -625,6 +704,18 @@ class GBDT:
         if pred_contrib:
             from .shap import predict_contrib
             return predict_contrib(self, Xi)
+        if pred_early_stop or self.config.pred_early_stop:
+            out = self._predict_early_stop(
+                Xi, start_iteration, num_iteration,
+                pred_early_stop_freq or self.config.pred_early_stop_freq,
+                pred_early_stop_margin if pred_early_stop_margin is not None
+                else self.config.pred_early_stop_margin)
+            if out is not None:
+                if raw_score or self.objective is None:
+                    return out[:, 0] if k == 1 else out
+                conv = self.objective.convert_output(
+                    jnp.asarray(out if k > 1 else out[:, 0]))
+                return np.asarray(conv)
         batch = self._tree_batch()
         if batch is None:
             n_iter_trees = 0
@@ -648,6 +739,41 @@ class GBDT:
         if raw_score or self.objective is None:
             return raw[:, 0] if k == 1 else raw
         out = self.objective.convert_output(jnp.asarray(raw if k > 1 else raw[:, 0]))
+        return np.asarray(out)
+
+    def _predict_early_stop(self, Xi, start_iteration, num_iteration,
+                            freq, margin):
+        """Margin-based prediction early stop (prediction_early_stop.cpp):
+        binary and multiclass only; None when not applicable."""
+        from .tree import predict_raw_early_stop
+        k = self.num_tree_per_iteration
+        obj = self.config.objective
+        if k > 1:
+            mode = "multiclass"
+        elif obj == "binary":
+            mode = "binary"
+        else:
+            log_warning("pred_early_stop applies to binary/multiclass "
+                        "objectives only; predicting normally")
+            return None
+        batch = self._tree_batch()
+        if batch is None:
+            return np.zeros((Xi.shape[0], k), np.float32)
+        if batch.has_linear:
+            log_warning("pred_early_stop is not supported with linear "
+                        "trees; predicting normally")
+            return None
+        t0 = start_iteration * k
+        t1 = batch.num_trees if num_iteration is None else min(
+            batch.num_trees, (start_iteration + num_iteration) * k)
+        base = (batch.split_feature, batch.threshold, batch.cat_words,
+                batch.decision_type, batch.left_child, batch.right_child,
+                batch.leaf_value, batch.num_leaves)
+        per_class = tuple(tuple(a[t0 + c:t1:k] for a in base)
+                          for c in range(k))
+        out = predict_raw_early_stop(per_class, jnp.asarray(Xi),
+                                     float(margin), freq=max(1, int(freq)),
+                                     mode=mode)
         return np.asarray(out)
 
     def _predict_leaf(self, Xi, start_iteration, num_iteration):

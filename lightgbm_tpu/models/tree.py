@@ -19,6 +19,7 @@ decision_type bit layout follows the reference (tree.h decision_type):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, List, Optional
 
 import jax
@@ -289,6 +290,52 @@ class TreeBatch:
         return (self.split_feature, self.threshold_bin, self.nan_bin,
                 self.cat_member, self.decision_type, self.left_child,
                 self.right_child, self.leaf_value, self.num_leaves)
+
+
+@functools.partial(jax.jit, static_argnames=("freq", "mode"))
+def predict_raw_early_stop(fields, X, margin, *, freq: int, mode: str):
+    """Raw prediction with per-row margin-based early exit across trees
+    (reference src/boosting/prediction_early_stop.cpp:54 binary — stop when
+    2|raw| > margin — and :25 multiclass — stop when top-2 margin exceeds
+    the threshold; checked every ``freq`` trees).  Stopped rows freeze
+    their partial sum (the reference returns the truncated score); the
+    tree loop exits entirely once every row has stopped.
+
+    fields: per-class tuple trees-first arrays as in predict_raw; for
+    multiclass a list of per-class field tuples sharing the walk.
+    """
+    per_class = fields
+    k = len(per_class)
+    t_total = per_class[0][0].shape[0]
+    n = X.shape[0]
+
+    def tree_at(c, t):
+        return tuple(a[t] for a in per_class[c])
+
+    def body(state):
+        t, out, stopped = state
+        deltas = []
+        for c in range(k):
+            val, _ = _walk_raw(X, *tree_at(c, t))
+            deltas.append(jnp.where(stopped, 0.0, val))
+        out = out + jnp.stack(deltas, axis=1)
+        check = ((t + 1) % freq == 0)
+        if mode == "binary":
+            stop_now = 2.0 * jnp.abs(out[:, 0]) > margin
+        else:
+            top2 = jax.lax.top_k(out, 2)[0]
+            stop_now = (top2[:, 0] - top2[:, 1]) > margin
+        stopped = stopped | (check & stop_now)
+        return t + 1, out, stopped
+
+    def cond(state):
+        t, _, stopped = state
+        return (t < t_total) & jnp.logical_not(jnp.all(stopped))
+
+    _, out, _ = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), jnp.zeros((n, k), jnp.float32),
+                     jnp.zeros((n,), jnp.bool_)))
+    return out
 
 
 @jax.jit

@@ -51,6 +51,13 @@ class SplitParams(NamedTuple):
     min_data_per_group: int = 100
     use_cat_subset: bool = False   # any categorical feature needs the
                                    # sorted-subset search (num_bin > onehot)
+    # cost-effective gradient boosting (cost_effective_gradient_boosting
+    # .hpp:103 DetlaGain): gain -= tradeoff*(penalty_split*leaf_count +
+    # coupled feature penalty when the feature is not yet used)
+    use_cegb: bool = False
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    feature_fraction_bynode: float = 1.0  # ColSampler by-node sampling
 
 BIG = 1e30  # "unbounded" leaf-output constraint sentinel
 
@@ -112,7 +119,9 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                            params: SplitParams,
                            monotone: Optional[jnp.ndarray] = None,
                            bound: Optional[jnp.ndarray] = None,
-                           depth: Optional[jnp.ndarray] = None) -> FeatureSplits:
+                           depth: Optional[jnp.ndarray] = None,
+                           cegb_penalty: Optional[jnp.ndarray] = None
+                           ) -> FeatureSplits:
     """Best split per feature from one leaf's histograms.
 
     Args:
@@ -329,6 +338,14 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                          take_bin(cum, best_r_bin))
     is_cat_b = is_cat[:, None]
     gain = jnp.where(is_cat, cat_best_gain, num_gain)
+    if params.use_cegb:
+        # constant per-feature penalty commutes with the per-bin argmax, so
+        # it is applied to each feature's best (DetlaGain subtracted from
+        # SplitInfo.gain in ComputeBestSplitForFeature)
+        delta = (params.cegb_tradeoff * params.cegb_penalty_split *
+                 parent_sum[2] +
+                 (cegb_penalty if cegb_penalty is not None else 0.0))
+        gain = jnp.where(gain > NEG_INF / 2, gain - delta, gain)
     cat_member = cat_member & is_cat_b & (gain > NEG_INF / 2)[:, None]
     # cat threshold_bin kept as the first member bin (display/compat; the
     # partition decision uses the membership vector)

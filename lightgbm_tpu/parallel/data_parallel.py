@@ -88,12 +88,17 @@ class DataParallelStrategy(CommStrategy):
                 bcast(ls), bcast(rs), bcast(member.astype(jnp.int32)) > 0)
 
     def pair_candidates(self, hist_l, hist_r, lsum, rsum, feature_mask,
-                        params, bound_l, bound_r, depth):
+                        params, bound_l, bound_r, depth, fm_l=None,
+                        fm_r=None):
         # collectives are not vmap-batched: two sequential candidate calls
-        return (self.leaf_candidates(hist_l, lsum, feature_mask, params,
-                                     bound_l, depth),
-                self.leaf_candidates(hist_r, rsum, feature_mask, params,
-                                     bound_r, depth))
+        return (self.leaf_candidates(
+                    hist_l, lsum,
+                    feature_mask if fm_l is None else fm_l, params,
+                    bound_l, depth),
+                self.leaf_candidates(
+                    hist_r, rsum,
+                    feature_mask if fm_r is None else fm_r, params,
+                    bound_r, depth))
 
 
 class DataParallelTreeLearner:

@@ -1,0 +1,123 @@
+"""CEGB, feature_fraction_bynode, and prediction early stop tests
+(reference: cost_effective_gradient_boosting.hpp, col_sampler.hpp bynode,
+prediction_early_stop.cpp)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(2)
+    n = 1000
+    X = rng.randn(n, 6)
+    y = (X[:, 0] + 0.8 * X[:, 1] + 0.3 * X[:, 2] > 0).astype(float)
+    return X, y
+
+
+P = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+     "metric": "binary_logloss"}
+
+
+def test_cegb_coupled_penalty_blocks_expensive_features(data):
+    X, y = data
+    base = lgb.train(P, lgb.Dataset(X, y), 15)
+
+    def used(bst):
+        s = set()
+        for t in bst._gbdt.models:
+            s.update(int(f) for f in t.split_feature[:t.num_leaves - 1]
+                     if f >= 0)
+        return s
+    assert 1 in used(base)
+    # a huge coupled penalty on feature 1 keeps it out of the model
+    pen = [0.0, 1e9, 0.0, 0.0, 0.0, 0.0]
+    bst = lgb.train({**P, "cegb_penalty_feature_coupled": pen},
+                    lgb.Dataset(X, y), 15)
+    assert 1 not in used(bst)
+    # a small penalty is paid once: feature 1 comes back
+    pen2 = [0.0, 1e-3, 0.0, 0.0, 0.0, 0.0]
+    bst2 = lgb.train({**P, "cegb_penalty_feature_coupled": pen2},
+                     lgb.Dataset(X, y), 15)
+    assert 1 in used(bst2)
+
+
+def test_cegb_split_penalty_shrinks_trees(data):
+    X, y = data
+    base = lgb.train(P, lgb.Dataset(X, y), 10)
+    bst = lgb.train({**P, "cegb_penalty_split": 0.01}, lgb.Dataset(X, y), 10)
+
+    def leaves(b):
+        return sum(t.num_leaves for t in b._gbdt.models)
+    assert leaves(bst) < leaves(base)
+
+
+def test_feature_fraction_bynode(data):
+    X, y = data
+    bst = lgb.train({**P, "feature_fraction_bynode": 0.5},
+                    lgb.Dataset(X, y), 15)
+    # still learns
+    pred = bst.predict(X)
+    auc_order = np.argsort(-pred)
+    assert y[auc_order[:200]].mean() > 0.7
+    # deterministic given the seed
+    bst2 = lgb.train({**P, "feature_fraction_bynode": 0.5},
+                     lgb.Dataset(X, y), 15)
+    np.testing.assert_array_equal(bst.predict(X), bst2.predict(X))
+    # different from un-sampled training
+    base = lgb.train(P, lgb.Dataset(X, y), 15)
+    assert not np.allclose(bst.predict(X), base.predict(X))
+
+
+def test_forced_splits(data, tmp_path):
+    """forcedsplits_filename JSON BFS (serial_tree_learner.cpp:450): the
+    first tree's top splits follow the file regardless of gain."""
+    import json
+    X, y = data
+    fs = {"feature": 5, "threshold": 0.0,
+          "left": {"feature": 4, "threshold": 0.5}}
+    path = str(tmp_path / "forced.json")
+    json.dump(fs, open(path, "w"))
+    bst = lgb.train({**P, "forcedsplits_filename": path},
+                    lgb.Dataset(X, y), 5)
+    for tree in bst._gbdt.models:
+        assert tree.split_feature[0] == 5
+        assert abs(tree.threshold[0] - 0.0) < 0.1
+        # node 1 is the forced left-child split on feature 4
+        assert tree.split_feature[1] == 4
+    # feature 5 is noise: an unforced model would not split it at the root
+    base = lgb.train(P, lgb.Dataset(X, y), 5)
+    assert base._gbdt.models[0].split_feature[0] != 5
+
+
+def test_pred_early_stop_binary(data):
+    X, y = data
+    bst = lgb.train(P, lgb.Dataset(X, y), 60)
+    full = bst.predict(X, raw_score=True)
+    es = bst.predict(X, raw_score=True, pred_early_stop=True,
+                     pred_early_stop_freq=5, pred_early_stop_margin=2.0)
+    # stopped rows froze their margin beyond the threshold, same sign
+    assert np.all(np.sign(es[np.abs(full) > 3]) ==
+                  np.sign(full[np.abs(full) > 3]))
+    # a huge margin means no early exit at all
+    es_off = bst.predict(X, raw_score=True, pred_early_stop=True,
+                         pred_early_stop_margin=1e9)
+    np.testing.assert_allclose(es_off, full, rtol=1e-5, atol=1e-6)
+
+
+def test_pred_early_stop_multiclass():
+    rng = np.random.RandomState(4)
+    X = rng.randn(600, 5)
+    y = np.argmax(X[:, :3] + 0.3 * rng.randn(600, 3), axis=1).astype(float)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 15, "verbosity": -1}, lgb.Dataset(X, y), 40)
+    full = bst.predict(X)
+    es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                     pred_early_stop_margin=1e9)
+    np.testing.assert_allclose(es, full, rtol=1e-5, atol=1e-6)
+    es2 = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=2,
+                      pred_early_stop_margin=0.5)
+    # class decisions overwhelmingly agree even with early exits
+    assert (np.argmax(es2, 1) == np.argmax(full, 1)).mean() > 0.95
