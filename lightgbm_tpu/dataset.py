@@ -113,6 +113,7 @@ class Dataset:
         self.num_bins_per_feature: Optional[np.ndarray] = None
         self.used_feature_map: Optional[np.ndarray] = None  # inner -> real index
         self.num_total_features = 0
+        self.efb = None  # BundleInfo when EFB-bundled (efb.py)
         self._device_cache: Dict[Any, Any] = {}
 
     # -- construction --------------------------------------------------------
@@ -121,11 +122,20 @@ class Dataset:
             return self
         cfg = config or Config(self.params)
         raw, feature_names = self._materialize_raw()
+        sparse = hasattr(raw, "tocsc")
+        if sparse:
+            raw = raw.tocsc()
         n, f = raw.shape
         self.num_total_features = f
         self.feature_names_ = feature_names
+        self.efb = None
 
         cat_indices = self._resolve_categoricals(feature_names)
+
+        rng = np.random.RandomState(cfg.data_random_seed)
+        sample_cnt = min(n, int(cfg.bin_construct_sample_cnt))
+        sample_idx = (np.sort(rng.choice(n, size=sample_cnt, replace=False))
+                      if sample_cnt < n else np.arange(n))
 
         if self.reference is not None:
             ref = self.reference
@@ -135,20 +145,29 @@ class Dataset:
             self.bin_mappers = ref.bin_mappers
             self.used_feature_map = ref.used_feature_map
             self.num_bins_per_feature = ref.num_bins_per_feature
+            self.efb = ref.efb
         else:
             # sample rows for bin finding (dataset_loader.cpp:902
             # SampleTextDataFromFile — here rows are already in memory)
-            sample_cnt = min(n, int(cfg.bin_construct_sample_cnt))
-            if sample_cnt < n:
-                rng = np.random.RandomState(cfg.data_random_seed)
-                sample_idx = rng.choice(n, size=sample_cnt, replace=False)
-                sample = raw[np.sort(sample_idx)]
-            else:
-                sample = raw
             self.bin_mappers = []
             for j in range(f):
+                if sparse:
+                    # sparse column: sampled nonzeros + proportional
+                    # implied zeros (no densification)
+                    lo, hi = raw.indptr[j], raw.indptr[j + 1]
+                    vals = np.asarray(raw.data[lo:hi], np.float64)
+                    if len(vals) > sample_cnt:
+                        vals = vals[np.sort(rng.choice(len(vals),
+                                                       sample_cnt, False))]
+                    zfrac = 1.0 - (hi - lo) / max(n, 1)
+                    nz = int(round(len(vals) * zfrac / max(1e-9, 1 - zfrac))) \
+                        if zfrac < 1.0 else sample_cnt
+                    nz = min(nz, sample_cnt)
+                    col_sample = np.concatenate([vals, np.zeros(nz)])
+                else:
+                    col_sample = raw[sample_idx, j]
                 self.bin_mappers.append(find_bin(
-                    sample[:, j], max_bin=cfg.max_bin,
+                    col_sample, max_bin=cfg.max_bin,
                     min_data_in_bin=cfg.min_data_in_bin,
                     total_cnt=n,
                     is_categorical=(j in cat_indices),
@@ -168,8 +187,36 @@ class Dataset:
 
         used = self.used_feature_map
         mappers = [self.bin_mappers[j] for j in used]
-        self.X_binned = bin_matrix(raw[:, used], mappers)
-        if cfg.linear_tree:
+
+        if self.efb is None:
+            self.efb = self._maybe_bundle(cfg, raw, sparse, used, mappers,
+                                          sample_idx, n)
+        if self.efb is not None:
+            from .efb import bundle_binned_matrix, bundle_sparse_csc
+            if sparse:
+                self.X_binned = bundle_sparse_csc(raw[:, used].tocsc(),
+                                                  mappers, self.efb)
+            else:
+                self.X_binned = bundle_binned_matrix(
+                    bin_matrix(raw[:, used], mappers), self.efb)
+            log_info(f"EFB: bundled {len(used)} features into "
+                     f"{self.efb.n_bundles} device columns "
+                     f"({self.efb.bundle_bins} bundle bins)")
+        elif sparse:
+            # no beneficial bundling: densify the BINNED codes (uint8),
+            # never the raw float64 values
+            cols = []
+            csc = raw[:, used].tocsc()
+            for jj, m in enumerate(mappers):
+                col = np.full(n, m.default_bin, np.uint8)
+                lo, hi = csc.indptr[jj], csc.indptr[jj + 1]
+                col[csc.indices[lo:hi]] = m.value_to_bin(
+                    np.asarray(csc.data[lo:hi], np.float64)).astype(np.uint8)
+                cols.append(col)
+            self.X_binned = np.stack(cols, axis=1)
+        else:
+            self.X_binned = bin_matrix(raw[:, used], mappers)
+        if cfg.linear_tree and not sparse:
             # linear trees fit on RAW feature values (reference
             # linear_tree_learner.cpp raw_index); keep the used columns
             self.raw_used = raw[:, used].astype(np.float32)
@@ -180,6 +227,50 @@ class Dataset:
         if self.free_raw_data:
             self.data = None
         return self
+
+    def _maybe_bundle(self, cfg, raw, sparse, used, mappers, sample_idx, n):
+        """Decide + build EFB bundles (dataset.cpp:239 FastFeatureBundling);
+        serial-learner training only, and only when it shrinks the device
+        matrix."""
+        from .efb import build_bundle_info, find_bundles
+        if (not cfg.enable_bundle or cfg.tree_learner != "serial"
+                or cfg.linear_tree or len(used) < 3):
+            return None
+        # non-default masks over the sampled rows; categorical features
+        # stay singleton (their set-membership decisions read raw bins)
+        nondefault = []
+        cand = []
+        for jj, m in enumerate(mappers):
+            if m.is_categorical:
+                continue
+            j = int(used[jj])
+            if sparse:
+                lo, hi = raw.indptr[j], raw.indptr[j + 1]
+                mask = np.zeros(len(sample_idx), bool)
+                mask[np.searchsorted(sample_idx,
+                                     np.intersect1d(raw.indices[lo:hi],
+                                                    sample_idx))] = True
+            else:
+                col = mappers[jj].value_to_bin(raw[sample_idx, j])
+                mask = col != mappers[jj].default_bin
+            # only near-sparse features are worth bundling
+            if mask.mean() <= 0.5:
+                nondefault.append(mask)
+                cand.append(jj)
+        if len(cand) < 2:
+            return None
+        cand_mappers = [mappers[jj] for jj in cand]
+        bundles_local = find_bundles(cand_mappers, nondefault, n,
+                                     len(sample_idx))
+        bundles = [[cand[i] for i in b] for b in bundles_local]
+        in_bundle = {f for b in bundles for f in b}
+        for jj in range(len(mappers)):
+            if jj not in in_bundle:
+                bundles.append([jj])
+        if len(bundles) > 0.9 * len(mappers):
+            return None  # not worth the indirection
+        max_b = max(m.num_bin for m in mappers)
+        return build_bundle_info(mappers, bundles, max_b)
 
     def _materialize_raw(self):
         data = self.data
@@ -200,10 +291,12 @@ class Dataset:
                 return raw, names
         except ImportError:
             pass
-        if hasattr(data, "tocsr"):  # scipy sparse
-            raw = np.asarray(data.todense(), dtype=np.float64)
-        else:
-            raw = np.asarray(data, dtype=np.float64)
+        if hasattr(data, "tocsc"):  # scipy sparse: handled without
+            raw = data                # densification in construct()
+            if self.feature_name != "auto" and self.feature_name is not None:
+                return raw, list(self.feature_name)
+            return raw, [f"Column_{i}" for i in range(raw.shape[1])]
+        raw = np.asarray(data, dtype=np.float64)
         if raw.ndim == 1:
             raw = raw.reshape(-1, 1)
         if self.feature_name != "auto" and self.feature_name is not None:
@@ -292,7 +385,9 @@ class Dataset:
 
     def num_feature(self) -> int:
         self._check_constructed()
-        return int(self.X_binned.shape[1])
+        # inner FEATURE count — under EFB the device matrix is narrower
+        # (bundle columns), but the feature surface stays per-feature
+        return int(len(self.used_feature_map))
 
     @property
     def feature_names(self) -> List[str]:
@@ -343,6 +438,7 @@ class Dataset:
             "used_feature_map": self.used_feature_map,
             "num_bins_per_feature": self.num_bins_per_feature,
             "feature_names": self.feature_names_,
+            "efb": self.efb,
             "label": self.metadata.label,
             "weight": self.metadata.weight,
             "group": self.metadata.group,
@@ -365,6 +461,7 @@ class Dataset:
         ds.used_feature_map = payload["used_feature_map"]
         ds.num_bins_per_feature = payload["num_bins_per_feature"]
         ds.feature_names_ = payload["feature_names"]
+        ds.efb = payload.get("efb")
         ds.num_total_features = len(ds.feature_names_)
         if payload["label"] is not None:
             ds.metadata.set_label(payload["label"])

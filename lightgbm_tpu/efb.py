@@ -1,0 +1,182 @@
+"""Exclusive Feature Bundling (EFB) — one of the two core LightGBM tricks.
+
+TPU-native re-implementation of the reference's bundling
+(reference: src/io/dataset.cpp:53 ``GetConflictCount``, :100 ``FindGroups``
+greedy conflict-bounded grouping, :239 ``FastFeatureBundling``, bin offsets
+per feature inside a group à la feature_group.h).
+
+TPU-first design: the DEVICE matrix holds one uint8 column per BUNDLE
+(width ≈ bundle count, the whole point for wide-sparse data), histograms
+are built and pooled in bundle space (G, Bb, 3), and a cheap gather
+"expansion" rebuilds per-ORIGINAL-feature histograms (F, B, 3) right
+before each split scan — each feature's default (zero) bin is restored
+from the leaf totals, the reference's Dataset::FixHistogram trick
+(dataset.cpp:1239).  Tree structure, split finding, and the model format
+stay entirely in original-feature space, so EFB is invisible outside
+training.
+
+Bundle bin layout: bundle bin 0 = "every member feature at its default
+bin"; member feature f with nb_f bins gets the range
+[offset_f, offset_f + nb_f - 1) for its non-default bins (the default is
+elided).  Singleton bundles keep their feature's bins verbatim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .utils.log import log_info, log_warning
+
+MAX_BUNDLE_BINS = 256    # uint8 device columns
+CONFLICT_RATE = 1e-4     # max conflicting rows per bundle, as fraction of N
+
+
+@dataclasses.dataclass
+class BundleInfo:
+    """Static bundling descriptors over INNER (used) features."""
+    n_bundles: int
+    bundle_bins: int                 # Bb: max bins over bundles
+    f_bundle: np.ndarray             # (F,) bundle id per feature
+    f_offset: np.ndarray             # (F,) non-default bin offset in bundle
+    f_default: np.ndarray            # (F,) the feature's default bin
+    f_nbins: np.ndarray              # (F,) the feature's bin count
+    f_single: np.ndarray             # (F,) bool: singleton bundle (verbatim)
+    exp_map: np.ndarray              # (F, B) flat bundle-bin id or -1
+    fix_mask: np.ndarray             # (F,) bool: restore default via totals
+
+    @property
+    def needs_fix(self) -> bool:
+        return bool(self.fix_mask.any())
+
+
+def find_bundles(mappers: Sequence, nondefault: List[np.ndarray], n_rows: int,
+                 sample_rows: int,
+                 max_bundle_bins: int = MAX_BUNDLE_BINS,
+                 conflict_rate: float = CONFLICT_RATE) -> List[List[int]]:
+    """Greedy conflict-bounded grouping (dataset.cpp:100 FindGroups).
+
+    nondefault[f] is a bool mask over the SAMPLED rows where feature f is
+    away from its default bin.  Returns bundles as lists of feature ids.
+    """
+    F = len(mappers)
+    max_conflict = max(0, int(conflict_rate * sample_rows))
+    counts = np.array([int(m.sum()) for m in nondefault])
+    order = np.argsort(-counts, kind="stable")
+
+    bundles: List[List[int]] = []
+    bundle_mask: List[np.ndarray] = []
+    bundle_conflict: List[int] = []
+    bundle_bins: List[int] = []
+    for f in order:
+        nb_extra = int(mappers[f].num_bin) - 1
+        placed = False
+        for bi in range(len(bundles)):
+            if bundle_bins[bi] + nb_extra >= max_bundle_bins:
+                continue
+            conflict = int(np.count_nonzero(bundle_mask[bi] & nondefault[f]))
+            if bundle_conflict[bi] + conflict <= max_conflict:
+                bundles[bi].append(int(f))
+                bundle_mask[bi] |= nondefault[f]
+                bundle_conflict[bi] += conflict
+                bundle_bins[bi] += nb_extra
+                placed = True
+                break
+        if not placed:
+            bundles.append([int(f)])
+            bundle_mask.append(nondefault[f].copy())
+            bundle_conflict.append(0)
+            bundle_bins.append(1 + nb_extra)
+    return bundles
+
+
+def build_bundle_info(mappers: Sequence, bundles: List[List[int]],
+                      max_feature_bins: int) -> BundleInfo:
+    F = len(mappers)
+    B = max_feature_bins
+    f_bundle = np.zeros(F, np.int32)
+    f_offset = np.zeros(F, np.int32)
+    f_default = np.asarray([int(m.default_bin) for m in mappers], np.int32)
+    f_nbins = np.asarray([int(m.num_bin) for m in mappers], np.int32)
+    f_single = np.zeros(F, bool)
+    bb = 1
+    for g, feats in enumerate(bundles):
+        if len(feats) == 1:
+            f = feats[0]
+            f_bundle[f] = g
+            f_offset[f] = 0
+            f_single[f] = True
+            bb = max(bb, int(f_nbins[f]))
+        else:
+            off = 1
+            for f in feats:
+                f_bundle[f] = g
+                f_offset[f] = off
+                off += int(f_nbins[f]) - 1
+            bb = max(bb, off)
+
+    G = len(bundles)
+    exp_map = np.full((F, B), -1, np.int64)
+    fix_mask = np.zeros(F, bool)
+    for f in range(F):
+        g = int(f_bundle[f])
+        nb = int(f_nbins[f])
+        if f_single[f]:
+            exp_map[f, :nb] = g * bb + np.arange(nb)
+        else:
+            fix_mask[f] = True
+            d = int(f_default[f])
+            o = int(f_offset[f])
+            for b in range(nb):
+                if b == d:
+                    continue  # restored from leaf totals (FixHistogram)
+                exp_map[f, b] = g * bb + o + b - (1 if b > d else 0)
+    return BundleInfo(n_bundles=G, bundle_bins=bb, f_bundle=f_bundle,
+                      f_offset=f_offset, f_default=f_default,
+                      f_nbins=f_nbins, f_single=f_single,
+                      exp_map=exp_map.astype(np.int32), fix_mask=fix_mask)
+
+
+def bundle_binned_matrix(X_binned: np.ndarray, info: BundleInfo) -> np.ndarray:
+    """Compress a per-feature binned matrix (N, F) into bundle columns
+    (N, G) (dense-input path)."""
+    n = X_binned.shape[0]
+    out = np.zeros((n, info.n_bundles), np.uint8)
+    for f in range(X_binned.shape[1]):
+        g = int(info.f_bundle[f])
+        col = X_binned[:, f].astype(np.int32)
+        if info.f_single[f]:
+            out[:, g] = col.astype(np.uint8)
+        else:
+            d = int(info.f_default[f])
+            o = int(info.f_offset[f])
+            nd = col != d
+            vals = o + col[nd] - (col[nd] > d)
+            out[nd, g] = vals.astype(np.uint8)
+    return out
+
+
+def bundle_sparse_csc(csc, mappers: Sequence, info: BundleInfo) -> np.ndarray:
+    """Build the bundled matrix straight from a scipy CSC matrix — the raw
+    data is never densified (sparse-ingestion path; reference
+    sparse_bin.hpp's role collapses into this one pass)."""
+    n = csc.shape[0]
+    out = np.zeros((n, info.n_bundles), np.uint8)
+    for f in range(len(mappers)):
+        g = int(info.f_bundle[f])
+        lo, hi = csc.indptr[f], csc.indptr[f + 1]
+        rows = csc.indices[lo:hi]
+        vals = np.asarray(csc.data[lo:hi], np.float64)
+        bins = mappers[f].value_to_bin(vals).astype(np.int32)
+        d = int(mappers[f].default_bin)
+        if info.f_single[f]:
+            if d:
+                out[:, g] = np.uint8(d)  # implied zeros sit in bin(0.0)
+            out[rows, g] = bins.astype(np.uint8)
+        else:
+            o = int(info.f_offset[f])
+            nd = bins != d
+            out[rows[nd], g] = (o + bins[nd] - (bins[nd] > d)).astype(np.uint8)
+    return out

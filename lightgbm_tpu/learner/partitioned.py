@@ -63,7 +63,8 @@ def _round_up(x: int, m: int) -> int:
 def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                              max_bins: int, max_depth: int, split_params,
                              hist_impl: str, interpret: bool = False,
-                             jit: bool = True, forced_splits: tuple = ()):
+                             jit: bool = True, forced_splits: tuple = (),
+                             efb_dims=None):
     """Build the partition-ordered single-tree grower.
 
     Returned signature:
@@ -73,7 +74,12 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
     """
     L = num_leaves
     F = num_features
-    W = _round_up(F + 13, 8)
+    # EFB (lightgbm_tpu/efb.py): the packed matrix holds one column per
+    # BUNDLE (G <= F) with Bb bundle bins; histograms live in bundle space
+    # and are expanded to per-feature space right before each split scan
+    use_efb = efb_dims is not None
+    G, Bb = efb_dims if use_efb else (F, max_bins)
+    W = _round_up(G + 13, 8)
     pallas = hist_impl == "pallas"
     if pallas:
         from ..ops.histogram_pallas import build_histogram_pallas
@@ -97,29 +103,61 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                               jnp.int32)
 
     def _hist_from_seg(seg, valid):
-        """(F, B, 3) histogram of one packed chunk (seg: (C, W) u8)."""
-        bins_rows = seg[:, :F]
-        gm = jax.lax.bitcast_convert_type(seg[:, F:F + 4], jnp.float32)
-        hm = jax.lax.bitcast_convert_type(seg[:, F + 4:F + 8], jnp.float32)
-        bag = seg[:, F + 12].astype(jnp.float32)
+        """(G, Bb, 3) bundle-space histogram of one packed chunk."""
+        bins_rows = seg[:, :G]
+        gm = jax.lax.bitcast_convert_type(seg[:, G:G + 4], jnp.float32)
+        hm = jax.lax.bitcast_convert_type(seg[:, G + 4:G + 8], jnp.float32)
+        bag = seg[:, G + 12].astype(jnp.float32)
         mask = bag * valid
         if pallas:
             return build_histogram_pallas(
                 jnp.swapaxes(bins_rows, 0, 1), gm, hm, mask,
-                num_bins=max_bins, interpret=interpret)
-        return build_histogram(bins_rows, gm, hm, mask, num_bins=max_bins,
+                num_bins=Bb, interpret=interpret)
+        return build_histogram(bins_rows, gm, hm, mask, num_bins=Bb,
                                impl=hist_impl)
 
     def grow(X: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
              bag_mask: jnp.ndarray, num_bins: jnp.ndarray,
              is_cat: jnp.ndarray, has_nan: jnp.ndarray,
              monotone: jnp.ndarray, cegb_penalty: jnp.ndarray,
-             node_key: jnp.ndarray, feature_mask: jnp.ndarray) -> GrownTree:
+             node_key: jnp.ndarray, efb_arrays: tuple,
+             feature_mask: jnp.ndarray) -> GrownTree:
         n = X.shape[0]
         strat = CommStrategy(num_bins, is_cat, has_nan, monotone)
         strat.cegb_full = cegb_penalty if split_params.use_cegb else None
         chunk_bulk = min(CHUNK_BULK, n)
         chunk_tail = min(CHUNK_TAIL, n)
+
+        if use_efb:
+            exp_map, f_bundle, f_off, f_def, f_nb, f_single = efb_arrays
+
+        def expand_hist(hb, total):
+            """Bundle-space (G, Bb, 3) -> per-feature (F, B, 3) histograms
+            (gather through exp_map + Dataset::FixHistogram default-bin
+            restore from the leaf totals, dataset.cpp:1239)."""
+            if not use_efb:
+                return hb
+            flat = hb.reshape(G * Bb, 3)
+            e = jnp.where((exp_map >= 0)[:, :, None],
+                          flat[jnp.maximum(exp_map, 0)], 0.0)
+            fix = total[None, :] - jnp.sum(e, axis=1)
+            fixable = jnp.logical_not(f_single).astype(jnp.float32)
+            e = e.at[jnp.arange(F), f_def].add(fix * fixable[:, None])
+            return e
+
+        def feature_col(seg, feat, csize):
+            """The FEATURE-space bin codes of one chunk for feature
+            ``feat`` (reconstructed from its bundle column under EFB)."""
+            g = f_bundle[feat] if use_efb else feat
+            v = jax.lax.dynamic_slice(
+                seg, (0, g), (csize, 1))[:, 0].astype(jnp.int32)
+            if not use_efb:
+                return v
+            u = v - f_off[feat]
+            inr = (u >= 0) & (u < f_nb[feat] - 1)
+            mapped = jnp.where(inr, u + (u >= f_def[feat]).astype(jnp.int32),
+                               f_def[feat])
+            return jnp.where(f_single[feat], v, mapped)
 
         def node_mask(idx):
             """Exact-count per-node feature sample (ColSampler bynode,
@@ -138,7 +176,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             jax.lax.bitcast_convert_type(
                 jnp.arange(n, dtype=jnp.int32), jnp.uint8),
             (bag_mask > 0).astype(jnp.uint8)[:, None],
-            jnp.zeros((n, W - F - 13), jnp.uint8),
+            jnp.zeros((n, W - G - 13), jnp.uint8),
         ], axis=1)
 
         def _sweep(start, cnt, fn, carry):
@@ -185,7 +223,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                          ).astype(jnp.float32)
                 return acc + _hist_from_seg(seg, valid)
 
-            acc0 = jnp.zeros((F, max_bins, 3), jnp.float32)
+            acc0 = jnp.zeros((G, Bb, 3), jnp.float32)
             return _sweep(start, cnt, step, acc0)
 
         def _decide_col(col, clamped, cstart, cend, csize, feat_args):
@@ -216,9 +254,9 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             # pass A: left count (column-only loads)
             def count_step(cstart, csize, acc):
                 clamped = jnp.minimum(cstart, n - csize)
-                col = jax.lax.dynamic_slice(
-                    P_ref[0], (clamped, feat), (csize, 1))[:, 0].astype(
-                    jnp.int32)
+                col = feature_col(
+                    jax.lax.dynamic_slice(P_ref[0], (clamped, 0),
+                                          (csize, W)), feat, csize)
                 gl, _ = _decide_col(col, clamped, cstart, cend, csize,
                                     feat_args)
                 return acc + jnp.sum(gl.astype(jnp.int32))
@@ -234,8 +272,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             def stage_step(cstart, csize, carry):
                 Lb, Rb, dl, dr = carry
                 seg, clamped = _chunk_rows(cstart, csize)
-                col = jax.lax.dynamic_slice(
-                    seg, (0, feat), (csize, 1))[:, 0].astype(jnp.int32)
+                col = feature_col(seg, feat, csize)
                 gl, valid = _decide_col(col, clamped, cstart, cend, csize,
                                         feat_args)
                 # push invalid rows to the very end (key 2) so valid
@@ -291,7 +328,8 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         root_sum = jnp.stack([jnp.sum(gm), jnp.sum(hm), jnp.sum(bag_mask)])
         root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
         fm_root = feature_mask & node_mask(2 * L) if bynode else feature_mask
-        cand = strat.leaf_candidates(root_hist, root_sum, fm_root, sp,
+        cand = strat.leaf_candidates(expand_hist(root_hist, root_sum),
+                                     root_sum, fm_root, sp,
                                      root_bound, jnp.asarray(0, jnp.int32))
 
         state = {
@@ -311,7 +349,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             "cand_rsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[5]),
             "cand_member": jnp.zeros((L, max_bins), jnp.bool_).at[0].set(
                 cand[6]),
-            "hists": jnp.zeros((L, F, max_bins, 3), jnp.float32).at[0].set(
+            "hists": jnp.zeros((L, G, Bb, 3), jnp.float32).at[0].set(
                 root_hist),
             "split_feature": jnp.full((L - 1,), -1, jnp.int32),
             "threshold_bin": jnp.zeros((L - 1,), jnp.int32),
@@ -363,7 +401,8 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                 thr = jnp.where(is_forced, f_bin_c[fi], thr)
                 dleft = jnp.where(is_forced, False, dleft)
                 member = jnp.where(is_forced, jnp.zeros_like(member), member)
-                fh = s["hists"][best_leaf, feat]          # (B, 3)
+                fh = expand_hist(s["hists"][best_leaf],
+                                 s["leaf_sum"][best_leaf])[feat]   # (B, 3)
                 csum = jnp.cumsum(fh, axis=0)
                 lsum_f = csum[jnp.clip(thr, 0, max_bins - 1)]
                 rsum_f = s["leaf_sum"][best_leaf] - lsum_f
@@ -422,9 +461,10 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                 fm_r = feature_mask & node_mask(2 * t + 1)
             else:
                 fm_l = fm_r = None
-            cl, cr = strat.pair_candidates(hist_left, hist_right, lsum, rsum,
-                                           feature_mask, sp, bound_l, bound_r,
-                                           child_depth, fm_l, fm_r)
+            cl, cr = strat.pair_candidates(
+                expand_hist(hist_left, lsum), expand_hist(hist_right, rsum),
+                lsum, rsum, feature_mask, sp, bound_l, bound_r,
+                child_depth, fm_l, fm_r)
             gl_ = jnp.where(depth_ok, cl[0], NEG_INF)
             gr_ = jnp.where(depth_ok, cr[0], NEG_INF)
 
@@ -533,7 +573,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         leaf_of_pos = order[
             jnp.searchsorted(starts_sorted, pos, side="right") - 1
         ].astype(jnp.int32)
-        orig = jax.lax.bitcast_convert_type(s["P"][:, F + 8:F + 12],
+        orig = jax.lax.bitcast_convert_type(s["P"][:, G + 8:G + 12],
                                             jnp.int32)
         row_leaf = jnp.zeros((n,), jnp.int32).at[orig].set(leaf_of_pos)
 

@@ -576,8 +576,20 @@ class SerialTreeLearner:
     def __init__(self, config: Config, num_features: int, max_bins: int,
                  num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
                  monotone: Optional[np.ndarray] = None,
-                 forced_splits: tuple = ()):
+                 forced_splits: tuple = (), efb=None):
         self.config = config
+        self.efb = efb
+        if efb is not None:
+            self._efb_args = (jnp.asarray(efb.exp_map),
+                              jnp.asarray(efb.f_bundle),
+                              jnp.asarray(efb.f_offset),
+                              jnp.asarray(efb.f_default),
+                              jnp.asarray(efb.f_nbins),
+                              jnp.asarray(efb.f_single))
+            self._efb_dims = (int(efb.n_bundles), int(efb.bundle_bins))
+        else:
+            self._efb_args = ()
+            self._efb_dims = None
         self.max_bins = int(max_bins)
         self.num_bins = jnp.asarray(num_bins, jnp.int32)
         self.is_cat = jnp.asarray(is_cat, jnp.bool_)
@@ -587,7 +599,12 @@ class SerialTreeLearner:
             jnp.int32)
         self.num_features = num_features
         self.split_params = split_params_from_config(config, num_bins, is_cat)
-        self.use_hist_pool = hist_pool_fits(config, num_features, self.max_bins)
+        pool_f, pool_b = (self._efb_dims if self._efb_dims is not None
+                          else (num_features, self.max_bins))
+        self.use_hist_pool = hist_pool_fits(config, pool_f, pool_b)
+        if efb is not None and not self.use_hist_pool:
+            raise ValueError("EFB requires the partitioned grower; raise "
+                             "histogram_pool_size or disable enable_bundle")
         impl = resolve_hist_impl(config)
         if not self.use_hist_pool and impl == "pallas":
             # the pool-less fallback grower takes no transposed X and no row
@@ -605,7 +622,7 @@ class SerialTreeLearner:
         if self.partitioned:
             key = ("part", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
-                   impl, forced_splits)
+                   impl, forced_splits, self._efb_dims)
             if key not in _GROW_FN_CACHE:
                 from .partitioned import make_partitioned_grow_fn
                 _GROW_FN_CACHE[key] = make_partitioned_grow_fn(
@@ -613,7 +630,7 @@ class SerialTreeLearner:
                     num_features=num_features, max_bins=self.max_bins,
                     max_depth=int(config.max_depth),
                     split_params=self.split_params, hist_impl=impl,
-                    forced_splits=forced_splits)
+                    forced_splits=forced_splits, efb_dims=self._efb_dims)
         else:
             key = ("serial", int(config.num_leaves), self.max_bins,
                    int(config.max_depth), self.split_params, impl,
@@ -667,7 +684,7 @@ class SerialTreeLearner:
         grown = self._grow(self._Xp, grad, hess, sample_mask,
                            self.num_bins, self.is_cat, self.has_nan,
                            self.monotone, cegb_penalty, node_key,
-                           feature_mask)
+                           self._efb_args, feature_mask)
         if pad:
             grown = grown._replace(row_leaf=grown.row_leaf[:n])
         return grown

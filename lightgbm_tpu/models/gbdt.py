@@ -289,7 +289,8 @@ class GBDT:
                 cfg.tree_learner not in ("data", "feature", "voting"):
             return SerialTreeLearner(cfg, self.num_features, self.max_bins,
                                      num_bins, is_cat, has_nan, monotone,
-                                     self._parse_forced_splits())
+                                     self._parse_forced_splits(),
+                                     efb=self.train_set.efb)
         if cfg.forcedsplits_filename:
             log_warning("forcedsplits_filename is applied by the serial "
                         "learner only; this parallel learner ignores it")
@@ -298,6 +299,11 @@ class GBDT:
                                        num_bins, is_cat, has_nan, monotone)
 
     def add_valid(self, valid_set: Dataset, name: str) -> None:
+        if getattr(self.train_set, "efb", None) is not None:
+            raise NotImplementedError(
+                "validation sets on an EFB-bundled Dataset are not "
+                "supported yet (the binned valid walk needs a bundle-space "
+                "variant); set enable_bundle=false to use valid sets")
         valid_set.construct(self.config)
         if valid_set.num_feature() != self.num_features:
             raise ValueError("validation set feature count differs from train")
@@ -936,6 +942,10 @@ class GBDT:
         self._rebuild_scores()
 
     def _rebuild_scores(self) -> None:
+        if getattr(self.train_set, "efb", None) is not None and self.models:
+            raise NotImplementedError(
+                "score rebuilds (rollback/continued training) on an "
+                "EFB-bundled Dataset are not supported yet")
         k = self.num_tree_per_iteration
         n = self.num_data
         shape = (n,) if k == 1 else (n, k)

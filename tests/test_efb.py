@@ -1,0 +1,102 @@
+"""EFB (exclusive feature bundling) + sparse ingestion tests (reference:
+dataset.cpp:53-353 FindGroups/FastFeatureBundling; verdict round-2 bar:
+a wide 99%-sparse synthetic trains with device width ~ bundle count and
+matches unbundled predictions)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+
+
+def _sparse_data(n=3000, f=60, seed=0, density=0.02):
+    """Wide sparse one-hot-ish features + 2 dense informative columns."""
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, f))
+    X[:, 0] = rng.randn(n)
+    X[:, 1] = rng.randn(n)
+    for j in range(2, f):
+        rows = rng.choice(n, size=max(1, int(n * density)), replace=False)
+        X[rows, j] = rng.rand(len(rows)) * 2 + 0.5
+    y = (X[:, 0] + 0.5 * X[:, 1] + 2.0 * (X[:, 7] > 0) - (X[:, 11] > 0)
+         + 0.1 * rng.randn(n))
+    return X, y
+
+
+P = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+     "metric": "l2", "min_data_in_leaf": 5}
+
+
+def test_bundles_shrink_device_width():
+    X, y = _sparse_data()
+    ds = lgb.Dataset(X, y, params=P)
+    ds.construct(Config(P))
+    assert ds.efb is not None
+    assert ds.X_binned.shape[1] == ds.efb.n_bundles
+    # 58 sparse columns collapse to the 255-bundle-bin capacity limit
+    assert ds.efb.n_bundles < 25
+
+
+def test_bundled_matches_unbundled_predictions():
+    X, y = _sparse_data()
+    b_on = lgb.train(P, lgb.Dataset(X, y), 15)
+    b_off = lgb.train({**P, "enable_bundle": False}, lgb.Dataset(X, y), 15)
+    assert b_on._gbdt.train_set.efb is not None
+    assert b_off._gbdt.train_set.efb is None
+    np.testing.assert_allclose(b_on.predict(X), b_off.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_csr_input_no_densify():
+    X, y = _sparse_data()
+    Xs = sp.csr_matrix(X)
+    bst = lgb.train(P, lgb.Dataset(Xs, y), 15)
+    dense = lgb.train(P, lgb.Dataset(X, y), 15)
+    # same binning from sparse vs dense ingestion -> same predictions
+    np.testing.assert_allclose(bst.predict(X), dense.predict(X),
+                               rtol=1e-3, atol=1e-3)
+    mse = np.mean((bst.predict(X) - y) ** 2)
+    assert mse < np.var(y) * 0.3
+
+
+def test_wide_sparse_trains():
+    """10k-feature 99%-sparse synthetic (the verdict's acceptance bar)."""
+    rng = np.random.RandomState(3)
+    n, f = 4000, 10000
+    nnz_per_row = 40
+    rows = np.repeat(np.arange(n), nnz_per_row)
+    cols = rng.randint(0, f, n * nnz_per_row)
+    vals = rng.rand(n * nnz_per_row) + 0.5
+    Xs = sp.csr_matrix((vals, (rows, cols)), shape=(n, f))
+    w = np.zeros(f)
+    w[:50] = rng.randn(50)
+    y = np.asarray(Xs[:, :50] @ w[:50]).ravel() + 0.1 * rng.randn(n)
+    ds = lgb.Dataset(Xs, y, params=P)
+    bst = lgb.train({**P, "num_leaves": 31}, ds, 10)
+    efb = bst._gbdt.train_set.efb
+    assert efb is not None
+    width = bst._gbdt.train_set.X_binned.shape[1]
+    assert width == efb.n_bundles
+    assert width < f / 10  # 10k features in <1k device columns
+    mse = np.mean((bst.predict(np.asarray(Xs.todense())) - y) ** 2)
+    assert mse < np.var(y) * 0.6
+
+
+def test_efb_model_io_roundtrip(tmp_path):
+    X, y = _sparse_data()
+    bst = lgb.train(P, lgb.Dataset(X, y), 10)
+    assert bst._gbdt.train_set.efb is not None
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(loaded.predict(X), bst.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_efb_valid_set_raises_clearly():
+    X, y = _sparse_data()
+    ds = lgb.Dataset(X, y)
+    with pytest.raises(NotImplementedError):
+        lgb.train(P, ds, 5, valid_sets=[lgb.Dataset(X, y, reference=ds)])
