@@ -391,7 +391,6 @@ _UNIMPLEMENTED_PARAMS: Tuple[str, ...] = (
                            # the reference's flag also forces col-wise
     "max_cat_to_onehot",
     "cegb_penalty_feature_lazy",
-    "interaction_constraints",
     "path_smooth",
 )
 

@@ -64,7 +64,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                              max_bins: int, max_depth: int, split_params,
                              hist_impl: str, interpret: bool = False,
                              jit: bool = True, forced_splits: tuple = (),
-                             efb_dims=None):
+                             efb_dims=None, interaction_groups: tuple = ()):
     """Build the partition-ordered single-tree grower.
 
     Returned signature:
@@ -90,6 +90,24 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
     import math as _math
     kcnt = max(1, int(_math.ceil(F * split_params.feature_fraction_bynode))) \
         if bynode else F
+    # interaction constraints (reference col_sampler.hpp GetByNode): at any
+    # node, the allowed features are the union of constraint sets that
+    # contain every feature already used on the branch path
+    use_ic = len(interaction_groups) > 0
+    if use_ic:
+        import numpy as _np
+        _g = _np.zeros((len(interaction_groups), F), bool)
+        for gi, feats in enumerate(interaction_groups):
+            for ff in feats:
+                if 0 <= ff < F:
+                    _g[gi, ff] = True
+        ic_groups = jnp.asarray(_g)
+
+        def allowed_features(path):
+            compat = jnp.logical_not(
+                jnp.any(path[None, :] & jnp.logical_not(ic_groups), axis=1))
+            return jnp.any(ic_groups & compat[:, None], axis=0)
+
     # forced splits (serial_tree_learner.cpp:450 ForceSplits): BFS-ordered
     # (leaf, inner feature, threshold bin) triples applied before best-gain
     # growth; static per grower (they come from a config file)
@@ -328,6 +346,9 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         root_sum = jnp.stack([jnp.sum(gm), jnp.sum(hm), jnp.sum(bag_mask)])
         root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
         fm_root = feature_mask & node_mask(2 * L) if bynode else feature_mask
+        if use_ic:
+            fm_root = fm_root & allowed_features(
+                jnp.zeros((F,), jnp.bool_))
         cand = strat.leaf_candidates(expand_hist(root_hist, root_sum),
                                      root_sum, fm_root, sp,
                                      root_bound, jnp.asarray(0, jnp.int32))
@@ -369,6 +390,8 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             "num_leaves": jnp.asarray(1, jnp.int32),
             "done": jnp.asarray(False),
         }
+        if use_ic:
+            state["leaf_path"] = jnp.zeros((L, F), jnp.bool_)
         if use_mc:
             state["leaf_mn"] = jnp.full((L,), -BIG, jnp.float32)
             state["leaf_mx"] = jnp.full((L,), BIG, jnp.float32)
@@ -461,6 +484,12 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                 fm_r = feature_mask & node_mask(2 * t + 1)
             else:
                 fm_l = fm_r = None
+            if use_ic:
+                child_path = s["leaf_path"][best_leaf] | \
+                    (jnp.arange(F) == feat)
+                allowed = allowed_features(child_path)
+                fm_l = (feature_mask if fm_l is None else fm_l) & allowed
+                fm_r = (feature_mask if fm_r is None else fm_r) & allowed
             cl, cr = strat.pair_candidates(
                 expand_hist(hist_left, lsum), expand_hist(hist_right, rsum),
                 lsum, rsum, feature_mask, sp, bound_l, bound_r,
@@ -549,6 +578,9 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             out["leaf_weight"] = upd(lw, new_id, rsum[1])
             lc = upd(s["leaf_count"], best_leaf, lsum[2])
             out["leaf_count"] = upd(lc, new_id, rsum[2])
+            if use_ic:
+                out["leaf_path"] = upd(upd(s["leaf_path"], best_leaf,
+                                           child_path), new_id, child_path)
             out["num_leaves"] = s["num_leaves"] + do.astype(jnp.int32)
             # a skipped FORCED split (empty leaf) must not end growth
             out["done"] = jnp.logical_not(do) & (t >= n_forced) \

@@ -576,7 +576,8 @@ class SerialTreeLearner:
     def __init__(self, config: Config, num_features: int, max_bins: int,
                  num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
                  monotone: Optional[np.ndarray] = None,
-                 forced_splits: tuple = (), efb=None):
+                 forced_splits: tuple = (), efb=None,
+                 interaction_groups: tuple = ()):
         self.config = config
         self.efb = efb
         if efb is not None:
@@ -619,10 +620,12 @@ class SerialTreeLearner:
         # as the shared body of the parallel strategies.
         self.partitioned = self.use_hist_pool
         forced_splits = tuple(tuple(f) for f in forced_splits)
+        interaction_groups = tuple(tuple(g) for g in interaction_groups)
         if self.partitioned:
             key = ("part", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
-                   impl, forced_splits, self._efb_dims)
+                   impl, forced_splits, self._efb_dims,
+                   interaction_groups)
             if key not in _GROW_FN_CACHE:
                 from .partitioned import make_partitioned_grow_fn
                 _GROW_FN_CACHE[key] = make_partitioned_grow_fn(
@@ -630,7 +633,8 @@ class SerialTreeLearner:
                     num_features=num_features, max_bins=self.max_bins,
                     max_depth=int(config.max_depth),
                     split_params=self.split_params, hist_impl=impl,
-                    forced_splits=forced_splits, efb_dims=self._efb_dims)
+                    forced_splits=forced_splits, efb_dims=self._efb_dims,
+                    interaction_groups=interaction_groups)
         else:
             key = ("serial", int(config.num_leaves), self.max_bins,
                    int(config.max_depth), self.split_params, impl,

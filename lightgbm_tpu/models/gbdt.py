@@ -283,6 +283,23 @@ class GBDT:
                 q.append((node["right"], new_id))
         return tuple(out)
 
+    def _parse_interaction_constraints(self) -> tuple:
+        """config.interaction_constraints "[0,1],[2,3]" -> tuples of INNER
+        feature indices (reference col_sampler.hpp constraint sets)."""
+        spec = self.config.interaction_constraints
+        if not spec:
+            return ()
+        import re
+        ts = self.train_set
+        inner_of_real = {int(r): i for i, r in enumerate(ts.used_feature_map)}
+        groups = []
+        for grp in re.findall(r"\[([^\]]*)\]", str(spec)):
+            feats = [inner_of_real[int(v)] for v in grp.split(",")
+                     if v.strip() and int(v) in inner_of_real]
+            if feats:
+                groups.append(tuple(sorted(set(feats))))
+        return tuple(groups)
+
     def _create_learner(self, num_bins, is_cat, has_nan, monotone=None):
         cfg = self.config
         if cfg.tree_learner == "serial" or cfg.num_machines <= 1 and \
@@ -290,10 +307,13 @@ class GBDT:
             return SerialTreeLearner(cfg, self.num_features, self.max_bins,
                                      num_bins, is_cat, has_nan, monotone,
                                      self._parse_forced_splits(),
-                                     efb=self.train_set.efb)
-        if cfg.forcedsplits_filename:
-            log_warning("forcedsplits_filename is applied by the serial "
-                        "learner only; this parallel learner ignores it")
+                                     efb=self.train_set.efb,
+                                     interaction_groups=
+                                     self._parse_interaction_constraints())
+        if cfg.forcedsplits_filename or cfg.interaction_constraints:
+            log_warning("forcedsplits_filename / interaction_constraints are "
+                        "applied by the serial learner only; this parallel "
+                        "learner ignores them")
         from ..parallel import create_parallel_learner
         return create_parallel_learner(cfg, self.num_features, self.max_bins,
                                        num_bins, is_cat, has_nan, monotone)

@@ -71,6 +71,32 @@ def test_feature_fraction_bynode(data):
     assert not np.allclose(bst.predict(X), base.predict(X))
 
 
+def test_interaction_constraints(data):
+    """col_sampler.hpp GetByNode semantics: two features may share a branch
+    only when some constraint set contains both."""
+    X, y = data
+    bst = lgb.train({**P, "interaction_constraints": "[0,3],[1,2]"},
+                    lgb.Dataset(X, y), 10)
+
+    def check(tree, node, path):
+        if node < 0:
+            return
+        f = int(tree.split_feature[node])
+        path2 = path | {f}
+        assert path2 <= {0, 3} or path2 <= {1, 2}, \
+            f"branch uses features {path2} across constraint groups"
+        check(tree, int(tree.left_child[node]), path2)
+        check(tree, int(tree.right_child[node]), path2)
+
+    for tree in bst._gbdt.models:
+        if tree.num_leaves > 1:
+            check(tree, 0, set())
+    # features outside every group (4, 5) never appear
+    for tree in bst._gbdt.models:
+        sf = set(int(f) for f in tree.split_feature[:tree.num_leaves - 1])
+        assert not (sf & {4, 5})
+
+
 def test_forced_splits(data, tmp_path):
     """forcedsplits_filename JSON BFS (serial_tree_learner.cpp:450): the
     first tree's top splits follow the file regardless of gain."""
