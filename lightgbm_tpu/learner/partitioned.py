@@ -53,7 +53,7 @@ __all__ = ["make_partitioned_grow_fn", "PART_ROW_BLOCK"]
 
 PART_ROW_BLOCK = 4096   # pad quantum; == Pallas kernel row-block contract
 CHUNK_BULK = 1 << 20    # bulk sweep chunk (rows)
-CHUNK_TAIL = 1 << 15    # tail sweep chunk (rows)
+CHUNK_TAIL = 1 << 15    # tail sweep chunk (rows; 16K/64K measured worse)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -229,8 +229,12 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         # stale contents are never read (the combine pass only reads
         # positions the current split wrote).
         P_ref = [P]
+        # R carries a front pad of one bulk chunk: rights are staged at
+        # segment-relative positions (+pad) and the combine pass reads at
+        # (pos - nl + pad), which stays non-negative for every chunk that
+        # touches the right region
         stage_ref = [jnp.zeros((n + chunk_bulk, W), jnp.uint8),
-                     jnp.zeros((n + chunk_bulk, W), jnp.uint8)]
+                     jnp.zeros((n + 2 * chunk_bulk, W), jnp.uint8)]
 
         def hist_of_segment(start, cnt):
             def step(cstart, csize, acc):
@@ -269,22 +273,14 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             feat_args = (feat, thr, dleft, fcat, fnanb, member)
             cend = start + cnt
 
-            # pass A: left count (column-only loads)
-            def count_step(cstart, csize, acc):
-                clamped = jnp.minimum(cstart, n - csize)
-                col = feature_col(
-                    jax.lax.dynamic_slice(P_ref[0], (clamped, 0),
-                                          (csize, W)), feat, csize)
-                gl, _ = _decide_col(col, clamped, cstart, cend, csize,
-                                    feat_args)
-                return acc + jnp.sum(gl.astype(jnp.int32))
-
-            nl = _sweep(start, cnt, count_step, jnp.asarray(0, jnp.int32))
-
-            # pass B: per-chunk stable sort + staged contiguous writes.
-            # Lefts land in the L staging buffer at their FINAL positions;
-            # rights land in the R buffer at theirs (one shared buffer is
-            # unsafe: left/right full-chunk writes would collide).
+            # pass A: per-chunk stable sort + staged contiguous writes.
+            # Lefts land in the L staging buffer at their FINAL positions
+            # [start+dl, ...); rights land in the R buffer at positions
+            # RELATIVE to the segment start [start+dr, ...) — the combine
+            # pass shifts its R reads by nl, which is only known after this
+            # pass (this removes the separate left-count sweep an earlier
+            # version needed).  One shared buffer would be unsafe: the
+            # left/right full-chunk stores collide.
             Wq = W // 4
 
             def stage_step(cstart, csize, carry):
@@ -305,29 +301,33 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                     jnp.stack(out[1:], axis=1), jnp.uint8).reshape(csize, W)
                 clt = jnp.sum(gl.astype(jnp.int32))
                 crt = jnp.sum(valid.astype(jnp.int32)) - clt
-                # full-chunk stores; only the leading valid parts matter
+                # full-chunk stores; only the leading valid parts matter —
+                # each garbage tail is overwritten by the next chunk's
+                # store or ignored by the combine's range masks
                 Lb = jax.lax.dynamic_update_slice(
                     Lb, sorted_u8, (start + dl, 0))
-                # rights begin at local row clt; place them at their final
-                # position start+nl+dr by writing the whole chunk at
-                # (start+nl+dr-clt); the left part before it is garbage
-                # that the combine pass never reads from Rb
+                # rights begin at local row clt; write the whole chunk at
+                # (start+dr-clt) so they land at relative position dr; the
+                # left part before it is garbage the combine never reads
                 Rb = jax.lax.dynamic_update_slice(
-                    Rb, sorted_u8, (jnp.maximum(start + nl + dr - clt, 0), 0))
+                    Rb, sorted_u8, (start + dr - clt + chunk_bulk, 0))
                 return Lb, Rb, dl + clt, dr + crt
 
-            Lb, Rb, _, _ = _sweep(start, cnt, stage_step,
-                                  (stage_ref[0], stage_ref[1],
-                                   jnp.asarray(0, jnp.int32),
-                                   jnp.asarray(0, jnp.int32)))
+            Lb, Rb, nl, _ = _sweep(start, cnt, stage_step,
+                                   (stage_ref[0], stage_ref[1],
+                                    jnp.asarray(0, jnp.int32),
+                                    jnp.asarray(0, jnp.int32)))
             stage_ref[0] = Lb
             stage_ref[1] = Rb
 
-            # combine: contiguous sweep selecting Lb below start+nl, Rb above
+            # combine: contiguous sweep selecting Lb below start+nl, and Rb
+            # (shifted by -nl) above
             def combine_step(cstart, csize, P_out):
                 clamped = jnp.minimum(cstart, n - csize)
                 lrow = jax.lax.dynamic_slice(Lb, (clamped, 0), (csize, W))
-                rrow = jax.lax.dynamic_slice(Rb, (clamped, 0), (csize, W))
+                rrow = jax.lax.dynamic_slice(
+                    Rb, (jnp.maximum(clamped - nl + chunk_bulk, 0), 0),
+                    (csize, W))
                 cur = jax.lax.dynamic_slice(P_out, (clamped, 0), (csize, W))
                 j = jnp.arange(csize, dtype=jnp.int32)
                 gpos = clamped + j
