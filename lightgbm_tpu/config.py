@@ -382,14 +382,11 @@ class Config:
 _UNIMPLEMENTED_PARAMS: Tuple[str, ...] = (
     "extra_trees",
     "feature_contri",
-    "pos_bagging_fraction",
-    "neg_bagging_fraction",
     "forcedbins_filename",
     "two_round",
     "pre_partition",
     "deterministic",       # training is deterministic by construction, but
                            # the reference's flag also forces col-wise
-    "max_cat_to_onehot",
     "cegb_penalty_feature_lazy",
     "path_smooth",
 )

@@ -368,15 +368,30 @@ class GBDT:
         bagging_freq iters); GOSS/RF override."""
         cfg = self.config
         n = self.num_data
-        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+        pos_neg = (cfg.objective == "binary" and
+                   (cfg.pos_bagging_fraction < 1.0 or
+                    cfg.neg_bagging_fraction < 1.0))
+        if cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0 or pos_neg):
             # resample every bagging_freq iterations with a deterministic
             # per-block seed (reference bagging_seed + iteration)
             block = self.iter_ // cfg.bagging_freq
             rng = host_rng(cfg.bagging_seed, block)
-            k = int(n * cfg.bagging_fraction)
-            idx = rng.choice(n, size=k, replace=False)
             mask = np.zeros(n, np.float32)
-            mask[idx] = 1.0
+            if pos_neg:
+                # balanced bagging (gbdt.cpp:199 BaggingHelper pos/neg
+                # fractions over the binary label)
+                label = np.asarray(self.train_set.metadata.label)
+                pos = np.nonzero(label > 0)[0]
+                neg = np.nonzero(label <= 0)[0]
+                kp = int(len(pos) * cfg.pos_bagging_fraction)
+                kn = int(len(neg) * cfg.neg_bagging_fraction)
+                if kp:
+                    mask[rng.choice(pos, size=kp, replace=False)] = 1.0
+                if kn:
+                    mask[rng.choice(neg, size=kn, replace=False)] = 1.0
+            else:
+                k = int(n * cfg.bagging_fraction)
+                mask[rng.choice(n, size=k, replace=False)] = 1.0
             self._bag_mask = jnp.asarray(mask)
         elif not hasattr(self, "_bag_mask") or self._bag_mask.shape[0] != n:
             self._bag_mask = jnp.ones(n, jnp.float32)

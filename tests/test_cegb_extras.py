@@ -71,6 +71,18 @@ def test_feature_fraction_bynode(data):
     assert not np.allclose(bst.predict(X), base.predict(X))
 
 
+def test_pos_neg_bagging(data):
+    """Balanced bagging (gbdt.cpp:199): per-class sampling fractions."""
+    X, y = data
+    bst = lgb.train({**P, "bagging_freq": 1, "pos_bagging_fraction": 0.9,
+                     "neg_bagging_fraction": 0.3}, lgb.Dataset(X, y), 3)
+    mask = np.asarray(bst._gbdt._bag_mask)
+    pos_rate = mask[y > 0].mean()
+    neg_rate = mask[y <= 0].mean()
+    assert abs(pos_rate - 0.9) < 0.02
+    assert abs(neg_rate - 0.3) < 0.02
+
+
 def test_interaction_constraints(data):
     """col_sampler.hpp GetByNode semantics: two features may share a branch
     only when some constraint set contains both."""
