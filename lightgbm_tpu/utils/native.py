@@ -1,0 +1,111 @@
+"""Native host-runtime loader: compiles + loads the C++ helpers in
+``native/`` on first use (ctypes ABI; reference's ingest hot loops are C++
+too — src/io/bin.cpp / dense_bin.hpp).  Falls back to numpy silently when
+no compiler is available, so the framework stays pure-Python-runnable."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native")
+
+
+def _n_threads() -> int:
+    return max(1, min(os.cpu_count() or 1, 32))
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    src = os.path.join(_NATIVE_DIR, "binning.cc")
+    if not os.path.exists(src):
+        return None
+    cache = os.path.join(tempfile.gettempdir(),
+                         f"lgbm_tpu_native_{os.getuid()}")
+    os.makedirs(cache, exist_ok=True)
+    lib_path = os.path.join(cache, "libbinning.so")
+    if (not os.path.exists(lib_path) or
+            os.path.getmtime(lib_path) < os.path.getmtime(src)):
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                 src, "-o", lib_path + ".tmp"],
+                check=True, capture_output=True, timeout=120)
+            os.replace(lib_path + ".tmp", lib_path)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError:
+        return None
+    lib.bin_numerical.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
+    lib.bin_matrix_f64.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if not _TRIED:
+        _TRIED = True
+        _LIB = _build_and_load()
+    return _LIB
+
+
+def _ptr(a: np.ndarray, ct):
+    return a.ctypes.data_as(ctypes.POINTER(ct))
+
+
+def bin_numerical(values: np.ndarray, uppers: np.ndarray, num_bin: int,
+                  missing_nan: bool) -> Optional[np.ndarray]:
+    """Threaded value->bin for one numerical column; None -> use numpy."""
+    lib = get_lib()
+    if lib is None or len(values) < (1 << 16):
+        return None
+    vals = np.ascontiguousarray(values, np.float64)
+    ub = np.ascontiguousarray(uppers, np.float64)
+    out = np.empty(len(vals), np.uint8)
+    lib.bin_numerical(_ptr(vals, ctypes.c_double), len(vals),
+                      _ptr(ub, ctypes.c_double), len(ub), int(num_bin),
+                      1 if missing_nan else 0,
+                      _ptr(out, ctypes.c_uint8), _n_threads())
+    return out
+
+
+def bin_matrix_numerical(X: np.ndarray, uppers_list, num_bins, missing_nan
+                         ) -> Optional[np.ndarray]:
+    """Threaded whole-matrix binning (all columns NUMERICAL with <=256
+    bins); None -> use the per-column python path."""
+    lib = get_lib()
+    if lib is None or X.shape[0] * X.shape[1] < (1 << 18):
+        return None
+    n, f = X.shape
+    Xc = np.ascontiguousarray(X, np.float64)
+    uppers_flat = np.ascontiguousarray(np.concatenate(uppers_list),
+                                       np.float64)
+    offsets = np.zeros(f + 1, np.int64)
+    offsets[1:] = np.cumsum([len(u) for u in uppers_list])
+    nb = np.ascontiguousarray(num_bins, np.int32)
+    mn = np.ascontiguousarray(missing_nan, np.int32)
+    out = np.empty((n, f), np.uint8)
+    lib.bin_matrix_f64(_ptr(Xc, ctypes.c_double), n, f,
+                       _ptr(uppers_flat, ctypes.c_double),
+                       _ptr(offsets, ctypes.c_int64),
+                       _ptr(nb, ctypes.c_int32), _ptr(mn, ctypes.c_int32),
+                       _ptr(out, ctypes.c_uint8), _n_threads())
+    return out

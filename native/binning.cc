@@ -1,0 +1,126 @@
+// Native host-side binning engine (value -> bin quantization).
+//
+// TPU-native equivalent of the reference's hot ingest loops
+// (reference: src/io/bin.cpp ValueToBin dispatch + dense_bin.hpp push
+// paths; the reference parallelizes ingest with OpenMP).  The Python
+// BinMapper keeps the bin-BOUNDARY search logic; this library does the
+// bulk value->bin mapping with std::thread parallelism — numpy's
+// searchsorted is single-threaded and dominated Dataset.construct at
+// 10.5M rows (~100 s; this path cuts it to seconds).
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 -pthread binning.cc -o libbinning.so
+// Loaded via ctypes (lightgbm_tpu/utils/native.py); numpy fallback when
+// unavailable.
+
+#include <cmath>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline int search_left(const double* uppers, int nb, double v) {
+  // first index i with uppers[i] >= v  (numpy searchsorted side='left')
+  int lo = 0, hi = nb;
+  while (lo < hi) {
+    int mid = (lo + hi) >> 1;
+    if (uppers[mid] < v) {
+      lo = mid + 1;
+    } else {
+      hi = mid;
+    }
+  }
+  return lo;
+}
+
+void bin_range(const double* vals, int64_t lo, int64_t hi,
+               const double* uppers, int nb, int num_bin, int missing_nan,
+               uint8_t* out) {
+  const int last_real = nb - 1;
+  for (int64_t i = lo; i < hi; ++i) {
+    double v = vals[i];
+    if (std::isnan(v)) {
+      if (missing_nan) {
+        out[i] = static_cast<uint8_t>(num_bin - 1);
+        continue;
+      }
+      v = 0.0;  // MissingType::NONE/ZERO route NaN through 0.0
+    }
+    int b = search_left(uppers, nb, v);
+    out[i] = static_cast<uint8_t>(b > last_real ? last_real : b);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Bin one numerical column: out[i] = bin of vals[i].
+//   uppers: ascending bin upper bounds (nb of them; the real-value bins)
+//   num_bin: total bins including a trailing NaN bin when missing_nan
+void bin_numerical(const double* vals, int64_t n, const double* uppers,
+                   int32_t nb, int32_t num_bin, int32_t missing_nan,
+                   uint8_t* out, int32_t n_threads) {
+  if (n_threads <= 1 || n < (1 << 16)) {
+    bin_range(vals, 0, n, uppers, nb, num_bin, missing_nan, out);
+    return;
+  }
+  std::vector<std::thread> workers;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    workers.emplace_back(bin_range, vals, lo, hi, uppers, nb, num_bin,
+                         missing_nan, out);
+  }
+  for (auto& w : workers) w.join();
+}
+
+// Bin a whole row-major float64 matrix (n x f) into a row-major uint8
+// matrix, one mapper per column.  Boundary arrays are concatenated;
+// offsets[j]..offsets[j+1] delimit column j's uppers.
+void bin_matrix_f64(const double* X, int64_t n, int32_t f,
+                    const double* uppers_flat, const int64_t* offsets,
+                    const int32_t* num_bin, const int32_t* missing_nan,
+                    uint8_t* out, int32_t n_threads) {
+  auto work = [&](int64_t row_lo, int64_t row_hi) {
+    for (int64_t i = row_lo; i < row_hi; ++i) {
+      const double* row = X + i * f;
+      uint8_t* orow = out + i * f;
+      for (int32_t j = 0; j < f; ++j) {
+        const double* uppers = uppers_flat + offsets[j];
+        int nb = static_cast<int>(offsets[j + 1] - offsets[j]);
+        double v = row[j];
+        int last_real = nb - 1;
+        int b;
+        if (std::isnan(v)) {
+          if (missing_nan[j]) {
+            b = num_bin[j] - 1;
+            orow[j] = static_cast<uint8_t>(b);
+            continue;
+          }
+          v = 0.0;
+        }
+        b = search_left(uppers, nb, v);
+        if (b > last_real) b = last_real;
+        orow[j] = static_cast<uint8_t>(b);
+      }
+    }
+  };
+  if (n_threads <= 1 || n < (1 << 14)) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> workers;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    workers.emplace_back(work, lo, hi);
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // extern "C"
