@@ -1,0 +1,361 @@
+"""The ``LGBM_*`` C-API surface (reference: include/LightGBM/c_api.h,
+src/c_api.cpp — the stable handle-based ABI behind the Python/R/SWIG
+bindings).
+
+In this framework the boosting driver is in-process Python, so the ABI's
+raw-pointer marshalling collapses: handles are integers in a registry,
+matrices are numpy arrays, and every function keeps the reference's NAME,
+argument order, and 0/-1 + ``LGBM_GetLastError`` error contract.  Code
+written against the reference's ctypes surface ports by swapping
+``_LIB.LGBM_x(...)`` for ``capi.LGBM_x(...)``; a future native embedding
+can re-export these symbols unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster
+from .config import Config
+from .dataset import Dataset
+from .utils.log import log_warning
+
+__all__ = [n for n in dir() if n.startswith("LGBM_")]
+
+_lock = threading.Lock()
+_handles: Dict[int, Any] = {}
+_next_handle = [1]
+_last_error = [""]
+
+C_API_DTYPE_FLOAT32 = 0
+C_API_DTYPE_FLOAT64 = 1
+C_API_DTYPE_INT32 = 2
+C_API_DTYPE_INT64 = 3
+
+C_API_PREDICT_NORMAL = 0
+C_API_PREDICT_RAW_SCORE = 1
+C_API_PREDICT_LEAF_INDEX = 2
+C_API_PREDICT_CONTRIB = 3
+
+
+def _register(obj: Any) -> int:
+    with _lock:
+        h = _next_handle[0]
+        _next_handle[0] += 1
+        _handles[h] = obj
+    return h
+
+
+def _get(handle: int):
+    try:
+        return _handles[handle]
+    except KeyError:
+        raise ValueError(f"invalid handle {handle}")
+
+
+def _api(fn):
+    """Error contract: 0 on success, -1 + LGBM_GetLastError on failure
+    (reference c_api.cpp API_BEGIN/API_END)."""
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — the ABI swallows into -1
+            _last_error[0] = f"{type(e).__name__}: {e}"
+            return -1, None
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
+def LGBM_GetLastError() -> str:
+    """reference c_api.h:46."""
+    return _last_error[0]
+
+
+def _parse_params(parameters: Optional[str]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for tok in (parameters or "").replace("\n", " ").split(" "):
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+# ---- Dataset surface (c_api.h:66-398) -----------------------------------
+
+@_api
+def LGBM_DatasetCreateFromMat(data, parameters: str = "",
+                              label=None, reference: Optional[int] = None):
+    params = _parse_params(parameters)
+    ref = _get(reference) if reference else None
+    ds = Dataset(np.asarray(data), label=label, reference=ref, params=params)
+    ds.construct(Config(params) if ref is None else None)
+    return 0, _register(ds)
+
+
+@_api
+def LGBM_DatasetCreateFromCSR(csr, parameters: str = "", label=None,
+                              reference: Optional[int] = None):
+    params = _parse_params(parameters)
+    ref = _get(reference) if reference else None
+    ds = Dataset(csr, label=label, reference=ref, params=params)
+    ds.construct(Config(params) if ref is None else None)
+    return 0, _register(ds)
+
+
+@_api
+def LGBM_DatasetCreateFromFile(filename: str, parameters: str = "",
+                               reference: Optional[int] = None):
+    params = _parse_params(parameters)
+    ref = _get(reference) if reference else None
+    ds = Dataset(filename, reference=ref, params=params)
+    ds.construct(Config(params) if ref is None else None)
+    return 0, _register(ds)
+
+
+@_api
+def LGBM_DatasetFree(handle: int):
+    with _lock:
+        _handles.pop(handle, None)
+    return 0, None
+
+
+@_api
+def LGBM_DatasetGetNumData(handle: int):
+    return 0, _get(handle).num_data()
+
+
+@_api
+def LGBM_DatasetGetNumFeature(handle: int):
+    return 0, _get(handle).num_feature()
+
+
+@_api
+def LGBM_DatasetSetField(handle: int, field_name: str, field_data):
+    ds = _get(handle)
+    if field_name == "label":
+        ds.set_label(field_data)
+    elif field_name == "weight":
+        ds.set_weight(field_data)
+    elif field_name in ("group", "query"):
+        ds.set_group(field_data)
+    elif field_name == "init_score":
+        ds.set_init_score(field_data)
+    else:
+        raise ValueError(f"unknown field {field_name}")
+    return 0, None
+
+
+@_api
+def LGBM_DatasetGetField(handle: int, field_name: str):
+    ds = _get(handle)
+    md = ds.metadata
+    val = {"label": md.label, "weight": md.weight, "group": md.group,
+           "query": md.group, "init_score": md.init_score}.get(field_name)
+    if val is None and field_name not in ("label", "weight", "group",
+                                          "query", "init_score"):
+        raise ValueError(f"unknown field {field_name}")
+    return 0, val
+
+
+@_api
+def LGBM_DatasetSaveBinary(handle: int, filename: str):
+    _get(handle).save_binary(filename)
+    return 0, None
+
+
+# ---- Booster surface (c_api.h:418-1263) ---------------------------------
+
+@_api
+def LGBM_BoosterCreate(train_data: int, parameters: str = ""):
+    ds = _get(train_data)
+    bst = Booster(params=_parse_params(parameters), train_set=ds)
+    return 0, _register(bst)
+
+
+@_api
+def LGBM_BoosterCreateFromModelfile(filename: str):
+    bst = Booster(model_file=filename)
+    return 0, _register(bst)
+
+
+@_api
+def LGBM_BoosterLoadModelFromString(model_str: str):
+    bst = Booster(model_str=model_str)
+    return 0, _register(bst)
+
+
+@_api
+def LGBM_BoosterFree(handle: int):
+    with _lock:
+        _handles.pop(handle, None)
+    return 0, None
+
+
+@_api
+def LGBM_BoosterAddValidData(handle: int, valid_data: int):
+    bst = _get(handle)
+    bst.add_valid(_get(valid_data), f"valid_{len(bst._gbdt.valid_sets)}")
+    return 0, None
+
+
+@_api
+def LGBM_BoosterUpdateOneIter(handle: int):
+    finished = _get(handle).update()
+    return 0, 1 if finished else 0
+
+
+@_api
+def LGBM_BoosterUpdateOneIterCustom(handle: int, grad, hess):
+    bst = _get(handle)
+    finished = bst._gbdt.train_one_iter(np.asarray(grad, np.float32),
+                                        np.asarray(hess, np.float32))
+    return 0, 1 if finished else 0
+
+
+@_api
+def LGBM_BoosterRollbackOneIter(handle: int):
+    _get(handle).rollback_one_iter()
+    return 0, None
+
+
+@_api
+def LGBM_BoosterGetCurrentIteration(handle: int):
+    return 0, _get(handle).current_iteration
+
+
+@_api
+def LGBM_BoosterNumModelPerIteration(handle: int):
+    return 0, _get(handle).num_model_per_iteration()
+
+
+@_api
+def LGBM_BoosterNumberOfTotalModel(handle: int):
+    return 0, _get(handle).num_trees()
+
+
+@_api
+def LGBM_BoosterGetNumClasses(handle: int):
+    return 0, _get(handle)._gbdt.config.num_class
+
+
+@_api
+def LGBM_BoosterGetNumFeature(handle: int):
+    return 0, _get(handle).num_feature()
+
+
+@_api
+def LGBM_BoosterGetFeatureNames(handle: int):
+    return 0, _get(handle).feature_name()
+
+
+@_api
+def LGBM_BoosterGetEval(handle: int, data_idx: int):
+    """data_idx 0 = training, i+1 = i-th validation set (c_api.h:648)."""
+    bst = _get(handle)
+    res = bst.eval_train() if data_idx == 0 else bst.eval_valid()
+    if data_idx > 0:
+        names = [n for n, _ in bst._gbdt.valid_sets]
+        want = names[data_idx - 1]
+        res = [r for r in res if r[0] == want]
+    return 0, [(name, val) for _, name, val, _ in res]
+
+
+@_api
+def LGBM_BoosterSaveModel(handle: int, filename: str,
+                          start_iteration: int = 0,
+                          num_iteration: int = -1):
+    _get(handle).save_model(filename,
+                            None if num_iteration < 0 else num_iteration,
+                            start_iteration)
+    return 0, None
+
+
+@_api
+def LGBM_BoosterSaveModelToString(handle: int, start_iteration: int = 0,
+                                  num_iteration: int = -1):
+    return 0, _get(handle).model_to_string(
+        None if num_iteration < 0 else num_iteration, start_iteration)
+
+
+@_api
+def LGBM_BoosterDumpModel(handle: int, start_iteration: int = 0,
+                          num_iteration: int = -1):
+    return 0, _get(handle).dump_model(
+        None if num_iteration < 0 else num_iteration, start_iteration)
+
+
+@_api
+def LGBM_BoosterPredictForMat(handle: int, data, predict_type: int = 0,
+                              start_iteration: int = 0,
+                              num_iteration: int = -1,
+                              parameter: str = ""):
+    bst = _get(handle)
+    out = bst.predict(np.asarray(data),
+                      start_iteration=start_iteration,
+                      num_iteration=None if num_iteration < 0 else
+                      num_iteration,
+                      raw_score=predict_type == C_API_PREDICT_RAW_SCORE,
+                      pred_leaf=predict_type == C_API_PREDICT_LEAF_INDEX,
+                      pred_contrib=predict_type == C_API_PREDICT_CONTRIB)
+    return 0, out
+
+
+@_api
+def LGBM_BoosterPredictForCSR(handle: int, csr, predict_type: int = 0,
+                              start_iteration: int = 0,
+                              num_iteration: int = -1,
+                              parameter: str = ""):
+    bst = _get(handle)
+    out = bst.predict(np.asarray(csr.todense()),
+                      start_iteration=start_iteration,
+                      num_iteration=None if num_iteration < 0 else
+                      num_iteration,
+                      raw_score=predict_type == C_API_PREDICT_RAW_SCORE,
+                      pred_leaf=predict_type == C_API_PREDICT_LEAF_INDEX,
+                      pred_contrib=predict_type == C_API_PREDICT_CONTRIB)
+    return 0, out
+
+
+@_api
+def LGBM_BoosterFeatureImportance(handle: int, num_iteration: int = -1,
+                                  importance_type: int = 0):
+    kind = "split" if importance_type == 0 else "gain"
+    return 0, _get(handle).feature_importance(kind)
+
+
+@_api
+def LGBM_BoosterRefit(handle: int, data, label, decay_rate: float = 0.9):
+    new_bst = _get(handle).refit(np.asarray(data), np.asarray(label),
+                                 decay_rate)
+    return 0, _register(new_bst)
+
+
+@_api
+def LGBM_BoosterResetParameter(handle: int, parameters: str):
+    _get(handle).reset_parameter(_parse_params(parameters))
+    return 0, None
+
+
+# ---- network (c_api.h:1274) ---------------------------------------------
+
+@_api
+def LGBM_NetworkInit(machines: str, local_listen_port: int,
+                     listen_time_out: int, num_machines: int):
+    if num_machines > 1:
+        raise NotImplementedError(
+            "socket meshes are replaced by the JAX runtime: call "
+            "lightgbm_tpu.distributed.init(...) per process instead")
+    log_warning("LGBM_NetworkInit with one machine is a no-op")
+    return 0, None
+
+
+@_api
+def LGBM_NetworkFree():
+    return 0, None
+
+
+__all__ = sorted(n for n in dir() if n.startswith("LGBM_"))

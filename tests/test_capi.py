@@ -1,0 +1,93 @@
+"""LGBM_* C-API surface tests (reference pattern: tests/c_api_test/test_.py
+— dataset + booster round trips through the handle-based API)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu.capi as capi
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(8)
+    X = rng.randn(400, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def test_dataset_booster_roundtrip(data, tmp_path):
+    X, y = data
+    code, dh = capi.LGBM_DatasetCreateFromMat(
+        X, "objective=binary verbosity=-1", label=y)
+    assert code == 0
+    assert capi.LGBM_DatasetGetNumData(dh) == (0, 400)
+    assert capi.LGBM_DatasetGetNumFeature(dh) == (0, 5)
+
+    code, bh = capi.LGBM_BoosterCreate(
+        dh, "objective=binary num_leaves=15 verbosity=-1 metric=auc "
+            "is_training_metric=true")
+    assert code == 0
+    for _ in range(10):
+        code, finished = capi.LGBM_BoosterUpdateOneIter(bh)
+        assert code == 0
+    assert capi.LGBM_BoosterGetCurrentIteration(bh) == (0, 10)
+    assert capi.LGBM_BoosterNumberOfTotalModel(bh) == (0, 10)
+
+    code, evals = capi.LGBM_BoosterGetEval(bh, 0)
+    assert code == 0 and evals and evals[0][0] == "auc"
+    assert evals[0][1] > 0.8
+
+    code, preds = capi.LGBM_BoosterPredictForMat(bh, X)
+    assert code == 0 and preds.shape == (400,)
+
+    path = str(tmp_path / "m.txt")
+    assert capi.LGBM_BoosterSaveModel(bh, path)[0] == 0
+    code, bh2 = capi.LGBM_BoosterCreateFromModelfile(path)
+    assert code == 0
+    code, preds2 = capi.LGBM_BoosterPredictForMat(bh2, X)
+    np.testing.assert_allclose(preds2, preds, rtol=1e-5, atol=1e-6)
+
+    assert capi.LGBM_BoosterFree(bh)[0] == 0
+    assert capi.LGBM_DatasetFree(dh)[0] == 0
+
+
+def test_fields_and_custom_update(data):
+    X, y = data
+    _, dh = capi.LGBM_DatasetCreateFromMat(X, "objective=none verbosity=-1")
+    assert capi.LGBM_DatasetSetField(dh, "label", y)[0] == 0
+    code, lab = capi.LGBM_DatasetGetField(dh, "label")
+    np.testing.assert_array_equal(lab, y.astype(np.float32))
+
+    _, bh = capi.LGBM_BoosterCreate(dh, "objective=none verbosity=-1 "
+                                        "num_leaves=7")
+    score = np.zeros(len(y), np.float32)
+    for _ in range(3):
+        p = 1.0 / (1.0 + np.exp(-score))
+        code, _ = capi.LGBM_BoosterUpdateOneIterCustom(bh, p - y, p * (1 - p))
+        assert code == 0
+    assert capi.LGBM_BoosterNumberOfTotalModel(bh) == (0, 3)
+
+
+def test_error_contract():
+    code, _ = capi.LGBM_BoosterCreate(99999, "")
+    assert code == -1
+    assert "handle" in capi.LGBM_GetLastError()
+
+
+def test_predict_types(data):
+    X, y = data
+    _, dh = capi.LGBM_DatasetCreateFromMat(
+        X, "objective=binary verbosity=-1", label=y)
+    _, bh = capi.LGBM_BoosterCreate(dh, "objective=binary verbosity=-1 "
+                                        "num_leaves=7")
+    for _ in range(5):
+        capi.LGBM_BoosterUpdateOneIter(bh)
+    _, raw = capi.LGBM_BoosterPredictForMat(
+        bh, X, predict_type=capi.C_API_PREDICT_RAW_SCORE)
+    _, leaf = capi.LGBM_BoosterPredictForMat(
+        bh, X, predict_type=capi.C_API_PREDICT_LEAF_INDEX)
+    _, contrib = capi.LGBM_BoosterPredictForMat(
+        bh, X, predict_type=capi.C_API_PREDICT_CONTRIB)
+    assert leaf.shape == (400, 5) and leaf.dtype.kind == "i"
+    assert contrib.shape == (400, 6)
+    np.testing.assert_allclose(contrib.sum(1), raw, rtol=1e-4, atol=1e-4)
