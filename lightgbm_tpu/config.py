@@ -381,7 +381,6 @@ class Config:
 # Entries are removed as features land; tests assert this list shrinks only.
 _UNIMPLEMENTED_PARAMS: Tuple[str, ...] = (
     "extra_trees",
-    "feature_contri",
     "forcedbins_filename",
     "two_round",
     "pre_partition",

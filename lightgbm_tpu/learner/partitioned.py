@@ -64,7 +64,8 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                              max_bins: int, max_depth: int, split_params,
                              hist_impl: str, interpret: bool = False,
                              jit: bool = True, forced_splits: tuple = (),
-                             efb_dims=None, interaction_groups: tuple = ()):
+                             efb_dims=None, interaction_groups: tuple = (),
+                             feature_contri: tuple = ()):
     """Build the partition-ordered single-tree grower.
 
     Returned signature:
@@ -143,6 +144,8 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         n = X.shape[0]
         strat = CommStrategy(num_bins, is_cat, has_nan, monotone)
         strat.cegb_full = cegb_penalty if split_params.use_cegb else None
+        if feature_contri:
+            strat.contri_full = jnp.asarray(feature_contri, jnp.float32)
         chunk_bulk = min(CHUNK_BULK, n)
         chunk_tail = min(CHUNK_TAIL, n)
 

@@ -73,12 +73,14 @@ class GrownTree(NamedTuple):
 
 def local_best_candidate(hist, leaf_sum, num_bins, is_cat, has_nan,
                          feature_mask, params, monotone=None, bound=None,
-                         depth=None, cegb=None) -> Tuple[jnp.ndarray, ...]:
+                         depth=None, cegb=None, contri=None
+                         ) -> Tuple[jnp.ndarray, ...]:
     """Best split over (local) features for one leaf -> scalar candidate
     tuple (gain, feat, bin, default_left, left_sum, right_sum)."""
     fs: FeatureSplits = best_split_per_feature(hist, leaf_sum, num_bins,
                                                is_cat, has_nan, params,
-                                               monotone, bound, depth, cegb)
+                                               monotone, bound, depth, cegb,
+                                               contri)
     gain = jnp.where(feature_mask, fs.gain, NEG_INF)
     f = jnp.argmax(gain)
     return (gain[f], f.astype(jnp.int32), fs.threshold_bin[f],
@@ -127,7 +129,8 @@ class CommStrategy:
         nb, ic, hn, fm = self.local_meta(feature_mask)
         return local_best_candidate(hist, leaf_sum, nb, ic, hn, fm, params,
                                     self.monotone_full, bound, depth,
-                                    getattr(self, "cegb_full", None))
+                                    getattr(self, "cegb_full", None),
+                                    getattr(self, "contri_full", None))
 
     def pair_candidates(self, hist_l, hist_r, lsum, rsum, feature_mask,
                         params, bound_l, bound_r, depth, fm_l=None,
@@ -147,10 +150,12 @@ class CommStrategy:
         else:
             bounds = jnp.stack([bound_l, bound_r])
         cegb = getattr(self, "cegb_full", None)
+        contri = getattr(self, "contri_full", None)
 
         def one(h, s, b, f_m):
             return local_best_candidate(h, s, nb, ic, hn, f_m, params,
-                                        self.monotone_full, b, depth, cegb)
+                                        self.monotone_full, b, depth, cegb,
+                                        contri)
 
         out = jax.vmap(one)(hists, sums, bounds, fms)
         cl = tuple(o[0] for o in out)
@@ -577,7 +582,8 @@ class SerialTreeLearner:
                  num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
                  monotone: Optional[np.ndarray] = None,
                  forced_splits: tuple = (), efb=None,
-                 interaction_groups: tuple = ()):
+                 interaction_groups: tuple = (),
+                 feature_contri: tuple = ()):
         self.config = config
         self.efb = efb
         if efb is not None:
@@ -621,11 +627,12 @@ class SerialTreeLearner:
         self.partitioned = self.use_hist_pool
         forced_splits = tuple(tuple(f) for f in forced_splits)
         interaction_groups = tuple(tuple(g) for g in interaction_groups)
+        feature_contri = tuple(float(v) for v in feature_contri)
         if self.partitioned:
             key = ("part", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
                    impl, forced_splits, self._efb_dims,
-                   interaction_groups)
+                   interaction_groups, feature_contri)
             if key not in _GROW_FN_CACHE:
                 from .partitioned import make_partitioned_grow_fn
                 _GROW_FN_CACHE[key] = make_partitioned_grow_fn(
@@ -634,7 +641,8 @@ class SerialTreeLearner:
                     max_depth=int(config.max_depth),
                     split_params=self.split_params, hist_impl=impl,
                     forced_splits=forced_splits, efb_dims=self._efb_dims,
-                    interaction_groups=interaction_groups)
+                    interaction_groups=interaction_groups,
+                    feature_contri=feature_contri)
         else:
             key = ("serial", int(config.num_leaves), self.max_bins,
                    int(config.max_depth), self.split_params, impl,

@@ -283,6 +283,17 @@ class GBDT:
                 q.append((node["right"], new_id))
         return tuple(out)
 
+    def _inner_contri(self) -> tuple:
+        """config.feature_contri (original column indexing) -> per-inner-
+        feature gain multipliers (feature_histogram.hpp:94 penalty)."""
+        fc = self.config.feature_contri
+        if not fc:
+            return ()
+        ts = self.train_set
+        full = np.ones(ts.num_total_features, np.float64)
+        full[:len(fc)] = [float(v) for v in fc]
+        return tuple(full[ts.used_feature_map])
+
     def _parse_interaction_constraints(self) -> tuple:
         """config.interaction_constraints "[0,1],[2,3]" -> tuples of INNER
         feature indices (reference col_sampler.hpp constraint sets)."""
@@ -309,7 +320,8 @@ class GBDT:
                                      self._parse_forced_splits(),
                                      efb=self.train_set.efb,
                                      interaction_groups=
-                                     self._parse_interaction_constraints())
+                                     self._parse_interaction_constraints(),
+                                     feature_contri=self._inner_contri())
         if cfg.forcedsplits_filename or cfg.interaction_constraints:
             log_warning("forcedsplits_filename / interaction_constraints are "
                         "applied by the serial learner only; this parallel "

@@ -120,7 +120,8 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                            monotone: Optional[jnp.ndarray] = None,
                            bound: Optional[jnp.ndarray] = None,
                            depth: Optional[jnp.ndarray] = None,
-                           cegb_penalty: Optional[jnp.ndarray] = None
+                           cegb_penalty: Optional[jnp.ndarray] = None,
+                           gain_scale: Optional[jnp.ndarray] = None
                            ) -> FeatureSplits:
     """Best split per feature from one leaf's histograms.
 
@@ -346,6 +347,10 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                  parent_sum[2] +
                  (cegb_penalty if cegb_penalty is not None else 0.0))
         gain = jnp.where(gain > NEG_INF / 2, gain - delta, gain)
+    if gain_scale is not None:
+        # per-feature gain penalty (feature_contri; feature_histogram.hpp:94
+        # ``output->gain *= meta_->penalty``)
+        gain = jnp.where(gain > NEG_INF / 2, gain * gain_scale, gain)
     cat_member = cat_member & is_cat_b & (gain > NEG_INF / 2)[:, None]
     # cat threshold_bin kept as the first member bin (display/compat; the
     # partition decision uses the membership vector)

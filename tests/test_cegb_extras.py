@@ -71,6 +71,19 @@ def test_feature_fraction_bynode(data):
     assert not np.allclose(bst.predict(X), base.predict(X))
 
 
+def test_feature_contri(data):
+    """Per-feature gain multipliers (feature_histogram.hpp:94 penalty)."""
+    X, y = data
+    base = lgb.train(P, lgb.Dataset(X, y), 10)
+    pen = lgb.train({**P, "feature_contri": [1, 0.01, 1, 1, 1, 1]},
+                    lgb.Dataset(X, y), 10)
+
+    def uses(b, f):
+        return sum(int(np.sum(t.split_feature[:t.num_leaves - 1] == f))
+                   for t in b._gbdt.models)
+    assert uses(pen, 1) < uses(base, 1)
+
+
 def test_pos_neg_bagging(data):
     """Balanced bagging (gbdt.cpp:199): per-class sampling fractions."""
     X, y = data
