@@ -198,6 +198,15 @@ _PARAMS: List[ParamSpec] = [
     _p("tpu_histogram_impl", str, "auto"),   # auto | segment | onehot | pallas
     _p("tpu_rows_per_chunk", int, 0),        # 0 = auto-tune
     _p("tpu_double_precision_gain", bool, False),  # like gpu_use_dp for split gains
+    # tree_grow_mode: auto | wave | partition.  "wave" = leaf-wise growth
+    # with MXU leaf-batched histograms and no row movement (learner/wave.py,
+    # up to tpu_wave_size splits committed per wave); "partition" = exact
+    # sequential leaf-wise with leaf-contiguous packed rows
+    # (learner/partitioned.py).  "auto" picks wave on TPU when no
+    # wave-incompatible feature (forced splits / interaction constraints /
+    # bynode sampling) is active.
+    _p("tree_grow_mode", str, "auto"),
+    _p("tpu_wave_size", int, 16, check=">0"),
     _p("num_devices", int, 0),               # 0 = all visible devices
 ]
 
@@ -338,6 +347,11 @@ class Config:
              "top_rate + other_rate must be <=1 (GOSS)"),
             (not (self.force_col_wise and self.force_row_wise),
              "cannot set both force_col_wise and force_row_wise"),
+            (self.tree_grow_mode in ("auto", "wave", "partition"),
+             "tree_grow_mode must be one of auto|wave|partition"),
+            (self.tpu_histogram_impl in ("auto", "segment", "onehot",
+                                         "pallas"),
+             "tpu_histogram_impl must be auto|segment|onehot|pallas"),
         ]
         for ok, msg in checks:
             if not ok:

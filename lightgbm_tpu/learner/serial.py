@@ -621,14 +621,47 @@ class SerialTreeLearner:
         self.pallas = impl == "pallas"
         self._x_src = None
         # The partition-ordered grower (learner/partitioned.py) is the
-        # default serial path — no full-N work per split.  The masked
+        # exact sequential serial path — no full-N work per split.  The
+        # wave grower (learner/wave.py) trades row movement for MXU
+        # leaf-batched histogram passes and wins on TPU.  The masked
         # grower below remains for the pool-less huge-feature fallback and
         # as the shared body of the parallel strategies.
         self.partitioned = self.use_hist_pool
         forced_splits = tuple(tuple(f) for f in forced_splits)
         interaction_groups = tuple(tuple(g) for g in interaction_groups)
         feature_contri = tuple(float(v) for v in feature_contri)
-        if self.partitioned:
+        wave_ok = (self.use_hist_pool and not forced_splits and
+                   not interaction_groups and
+                   self.split_params.feature_fraction_bynode >= 1.0 and
+                   int(config.num_leaves) > 2)
+        mode = str(config.tree_grow_mode)
+        if mode == "wave" and not wave_ok:
+            from ..utils.log import log_warning
+            log_warning("tree_grow_mode=wave is incompatible with forced "
+                        "splits / interaction constraints / bynode "
+                        "sampling / pool-less growth; falling back to the "
+                        "partitioned grower")
+            mode = "partition"
+        elif mode == "auto":
+            mode = "wave" if (wave_ok and impl == "pallas") else "partition"
+        self.grow_mode = mode if self.use_hist_pool else "masked"
+        if self.grow_mode == "wave":
+            wave_size = int(config.tpu_wave_size)
+            any_cat = bool(np.any(np.asarray(is_cat)))
+            key = ("wave", int(config.num_leaves), num_features,
+                   self.max_bins, int(config.max_depth), self.split_params,
+                   impl, any_cat, wave_size, self._efb_dims, feature_contri)
+            if key not in _GROW_FN_CACHE:
+                from .wave import make_wave_grow_fn
+                _GROW_FN_CACHE[key] = make_wave_grow_fn(
+                    num_leaves=int(config.num_leaves),
+                    num_features=num_features, max_bins=self.max_bins,
+                    max_depth=int(config.max_depth),
+                    split_params=self.split_params, hist_impl=impl,
+                    any_cat=any_cat, wave_size=wave_size,
+                    efb_dims=self._efb_dims, feature_contri=feature_contri)
+            self._grow = _GROW_FN_CACHE[key]
+        elif self.partitioned:
             key = ("part", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
                    impl, forced_splits, self._efb_dims,
@@ -685,18 +718,31 @@ class SerialTreeLearner:
         else:
             n_pad = n
         if self._x_src is not X_dev:  # strong ref: ids can be recycled
-            self._Xp = jnp.pad(X_dev, ((0, n_pad - n), (0, 0))) \
+            Xp = jnp.pad(X_dev, ((0, n_pad - n), (0, 0))) \
                 if n_pad != n else X_dev
+            if self.grow_mode == "wave":
+                # only the feature-major copy is consumed; do not keep the
+                # padded row-major matrix alive next to it in HBM
+                self._XpT = jnp.asarray(jnp.swapaxes(Xp, 0, 1))
+                self._Xp = None
+            else:
+                self._Xp = Xp
             self._x_src = X_dev
         pad = n_pad - n
         if pad:
             grad = jnp.pad(grad, (0, pad))
             hess = jnp.pad(hess, (0, pad))
             sample_mask = jnp.pad(sample_mask, (0, pad))
-        grown = self._grow(self._Xp, grad, hess, sample_mask,
-                           self.num_bins, self.is_cat, self.has_nan,
-                           self.monotone, cegb_penalty, node_key,
-                           self._efb_args, feature_mask)
+        if self.grow_mode == "wave":
+            grown = self._grow(self._XpT, grad, hess, sample_mask,
+                               self.num_bins, self.is_cat, self.has_nan,
+                               self.monotone, cegb_penalty,
+                               self._efb_args, feature_mask)
+        else:
+            grown = self._grow(self._Xp, grad, hess, sample_mask,
+                               self.num_bins, self.is_cat, self.has_nan,
+                               self.monotone, cegb_penalty, node_key,
+                               self._efb_args, feature_mask)
         if pad:
             grown = grown._replace(row_leaf=grown.row_leaf[:n])
         return grown

@@ -1,0 +1,385 @@
+"""Wave grower — leaf-wise growth with NO physical row movement.
+
+The partitioned grower (learner/partitioned.py) keeps rows leaf-contiguous
+so per-split histogram work scales with the split leaf's size; the price is
+moving every row once per level it participates in (~37 ns/row via the
+1-bit-sort partition — 55-60%% of tree time at Higgs scale, PERF.md).  This
+grower removes that cost entirely by exploiting the MXU's lane dimension
+instead: the leaf-batched Pallas kernel
+(ops/histogram_pallas.py ``build_histogram_pallas_leaves``) computes
+**16 leaf histograms in one full-data pass** for the cost of one — the
+single-leaf kernel wastes 123 of the 128 output lanes of its one-hot
+contraction, so 16 leaves x 8 weight channels exactly fill the lanes.
+
+Growth proceeds in *waves*: each wave splits the top-``wave_size`` leaves
+by candidate gain (best-first, like the reference's leaf-wise ArgMax over
+best_split_per_leaf_, serial_tree_learner.cpp:194), updates the per-row
+``row_leaf`` vector with masked wheres (streaming, no gather/scatter), and
+builds the 16 SMALLER children's histograms in one kernel pass — the
+larger siblings come from the subtraction trick
+(serial_tree_learner.cpp:311-320).  Total histogram passes per tree ≈
+ceil((L-1)/16) + frontier ramp-up, independent of data size beyond the
+pass cost itself.
+
+Semantics vs the exact sequential leaf-wise order: identical while fewer
+than ``num_leaves`` leaves exist and all wave candidates have positive
+gain, EXCEPT that a wave commits its top-k splits before the children of
+those splits can compete for the budget.  With ``wave_size=1`` the grower
+reproduces the sequential order exactly (tests cross-check this); at
+wave_size=16 the tree can differ near budget exhaustion — quality parity
+is asserted by tests on held-out loss.
+
+Feature gates: forced splits, interaction constraints and by-node feature
+sampling are not traced here — SerialTreeLearner falls back to the
+partitioned grower when they are active.  EFB, monotone constraints, CEGB
+and categorical splits are fully supported.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
+from ..ops.histogram import build_histogram_leaves
+from ..ops.split import BIG, NEG_INF, leaf_output
+from .serial import CommStrategy, GrownTree, local_best_candidate
+
+__all__ = ["make_wave_grow_fn", "WAVE_SIZE"]
+
+WAVE_SIZE = 16   # == ops.histogram_pallas.LEAF_CHANNELS
+
+
+def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
+                      max_depth: int, split_params, hist_impl: str,
+                      any_cat: bool = True, interpret: bool = False,
+                      jit: bool = True, wave_size: int = WAVE_SIZE,
+                      efb_dims=None, feature_contri: tuple = ()):
+    """Build the wave single-tree grower.
+
+    Returned signature matches the partitioned grower:
+    ``grow(X_T, grad, hess, bag_mask, num_bins, is_cat, has_nan, monotone,
+    cegb_penalty, efb_arrays, feature_mask) -> GrownTree`` with X_T the
+    FEATURE-MAJOR (G, N) bin matrix (bundle-space under EFB), N a multiple
+    of the Pallas row block when hist_impl == 'pallas'.
+    """
+    L = num_leaves
+    F = num_features
+    W = max(1, min(int(wave_size), WAVE_SIZE, L - 1))
+    use_efb = efb_dims is not None
+    G, Bb = efb_dims if use_efb else (F, max_bins)
+    pallas = hist_impl == "pallas"
+    if pallas:
+        from ..ops.histogram_pallas import (build_histogram_pallas_leaves,
+                                            pack_weights8)
+
+    sp = split_params
+    use_mc = split_params.use_monotone
+
+    def grow(X_T: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+             bag_mask: jnp.ndarray, num_bins: jnp.ndarray,
+             is_cat: jnp.ndarray, has_nan: jnp.ndarray,
+             monotone: jnp.ndarray, cegb_penalty: jnp.ndarray,
+             efb_arrays: tuple, feature_mask: jnp.ndarray) -> GrownTree:
+        n = X_T.shape[1]
+        strat = CommStrategy(num_bins, is_cat, has_nan, monotone)
+        strat.cegb_full = cegb_penalty if sp.use_cegb else None
+        if feature_contri:
+            strat.contri_full = jnp.asarray(feature_contri, jnp.float32)
+        nb_full, ic_full, hn_full = num_bins, is_cat, has_nan
+
+        if use_efb:
+            exp_map, f_bundle, f_off, f_def, f_nb, f_single = efb_arrays
+
+        gm = (grad * bag_mask).astype(jnp.float32)
+        hm = (hess * bag_mask).astype(jnp.float32)
+        cnt_mask = (bag_mask > 0).astype(jnp.float32)
+        if pallas:
+            w8 = pack_weights8(grad, hess, bag_mask)
+            bins_rows = None
+        else:
+            # row-major copy made ONCE per grow call (outside the wave
+            # loop; XLA cannot hoist it out of lax.while itself)
+            bins_rows = jnp.swapaxes(X_T, 0, 1)
+
+        def hist_waves(ch):
+            """(W', G, Bb, 3) histograms of the wave's leaf channels."""
+            if pallas:
+                h = build_histogram_pallas_leaves(X_T, w8, ch, num_bins=Bb,
+                                                  interpret=interpret)
+            else:
+                h = build_histogram_leaves(
+                    bins_rows, gm, hm, cnt_mask, ch,
+                    num_channels=WAVE_SIZE, num_bins=Bb, impl=hist_impl)
+            return h[:W]
+
+        def expand_hist(hb, total):
+            """Bundle-space -> feature-space (Dataset::FixHistogram restore
+            of the default bin from leaf totals, dataset.cpp:1239)."""
+            if not use_efb:
+                return hb
+            flat = hb.reshape(G * Bb, 3)
+            e = jnp.where((exp_map >= 0)[:, :, None],
+                          flat[jnp.maximum(exp_map, 0)], 0.0)
+            fix = total[None, :] - jnp.sum(e, axis=1)
+            fixable = jnp.logical_not(f_single).astype(jnp.float32)
+            e = e.at[jnp.arange(F), f_def].add(fix * fixable[:, None])
+            return e
+
+        def feature_col(feat):
+            """FEATURE-space bin codes (N,) of one feature (decoded from
+            its bundle column under EFB)."""
+            g = f_bundle[feat] if use_efb else feat
+            v = jax.lax.dynamic_slice(X_T, (g, 0), (1, n))[0].astype(
+                jnp.int32)
+            if not use_efb:
+                return v
+            u = v - f_off[feat]
+            inr = (u >= 0) & (u < f_nb[feat] - 1)
+            mapped = jnp.where(inr, u + (u >= f_def[feat]).astype(jnp.int32),
+                               f_def[feat])
+            return jnp.where(f_single[feat], v, mapped)
+
+        def many_candidates(hists, sums, bounds, depths, k):
+            """Best-split candidates for k leaves in one vmapped scan."""
+            def one(h, s, bd, d):
+                return local_best_candidate(
+                    h, s, nb_full, ic_full, hn_full, feature_mask, sp,
+                    monotone, bd if use_mc else None, d,
+                    getattr(strat, "cegb_full", None),
+                    getattr(strat, "contri_full", None))
+            return jax.vmap(one)(hists, sums, bounds, depths)
+
+        # ---- root ----
+        root_sum = jnp.stack([jnp.sum(gm), jnp.sum(hm), jnp.sum(cnt_mask)])
+        root_hist = hist_waves(jnp.zeros((n,), jnp.int32))[0]
+        root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
+        cand = strat.leaf_candidates(expand_hist(root_hist, root_sum),
+                                     root_sum, feature_mask, sp,
+                                     root_bound, jnp.asarray(0, jnp.int32))
+
+        state = {
+            "row_leaf": jnp.zeros((n,), jnp.int32),
+            "leaf_sum": jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum),
+            "leaf_depth": jnp.zeros((L,), jnp.int32),
+            "leaf_parent": jnp.full((L,), -1, jnp.int32),
+            "cand_gain": jnp.full((L,), NEG_INF, jnp.float32).at[0].set(cand[0]),
+            "cand_feat": jnp.zeros((L,), jnp.int32).at[0].set(cand[1]),
+            "cand_bin": jnp.zeros((L,), jnp.int32).at[0].set(cand[2]),
+            "cand_dleft": jnp.zeros((L,), jnp.bool_).at[0].set(cand[3]),
+            "cand_lsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[4]),
+            "cand_rsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[5]),
+            "cand_member": jnp.zeros((L, max_bins), jnp.bool_).at[0].set(
+                cand[6]),
+            "hists": jnp.zeros((L, G, Bb, 3), jnp.float32).at[0].set(
+                root_hist),
+            "split_feature": jnp.full((L - 1,), -1, jnp.int32),
+            "threshold_bin": jnp.zeros((L - 1,), jnp.int32),
+            "nan_bin": jnp.full((L - 1,), -1, jnp.int32),
+            "cat_member": jnp.zeros((L - 1, max_bins), jnp.bool_),
+            "decision_type": jnp.zeros((L - 1,), jnp.int32),
+            "left_child": jnp.zeros((L - 1,), jnp.int32),
+            "right_child": jnp.zeros((L - 1,), jnp.int32),
+            "split_gain": jnp.zeros((L - 1,), jnp.float32),
+            "internal_value": jnp.zeros((L - 1,), jnp.float32),
+            "internal_weight": jnp.zeros((L - 1,), jnp.float32),
+            "internal_count": jnp.zeros((L - 1,), jnp.float32),
+            "leaf_value": jnp.zeros((L,), jnp.float32).at[0].set(
+                leaf_output(root_sum[0], root_sum[1], sp)),
+            "leaf_weight": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[1]),
+            "leaf_count": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[2]),
+            "num_leaves": jnp.asarray(1, jnp.int32),
+            "done": jnp.asarray(False),
+        }
+        if use_mc:
+            state["leaf_mn"] = jnp.full((L,), -BIG, jnp.float32)
+            state["leaf_mx"] = jnp.full((L,), BIG, jnp.float32)
+
+        jarange = jnp.arange(W, dtype=jnp.int32)
+
+        def body(s):
+            nl0 = s["num_leaves"]
+            budget = L - nl0
+            # Endgame taper: committing a full wave close to the leaf
+            # budget would lock in splits that freshly-created children
+            # (whose gains are not yet known) should have outcompeted —
+            # the sequential best-first order lets them.  Halving the wave
+            # once budget < 2W adds only ~log2(W) extra waves and closes
+            # most of the quality gap to the exact order.
+            k_eff = jnp.minimum(W, jnp.maximum(
+                1, jnp.where(budget >= 2 * W, budget, budget // 2)))
+            vals, sel_leaves = jax.lax.top_k(s["cand_gain"], W)
+            sel = (vals > 0) & (jarange < k_eff)
+            prefix = jnp.cumsum(sel.astype(jnp.int32))
+            total_new = prefix[-1]
+            new_ids = nl0 + prefix - 1                     # valid where sel
+            node_ids = (nl0 - 1) + prefix - 1              # node index
+
+            feat = s["cand_feat"][sel_leaves]              # (W,)
+            thr = s["cand_bin"][sel_leaves]
+            dleft = s["cand_dleft"][sel_leaves]
+            lsum = s["cand_lsum"][sel_leaves]              # (W, 3)
+            rsum = s["cand_rsum"][sel_leaves]
+            member = s["cand_member"][sel_leaves]          # (W, B)
+            psum_ = s["leaf_sum"][sel_leaves]
+            left_smaller = lsum[:, 2] <= rsum[:, 2]        # (W,)
+            fcat = ic_full[feat]
+            fnan = hn_full[feat]
+            f_nan_bin = jnp.where(fnan, nb_full[feat] - 1, -1)
+
+            # ---- row_leaf + wave-channel update: W streaming passes ----
+            rl = s["row_leaf"]
+            ch = jnp.full((n,), -1, jnp.int32)
+            for j in range(W):
+                col = feature_col(feat[j])
+                if any_cat:
+                    go_left = jnp.where(
+                        fcat[j], member[j][col],
+                        jnp.where(col == f_nan_bin[j], dleft[j],
+                                  col <= thr[j]))
+                else:
+                    go_left = jnp.where(col == f_nan_bin[j], dleft[j],
+                                        col <= thr[j])
+                upd = sel[j] & (rl == sel_leaves[j])
+                ch = jnp.where(upd & (go_left == left_smaller[j]), j, ch)
+                rl = jnp.where(upd & jnp.logical_not(go_left), new_ids[j],
+                               rl)
+
+            # ---- one kernel pass: all W smaller-child histograms ----
+            hist_small = hist_waves(ch)                    # (W, G, Bb, 3)
+            parents = s["hists"][sel_leaves]
+            hist_big = parents - hist_small
+            ls4 = left_smaller[:, None, None, None]
+            hist_l = jnp.where(ls4, hist_small, hist_big)
+            hist_r = jnp.where(ls4, hist_big, hist_small)
+
+            # ---- monotone bounds (BasicLeafConstraints::Update) ----
+            if use_mc:
+                p_mn = s["leaf_mn"][sel_leaves]
+                p_mx = s["leaf_mx"][sel_leaves]
+                out_l = jnp.clip(leaf_output(lsum[:, 0], lsum[:, 1], sp),
+                                 p_mn, p_mx)
+                out_r = jnp.clip(leaf_output(rsum[:, 0], rsum[:, 1], sp),
+                                 p_mn, p_mx)
+                m = jnp.where(fcat, 0, monotone[feat])
+                mid = (out_l + out_r) / 2.0
+                mn_l = jnp.where(m < 0, jnp.maximum(p_mn, mid), p_mn)
+                mx_l = jnp.where(m > 0, jnp.minimum(p_mx, mid), p_mx)
+                mn_r = jnp.where(m > 0, jnp.maximum(p_mn, mid), p_mn)
+                mx_r = jnp.where(m < 0, jnp.minimum(p_mx, mid), p_mx)
+                bounds2 = jnp.concatenate([
+                    jnp.stack([mn_l, mx_l], axis=1),
+                    jnp.stack([mn_r, mx_r], axis=1)])       # (2W, 2)
+            else:
+                bounds2 = jnp.zeros((2 * W, 2), jnp.float32)
+
+            # ---- children candidates: one vmapped scan over 2W ----
+            child_depth = s["leaf_depth"][sel_leaves] + 1
+            hists2 = jnp.concatenate([hist_l, hist_r])      # (2W, G, Bb, 3)
+            sums2 = jnp.concatenate([lsum, rsum])
+            totals2 = sums2
+            ex2 = jax.vmap(expand_hist)(hists2, totals2)
+            depth2 = jnp.concatenate([child_depth, child_depth])
+            cands = many_candidates(ex2, sums2, bounds2, depth2, 2 * W)
+            depth_ok = jnp.logical_or(max_depth <= 0, child_depth < max_depth)
+            dok2 = jnp.concatenate([depth_ok, depth_ok])
+            cg = jnp.where(dok2 & jnp.concatenate([sel, sel]), cands[0],
+                           NEG_INF)
+
+            # ---- scatter state updates (invalid lanes -> dropped) ----
+            idx_l = jnp.where(sel, sel_leaves, L)
+            idx_r = jnp.where(sel, new_ids, L)
+            idx2 = jnp.concatenate([idx_l, idx_r])
+
+            def sc2(arr, val2):
+                return arr.at[idx2].set(val2, mode="drop")
+
+            out = dict(s)
+            out["row_leaf"] = rl
+            out["hists"] = s["hists"].at[idx_l].set(
+                hist_l, mode="drop").at[idx_r].set(hist_r, mode="drop")
+            out["leaf_sum"] = sc2(s["leaf_sum"], sums2)
+            out["leaf_depth"] = sc2(s["leaf_depth"], depth2)
+            node2 = jnp.concatenate([node_ids, node_ids])
+            out["leaf_parent"] = sc2(s["leaf_parent"], node2)
+            out["cand_gain"] = sc2(s["cand_gain"], cg)
+            out["cand_feat"] = sc2(s["cand_feat"], cands[1])
+            out["cand_bin"] = sc2(s["cand_bin"], cands[2])
+            out["cand_dleft"] = sc2(s["cand_dleft"], cands[3])
+            out["cand_lsum"] = sc2(s["cand_lsum"], cands[4])
+            out["cand_rsum"] = sc2(s["cand_rsum"], cands[5])
+            out["cand_member"] = sc2(s["cand_member"], cands[6])
+            if use_mc:
+                out["leaf_mn"] = sc2(s["leaf_mn"],
+                                     jnp.concatenate([mn_l, mn_r]))
+                out["leaf_mx"] = sc2(s["leaf_mx"],
+                                     jnp.concatenate([mx_l, mx_r]))
+                lv2 = jnp.concatenate([out_l, out_r])
+            else:
+                lv2 = leaf_output(sums2[:, 0], sums2[:, 1], sp)
+            out["leaf_value"] = sc2(s["leaf_value"], lv2)
+            out["leaf_weight"] = sc2(s["leaf_weight"], sums2[:, 1])
+            out["leaf_count"] = sc2(s["leaf_count"], sums2[:, 2])
+
+            # ---- tree node records ----
+            nidx = jnp.where(sel, node_ids, L - 1)
+            dleft_rec = jnp.where(fcat, member[:, 0], dleft)
+            dt_bits = (jnp.where(fcat, CAT_MASK, 0) |
+                       jnp.where(dleft_rec, DEFAULT_LEFT_MASK, 0) |
+                       jnp.where(fnan & jnp.logical_not(fcat), MISSING_NAN, 0)
+                       ).astype(jnp.int32)
+
+            def scn(arr, val):
+                return arr.at[nidx].set(val, mode="drop")
+
+            out["split_feature"] = scn(s["split_feature"], feat)
+            out["threshold_bin"] = scn(s["threshold_bin"], thr)
+            out["nan_bin"] = scn(s["nan_bin"], f_nan_bin)
+            out["cat_member"] = scn(s["cat_member"], member)
+            out["decision_type"] = scn(s["decision_type"], dt_bits)
+            out["split_gain"] = scn(s["split_gain"], vals)
+            out["internal_value"] = scn(
+                s["internal_value"], leaf_output(psum_[:, 0], psum_[:, 1], sp))
+            out["internal_weight"] = scn(s["internal_weight"], psum_[:, 1])
+            out["internal_count"] = scn(s["internal_count"], psum_[:, 2])
+
+            # patch parent nodes' child slots pointing at the split leaves
+            # (encoded as -(leaf+1)), then write the new nodes' own slots
+            enc = -(sel_leaves + 1)
+            for name in ("left_child", "right_child"):
+                arr = s[name]
+                match = (arr[:, None] == enc[None, :]) & sel[None, :]
+                has = jnp.any(match, axis=1)
+                pick = jnp.argmax(match, axis=1)
+                arr = jnp.where(has, node_ids[pick], arr)
+                if name == "left_child":
+                    arr = arr.at[nidx].set(enc, mode="drop")
+                else:
+                    arr = arr.at[nidx].set(-(new_ids + 1), mode="drop")
+                out[name] = arr
+
+            out["num_leaves"] = nl0 + total_new
+            out["done"] = total_new == 0
+            return out
+
+        def cond(s):
+            return jnp.logical_not(s["done"]) & (s["num_leaves"] < L)
+
+        s = jax.lax.while_loop(cond, body, state)
+
+        return GrownTree(
+            split_feature=s["split_feature"],
+            threshold_bin=s["threshold_bin"],
+            nan_bin=s["nan_bin"], cat_member=s["cat_member"],
+            decision_type=s["decision_type"],
+            left_child=s["left_child"], right_child=s["right_child"],
+            split_gain=s["split_gain"], internal_value=s["internal_value"],
+            internal_weight=s["internal_weight"],
+            internal_count=s["internal_count"], leaf_value=s["leaf_value"],
+            leaf_weight=s["leaf_weight"], leaf_count=s["leaf_count"],
+            num_leaves=s["num_leaves"], row_leaf=s["row_leaf"])
+
+    return jax.jit(grow) if jit else grow

@@ -36,7 +36,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["build_histogram", "histogram_subtract", "split_hi_lo"]
+__all__ = ["build_histogram", "build_histogram_leaves", "histogram_subtract",
+           "split_hi_lo"]
 
 
 def split_hi_lo(v: jnp.ndarray):
@@ -139,6 +140,67 @@ def build_histogram(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     init = jnp.zeros((f, num_bins, 3), dtype=jnp.float32)
     hist, _ = jax.lax.scan(scan_body, init, (bins_c, w_c))
     return hist
+
+
+@functools.partial(jax.jit, static_argnames=("num_channels", "num_bins",
+                                             "impl"))
+def build_histogram_leaves(bins: jnp.ndarray, grad: jnp.ndarray,
+                           hess: jnp.ndarray, mask: jnp.ndarray,
+                           ch: jnp.ndarray, *, num_channels: int,
+                           num_bins: int, impl: str = "auto") -> jnp.ndarray:
+    """(K, F, B, 3) histograms of K leaf channels in one logical pass.
+
+    Portable counterpart of ``build_histogram_pallas_leaves``: rows carry a
+    leaf-channel id ``ch`` in [0, K) (or -1 = skip).  The ``segment`` path
+    folds the channel into the scatter index; the ``onehot`` path loops the
+    K channels (still one XLA program).  Used by the wave grower
+    (learner/wave.py) off-TPU and in tests.
+    """
+    if impl == "auto":
+        impl = _auto_impl()
+    n, f = bins.shape
+    k = num_channels
+    w = jnp.stack([grad * mask, hess * mask, mask], axis=-1)      # (N, 3)
+    if impl == "segment":
+        def chunk_hist(bins_c, w_c, ch_c):
+            m = bins_c.shape[0]
+            ids = (ch_c.astype(jnp.int32)[:, None] * f +
+                   jnp.arange(f, dtype=jnp.int32)[None, :]) * num_bins + \
+                bins_c.astype(jnp.int32)
+            ids = jnp.where(ch_c[:, None] >= 0, ids, k * f * num_bins)
+            flat = jnp.zeros((k * f * num_bins, 3), dtype=jnp.float32)
+            upd = jnp.broadcast_to(w_c[:, None, :], (m, f, 3)).reshape(-1, 3)
+            return flat.at[ids.reshape(-1)].add(
+                upd, mode="drop").reshape(k, f, num_bins, 3)
+
+        # bound the (rows, F, 3) updates tensor like build_histogram does
+        rows_per_chunk = max(256, int((64 << 20) / 12 / max(1, f)))
+        if n <= rows_per_chunk:
+            return chunk_hist(bins, w, ch)
+        num_chunks = -(-n // rows_per_chunk)
+        pad = num_chunks * rows_per_chunk - n
+        bins_p = jnp.pad(bins, ((0, pad), (0, 0)))
+        w_p = jnp.pad(w, ((0, pad), (0, 0)))
+        ch_p = jnp.pad(ch, (0, pad), constant_values=-1)
+
+        def scan_body(acc, c):
+            b_, w_, c_ = c
+            return acc + chunk_hist(b_, w_, c_), None
+
+        init = jnp.zeros((k, f, num_bins, 3), dtype=jnp.float32)
+        hist, _ = jax.lax.scan(
+            scan_body, init,
+            (bins_p.reshape(num_chunks, rows_per_chunk, f),
+             w_p.reshape(num_chunks, rows_per_chunk, 3),
+             ch_p.reshape(num_chunks, rows_per_chunk)))
+        return hist
+
+    def one(c):
+        m = mask * (ch == c).astype(jnp.float32)
+        return build_histogram(bins, grad, hess, m, num_bins=num_bins,
+                               impl=impl)
+
+    return jnp.stack([one(c) for c in range(k)])
 
 
 def histogram_subtract(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:

@@ -40,10 +40,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["build_histogram_pallas", "DEFAULT_ROW_BLOCK", "pad_rows"]
+__all__ = ["build_histogram_pallas", "build_histogram_pallas_leaves",
+           "pack_weights8", "DEFAULT_ROW_BLOCK", "pad_rows", "LEAF_CHANNELS"]
 
 DEFAULT_ROW_BLOCK = 4096
 _C = 8  # weight channels (5 used), padded to a power of two for clean tiles
+LEAF_CHANNELS = 16  # leaves per pass in the leaf-batched kernel (16*_C = 128)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -189,3 +191,154 @@ def build_histogram_pallas(bins_t: jnp.ndarray, grad: jnp.ndarray,
                       out[:, :, 2] + out[:, :, 3],
                       out[:, :, 4]], axis=-1)
     return hist[:f, :num_bins, :]
+
+
+# ---------------------------------------------------------------------------
+# Leaf-channel batched kernel: 16 leaf histograms per pass.
+#
+# The single-leaf kernel above uses only 5 of the MXU's 128 output lanes
+# (the one-hot contraction's N dimension); the systolic array computes the
+# other 123 for free.  This variant packs LEAF_CHANNELS=16 leaves x 8 weight
+# channels into the lane dimension: each row carries a leaf-channel id
+# ``ch`` in [0, 16) (or -1 = inactive), the kernel expands the row's 8-wide
+# weight vector into the 8 lanes of its leaf's lane-block, and ONE
+# contraction per row block accumulates all 16 histograms.  A tree grower
+# that batches 16 splits per wave (learner/wave.py) gets its 16 smaller-child
+# histograms for the price of one full pass — which removes the need to
+# physically partition rows at all (PERF.md round-3 analysis: row movement
+# was 55-60%% of tree time).
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def pack_weights8(grad: jnp.ndarray, hess: jnp.ndarray,
+                  mask: jnp.ndarray) -> jnp.ndarray:
+    """(N, 8) bf16 weight rows [g_hi, g_lo, h_hi, h_lo, count, 0, 0, 0].
+
+    Precompute once per tree: gradients do not change across waves, only
+    the per-row leaf channel does.  ``mask`` may carry bagging weights
+    (GOSS amplification) — they scale grad/hess, while the count channel
+    is strictly 0/1 row membership (reference counts rows, not weights).
+    """
+    gm = grad * mask
+    hm = hess * mask
+    g_hi, g_lo = _split_hi_lo(gm)
+    h_hi, h_lo = _split_hi_lo(hm)
+    z = jnp.zeros_like(g_hi)
+    return jnp.stack([g_hi, g_lo, h_hi, h_lo,
+                      (mask > 0).astype(jnp.bfloat16), z, z, z], axis=-1)
+
+
+def _hist_leaves_kernel(bins_ref, w_ref, ch_ref, out_ref, *,
+                        num_features: int, num_bins: int, group: int,
+                        fstep: int):
+    """Accumulate (F*B, 16*8) histograms over one row block."""
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    w = w_ref[...]                      # (R, 8) bf16
+    ch = ch_ref[...]                    # (R, 1) int32
+    r = w.shape[0]
+    b = num_bins
+
+    # Expand (R, 8) weights into (R, 128): lane l carries weight channel
+    # l%8 iff this row's leaf channel == l//8.  All arithmetic — Mosaic
+    # cannot relayout i1 masks between lane-/sublane-replicated operands,
+    # so the equality select is ``relu(1 - |ch - leaf_of_lane|)`` (exactly
+    # 1.0 on match, 0.0 otherwise for integer distances) and the channel
+    # tiling is a lane concatenate.  Pure VPU work, no gather.
+    lane = jax.lax.broadcasted_iota(jnp.int32, (r, 128), 1)
+    leaf_of_lane = lane // _C
+    d = (ch - leaf_of_lane).astype(jnp.float32)     # (R, 128) via broadcast
+    sel = jnp.maximum(0.0, 1.0 - jnp.abs(d)).astype(jnp.bfloat16)
+    wtile = jnp.concatenate([w] * (128 // _C), axis=1)          # (R, 128)
+    w128 = wtile * sel
+
+    iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, r), 0) % b
+
+    def do(i, carry):
+        f0 = i * fstep
+        cols_blk = bins_ref[pl.ds(f0, fstep), :].astype(jnp.int32)
+        for k in range(fstep // group):
+            cols = cols_blk[k * group:(k + 1) * group]           # (g, R)
+            colrep = jnp.repeat(cols, b, axis=0)                 # (g*B, R)
+            onehot = (colrep == iota_gb).astype(jnp.bfloat16)
+            part = jax.lax.dot_general(
+                onehot, w128, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)              # (g*B, 128)
+            out_ref[pl.ds((f0 + k * group) * b, group * b)] += part
+        return carry
+
+    jax.lax.fori_loop(0, num_features // fstep, do, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "row_block", "interpret"))
+def build_histogram_pallas_leaves(bins_t: jnp.ndarray, w8: jnp.ndarray,
+                                  ch: jnp.ndarray, *, num_bins: int,
+                                  row_block: int = DEFAULT_ROW_BLOCK,
+                                  interpret: bool = False) -> jnp.ndarray:
+    """(16, F, B, 3) histograms of 16 leaf channels in one pass.
+
+    Args:
+      bins_t: (F, N) integer bin codes, N a multiple of ``row_block``.
+      w8: (N, 8) bf16 weight rows from :func:`pack_weights8`.
+      ch: (N,) int32 leaf channel in [0, 16), or -1 for rows that belong to
+        no batched leaf (they contribute nothing).
+      num_bins: static global bin count B.
+    """
+    f, n = bins_t.shape
+    if n % row_block != 0:
+        raise ValueError(f"pallas histogram needs N % {row_block} == 0, "
+                         f"got N={n} (use pad_rows)")
+    b = _round_up(num_bins, 64)
+    group = next((g for g in (2, 4, 8) if (g * b) % 128 == 0), 1)
+    while group * 2 <= f and group * 2 * b <= 512:
+        group *= 2
+    if group > f or (group * b) % 128 != 0:
+        b = _round_up(num_bins, 128)
+        group = 1
+
+    ch2 = ch.astype(jnp.int32)[:, None]                    # (N, 1)
+
+    # The (ft*b, 128) f32 accumulator must stay well inside VMEM next to
+    # the bins / weight blocks; 8192 sublanes (4 MiB) measured best at
+    # Higgs scale (one feature tile for F=28/B=256: 229 ms vs 257 ms with
+    # two tiles; kr/group sweeps were flat within 15%).
+    fstep = max(group, 8)
+    ft_cap = max(fstep, 8192 // b // fstep * fstep)
+    ft = min(_round_up(f, fstep), ft_cap)
+    f_pad = _round_up(f, ft)
+    if f_pad != f:
+        bins_t = jnp.pad(bins_t, ((0, f_pad - f), (0, 0)))
+    kr = math.gcd(row_block, 1024)
+
+    grid = (f_pad // ft, n // kr)
+    out = pl.pallas_call(
+        functools.partial(_hist_leaves_kernel, num_features=ft, num_bins=b,
+                          group=group, fstep=fstep),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ft, kr), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kr, _C), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kr, 1), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f_pad * b, 128), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * f_pad * b * n * 128,
+            bytes_accessed=f_pad * n + n * (_C * 2 + 4) + f_pad * b * 512,
+            transcendentals=0),
+        interpret=interpret,
+    )(bins_t, w8, ch2)
+
+    out = out.reshape(f_pad, b, LEAF_CHANNELS, _C)
+    hist = jnp.stack([out[..., 0] + out[..., 1],
+                      out[..., 2] + out[..., 3],
+                      out[..., 4]], axis=-1)              # (F, B, 16, 3)
+    return jnp.transpose(hist, (2, 0, 1, 3))[:, :f, :num_bins, :]

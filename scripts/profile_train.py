@@ -43,7 +43,8 @@ def main():
     mark("data generated")
 
     params = {"objective": "binary", "num_leaves": leaves, "max_bin": bins,
-              "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1}
+              "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1,
+              "tree_grow_mode": os.environ.get("GROW_MODE", "auto")}
     ds = lgb.Dataset(X, y, params=params)
     from lightgbm_tpu.config import Config
     ds.construct(Config(params))

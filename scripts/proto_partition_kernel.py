@@ -1,0 +1,154 @@
+"""Prototype: Pallas per-block permutation-matmul row compaction.
+
+Replaces the 1-bit lax.sort in the partitioned grower's stage pass.  A
+stable lefts/rights/invalid partition of an R-row block is a permutation;
+applied as a one-hot (R, R) @ (R, W) bf16 matmul it rides the MXU and the
+permutation matrix never leaves VMEM (the XLA formulation materializes it
+in HBM and is no faster than the sort — scripts/time_partition.py).
+
+Pipeline per chunk: XLA computes go_left + within-block destinations
+(cheap streaming cumsums), the kernel permutes each block, XLA coalesces
+the per-block runs with the staged-write trick already used by the grower.
+"""
+import os
+import sys
+import time
+import functools
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 1 << 20
+W = 48
+rng = np.random.RandomState(0)
+P_np = rng.randint(0, 255, (N, W)).astype(np.uint8)
+key_np = (rng.rand(N) < 0.47)
+valid_np = np.ones(N, bool)
+valid_np[rng.rand(N) < 0.1] = False
+
+
+def _force(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    return float(jnp.asarray(leaves[0]).ravel()[-1])
+
+
+def timeit(name, fn, *args, reps=5):
+    _force(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _force(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:44s} {dt*1e3:8.2f} ms   {dt/N*1e9:6.1f} ns/row")
+    return out
+
+
+def _permute_kernel(dest_ref, rows_ref, out_ref, *, r: int):
+    dest = dest_ref[...]                      # (R, 1) int32
+    rows = rows_ref[...].astype(jnp.int32).astype(jnp.bfloat16)  # (R, W)
+    # perm[d, s] = 1 iff dest[s] == d ; arithmetic (no i1 relayout)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (r, r), 0)      # d index
+    d = (dest[:, 0][None, :] - iota).astype(jnp.float32)       # (d, s)
+    perm = jnp.maximum(0.0, 1.0 - jnp.abs(d)).astype(jnp.bfloat16)
+    out = jax.lax.dot_general(perm, rows, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    out_ref[...] = out.astype(jnp.int32).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("r",))
+def permute_blocks(P, dest, *, r=512):
+    """Apply within-block permutation dest over blocks of r rows."""
+    n, w = P.shape
+    grid = (n // r,)
+    return pl.pallas_call(
+        functools.partial(_permute_kernel, r=r),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((r, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((r, w), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r, w), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, w), jnp.uint8),
+    )(dest[:, None], P)
+
+
+@functools.partial(jax.jit, static_argnames=("r",))
+def kernel_partition(P, gl, valid, *, r=512):
+    """Full stage-pass equivalent: per-block compact + staged coalesce.
+    Returns (Lb, Rb, nl) like the grower's stage pass (lefts at [0, nl) of
+    Lb, rights at [0, nr) of Rb)."""
+    n, w = P.shape
+    nb = n // r
+    glb = gl.reshape(nb, r)
+    vb = valid.reshape(nb, r)
+    l_ = (glb & vb)
+    r_ = ((~glb) & vb)
+    cl = jnp.cumsum(l_.astype(jnp.int32), axis=1)
+    cr = jnp.cumsum(r_.astype(jnp.int32), axis=1)
+    ci = jnp.cumsum((~vb).astype(jnp.int32), axis=1)
+    nl = cl[:, -1]
+    nr = cr[:, -1]
+    dest = jnp.where(l_, cl - 1,
+                     jnp.where(r_, nl[:, None] + cr - 1,
+                               (nl + nr)[:, None] + ci - 1))
+    comp = permute_blocks(P, dest.reshape(n), r=r)
+    comp = comp.reshape(nb, r, w)
+
+    offl = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(nl)])
+    offr = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(nr)])
+    Lb = jnp.zeros((n + r, w), jnp.uint8)
+    Rb = jnp.zeros((n + 2 * r, w), jnp.uint8)
+
+    def body(i, carry):
+        Lb, Rb = carry
+        blk = comp[i]
+        Lb = jax.lax.dynamic_update_slice(Lb, blk, (offl[i], 0))
+        Rb = jax.lax.dynamic_update_slice(
+            Rb, blk, (offr[i] - nl[i] + r, 0))
+        return Lb, Rb
+
+    Lb, Rb = jax.lax.fori_loop(0, nb, body, (Lb, Rb))
+    return Lb, Rb, offl[-1], offr[-1]
+
+
+@jax.jit
+def sort_partition(P, gl, valid):
+    key = jnp.where(gl & valid, 0, jnp.where(valid, 1, 2))
+    cols = jax.lax.bitcast_convert_type(P.reshape(N, W // 4, 4), jnp.int32)
+    ops = [key] + [cols[:, k] for k in range(W // 4)]
+    out = jax.lax.sort(ops, dimension=0, is_stable=True, num_keys=1)
+    return jax.lax.bitcast_convert_type(
+        jnp.stack(out[1:], axis=1), jnp.uint8).reshape(N, W)
+
+
+def main():
+    P = jnp.asarray(P_np)
+    gl = jnp.asarray(key_np)
+    valid = jnp.asarray(valid_np)
+    timeit("lax.sort 3-way (current)", sort_partition, P, gl, valid)
+    for r in (256, 512, 1024):
+        timeit(f"pallas permute r={r} (kernel only)",
+               lambda P, d=None, rr=r: permute_blocks(
+                   P, jnp.zeros(N, jnp.int32) +
+                   jnp.tile(jnp.arange(rr, dtype=jnp.int32), N // rr), r=rr),
+               P)
+    for r in (256, 512, 1024):
+        timeit(f"kernel partition full r={r}",
+               functools.partial(kernel_partition, r=r), P, gl, valid)
+
+    s = np.asarray(sort_partition(P, gl, valid))
+    Lb, Rb, nl, nr = kernel_partition(P, gl, valid, r=512)
+    nl, nr = int(nl), int(nr)
+    got = np.concatenate([np.asarray(Lb[:nl]), np.asarray(Rb[:nr])])
+    np.testing.assert_array_equal(s[:nl + nr], got)
+    print("kernel partition matches lax.sort (valid prefix)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""Microbench: two-way stable partition of packed rows — 1-bit lax.sort
+(the current partitioned-grower primitive) vs one-hot MXU matmul compaction.
+
+A stable lefts-first partition of a row chunk is a permutation; a
+permutation of rows is a one-hot (R, R) @ (R, W) matmul that rides the MXU
+— bf16 is exact for byte payloads (integers <= 256) and one-hot factors.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1 << 20          # one bulk chunk of the partitioned grower
+W = 48
+rng = np.random.RandomState(0)
+P_np = rng.randint(0, 255, (N, W)).astype(np.uint8)
+key_np = (rng.rand(N) < 0.47)
+
+
+def _force(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    return float(jnp.asarray(leaves[0]).ravel()[-1])
+
+
+def timeit(name, fn, *args, reps=5):
+    _force(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _force(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:42s} {dt*1e3:8.2f} ms   {dt/N*1e9:6.1f} ns/row")
+    return out
+
+
+@jax.jit
+def sort_partition(P, gl):
+    """Current primitive: stable 1-bit-key multi-operand sort."""
+    key = jnp.where(gl, 0, 1).astype(jnp.int32)
+    cols = jax.lax.bitcast_convert_type(P.reshape(N, W // 4, 4), jnp.int32)
+    ops = [key] + [cols[:, k] for k in range(W // 4)]
+    out = jax.lax.sort(ops, dimension=0, is_stable=True, num_keys=1)
+    return jax.lax.bitcast_convert_type(
+        jnp.stack(out[1:], axis=1), jnp.uint8).reshape(N, W)
+
+
+def matmul_partition(sub):
+    """One-hot permutation matmul over (nb, R, W) sub-chunks."""
+    @jax.jit
+    def f(P, gl):
+        R = sub
+        nb = N // R
+        Pb = P.reshape(nb, R, W).astype(jnp.bfloat16)
+        glb = gl.reshape(nb, R)
+        cl = jnp.cumsum(glb.astype(jnp.int32), axis=1)
+        nl = cl[:, -1:]
+        cr = jnp.cumsum((~glb).astype(jnp.int32), axis=1)
+        dest = jnp.where(glb, cl - 1, nl + cr - 1)          # (nb, R)
+        iota = jnp.arange(R, dtype=jnp.int32)
+        perm = (dest[:, None, :] == iota[None, :, None]).astype(jnp.bfloat16)
+        out = jax.lax.dot_general(
+            perm, Pb, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)             # (nb, R, W)
+        return out.astype(jnp.uint8), nl[:, 0]
+    return f
+
+
+@jax.jit
+def matmul_partition_scan(P, gl):
+    """Matmul compaction + sequential coalesce into one staging buffer
+    (the full replacement for sort_partition: output is globally
+    lefts-first compacted, like the sort)."""
+    R = 1024
+    nb = N // R
+    Pb = P.reshape(nb, R, W).astype(jnp.bfloat16)
+    glb = gl.reshape(nb, R)
+    cl = jnp.cumsum(glb.astype(jnp.int32), axis=1)
+    nl = cl[:, -1]
+    cr = jnp.cumsum((~glb).astype(jnp.int32), axis=1)
+    dest = jnp.where(glb, cl - 1, nl[:, None] + cr - 1)
+    iota = jnp.arange(R, dtype=jnp.int32)
+    perm = (dest[:, None, :] == iota[None, :, None]).astype(jnp.bfloat16)
+    comp = jax.lax.dot_general(
+        perm, Pb, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(jnp.uint8)
+    # coalesce: lefts ascending into L buffer, rights ascending into R buffer
+    offl = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(nl)])[:-1]
+    offr = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                            jnp.cumsum(R - nl)])[:-1]
+    Lb = jnp.zeros((N + R, W), jnp.uint8)
+    Rb = jnp.zeros((N + R, W), jnp.uint8)
+
+    def body(i, carry):
+        Lb, Rb = carry
+        blk = comp[i]
+        Lb = jax.lax.dynamic_update_slice(Lb, blk, (offl[i], 0))
+        # right rows start at local nl[i]; store the whole block so its
+        # rights land at offr[i] (garbage head/tail overwritten by
+        # neighbors, same trick as the grower's staging)
+        Rb = jax.lax.dynamic_update_slice(Rb, blk, (offr[i] + R - nl[i], 0))
+        return Lb, Rb
+
+    Lb, Rb = jax.lax.fori_loop(0, nb, body, (Lb, Rb))
+    return Lb, Rb, jnp.sum(nl)
+
+
+def main():
+    P = jnp.asarray(P_np)
+    gl = jnp.asarray(key_np)
+    timeit("lax.sort 1-bit key (current)", sort_partition, P, gl)
+    for sub in (256, 512, 1024, 2048):
+        timeit(f"matmul compact sub={sub} (no coalesce)",
+               matmul_partition(sub), P, gl)
+    timeit("matmul compact + coalesce (full)", matmul_partition_scan, P, gl)
+
+    # correctness: full pipeline vs sort
+    s = np.asarray(sort_partition(P, gl))
+    Lb, Rb, nl = matmul_partition_scan(P, gl)
+    nl = int(nl)
+    got = np.concatenate([np.asarray(Lb[:nl]), np.asarray(Rb[:N - nl])])
+    np.testing.assert_array_equal(s, got)
+    print("full-pipeline output matches lax.sort")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Wave grower tests (learner/wave.py).
+
+The wave grower must (a) reproduce the exact sequential leaf-wise order at
+wave_size=1, (b) stay quality-par at wave_size=16, and (c) support the
+same feature set as the partitioned grower minus the gated ones (forced
+splits / interaction constraints / bynode), incl. EFB, categoricals,
+monotone constraints and GOSS."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import lightgbm_tpu as lgb
+
+
+def _binary(n=4000, f=8, seed=0, nan_frac=0.05):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    if nan_frac:
+        X[rng.rand(n, f) < nan_frac] = np.nan
+    w = rng.randn(f)
+    y = ((np.nan_to_num(X) @ w + 0.5 * rng.randn(n)) > 0).astype(np.float64)
+    return X, y
+
+
+def _logloss(y, p):
+    p = np.clip(p, 1e-9, 1 - 1e-9)
+    return -np.mean(y * np.log(p) + (1 - y) * np.log(1 - p))
+
+
+def _params(mode, wave=16, **kw):
+    p = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
+         "learning_rate": 0.2, "verbosity": -1, "min_data_in_leaf": 20,
+         "tree_grow_mode": mode, "tpu_wave_size": wave}
+    p.update(kw)
+    return p
+
+
+def test_wave1_matches_sequential_exactly():
+    X, y = _binary()
+    pred_p = lgb.train(_params("partition"), lgb.Dataset(X, y),
+                       num_boost_round=6).predict(X)
+    pred_w = lgb.train(_params("wave", wave=1), lgb.Dataset(X, y),
+                       num_boost_round=6).predict(X)
+    np.testing.assert_allclose(pred_w, pred_p, atol=2e-4)
+
+
+def test_wave16_quality_parity():
+    X, y = _binary()
+    ll_p = _logloss(y, lgb.train(_params("partition"), lgb.Dataset(X, y),
+                                 num_boost_round=10).predict(X))
+    ll_w = _logloss(y, lgb.train(_params("wave"), lgb.Dataset(X, y),
+                                 num_boost_round=10).predict(X))
+    assert ll_w < ll_p * 1.05 + 1e-3
+
+
+def test_wave_regression_and_bagging():
+    rng = np.random.RandomState(1)
+    X = rng.randn(3000, 6).astype(np.float32)
+    y = X[:, 0] * 2 - X[:, 1] + 0.3 * rng.randn(3000)
+    p = _params("wave", objective="regression", metric="l2",
+                bagging_fraction=0.7, bagging_freq=1)
+    bst = lgb.train(p, lgb.Dataset(X, y), num_boost_round=15)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.5 * float(np.var(y))
+
+
+def test_wave_goss():
+    X, y = _binary(nan_frac=0)
+    p = _params("wave", boosting="goss")
+    bst = lgb.train(p, lgb.Dataset(X, y), num_boost_round=10)
+    assert _logloss(y, bst.predict(X)) < 0.6
+
+
+def test_wave_categorical():
+    rng = np.random.RandomState(3)
+    n = 3000
+    c = rng.randint(0, 12, n)
+    x1 = rng.randn(n)
+    y = (np.isin(c, [1, 3, 7]).astype(float) * 2 + x1 +
+         0.2 * rng.randn(n) > 1).astype(np.float64)
+    X = np.stack([c.astype(np.float32), x1.astype(np.float32)], 1)
+    p = _params("wave", max_cat_to_onehot=4)
+    bst = lgb.train(p, lgb.Dataset(X, y, categorical_feature=[0]),
+                    num_boost_round=10)
+    assert _logloss(y, bst.predict(X)) < 0.35
+
+
+def test_wave_monotone():
+    rng = np.random.RandomState(4)
+    n = 2000
+    x0 = rng.rand(n)
+    x1 = rng.rand(n)
+    y = 5 * x0 + np.sin(10 * np.pi * x0) + 3 * x1 + 0.1 * rng.randn(n)
+    X = np.stack([x0, x1], 1).astype(np.float32)
+    p = _params("wave", objective="regression",
+                monotone_constraints=[1, 0], learning_rate=0.1)
+    bst = lgb.train(p, lgb.Dataset(X, y), num_boost_round=20)
+    grid = np.linspace(0, 1, 101)
+    for _ in range(10):
+        row = rng.rand(2)
+        batch = np.tile(row, (101, 1))
+        batch[:, 0] = grid
+        assert (np.diff(bst.predict(batch)) >= -1e-9).all()
+
+
+def test_wave_efb_sparse():
+    rng = np.random.RandomState(5)
+    n, f = 2500, 40
+    X = np.zeros((n, f))
+    X[:, 0] = rng.randn(n)
+    for j in range(1, f):
+        rows = rng.choice(n, size=int(n * 0.02), replace=False)
+        X[rows, j] = rng.rand(len(rows)) + 0.5
+    y = X[:, 0] + 2.0 * (X[:, 7] > 0) - (X[:, 11] > 0) + 0.1 * rng.randn(n)
+    p = _params("wave", objective="regression", metric="l2",
+                min_data_in_leaf=5)
+    bst = lgb.train(p, lgb.Dataset(sp.csr_matrix(X), y), num_boost_round=15)
+    dense_p = dict(p, enable_bundle=False)
+    bst_d = lgb.train(dense_p, lgb.Dataset(X, y), num_boost_round=15)
+    mse_b = float(np.mean((bst.predict(X) - y) ** 2))
+    mse_d = float(np.mean((bst_d.predict(X) - y) ** 2))
+    assert mse_b < max(1.3 * mse_d, mse_d + 0.02)
+
+
+def test_wave_falls_back_when_gated():
+    X, y = _binary(nan_frac=0)
+    p = _params("wave", feature_fraction_bynode=0.5)
+    bst = lgb.train(p, lgb.Dataset(X, y), num_boost_round=3)
+    assert bst.current_iteration == 3  # fell back, still trains
+
+
+def test_wave_multiclass():
+    rng = np.random.RandomState(6)
+    n = 3000
+    X = rng.randn(n, 6).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    p = _params("wave", objective="multiclass", num_class=3,
+                metric="multi_logloss")
+    bst = lgb.train(p, lgb.Dataset(X, y.astype(float)), num_boost_round=8)
+    acc = float(np.mean(np.argmax(bst.predict(X), axis=1) == y))
+    assert acc > 0.75
