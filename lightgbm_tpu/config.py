@@ -206,7 +206,7 @@ _PARAMS: List[ParamSpec] = [
     # wave-incompatible feature (forced splits / interaction constraints /
     # bynode sampling) is active.
     _p("tree_grow_mode", str, "auto"),
-    _p("tpu_wave_size", int, 16, check=">0"),
+    _p("tpu_wave_size", int, 25, check=">0"),  # capped at kernel's 25
     _p("num_devices", int, 0),               # 0 = all visible devices
 ]
 
@@ -394,14 +394,12 @@ class Config:
 # than the same params produce on the reference (VERDICT r2 "what's weak" #5).
 # Entries are removed as features land; tests assert this list shrinks only.
 _UNIMPLEMENTED_PARAMS: Tuple[str, ...] = (
-    "extra_trees",
     "forcedbins_filename",
     "two_round",
     "pre_partition",
     "deterministic",       # training is deterministic by construction, but
                            # the reference's flag also forces col-wise
     "cegb_penalty_feature_lazy",
-    "path_smooth",
 )
 
 

@@ -180,3 +180,57 @@ def bundle_sparse_csc(csc, mappers: Sequence, info: BundleInfo) -> np.ndarray:
             nd = bins != d
             out[rows[nd], g] = (o + bins[nd] - (bins[nd] > d)).astype(np.uint8)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Device-side helpers shared by the growers (learner/partitioned.py and
+# learner/wave.py).  ``efb_arrays`` is the jnp tuple built by
+# SerialTreeLearner from BundleInfo: (exp_map, f_bundle, f_offset,
+# f_default, f_nbins, f_single).
+# ---------------------------------------------------------------------------
+
+
+def make_expand_hist(efb_arrays, num_features: int, n_bundles: int,
+                     bundle_bins: int):
+    """Closure mapping a bundle-space (G, Bb, 3) histogram to per-feature
+    (F, B, 3) space, restoring each feature's default bin from the leaf
+    totals (Dataset::FixHistogram, reference src/io/dataset.cpp:1239).
+    Identity when ``efb_arrays`` is empty (no bundling)."""
+    import jax.numpy as jnp
+
+    if not efb_arrays:
+        return lambda hb, total: hb
+    exp_map, f_bundle, f_off, f_def, f_nb, f_single = efb_arrays
+    G, Bb, F = n_bundles, bundle_bins, num_features
+
+    def expand(hb, total):
+        flat = hb.reshape(G * Bb, 3)
+        e = jnp.where((exp_map >= 0)[:, :, None],
+                      flat[jnp.maximum(exp_map, 0)], 0.0)
+        fix = total[None, :] - jnp.sum(e, axis=1)
+        fixable = jnp.logical_not(f_single).astype(jnp.float32)
+        e = e.at[jnp.arange(F), f_def].add(fix * fixable[:, None])
+        return e
+
+    return expand
+
+
+def make_bundle_decode(efb_arrays):
+    """Closure mapping a BUNDLE-space bin column ``v`` (int32 values of
+    feature ``feat``'s bundle column) to FEATURE-space bin codes —
+    the inverse of the offset encoding in bundle_binned_matrix().
+    Identity when ``efb_arrays`` is empty."""
+    import jax.numpy as jnp
+
+    if not efb_arrays:
+        return lambda v, feat: v
+    exp_map, f_bundle, f_off, f_def, f_nb, f_single = efb_arrays
+
+    def decode(v, feat):
+        u = v - f_off[feat]
+        inr = (u >= 0) & (u < f_nb[feat] - 1)
+        mapped = jnp.where(inr, u + (u >= f_def[feat]).astype(jnp.int32),
+                           f_def[feat])
+        return jnp.where(f_single[feat], v, mapped)
+
+    return decode

@@ -46,7 +46,7 @@ import jax.numpy as jnp
 
 from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
 from ..ops.histogram import build_histogram
-from ..ops.split import BIG, NEG_INF, leaf_output
+from ..ops.split import BIG, NEG_INF, leaf_output, leaf_output_smoothed
 from .serial import CommStrategy, GrownTree
 
 __all__ = ["make_partitioned_grow_fn", "PART_ROW_BLOCK"]
@@ -87,6 +87,12 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
 
     sp = split_params
     use_mc = split_params.use_monotone
+    use_sm = split_params.path_smooth > 0.0
+
+    def _child_out(s3, parent_out):
+        if use_sm:
+            return leaf_output_smoothed(s3[0], s3[1], s3[2], parent_out, sp)
+        return leaf_output(s3[0], s3[1], sp)
     bynode = split_params.feature_fraction_bynode < 1.0
     import math as _math
     kcnt = max(1, int(_math.ceil(F * split_params.feature_fraction_bynode))) \
@@ -149,43 +155,39 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         chunk_bulk = min(CHUNK_BULK, n)
         chunk_tail = min(CHUNK_TAIL, n)
 
-        if use_efb:
-            exp_map, f_bundle, f_off, f_def, f_nb, f_single = efb_arrays
-
-        def expand_hist(hb, total):
-            """Bundle-space (G, Bb, 3) -> per-feature (F, B, 3) histograms
-            (gather through exp_map + Dataset::FixHistogram default-bin
-            restore from the leaf totals, dataset.cpp:1239)."""
-            if not use_efb:
-                return hb
-            flat = hb.reshape(G * Bb, 3)
-            e = jnp.where((exp_map >= 0)[:, :, None],
-                          flat[jnp.maximum(exp_map, 0)], 0.0)
-            fix = total[None, :] - jnp.sum(e, axis=1)
-            fixable = jnp.logical_not(f_single).astype(jnp.float32)
-            e = e.at[jnp.arange(F), f_def].add(fix * fixable[:, None])
-            return e
+        from ..efb import make_bundle_decode, make_expand_hist
+        expand_hist = make_expand_hist(efb_arrays if use_efb else (),
+                                       F, G, Bb)
+        bundle_decode = make_bundle_decode(efb_arrays if use_efb else ())
+        f_bundle = efb_arrays[1] if use_efb else None
 
         def feature_col(seg, feat, csize):
             """The FEATURE-space bin codes of one chunk for feature
-            ``feat`` (reconstructed from its bundle column under EFB)."""
+            ``feat`` (reconstructed from its bundle column under EFB;
+            efb.make_bundle_decode)."""
             g = f_bundle[feat] if use_efb else feat
             v = jax.lax.dynamic_slice(
                 seg, (0, g), (csize, 1))[:, 0].astype(jnp.int32)
-            if not use_efb:
-                return v
-            u = v - f_off[feat]
-            inr = (u >= 0) & (u < f_nb[feat] - 1)
-            mapped = jnp.where(inr, u + (u >= f_def[feat]).astype(jnp.int32),
-                               f_def[feat])
-            return jnp.where(f_single[feat], v, mapped)
+            return bundle_decode(v, feat)
 
         def node_mask(idx):
             """Exact-count per-node feature sample (ColSampler bynode,
-            reference col_sampler.hpp)."""
-            r = jax.random.uniform(jax.random.fold_in(node_key, idx), (F,))
+            reference col_sampler.hpp).  node_key row 0 is the bynode
+            stream (feature_fraction_seed)."""
+            r = jax.random.uniform(jax.random.fold_in(node_key[0], idx),
+                                   (F,))
             kth = jax.lax.top_k(r, kcnt)[0][-1]
             return r >= kth
+
+        def node_rand(idx):
+            """One random threshold bin per feature for this node
+            (ExtraTrees, feature_histogram.hpp USE_RAND).  node_key row 1
+            is the ExtraTrees stream (extra_seed) — independent of the
+            bynode stream, like the reference's separate RNGs."""
+            u = jax.random.uniform(jax.random.fold_in(node_key[1], idx),
+                                   (F,))
+            span = jnp.maximum(num_bins - 1, 1).astype(jnp.float32)
+            return jnp.minimum((u * span).astype(jnp.int32), num_bins - 2)
 
         # ---- pack rows: bins | grad*bag | hess*bag | orig idx | bag ----
         gm = (grad * bag_mask).astype(jnp.float32)
@@ -277,14 +279,21 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             cend = start + cnt
 
             # pass A: per-chunk stable sort + staged contiguous writes.
-            # Lefts land in the L staging buffer at their FINAL positions
-            # [start+dl, ...); rights land in the R buffer at positions
-            # RELATIVE to the segment start [start+dr, ...) — the combine
-            # pass shifts its R reads by nl, which is only known after this
-            # pass (this removes the separate left-count sweep an earlier
-            # version needed).  One shared buffer would be unsafe: the
-            # left/right full-chunk stores collide.
+            # Lefts land in the L staging buffer at their FINAL positions,
+            # stacked ASCENDING from ``start``; rights are stacked
+            # DESCENDING from the fixed top T0 of the R buffer.  Both
+            # directions share the same correctness argument: each store's
+            # valid run abuts the previous watermark and its garbage lies
+            # strictly beyond the NEW watermark, so the last writer of any
+            # position inside the final valid range wrote valid rows there
+            # — for ANY mix of chunk sizes.  (An earlier version staged
+            # rights ascending at (dr - clt): each chunk's left-garbage
+            # then landed BELOW the right watermark, silently clobbering
+            # the previous chunks' staged rights whenever a segment
+            # spanned multiple chunks.)  One shared buffer would be
+            # unsafe: the left/right full-chunk stores collide.
             Wq = W // 4
+            T0 = n + chunk_bulk   # top of the descending rights stack
 
             def stage_step(cstart, csize, carry):
                 Lb, Rb, dl, dr = carry
@@ -292,9 +301,12 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                 col = feature_col(seg, feat, csize)
                 gl, valid = _decide_col(col, clamped, cstart, cend, csize,
                                         feat_args)
-                # push invalid rows to the very end (key 2) so valid
-                # lefts/rights are contiguous in the sorted chunk
-                key = jnp.where(gl, 0, jnp.where(valid, 1, 2))
+                # order [lefts | invalid | rights]: lefts at the chunk
+                # BOTTOM feed the ascending L stack, rights at the chunk
+                # TOP feed the descending R stack — garbage (including the
+                # invalid middle) then always falls on the safe side of
+                # both watermarks
+                key = jnp.where(gl, 0, jnp.where(valid, 2, 1))
                 cols = jax.lax.bitcast_convert_type(
                     seg.reshape(csize, Wq, 4), jnp.int32)
                 ops = [key] + [cols[:, k] for k in range(Wq)]
@@ -304,33 +316,34 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                     jnp.stack(out[1:], axis=1), jnp.uint8).reshape(csize, W)
                 clt = jnp.sum(gl.astype(jnp.int32))
                 crt = jnp.sum(valid.astype(jnp.int32)) - clt
-                # full-chunk stores; only the leading valid parts matter —
-                # each garbage tail is overwritten by the next chunk's
-                # store or ignored by the combine's range masks
+                # lefts: rows [0, clt) stored at the ascending watermark
                 Lb = jax.lax.dynamic_update_slice(
                     Lb, sorted_u8, (start + dl, 0))
-                # rights begin at local row clt; write the whole chunk at
-                # (start+dr-clt) so they land at relative position dr; the
-                # left part before it is garbage the combine never reads
+                # rights: the chunk's TOP crt rows land at [T0-dr-crt,
+                # T0-dr) — the descending watermark; left/invalid garbage
+                # falls strictly below it and is overwritten by later
+                # chunks or ignored by the combine's nr bound.  Segment
+                # order of rights becomes chunk-reversed, which is
+                # irrelevant: row order within a leaf segment is free.
                 Rb = jax.lax.dynamic_update_slice(
-                    Rb, sorted_u8, (start + dr - clt + chunk_bulk, 0))
+                    Rb, sorted_u8, (T0 - dr - csize, 0))
                 return Lb, Rb, dl + clt, dr + crt
 
-            Lb, Rb, nl, _ = _sweep(start, cnt, stage_step,
-                                   (stage_ref[0], stage_ref[1],
-                                    jnp.asarray(0, jnp.int32),
-                                    jnp.asarray(0, jnp.int32)))
+            Lb, Rb, nl, nr = _sweep(start, cnt, stage_step,
+                                    (stage_ref[0], stage_ref[1],
+                                     jnp.asarray(0, jnp.int32),
+                                     jnp.asarray(0, jnp.int32)))
             stage_ref[0] = Lb
             stage_ref[1] = Rb
 
-            # combine: contiguous sweep selecting Lb below start+nl, and Rb
-            # (shifted by -nl) above
+            # combine: contiguous sweep selecting Lb below start+nl, and
+            # the rights block [T0-nr, T0) above
             def combine_step(cstart, csize, P_out):
                 clamped = jnp.minimum(cstart, n - csize)
                 lrow = jax.lax.dynamic_slice(Lb, (clamped, 0), (csize, W))
                 rrow = jax.lax.dynamic_slice(
-                    Rb, (jnp.maximum(clamped - nl + chunk_bulk, 0), 0),
-                    (csize, W))
+                    Rb, (jnp.maximum(clamped - (start + nl) + T0 - nr, 0),
+                         0), (csize, W))
                 cur = jax.lax.dynamic_slice(P_out, (clamped, 0), (csize, W))
                 j = jnp.arange(csize, dtype=jnp.int32)
                 gpos = clamped + j
@@ -352,9 +365,12 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         if use_ic:
             fm_root = fm_root & allowed_features(
                 jnp.zeros((F,), jnp.bool_))
+        root_out = _child_out(root_sum, jnp.asarray(0.0, jnp.float32))
+        rb_root = node_rand(2 * L) if sp.extra_trees else None
         cand = strat.leaf_candidates(expand_hist(root_hist, root_sum),
                                      root_sum, fm_root, sp,
-                                     root_bound, jnp.asarray(0, jnp.int32))
+                                     root_bound, jnp.asarray(0, jnp.int32),
+                                     root_out, rb_root)
 
         state = {
             "P": P,
@@ -386,8 +402,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             "internal_value": jnp.zeros((L - 1,), jnp.float32),
             "internal_weight": jnp.zeros((L - 1,), jnp.float32),
             "internal_count": jnp.zeros((L - 1,), jnp.float32),
-            "leaf_value": jnp.zeros((L,), jnp.float32).at[0].set(
-                leaf_output(root_sum[0], root_sum[1], sp)),
+            "leaf_value": jnp.zeros((L,), jnp.float32).at[0].set(root_out),
             "leaf_weight": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[1]),
             "leaf_count": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[2]),
             "num_leaves": jnp.asarray(1, jnp.int32),
@@ -463,11 +478,14 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
 
             # ---- monotone bounds for the children (BasicLeafConstraints::
             # Update, monotone_constraints.hpp:487-501) ----
+            parent_lv = s["leaf_value"][best_leaf]
+            out_l = _child_out(lsum, parent_lv)
+            out_r = _child_out(rsum, parent_lv)
             if use_mc:
                 p_mn = s["leaf_mn"][best_leaf]
                 p_mx = s["leaf_mx"][best_leaf]
-                out_l = jnp.clip(leaf_output(lsum[0], lsum[1], sp), p_mn, p_mx)
-                out_r = jnp.clip(leaf_output(rsum[0], rsum[1], sp), p_mn, p_mx)
+                out_l = jnp.clip(out_l, p_mn, p_mx)
+                out_r = jnp.clip(out_r, p_mn, p_mx)
                 m = jnp.where(fcat, 0, monotone[feat])
                 mid = (out_l + out_r) / 2.0
                 mn_l = jnp.where(m < 0, jnp.maximum(p_mn, mid), p_mn)
@@ -493,10 +511,12 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                 allowed = allowed_features(child_path)
                 fm_l = (feature_mask if fm_l is None else fm_l) & allowed
                 fm_r = (feature_mask if fm_r is None else fm_r) & allowed
+            rb_l = node_rand(2 * t) if sp.extra_trees else None
+            rb_r = node_rand(2 * t + 1) if sp.extra_trees else None
             cl, cr = strat.pair_candidates(
                 expand_hist(hist_left, lsum), expand_hist(hist_right, rsum),
                 lsum, rsum, feature_mask, sp, bound_l, bound_r,
-                child_depth, fm_l, fm_r)
+                child_depth, fm_l, fm_r, out_l, out_r, rb_l, rb_r)
             gl_ = jnp.where(depth_ok, cl[0], NEG_INF)
             gr_ = jnp.where(depth_ok, cr[0], NEG_INF)
 
@@ -570,13 +590,8 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                                      new_id, mn_r)
                 out["leaf_mx"] = upd(upd(s["leaf_mx"], best_leaf, mx_l),
                                      new_id, mx_r)
-                lv = upd(s["leaf_value"], best_leaf, out_l)
-                out["leaf_value"] = upd(lv, new_id, out_r)
-            else:
-                lv = upd(s["leaf_value"], best_leaf,
-                         leaf_output(lsum[0], lsum[1], sp))
-                out["leaf_value"] = upd(lv, new_id,
-                                        leaf_output(rsum[0], rsum[1], sp))
+            lv = upd(s["leaf_value"], best_leaf, out_l)
+            out["leaf_value"] = upd(lv, new_id, out_r)
             lw = upd(s["leaf_weight"], best_leaf, lsum[1])
             out["leaf_weight"] = upd(lw, new_id, rsum[1])
             lc = upd(s["leaf_count"], best_leaf, lsum[2])

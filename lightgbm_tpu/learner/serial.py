@@ -73,14 +73,15 @@ class GrownTree(NamedTuple):
 
 def local_best_candidate(hist, leaf_sum, num_bins, is_cat, has_nan,
                          feature_mask, params, monotone=None, bound=None,
-                         depth=None, cegb=None, contri=None
+                         depth=None, cegb=None, contri=None,
+                         parent_out=None, rand_bins=None
                          ) -> Tuple[jnp.ndarray, ...]:
     """Best split over (local) features for one leaf -> scalar candidate
     tuple (gain, feat, bin, default_left, left_sum, right_sum)."""
     fs: FeatureSplits = best_split_per_feature(hist, leaf_sum, num_bins,
                                                is_cat, has_nan, params,
                                                monotone, bound, depth, cegb,
-                                               contri)
+                                               contri, parent_out, rand_bins)
     gain = jnp.where(feature_mask, fs.gain, NEG_INF)
     f = jnp.argmax(gain)
     return (gain[f], f.astype(jnp.int32), fs.threshold_bin[f],
@@ -125,19 +126,23 @@ class CommStrategy:
                 feature_mask)
 
     def leaf_candidates(self, hist, leaf_sum, feature_mask, params,
-                        bound=None, depth=None):
+                        bound=None, depth=None, parent_out=None,
+                        rand_bins=None):
         nb, ic, hn, fm = self.local_meta(feature_mask)
         return local_best_candidate(hist, leaf_sum, nb, ic, hn, fm, params,
                                     self.monotone_full, bound, depth,
                                     getattr(self, "cegb_full", None),
-                                    getattr(self, "contri_full", None))
+                                    getattr(self, "contri_full", None),
+                                    parent_out, rand_bins)
 
     def pair_candidates(self, hist_l, hist_r, lsum, rsum, feature_mask,
                         params, bound_l, bound_r, depth, fm_l=None,
-                        fm_r=None):
+                        fm_r=None, po_l=None, po_r=None, rb_l=None,
+                        rb_r=None):
         """Both children's candidates in ONE vmapped scan (halves the
         per-split fixed cost of the dozens of small ops in the bin scan).
-        fm_l/fm_r are optional per-child feature masks (bynode sampling).
+        fm_l/fm_r are optional per-child feature masks (bynode sampling);
+        po_l/po_r the children's own smoothed outputs (path_smooth).
         Parallel strategies override with two sequential calls — their
         collectives are not vmap-batched."""
         hists = jnp.stack([hist_l, hist_r])
@@ -149,15 +154,27 @@ class CommStrategy:
             bounds = jnp.zeros((2, 2), jnp.float32)
         else:
             bounds = jnp.stack([bound_l, bound_r])
+        pos = jnp.zeros((2,), jnp.float32) if po_l is None \
+            else jnp.stack([po_l, po_r])
         cegb = getattr(self, "cegb_full", None)
         contri = getattr(self, "contri_full", None)
 
-        def one(h, s, b, f_m):
-            return local_best_candidate(h, s, nb, ic, hn, f_m, params,
-                                        self.monotone_full, b, depth, cegb,
-                                        contri)
+        if rb_l is not None:
+            rbs = jnp.stack([rb_l, rb_r])
 
-        out = jax.vmap(one)(hists, sums, bounds, fms)
+            def one(h, s, b, f_m, po, rb):
+                return local_best_candidate(h, s, nb, ic, hn, f_m, params,
+                                            self.monotone_full, b, depth,
+                                            cegb, contri, po, rb)
+
+            out = jax.vmap(one)(hists, sums, bounds, fms, pos, rbs)
+        else:
+            def one(h, s, b, f_m, po):
+                return local_best_candidate(h, s, nb, ic, hn, f_m, params,
+                                            self.monotone_full, b, depth,
+                                            cegb, contri, po)
+
+            out = jax.vmap(one)(hists, sums, bounds, fms, pos)
         cl = tuple(o[0] for o in out)
         cr = tuple(o[1] for o in out)
         return cl, cr
@@ -184,6 +201,10 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
     hist_kwargs = dict(num_bins=max_bins, impl=hist_impl,
                        rows_per_chunk=rows_per_chunk)
     L = num_leaves
+    if split_params.extra_trees:
+        from ..utils.log import log_warning
+        log_warning("extra_trees is not applied on this grower (pool-less "
+                    "fallback / parallel learners); growing full scans")
     pallas = hist_impl == "pallas"
     if pallas:
         from ..ops.histogram_pallas import (DEFAULT_ROW_BLOCK,
@@ -195,6 +216,16 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
         return build_histogram(X, g, h, m, **hist_kwargs)
 
     use_mc = split_params.use_monotone
+    use_sm = split_params.path_smooth > 0.0
+
+    def _child_out(s3, parent_out):
+        """Child leaf value: smoothed toward the parent when path_smooth
+        is active (feature_histogram.hpp USE_SMOOTHING)."""
+        if use_sm:
+            from ..ops.split import leaf_output_smoothed
+            return leaf_output_smoothed(s3[0], s3[1], s3[2], parent_out,
+                                        split_params)
+        return leaf_output(s3[0], s3[1], split_params)
 
     def grow(X: jnp.ndarray, X_T, grad: jnp.ndarray, hess: jnp.ndarray,
              sample_mask: jnp.ndarray, num_bins: jnp.ndarray,
@@ -214,9 +245,10 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
             jnp.sum(sample_mask)]))
 
         root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
+        root_out = _child_out(root_sum, jnp.asarray(0.0, jnp.float32))
         cand = strat.leaf_candidates(root_hist, root_sum, feature_mask,
                                      split_params, root_bound,
-                                     jnp.asarray(0, jnp.int32))
+                                     jnp.asarray(0, jnp.int32), root_out)
 
         # Per-split child-row compaction buckets: the smaller child's rows
         # are gathered into the smallest adequate fixed-size buffer (a
@@ -270,8 +302,7 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
             "internal_value": jnp.zeros((L - 1,), jnp.float32),
             "internal_weight": jnp.zeros((L - 1,), jnp.float32),
             "internal_count": jnp.zeros((L - 1,), jnp.float32),
-            "leaf_value": jnp.zeros((L,), jnp.float32).at[0].set(
-                leaf_output(root_sum[0], root_sum[1], split_params)),
+            "leaf_value": jnp.zeros((L,), jnp.float32).at[0].set(root_out),
             "leaf_weight": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[1]),
             "leaf_count": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[2]),
             "num_leaves": jnp.asarray(1, jnp.int32),
@@ -374,13 +405,14 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
             # Update, monotone_constraints.hpp:487-501: split outputs are
             # clamped to the leaf's bounds; the mid-point partitions the
             # output range between the children) ----
+            parent_lv = s["leaf_value"][best_leaf]
+            out_l = _child_out(lsum, parent_lv)
+            out_r = _child_out(rsum, parent_lv)
             if use_mc:
                 p_mn = s["leaf_mn"][best_leaf]
                 p_mx = s["leaf_mx"][best_leaf]
-                out_l = jnp.clip(leaf_output(lsum[0], lsum[1], split_params),
-                                 p_mn, p_mx)
-                out_r = jnp.clip(leaf_output(rsum[0], rsum[1], split_params),
-                                 p_mn, p_mx)
+                out_l = jnp.clip(out_l, p_mn, p_mx)
+                out_r = jnp.clip(out_r, p_mn, p_mx)
                 m = jnp.where(fcat, 0, monotone[feat])
                 mid = (out_l + out_r) / 2.0
                 mn_l = jnp.where(m < 0, jnp.maximum(p_mn, mid), p_mn)
@@ -397,7 +429,8 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
             depth_ok = jnp.logical_or(max_depth <= 0, child_depth < max_depth)
             cl, cr = strat.pair_candidates(hist_left, hist_right, lsum, rsum,
                                            feature_mask, split_params,
-                                           bound_l, bound_r, child_depth)
+                                           bound_l, bound_r, child_depth,
+                                           po_l=out_l, po_r=out_r)
             gl = jnp.where(depth_ok, cl[0], NEG_INF)
             gr = jnp.where(depth_ok, cr[0], NEG_INF)
 
@@ -464,13 +497,8 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
                                      new_id, mn_r)
                 out["leaf_mx"] = upd(upd(s["leaf_mx"], best_leaf, mx_l),
                                      new_id, mx_r)
-                lv = upd(s["leaf_value"], best_leaf, out_l)
-                out["leaf_value"] = upd(lv, new_id, out_r)
-            else:
-                lv = upd(s["leaf_value"], best_leaf,
-                         leaf_output(lsum[0], lsum[1], split_params))
-                out["leaf_value"] = upd(
-                    lv, new_id, leaf_output(rsum[0], rsum[1], split_params))
+            lv = upd(s["leaf_value"], best_leaf, out_l)
+            out["leaf_value"] = upd(lv, new_id, out_r)
             lw = upd(s["leaf_weight"], best_leaf, lsum[1])
             out["leaf_weight"] = upd(lw, new_id, rsum[1])
             lc = upd(s["leaf_count"], best_leaf, lsum[2])
@@ -556,7 +584,8 @@ def split_params_from_config(config: Config,
         use_cegb=use_cegb,
         cegb_tradeoff=float(config.cegb_tradeoff),
         cegb_penalty_split=float(config.cegb_penalty_split),
-        feature_fraction_bynode=float(config.feature_fraction_bynode))
+        feature_fraction_bynode=float(config.feature_fraction_bynode),
+        extra_trees=bool(config.extra_trees))
 
 
 def hist_pool_fits(config: Config, num_features: int, max_bins: int) -> bool:
@@ -633,13 +662,15 @@ class SerialTreeLearner:
         wave_ok = (self.use_hist_pool and not forced_splits and
                    not interaction_groups and
                    self.split_params.feature_fraction_bynode >= 1.0 and
+                   not self.split_params.extra_trees and
                    int(config.num_leaves) > 2)
         mode = str(config.tree_grow_mode)
         if mode == "wave" and not wave_ok:
             from ..utils.log import log_warning
             log_warning("tree_grow_mode=wave is incompatible with forced "
                         "splits / interaction constraints / bynode "
-                        "sampling / pool-less growth; falling back to the "
+                        "sampling / extra_trees / num_leaves<=2 / "
+                        "pool-less growth; falling back to the "
                         "partitioned grower")
             mode = "partition"
         elif mode == "auto":
@@ -701,7 +732,7 @@ class SerialTreeLearner:
         if cegb_penalty is None:
             cegb_penalty = jnp.zeros((self.num_features,), jnp.float32)
         if node_key is None:
-            node_key = jnp.zeros((2,), jnp.uint32)
+            node_key = jnp.zeros((2, 2), jnp.uint32)
         if not self.partitioned:
             if self.split_params.use_cegb or \
                     self.split_params.feature_fraction_bynode < 1.0:

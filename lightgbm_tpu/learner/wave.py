@@ -7,18 +7,19 @@ moving every row once per level it participates in (~37 ns/row via the
 grower removes that cost entirely by exploiting the MXU's lane dimension
 instead: the leaf-batched Pallas kernel
 (ops/histogram_pallas.py ``build_histogram_pallas_leaves``) computes
-**16 leaf histograms in one full-data pass** for the cost of one — the
-single-leaf kernel wastes 123 of the 128 output lanes of its one-hot
-contraction, so 16 leaves x 8 weight channels exactly fill the lanes.
+**LEAF_CHANNELS=25 leaf histograms in one full-data pass** for the cost of
+one — the single-leaf kernel wastes 123 of the 128 output lanes of its
+one-hot contraction, so 25 leaves x 5 weight channels (125 lanes) fill
+them instead.
 
 Growth proceeds in *waves*: each wave splits the top-``wave_size`` leaves
 by candidate gain (best-first, like the reference's leaf-wise ArgMax over
 best_split_per_leaf_, serial_tree_learner.cpp:194), updates the per-row
 ``row_leaf`` vector with masked wheres (streaming, no gather/scatter), and
-builds the 16 SMALLER children's histograms in one kernel pass — the
+builds the wave's SMALLER children's histograms in one kernel pass — the
 larger siblings come from the subtraction trick
 (serial_tree_learner.cpp:311-320).  Total histogram passes per tree ≈
-ceil((L-1)/16) + frontier ramp-up, independent of data size beyond the
+ceil((L-1)/25) + frontier ramp-up, independent of data size beyond the
 pass cost itself.
 
 Semantics vs the exact sequential leaf-wise order: identical while fewer
@@ -37,20 +38,17 @@ and categorical splits are fully supported.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
 from ..ops.histogram import build_histogram_leaves
-from ..ops.split import BIG, NEG_INF, leaf_output
+from ..ops.split import BIG, NEG_INF, leaf_output, leaf_output_smoothed
 from .serial import CommStrategy, GrownTree, local_best_candidate
 
 __all__ = ["make_wave_grow_fn", "WAVE_SIZE"]
 
-WAVE_SIZE = 16   # == ops.histogram_pallas.LEAF_CHANNELS
+from ..ops.histogram_pallas import LEAF_CHANNELS as WAVE_SIZE  # 25/pass
 
 
 def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
@@ -78,6 +76,12 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
 
     sp = split_params
     use_mc = split_params.use_monotone
+    use_sm = split_params.path_smooth > 0.0
+
+    def _child_out(g, h, cnt, parent_out):
+        if use_sm:
+            return leaf_output_smoothed(g, h, cnt, parent_out, sp)
+        return leaf_output(g, h, sp)
 
     def grow(X_T: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
              bag_mask: jnp.ndarray, num_bins: jnp.ndarray,
@@ -91,8 +95,11 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             strat.contri_full = jnp.asarray(feature_contri, jnp.float32)
         nb_full, ic_full, hn_full = num_bins, is_cat, has_nan
 
-        if use_efb:
-            exp_map, f_bundle, f_off, f_def, f_nb, f_single = efb_arrays
+        from ..efb import make_bundle_decode, make_expand_hist
+        expand_hist = make_expand_hist(efb_arrays if use_efb else (),
+                                       F, G, Bb)
+        bundle_decode = make_bundle_decode(efb_arrays if use_efb else ())
+        f_bundle = efb_arrays[1] if use_efb else None
 
         gm = (grad * bag_mask).astype(jnp.float32)
         hm = (hess * bag_mask).astype(jnp.float32)
@@ -113,59 +120,42 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             else:
                 h = build_histogram_leaves(
                     bins_rows, gm, hm, cnt_mask, ch,
-                    num_channels=WAVE_SIZE, num_bins=Bb, impl=hist_impl)
+                    num_channels=W, num_bins=Bb, impl=hist_impl)
             return h[:W]
-
-        def expand_hist(hb, total):
-            """Bundle-space -> feature-space (Dataset::FixHistogram restore
-            of the default bin from leaf totals, dataset.cpp:1239)."""
-            if not use_efb:
-                return hb
-            flat = hb.reshape(G * Bb, 3)
-            e = jnp.where((exp_map >= 0)[:, :, None],
-                          flat[jnp.maximum(exp_map, 0)], 0.0)
-            fix = total[None, :] - jnp.sum(e, axis=1)
-            fixable = jnp.logical_not(f_single).astype(jnp.float32)
-            e = e.at[jnp.arange(F), f_def].add(fix * fixable[:, None])
-            return e
 
         def feature_col(feat):
             """FEATURE-space bin codes (N,) of one feature (decoded from
-            its bundle column under EFB)."""
+            its bundle column under EFB; efb.make_bundle_decode)."""
             g = f_bundle[feat] if use_efb else feat
             v = jax.lax.dynamic_slice(X_T, (g, 0), (1, n))[0].astype(
                 jnp.int32)
-            if not use_efb:
-                return v
-            u = v - f_off[feat]
-            inr = (u >= 0) & (u < f_nb[feat] - 1)
-            mapped = jnp.where(inr, u + (u >= f_def[feat]).astype(jnp.int32),
-                               f_def[feat])
-            return jnp.where(f_single[feat], v, mapped)
+            return bundle_decode(v, feat)
 
-        def many_candidates(hists, sums, bounds, depths, k):
+        def many_candidates(hists, sums, bounds, depths, pouts):
             """Best-split candidates for k leaves in one vmapped scan."""
-            def one(h, s, bd, d):
+            def one(h, s, bd, d, po):
                 return local_best_candidate(
                     h, s, nb_full, ic_full, hn_full, feature_mask, sp,
                     monotone, bd if use_mc else None, d,
                     getattr(strat, "cegb_full", None),
-                    getattr(strat, "contri_full", None))
-            return jax.vmap(one)(hists, sums, bounds, depths)
+                    getattr(strat, "contri_full", None), po)
+            return jax.vmap(one)(hists, sums, bounds, depths, pouts)
 
         # ---- root ----
         root_sum = jnp.stack([jnp.sum(gm), jnp.sum(hm), jnp.sum(cnt_mask)])
         root_hist = hist_waves(jnp.zeros((n,), jnp.int32))[0]
         root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
+        root_out = _child_out(root_sum[0], root_sum[1], root_sum[2],
+                              jnp.asarray(0.0, jnp.float32))
         cand = strat.leaf_candidates(expand_hist(root_hist, root_sum),
                                      root_sum, feature_mask, sp,
-                                     root_bound, jnp.asarray(0, jnp.int32))
+                                     root_bound, jnp.asarray(0, jnp.int32),
+                                     root_out)
 
         state = {
             "row_leaf": jnp.zeros((n,), jnp.int32),
             "leaf_sum": jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum),
             "leaf_depth": jnp.zeros((L,), jnp.int32),
-            "leaf_parent": jnp.full((L,), -1, jnp.int32),
             "cand_gain": jnp.full((L,), NEG_INF, jnp.float32).at[0].set(cand[0]),
             "cand_feat": jnp.zeros((L,), jnp.int32).at[0].set(cand[1]),
             "cand_bin": jnp.zeros((L,), jnp.int32).at[0].set(cand[2]),
@@ -187,8 +177,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             "internal_value": jnp.zeros((L - 1,), jnp.float32),
             "internal_weight": jnp.zeros((L - 1,), jnp.float32),
             "internal_count": jnp.zeros((L - 1,), jnp.float32),
-            "leaf_value": jnp.zeros((L,), jnp.float32).at[0].set(
-                leaf_output(root_sum[0], root_sum[1], sp)),
+            "leaf_value": jnp.zeros((L,), jnp.float32).at[0].set(root_out),
             "leaf_weight": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[1]),
             "leaf_count": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[2]),
             "num_leaves": jnp.asarray(1, jnp.int32),
@@ -256,14 +245,17 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             hist_l = jnp.where(ls4, hist_small, hist_big)
             hist_r = jnp.where(ls4, hist_big, hist_small)
 
-            # ---- monotone bounds (BasicLeafConstraints::Update) ----
+            # ---- children outputs (smoothed toward the split leaf's own
+            # value under path_smooth) + monotone bounds
+            # (BasicLeafConstraints::Update) ----
+            parent_lv = s["leaf_value"][sel_leaves]
+            out_l = _child_out(lsum[:, 0], lsum[:, 1], lsum[:, 2], parent_lv)
+            out_r = _child_out(rsum[:, 0], rsum[:, 1], rsum[:, 2], parent_lv)
             if use_mc:
                 p_mn = s["leaf_mn"][sel_leaves]
                 p_mx = s["leaf_mx"][sel_leaves]
-                out_l = jnp.clip(leaf_output(lsum[:, 0], lsum[:, 1], sp),
-                                 p_mn, p_mx)
-                out_r = jnp.clip(leaf_output(rsum[:, 0], rsum[:, 1], sp),
-                                 p_mn, p_mx)
+                out_l = jnp.clip(out_l, p_mn, p_mx)
+                out_r = jnp.clip(out_r, p_mn, p_mx)
                 m = jnp.where(fcat, 0, monotone[feat])
                 mid = (out_l + out_r) / 2.0
                 mn_l = jnp.where(m < 0, jnp.maximum(p_mn, mid), p_mn)
@@ -283,7 +275,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             totals2 = sums2
             ex2 = jax.vmap(expand_hist)(hists2, totals2)
             depth2 = jnp.concatenate([child_depth, child_depth])
-            cands = many_candidates(ex2, sums2, bounds2, depth2, 2 * W)
+            lv2 = jnp.concatenate([out_l, out_r])
+            cands = many_candidates(ex2, sums2, bounds2, depth2, lv2)
             depth_ok = jnp.logical_or(max_depth <= 0, child_depth < max_depth)
             dok2 = jnp.concatenate([depth_ok, depth_ok])
             cg = jnp.where(dok2 & jnp.concatenate([sel, sel]), cands[0],
@@ -303,8 +296,6 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 hist_l, mode="drop").at[idx_r].set(hist_r, mode="drop")
             out["leaf_sum"] = sc2(s["leaf_sum"], sums2)
             out["leaf_depth"] = sc2(s["leaf_depth"], depth2)
-            node2 = jnp.concatenate([node_ids, node_ids])
-            out["leaf_parent"] = sc2(s["leaf_parent"], node2)
             out["cand_gain"] = sc2(s["cand_gain"], cg)
             out["cand_feat"] = sc2(s["cand_feat"], cands[1])
             out["cand_bin"] = sc2(s["cand_bin"], cands[2])
@@ -317,9 +308,6 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                                      jnp.concatenate([mn_l, mn_r]))
                 out["leaf_mx"] = sc2(s["leaf_mx"],
                                      jnp.concatenate([mx_l, mx_r]))
-                lv2 = jnp.concatenate([out_l, out_r])
-            else:
-                lv2 = leaf_output(sums2[:, 0], sums2[:, 1], sp)
             out["leaf_value"] = sc2(s["leaf_value"], lv2)
             out["leaf_weight"] = sc2(s["leaf_weight"], sums2[:, 1])
             out["leaf_count"] = sc2(s["leaf_count"], sums2[:, 2])

@@ -481,10 +481,17 @@ class GBDT:
                         extra["cegb_penalty"] = jnp.asarray(
                             np.where(self._cegb_used, 0.0,
                                      self._cegb_coupled), jnp.float32)
-                    if cfg.feature_fraction_bynode < 1.0:
-                        extra["node_key"] = jax.random.fold_in(
-                            jax.random.PRNGKey(cfg.feature_fraction_seed),
-                            self.iter_ * k + cid)
+                    if cfg.feature_fraction_bynode < 1.0 or cfg.extra_trees:
+                        # independent streams, like the reference's separate
+                        # ColSampler and ExtraTrees RNGs: row 0 = bynode
+                        # sampling (feature_fraction_seed), row 1 =
+                        # ExtraTrees thresholds (extra_seed)
+                        it = self.iter_ * k + cid
+                        extra["node_key"] = jnp.stack([
+                            jax.random.fold_in(jax.random.PRNGKey(
+                                cfg.feature_fraction_seed), it),
+                            jax.random.fold_in(jax.random.PRNGKey(
+                                cfg.extra_seed), it)])
                 grown = self.learner.train(self.X_dev, g, h, mask,
                                            feature_mask=fmask, **extra)
                 tree = self._record_tree(grown, cid)
@@ -593,7 +600,13 @@ class GBDT:
             renewed = self._renew_leaf_values(grown, class_id)
         bias = self._pending_bias[class_id] if self.iter_ == 0 else 0.0
         if defer:
-            self._pending.append((grown, shrinkage, bias))
+            # keep only what _grown_to_tree reads: dropping row_leaf
+            # releases the (N,) per-tree assignment (42 MB/tree at Higgs
+            # scale) instead of holding it in HBM until flush and hauling
+            # it through the device->host pull
+            self._pending.append(
+                (grown._replace(row_leaf=jnp.zeros((0,), jnp.int32)),
+                 shrinkage, bias))
             tree = None
         else:
             tree = _grown_to_tree(grown, shrinkage, self.train_set,

@@ -45,7 +45,8 @@ __all__ = ["build_histogram_pallas", "build_histogram_pallas_leaves",
 
 DEFAULT_ROW_BLOCK = 4096
 _C = 8  # weight channels (5 used), padded to a power of two for clean tiles
-LEAF_CHANNELS = 16  # leaves per pass in the leaf-batched kernel (16*_C = 128)
+_CB = 5  # channels per leaf block in the leaf-batched kernel (no padding)
+LEAF_CHANNELS = 128 // _CB  # 25 leaves per pass (25*5 = 125 <= 128 lanes)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -194,19 +195,19 @@ def build_histogram_pallas(bins_t: jnp.ndarray, grad: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
-# Leaf-channel batched kernel: 16 leaf histograms per pass.
+# Leaf-channel batched kernel: 25 leaf histograms per pass.
 #
 # The single-leaf kernel above uses only 5 of the MXU's 128 output lanes
 # (the one-hot contraction's N dimension); the systolic array computes the
-# other 123 for free.  This variant packs LEAF_CHANNELS=16 leaves x 8 weight
-# channels into the lane dimension: each row carries a leaf-channel id
-# ``ch`` in [0, 16) (or -1 = inactive), the kernel expands the row's 8-wide
-# weight vector into the 8 lanes of its leaf's lane-block, and ONE
-# contraction per row block accumulates all 16 histograms.  A tree grower
-# that batches 16 splits per wave (learner/wave.py) gets its 16 smaller-child
-# histograms for the price of one full pass — which removes the need to
-# physically partition rows at all (PERF.md round-3 analysis: row movement
-# was 55-60%% of tree time).
+# other 123 for free.  This variant packs LEAF_CHANNELS=25 leaves x 5 weight
+# channels (g_hi, g_lo, h_hi, h_lo, count — nothing wasted) into the lane
+# dimension: each row carries a leaf-channel id ``ch`` in [0, 25) (or -1 =
+# inactive), the kernel expands the row's weight vector into the 5 lanes of
+# its leaf's lane-block, and ONE contraction per row block accumulates all
+# 25 histograms.  A tree grower that batches up to 25 splits per wave
+# (learner/wave.py) gets its smaller-child histograms for the price of one
+# full pass — which removes the need to physically partition rows at all
+# (PERF.md round-3 analysis: row movement was 55-60%% of tree time).
 # ---------------------------------------------------------------------------
 
 
@@ -232,7 +233,8 @@ def pack_weights8(grad: jnp.ndarray, hess: jnp.ndarray,
 def _hist_leaves_kernel(bins_ref, w_ref, ch_ref, out_ref, *,
                         num_features: int, num_bins: int, group: int,
                         fstep: int):
-    """Accumulate (F*B, 16*8) histograms over one row block."""
+    """Accumulate (F*B, 128) lane-packed leaf histograms over one row
+    block (25 leaves x 5 channels in the 128-lane dimension)."""
     @pl.when(pl.program_id(1) == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
@@ -243,16 +245,19 @@ def _hist_leaves_kernel(bins_ref, w_ref, ch_ref, out_ref, *,
     b = num_bins
 
     # Expand (R, 8) weights into (R, 128): lane l carries weight channel
-    # l%8 iff this row's leaf channel == l//8.  All arithmetic — Mosaic
+    # l%_CB iff this row's leaf channel == l//_CB.  All arithmetic — Mosaic
     # cannot relayout i1 masks between lane-/sublane-replicated operands,
     # so the equality select is ``relu(1 - |ch - leaf_of_lane|)`` (exactly
     # 1.0 on match, 0.0 otherwise for integer distances) and the channel
-    # tiling is a lane concatenate.  Pure VPU work, no gather.
+    # tiling is a lane concatenate (sliced to 128; the last 128 - 25*5 = 3
+    # lanes select leaf 25 which no row carries -> zero).  Pure VPU work,
+    # no gather.
     lane = jax.lax.broadcasted_iota(jnp.int32, (r, 128), 1)
-    leaf_of_lane = lane // _C
+    leaf_of_lane = lane // _CB
     d = (ch - leaf_of_lane).astype(jnp.float32)     # (R, 128) via broadcast
     sel = jnp.maximum(0.0, 1.0 - jnp.abs(d)).astype(jnp.bfloat16)
-    wtile = jnp.concatenate([w] * (128 // _C), axis=1)          # (R, 128)
+    w5 = w[:, :_CB]
+    wtile = jnp.concatenate([w5] * (128 // _CB + 1), axis=1)[:, :128]
     w128 = wtile * sel
 
     iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, r), 0) % b
@@ -279,13 +284,13 @@ def build_histogram_pallas_leaves(bins_t: jnp.ndarray, w8: jnp.ndarray,
                                   ch: jnp.ndarray, *, num_bins: int,
                                   row_block: int = DEFAULT_ROW_BLOCK,
                                   interpret: bool = False) -> jnp.ndarray:
-    """(16, F, B, 3) histograms of 16 leaf channels in one pass.
+    """(LEAF_CHANNELS, F, B, 3) histograms of 25 leaf channels in one pass.
 
     Args:
       bins_t: (F, N) integer bin codes, N a multiple of ``row_block``.
       w8: (N, 8) bf16 weight rows from :func:`pack_weights8`.
-      ch: (N,) int32 leaf channel in [0, 16), or -1 for rows that belong to
-        no batched leaf (they contribute nothing).
+      ch: (N,) int32 leaf channel in [0, LEAF_CHANNELS), or -1 for rows
+        that belong to no batched leaf (they contribute nothing).
       num_bins: static global bin count B.
     """
     f, n = bins_t.shape
@@ -337,8 +342,8 @@ def build_histogram_pallas_leaves(bins_t: jnp.ndarray, w8: jnp.ndarray,
         interpret=interpret,
     )(bins_t, w8, ch2)
 
-    out = out.reshape(f_pad, b, LEAF_CHANNELS, _C)
+    out = out[:, :LEAF_CHANNELS * _CB].reshape(f_pad, b, LEAF_CHANNELS, _CB)
     hist = jnp.stack([out[..., 0] + out[..., 1],
                       out[..., 2] + out[..., 3],
-                      out[..., 4]], axis=-1)              # (F, B, 16, 3)
+                      out[..., 4]], axis=-1)              # (F, B, 25, 3)
     return jnp.transpose(hist, (2, 0, 1, 3))[:, :f, :num_bins, :]

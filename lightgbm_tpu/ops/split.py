@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["SplitParams", "FeatureSplits", "best_split_per_feature",
-           "leaf_output", "monotone_penalty_factor", "BIG"]
+           "leaf_output", "leaf_output_smoothed",
+           "monotone_penalty_factor", "BIG"]
 
 NEG_INF = -1e30
 
@@ -58,6 +59,7 @@ class SplitParams(NamedTuple):
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
     feature_fraction_bynode: float = 1.0  # ColSampler by-node sampling
+    extra_trees: bool = False  # one random threshold per feature per node
 
 BIG = 1e30  # "unbounded" leaf-output constraint sentinel
 
@@ -92,6 +94,20 @@ def leaf_output(g: jnp.ndarray, h: jnp.ndarray, params: SplitParams) -> jnp.ndar
     return out
 
 
+def leaf_output_smoothed(g, h, cnt, parent_out, params: SplitParams):
+    """Leaf value with path smoothing (feature_histogram.hpp
+    ``CalculateSplittedLeafOutput`` USE_SMOOTHING branch): the raw output
+    shrinks toward the parent leaf's output by smooth/(n + smooth)."""
+    t = _threshold_l1(g, params.lambda_l1)
+    out = jnp.where(h + params.lambda_l2 > 0, -t / (h + params.lambda_l2), 0.0)
+    if params.path_smooth > 0.0:
+        f = cnt / (cnt + params.path_smooth)
+        out = out * f + parent_out * (1.0 - f)
+    if params.max_delta_step > 0.0:
+        out = jnp.clip(out, -params.max_delta_step, params.max_delta_step)
+    return out
+
+
 def _gain_given_output(g, h, out, l1: float, l2: float):
     """Objective improvement of a leaf FORCED to value ``out`` (reference
     feature_histogram.hpp ``GetLeafGainGivenOutput``) — equals the standard
@@ -121,7 +137,9 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                            bound: Optional[jnp.ndarray] = None,
                            depth: Optional[jnp.ndarray] = None,
                            cegb_penalty: Optional[jnp.ndarray] = None,
-                           gain_scale: Optional[jnp.ndarray] = None
+                           gain_scale: Optional[jnp.ndarray] = None,
+                           parent_out: Optional[jnp.ndarray] = None,
+                           rand_bins: Optional[jnp.ndarray] = None
                            ) -> FeatureSplits:
     """Best split per feature from one leaf's histograms.
 
@@ -144,11 +162,20 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     min_h = params.min_sum_hessian_in_leaf
     min_cnt = float(params.min_data_in_leaf)
     use_mc = params.use_monotone
+    use_sm = params.path_smooth > 0.0
+    use_out = use_mc or use_sm   # gains via explicit (possibly
+    #                              constrained/smoothed) outputs
     if use_mc:
         mn, mx = bound[0], bound[1]
         mono = jnp.where(is_cat, 0, monotone)[:, None]           # (F, 1)
 
-    parent_gain = _leaf_gain(parent_sum[0], parent_sum[1], l1, l2)
+    if use_sm:
+        # the leaf's own (smoothed) output is the smoothing target of its
+        # children and defines the gain shift (GetLeafGain USE_SMOOTHING)
+        parent_gain = _gain_given_output(parent_sum[0], parent_sum[1],
+                                         parent_out, l1, l2)
+    else:
+        parent_gain = _leaf_gain(parent_sum[0], parent_sum[1], l1, l2)
     min_gain_shift = parent_gain + params.min_gain_to_split
 
     bins_r = jnp.arange(b, dtype=jnp.int32)[None, :]            # (1, B)
@@ -159,6 +186,11 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     thr_valid = jnp.where(has_nan[:, None],
                           bins_r < nan_bin,             # b in [0, nan_bin-1]
                           bins_r < num_bins[:, None] - 1)
+    use_et = params.extra_trees and rand_bins is not None
+    if use_et:
+        # ExtraTrees (feature_histogram.hpp USE_RAND): evaluate ONE random
+        # threshold per feature per node instead of the full bin scan
+        thr_valid = thr_valid & (bins_r == rand_bins[:, None])
 
     # zero out bins beyond each feature's true range so cumsums are clean
     hist_m = jnp.where(real_bin[:, :, None], hist, 0.0)
@@ -172,28 +204,34 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     total = parent_sum[None, :]                                   # (1, 3)
 
     def clamped_out(s, l2_eff):
+        """Split-child output with smoothing and/or constraint clamping
+        (CalculateSplittedLeafOutput USE_SMOOTHING / USE_MC)."""
         t = _threshold_l1(s[..., 0], l1)
         h_ = s[..., 1] + l2_eff
         out = jnp.where(h_ > 0, -t / h_, 0.0)
+        if use_sm:
+            fac = s[..., 2] / (s[..., 2] + params.path_smooth)
+            out = out * fac + parent_out * (1.0 - fac)
         if params.max_delta_step > 0.0:
             out = jnp.clip(out, -params.max_delta_step, params.max_delta_step)
-        return jnp.clip(out, mn, mx)
+        return jnp.clip(out, mn, mx) if use_mc else out
 
     def dir_gain(left):
         right = total[:, None, :] - left
         ok = ((left[..., 2] >= min_cnt) & (right[..., 2] >= min_cnt) &
               (left[..., 1] >= min_h) & (right[..., 1] >= min_h) & thr_valid)
-        if use_mc:
-            # constrained outputs (GetSplitGains USE_MC branch,
-            # feature_histogram.hpp): clamp to the leaf's [min, max]; a
-            # monotone feature's split must respect the direction
+        if use_out:
+            # constrained/smoothed outputs (GetSplitGains USE_MC /
+            # USE_SMOOTHING branches, feature_histogram.hpp): gain is
+            # evaluated at the actually-deliverable output
             out_l = clamped_out(left, l2)
             out_r = clamped_out(right, l2)
             gl = _gain_given_output(left[..., 0], left[..., 1], out_l, l1, l2)
             gr = _gain_given_output(right[..., 0], right[..., 1], out_r, l1, l2)
-            viol = (((mono > 0) & (out_l > out_r)) |
-                    ((mono < 0) & (out_l < out_r)))
-            ok = ok & jnp.logical_not(viol)
+            if use_mc:
+                viol = (((mono > 0) & (out_l > out_r)) |
+                        ((mono < 0) & (out_l < out_r)))
+                ok = ok & jnp.logical_not(viol)
         else:
             gl = _leaf_gain(left[..., 0], left[..., 1], l1, l2)
             gr = _leaf_gain(right[..., 0], right[..., 1], l1, l2)
@@ -217,7 +255,7 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     cat_l2 = l2 + params.cat_l2
     cat_left = hist_m
     cat_right = total[:, None, :] - cat_left
-    if use_mc:  # clamp outputs to the leaf bounds (no direction for cats)
+    if use_out:  # clamp/smooth outputs (no direction check for cats)
         c_out_l = clamped_out(cat_left, cat_l2)
         c_out_r = clamped_out(cat_right, cat_l2)
         cgl = _gain_given_output(cat_left[..., 0], cat_left[..., 1], c_out_l,
@@ -229,6 +267,8 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
         cgr = _leaf_gain(cat_right[..., 0], cat_right[..., 1], l1, cat_l2)
     cat_ok = ((cat_left[..., 2] >= min_cnt) & (cat_right[..., 2] >= min_cnt) &
               (cat_left[..., 1] >= min_h) & (cat_right[..., 1] >= min_h) & real_bin)
+    if use_et:  # one random category per node (USE_RAND one-hot branch)
+        cat_ok = cat_ok & (bins_r == rand_bins[:, None])
     cat_gain = cgl + cgr - min_gain_shift
     cat_gain = jnp.where(cat_ok & (cat_gain > 0), cat_gain, NEG_INF)
     oh_bin = jnp.argmax(cat_gain, axis=1)
@@ -269,6 +309,9 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                                           (used[:, None] + 1) // 2),
                               used[:, None])                     # (F, 1)
         pos_ok = pos < max_pos
+        if use_et:  # one random subset size per node (USE_RAND)
+            pos_ok = pos_ok & (pos == rand_bins[:, None] %
+                               jnp.maximum(max_pos, 1))
 
         def subset_gain(left):
             right = total[:, None, :] - left
@@ -283,7 +326,7 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                   (left[..., 1] >= min_h) &
                   (right[..., 2] >= jnp.maximum(min_cnt, mdpg)) &
                   (right[..., 1] >= min_h) & (gcross > gprev))
-            if use_mc:
+            if use_out:
                 o_l = clamped_out(left, cat_l2)
                 o_r = clamped_out(right, cat_l2)
                 gl_ = _gain_given_output(left[..., 0], left[..., 1], o_l,

@@ -58,7 +58,7 @@ class DataParallelStrategy(CommStrategy):
     # feature blocks (reduce-scatter), never on the full tensor.
 
     def leaf_candidates(self, hist_local, leaf_sum, feature_mask, params,
-                        bound=None, depth=None):
+                        bound=None, depth=None, parent_out=None):
         fb = self.f_local
         r = jax.lax.axis_index(self.axis_name)
         start = r * fb
@@ -71,7 +71,7 @@ class DataParallelStrategy(CommStrategy):
         g, f_loc, b, dl, ls, rs, member = local_best_candidate(
             blk, leaf_sum, sl(self.num_bins_full), sl(self.is_cat_full),
             sl(self.has_nan_full), sl(feature_mask), params, mono, bound,
-            depth)
+            depth, parent_out=parent_out)
         # allreduce-max of the per-block winners with deterministic
         # tie-break on the global feature index (SplitInfo ladder)
         gmax = jax.lax.pmax(g, self.axis_name)
@@ -89,16 +89,16 @@ class DataParallelStrategy(CommStrategy):
 
     def pair_candidates(self, hist_l, hist_r, lsum, rsum, feature_mask,
                         params, bound_l, bound_r, depth, fm_l=None,
-                        fm_r=None):
+                        fm_r=None, po_l=None, po_r=None):
         # collectives are not vmap-batched: two sequential candidate calls
         return (self.leaf_candidates(
                     hist_l, lsum,
                     feature_mask if fm_l is None else fm_l, params,
-                    bound_l, depth),
+                    bound_l, depth, po_l),
                 self.leaf_candidates(
                     hist_r, rsum,
                     feature_mask if fm_r is None else fm_r, params,
-                    bound_r, depth))
+                    bound_r, depth, po_r))
 
 
 class DataParallelTreeLearner:

@@ -52,7 +52,7 @@ class FeatureParallelStrategy(CommStrategy):
             sl(self.has_nan_full), start
 
     def leaf_candidates(self, hist_local, leaf_sum, feature_mask, params,
-                        bound=None, depth=None):
+                        bound=None, depth=None, parent_out=None):
         nb, ic, hn, start = self._local_slices()
         r = jax.lax.axis_index(self.axis_name)
         fm = jax.lax.dynamic_slice(feature_mask, (r * self.f_local,),
@@ -61,7 +61,7 @@ class FeatureParallelStrategy(CommStrategy):
                                      (r * self.f_local,), (self.f_local,)) \
             if self.monotone_full is not None else None
         g, f_loc, b, dl, ls, rs, member = local_best_candidate(
-            hist_local, leaf_sum, nb, ic, hn, fm, params, mono, bound, depth)
+            hist_local, leaf_sum, nb, ic, hn, fm, params, mono, bound, depth, parent_out=parent_out)
         # global best with deterministic tie-break on the feature index
         # (reference SyncUpGlobalBestSplit allreduce-max)
         gmax = jax.lax.pmax(g, self.axis_name)
@@ -80,16 +80,16 @@ class FeatureParallelStrategy(CommStrategy):
 
     def pair_candidates(self, hist_l, hist_r, lsum, rsum, feature_mask,
                         params, bound_l, bound_r, depth, fm_l=None,
-                        fm_r=None):
+                        fm_r=None, po_l=None, po_r=None):
         # collectives are not vmap-batched: two sequential candidate calls
         return (self.leaf_candidates(
                     hist_l, lsum,
                     feature_mask if fm_l is None else fm_l, params,
-                    bound_l, depth),
+                    bound_l, depth, po_l),
                 self.leaf_candidates(
                     hist_r, rsum,
                     feature_mask if fm_r is None else fm_r, params,
-                    bound_r, depth))
+                    bound_r, depth, po_r))
 
     def get_column(self, X_local, feat_global):
         r = jax.lax.axis_index(self.axis_name)

@@ -52,7 +52,7 @@ class VotingStrategy(CommStrategy):
     # only voted features are aggregated below.
 
     def leaf_candidates(self, hist_local, leaf_sum, feature_mask, params,
-                        bound=None, depth=None):
+                        bound=None, depth=None, parent_out=None):
         k = self.top_k
         # 1. local candidate gains with relaxed (1/num_machines) constraints
         #    (voting_parallel_tree_learner.cpp:62-63)
@@ -60,7 +60,7 @@ class VotingStrategy(CommStrategy):
         fs = best_split_per_feature(hist_local, local_sum, self.num_bins_full,
                                     self.is_cat_full, self.has_nan_full,
                                     self.local_params, self.monotone_full,
-                                    bound, depth)
+                                    bound, depth, parent_out=parent_out)
         gain = jnp.where(feature_mask, fs.gain, NEG_INF)
         # 2. local top-k vote -> allgather (LightSplitInfo allgather :322)
         _, top_ids = jax.lax.top_k(gain, k)
@@ -83,21 +83,21 @@ class VotingStrategy(CommStrategy):
         mono = self.monotone_full[selected] \
             if self.monotone_full is not None else None
         g, f_loc, b, dl, ls, rs, member = local_best_candidate(
-            hist_sel, leaf_sum, nb, ic, hn, fm, params, mono, bound, depth)
+            hist_sel, leaf_sum, nb, ic, hn, fm, params, mono, bound, depth, parent_out=parent_out)
         return (g, selected[f_loc], b, dl, ls, rs, member)
 
     def pair_candidates(self, hist_l, hist_r, lsum, rsum, feature_mask,
                         params, bound_l, bound_r, depth, fm_l=None,
-                        fm_r=None):
+                        fm_r=None, po_l=None, po_r=None):
         # collectives are not vmap-batched: two sequential candidate calls
         return (self.leaf_candidates(
                     hist_l, lsum,
                     feature_mask if fm_l is None else fm_l, params,
-                    bound_l, depth),
+                    bound_l, depth, po_l),
                 self.leaf_candidates(
                     hist_r, rsum,
                     feature_mask if fm_r is None else fm_r, params,
-                    bound_r, depth))
+                    bound_r, depth, po_r))
 
 
 class VotingParallelTreeLearner:

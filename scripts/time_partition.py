@@ -88,21 +88,24 @@ def matmul_partition_scan(P, gl):
     comp = jax.lax.dot_general(
         perm, Pb, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32).astype(jnp.uint8)
-    # coalesce: lefts ascending into L buffer, rights ascending into R buffer
+    # coalesce: lefts ascend from 0 in the L buffer; rights DESCEND from
+    # the fixed top T0 of the R buffer (each store's garbage then falls
+    # strictly beyond the new watermark — the ascending-rights variant
+    # clobbered previously staged rights whenever a block held lefts)
     offl = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(nl)])[:-1]
     offr = jnp.concatenate([jnp.zeros(1, jnp.int32),
                             jnp.cumsum(R - nl)])[:-1]
     Lb = jnp.zeros((N + R, W), jnp.uint8)
     Rb = jnp.zeros((N + R, W), jnp.uint8)
+    T0 = N + R
 
     def body(i, carry):
         Lb, Rb = carry
         blk = comp[i]
         Lb = jax.lax.dynamic_update_slice(Lb, blk, (offl[i], 0))
-        # right rows start at local nl[i]; store the whole block so its
-        # rights land at offr[i] (garbage head/tail overwritten by
-        # neighbors, same trick as the grower's staging)
-        Rb = jax.lax.dynamic_update_slice(Rb, blk, (offr[i] + R - nl[i], 0))
+        # the block's TOP (R - nl[i]) rows are its rights; place them at
+        # [T0 - offr[i] - (R - nl[i]), T0 - offr[i])
+        Rb = jax.lax.dynamic_update_slice(Rb, blk, (T0 - offr[i] - R, 0))
         return Lb, Rb
 
     Lb, Rb = jax.lax.fori_loop(0, nb, body, (Lb, Rb))
@@ -118,12 +121,21 @@ def main():
                matmul_partition(sub), P, gl)
     timeit("matmul compact + coalesce (full)", matmul_partition_scan, P, gl)
 
-    # correctness: full pipeline vs sort
+    # correctness: full pipeline vs sort.  Rights are stacked descending
+    # (chunk-reversed order — row order within a side is free), so compare
+    # the two sides as multisets of rows.
     s = np.asarray(sort_partition(P, gl))
     Lb, Rb, nl = matmul_partition_scan(P, gl)
     nl = int(nl)
-    got = np.concatenate([np.asarray(Lb[:nl]), np.asarray(Rb[:N - nl])])
-    np.testing.assert_array_equal(s, got)
+    got_l = np.asarray(Lb[:nl])
+    got_r = np.asarray(Rb[1024 + nl:])  # [T0 - (N - nl), T0), T0 = N+1024
+    np.testing.assert_array_equal(s[:nl], got_l)   # lefts keep order
+
+    def rowset(a):
+        return np.sort(np.ascontiguousarray(a).view(
+            [("", a.dtype)] * a.shape[1]).ravel())
+
+    np.testing.assert_array_equal(rowset(s[nl:]), rowset(got_r))
     print("full-pipeline output matches lax.sort")
 
 

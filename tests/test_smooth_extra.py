@@ -1,0 +1,90 @@
+"""path_smooth + extra_trees behavioral tests (reference:
+test_engine.py's path_smooth/extra_trees checks — the params must change
+the model, keep quality sane, and stay deterministic under a fixed seed)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=3000, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f)
+    y = X @ w + np.sin(2 * X[:, 0]) + 0.3 * rng.randn(n)
+    return X, y
+
+
+BASE = {"objective": "regression", "num_leaves": 31, "max_bin": 63,
+        "metric": "l2", "verbosity": -1, "min_data_in_leaf": 10,
+        "learning_rate": 0.15}
+
+
+def _mse(bst, X, y):
+    return float(np.mean((bst.predict(X) - y) ** 2))
+
+
+def test_path_smooth_changes_model_and_shrinks_leaves():
+    X, y = _data()
+    b0 = lgb.train(BASE, lgb.Dataset(X, y), num_boost_round=10)
+    bs = lgb.train(dict(BASE, path_smooth=200.0), lgb.Dataset(X, y),
+                   num_boost_round=10)
+    p0, ps = b0.predict(X), bs.predict(X)
+    assert not np.allclose(p0, ps)
+    # smoothing regularizes: training fit is weaker but sane
+    m0, ms = _mse(b0, X, y), _mse(bs, X, y)
+    assert ms >= m0 * 0.99
+    assert ms < np.var(y) * 0.7
+
+
+def test_path_smooth_wave_matches_partition_semantics():
+    X, y = _data(seed=1)
+    p = dict(BASE, path_smooth=50.0)
+    pred_p = lgb.train(dict(p, tree_grow_mode="partition"),
+                       lgb.Dataset(X, y), num_boost_round=6).predict(X)
+    pred_w = lgb.train(dict(p, tree_grow_mode="wave", tpu_wave_size=1),
+                       lgb.Dataset(X, y), num_boost_round=6).predict(X)
+    np.testing.assert_allclose(pred_w, pred_p, atol=2e-4)
+
+
+def test_path_smooth_with_monotone():
+    rng = np.random.RandomState(2)
+    n = 2000
+    x0, x1 = rng.rand(n), rng.rand(n)
+    y = 4 * x0 + np.sin(8 * np.pi * x0) + 2 * x1 + 0.1 * rng.randn(n)
+    X = np.stack([x0, x1], 1).astype(np.float32)
+    p = dict(BASE, path_smooth=20.0, monotone_constraints=[1, 0])
+    bst = lgb.train(p, lgb.Dataset(X, y), num_boost_round=15)
+    grid = np.linspace(0, 1, 101)
+    for _ in range(8):
+        row = rng.rand(2)
+        batch = np.tile(row, (101, 1))
+        batch[:, 0] = grid
+        assert (np.diff(bst.predict(batch)) >= -1e-9).all()
+
+
+def test_extra_trees_trains_and_differs():
+    X, y = _data(seed=3)
+    b0 = lgb.train(BASE, lgb.Dataset(X, y), num_boost_round=10)
+    be = lgb.train(dict(BASE, extra_trees=True), lgb.Dataset(X, y),
+                   num_boost_round=10)
+    assert not np.allclose(b0.predict(X), be.predict(X))
+    # random single-threshold splits still learn the signal
+    assert _mse(be, X, y) < np.var(y) * 0.6
+
+
+def test_extra_trees_deterministic_under_seed():
+    X, y = _data(seed=4)
+    p = dict(BASE, extra_trees=True, extra_seed=7)
+    b1 = lgb.train(p, lgb.Dataset(X, y), num_boost_round=5)
+    b2 = lgb.train(p, lgb.Dataset(X, y), num_boost_round=5)
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X))
+
+
+def test_extra_trees_seed_changes_model():
+    X, y = _data(seed=5)
+    b1 = lgb.train(dict(BASE, extra_trees=True, extra_seed=1),
+                   lgb.Dataset(X, y), num_boost_round=5)
+    b2 = lgb.train(dict(BASE, extra_trees=True, extra_seed=99),
+                   lgb.Dataset(X, y), num_boost_round=5)
+    assert not np.allclose(b1.predict(X), b2.predict(X))
