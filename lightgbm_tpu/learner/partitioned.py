@@ -183,11 +183,15 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             """One random threshold bin per feature for this node
             (ExtraTrees, feature_histogram.hpp USE_RAND).  node_key row 1
             is the ExtraTrees stream (extra_seed) — independent of the
-            bynode stream, like the reference's separate RNGs."""
+            bynode stream, like the reference's separate RNGs.  Numeric
+            thresholds live in [0, nb-2]; categorical one-hot bins extend
+            to nb-1 (the last category must stay reachable)."""
             u = jax.random.uniform(jax.random.fold_in(node_key[1], idx),
                                    (F,))
-            span = jnp.maximum(num_bins - 1, 1).astype(jnp.float32)
-            return jnp.minimum((u * span).astype(jnp.int32), num_bins - 2)
+            hi = jnp.maximum(jnp.where(is_cat, num_bins - 1, num_bins - 2),
+                             0)
+            return jnp.minimum((u * (hi + 1).astype(jnp.float32)
+                                ).astype(jnp.int32), hi)
 
         # ---- pack rows: bins | grad*bag | hess*bag | orig idx | bag ----
         gm = (grad * bag_mask).astype(jnp.float32)
@@ -234,10 +238,12 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         # stale contents are never read (the combine pass only reads
         # positions the current split wrote).
         P_ref = [P]
-        # R carries a front pad of one bulk chunk: rights are staged at
-        # segment-relative positions (+pad) and the combine pass reads at
-        # (pos - nl + pad), which stays non-negative for every chunk that
-        # touches the right region
+        # L stacks lefts ASCENDING from the segment start (tail slack of
+        # one bulk chunk absorbs full-chunk store overhang); R stacks
+        # rights DESCENDING from the fixed top T0 = n + chunk_bulk, so it
+        # needs one bulk chunk of slack on BOTH sides: below T0-nr for
+        # each store's garbage overhang, above n for nothing-but-sizing
+        # symmetry of the store bounds (see partition_segment).
         stage_ref = [jnp.zeros((n + chunk_bulk, W), jnp.uint8),
                      jnp.zeros((n + 2 * chunk_bulk, W), jnp.uint8)]
 

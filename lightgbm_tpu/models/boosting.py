@@ -15,7 +15,6 @@ from ..dataset import Dataset
 from ..utils.log import log_info, log_warning
 from ..utils.random import host_rng
 from .gbdt import GBDT, _update_score_by_leaf
-from .tree import _walk_binned
 
 
 class GOSS(GBDT):
@@ -249,7 +248,7 @@ class RF(GBDT):
         self.score = self._rf_base + self._tree_sum / t
         for vi, (_, vset) in enumerate(self.valid_sets):
             vbins = vset._device_cache["bins"]
-            delta = _walk_binned(vbins, grown.split_feature, grown.threshold_bin,
+            delta = self._walk(vbins, grown.split_feature, grown.threshold_bin,
                                  grown.nan_bin, grown.cat_member,
                                  grown.decision_type,
                                  grown.left_child, grown.right_child,

@@ -115,7 +115,8 @@ def _update_score_by_leaf(score, row_leaf, leaf_value, shrinkage):
     return score + shrinkage * leaf_value[row_leaf]
 
 
-from .tree import _walk_binned  # tree walk for validation-set score updates
+from .tree import (_walk_binned,  # tree walk for valid-set score updates
+                   _walk_binned_efb)
 
 
 class GBDT:
@@ -167,6 +168,14 @@ class GBDT:
                                             self._inner_monotone())
         self.X_dev = jnp.asarray(train_set.X_binned)
         self._is_cat_np = is_cat
+        # bundle-space tree-walk decode arrays (EFB valid sets / rebuilds)
+        # — the standard efb_arrays layout minus exp_map (unused by the
+        # walk's decode)
+        efb = getattr(train_set, "efb", None)
+        self._efb_walk = None if efb is None else (
+            None, jnp.asarray(efb.f_bundle), jnp.asarray(efb.f_offset),
+            jnp.asarray(efb.f_default), jnp.asarray(efb.f_nbins),
+            jnp.asarray(efb.f_single))
         # CEGB (cost_effective_gradient_boosting.hpp): coupled per-feature
         # penalties charge once until the feature is first used; tracked
         # host-side across trees (per-tree granularity)
@@ -330,12 +339,15 @@ class GBDT:
         return create_parallel_learner(cfg, self.num_features, self.max_bins,
                                        num_bins, is_cat, has_nan, monotone)
 
+    def _walk(self, bins, *tree_args):
+        """Binned tree walk; routes through the bundle-space decode
+        when the dataset is EFB-bundled (valid sets aligned to an EFB
+        reference carry BUNDLE columns)."""
+        if self._efb_walk is not None:
+            return _walk_binned_efb(bins, self._efb_walk, *tree_args)
+        return _walk_binned(bins, *tree_args)
+
     def add_valid(self, valid_set: Dataset, name: str) -> None:
-        if getattr(self.train_set, "efb", None) is not None:
-            raise NotImplementedError(
-                "validation sets on an EFB-bundled Dataset are not "
-                "supported yet (the binned valid walk needs a bundle-space "
-                "variant); set enable_bundle=false to use valid sets")
         valid_set.construct(self.config)
         if valid_set.num_feature() != self.num_features:
             raise ValueError("validation set feature count differs from train")
@@ -359,7 +371,7 @@ class GBDT:
             vbins = valid_set._device_cache["bins"]
             for t, tree in enumerate(self.models):
                 cid = t % k
-                delta = _walk_binned(
+                delta = self._walk(
                     vbins, jnp.asarray(tree.split_feature),
                     jnp.asarray(tree.threshold_bin), jnp.asarray(tree.nan_bin),
                     _tree_cat_member(tree),
@@ -630,7 +642,7 @@ class GBDT:
         # update validation scores with a tree walk on their binned matrices
         for vi, (_, vset) in enumerate(self.valid_sets):
             vbins = vset._device_cache["bins"]
-            delta = _walk_binned(vbins, grown.split_feature, grown.threshold_bin,
+            delta = self._walk(vbins, grown.split_feature, grown.threshold_bin,
                                  grown.nan_bin, grown.cat_member,
                                  grown.decision_type,
                                  grown.left_child, grown.right_child,
@@ -694,7 +706,7 @@ class GBDT:
             self.score = self.score.at[:, class_id].add(delta)
         for vi, (_, vset) in enumerate(self.valid_sets):
             vbins = vset._device_cache["bins"]
-            idx_f = _walk_binned(
+            idx_f = self._walk(
                 vbins, grown.split_feature, grown.threshold_bin,
                 grown.nan_bin, grown.cat_member, grown.decision_type,
                 grown.left_child, grown.right_child,
@@ -1002,10 +1014,6 @@ class GBDT:
         self._rebuild_scores()
 
     def _rebuild_scores(self) -> None:
-        if getattr(self.train_set, "efb", None) is not None and self.models:
-            raise NotImplementedError(
-                "score rebuilds (rollback/continued training) on an "
-                "EFB-bundled Dataset are not supported yet")
         k = self.num_tree_per_iteration
         n = self.num_data
         shape = (n,) if k == 1 else (n, k)
@@ -1021,13 +1029,12 @@ class GBDT:
                        self._pending_bias[None, :].astype(np.float32))
         self.score = jnp.asarray(score0)
         if self.models:
-            from .tree import _walk_binned as wb
             score = self.score
             for t, tree in enumerate(self.models):
                 cid = t % k
                 if tree.is_linear:
                     from ..learner.linear import linear_score_delta
-                    idx_f = wb(self.X_dev, jnp.asarray(tree.split_feature),
+                    idx_f = self._walk(self.X_dev, jnp.asarray(tree.split_feature),
                                jnp.asarray(tree.threshold_bin),
                                jnp.asarray(tree.nan_bin),
                                _tree_cat_member(tree),
@@ -1041,7 +1048,8 @@ class GBDT:
                         self.X_raw_dev, idx_f.astype(jnp.int32), lf, fm, co,
                         lconst, lval, 1.0)
                 else:
-                    delta = wb(self.X_dev, jnp.asarray(tree.split_feature),
+                    delta = self._walk(
+                        self.X_dev, jnp.asarray(tree.split_feature),
                                jnp.asarray(tree.threshold_bin),
                                jnp.asarray(tree.nan_bin),
                                _tree_cat_member(tree),

@@ -385,6 +385,55 @@ def _walk_binned(bins, split_feature, threshold_bin, nan_bin, cat_member,
     return out
 
 
+@jax.jit
+def _walk_binned_efb(bins, efb_walk, split_feature, threshold_bin, nan_bin,
+                     cat_member, decision_type, left_child, right_child,
+                     leaf_value, num_leaves):
+    """_walk_binned over an EFB-bundled matrix: ``bins`` is (N, G)
+    BUNDLE-space codes; each node's feature code is decoded from its
+    bundle column (efb.make_bundle_decode — the same decode the growers
+    use) before the threshold test.  ``efb_walk`` is the standard
+    efb_arrays tuple (exp_map may be None; the decode ignores it)."""
+    from ..efb import make_bundle_decode
+    decode = make_bundle_decode(efb_walk)
+    f_bundle = efb_walk[1]
+    n = bins.shape[0]
+    node = jnp.where(num_leaves <= 1, -1, 0) * jnp.ones((n,), jnp.int32)
+    bm = cat_member.shape[1]
+
+    def cond(state):
+        node, _ = state
+        return jnp.any(node >= 0)
+
+    def body(state):
+        node, out = state
+        active = node >= 0
+        nd = jnp.maximum(node, 0)
+        f = split_feature[nd]
+        thr = threshold_bin[nd]
+        dt = decision_type[nd]
+        v = jnp.take_along_axis(bins, f_bundle[f][:, None],
+                                axis=1)[:, 0].astype(jnp.int32)
+        b = decode(v, f)
+        is_cat = (dt & CAT_MASK) != 0
+        dleft = (dt & DEFAULT_LEFT_MASK) != 0
+        is_nanbin = b == nan_bin[nd]
+        cat_go = cat_member.reshape(-1)[nd * bm + jnp.minimum(b, bm - 1)]
+        go_left = jnp.where(is_cat, cat_go,
+                            jnp.where(is_nanbin, dleft, b <= thr))
+        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
+        new_node = jnp.where(active, nxt, node)
+        out = jnp.where(active & (new_node < 0),
+                        leaf_value[jnp.maximum(~new_node, 0)], out)
+        return new_node, out
+
+    out0 = jnp.where(num_leaves <= 1,
+                     jnp.broadcast_to(leaf_value[0], (n,)),
+                     jnp.zeros((n,), jnp.float32))
+    node, out = jax.lax.while_loop(cond, body, (node, out0))
+    return out
+
+
 def predict_binned(batch: TreeBatch, bins: jnp.ndarray,
                    num_iteration: Optional[int] = None) -> jnp.ndarray:
     """Sum of per-tree leaf outputs on binned rows (training-time scoring)."""

@@ -95,8 +95,39 @@ def test_efb_model_io_roundtrip(tmp_path):
                                rtol=1e-5, atol=1e-6)
 
 
-def test_efb_valid_set_raises_clearly():
+def test_efb_valid_set_trains():
     X, y = _sparse_data()
     ds = lgb.Dataset(X, y)
-    with pytest.raises(NotImplementedError):
-        lgb.train(P, ds, 5, valid_sets=[lgb.Dataset(X, y, reference=ds)])
+    bst = lgb.train(P, ds, 5, valid_sets=[lgb.Dataset(X, y, reference=ds)])
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_efb_valid_sets_match_direct_prediction():
+    """Valid-set eval on an EFB-bundled reference must equal metrics
+    computed from direct raw-row prediction (the bundle-space tree walk,
+    models/tree.py _walk_binned_efb)."""
+    X, y = _sparse_data(seed=11)
+    ds = lgb.Dataset(sp.csr_matrix(X), y, params=P)
+    vs = lgb.Dataset(sp.csr_matrix(X[:600]), y[:600], reference=ds)
+    ev = {}
+    bst = lgb.train(P, ds, num_boost_round=8, valid_sets=[vs],
+                    valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(ev)])
+    got = ev["v"]["l2"][-1]
+    ref = float(np.mean((bst.predict(X[:600]) - y[:600]) ** 2))
+    assert abs(got - ref) < 1e-4 * max(1.0, abs(ref))
+
+
+def test_efb_continued_training_and_rollback(tmp_path):
+    """Score rebuilds on the bundle-space matrix: init_model resumes from
+    a saved EFB-trained model and keeps improving."""
+    X, y = _sparse_data(seed=12)
+    ds = lgb.Dataset(sp.csr_matrix(X), y, params=P)
+    bst = lgb.train(P, ds, num_boost_round=10)
+    path = str(tmp_path / "efb.txt")
+    bst.save_model(path)
+    ds2 = lgb.Dataset(sp.csr_matrix(X), y, params=P)
+    bst2 = lgb.train(P, ds2, num_boost_round=10, init_model=path)
+    m1 = float(np.mean((bst.predict(X) - y) ** 2))
+    m2 = float(np.mean((bst2.predict(X) - y) ** 2))
+    assert np.isfinite(m2) and m2 <= m1 + 1e-6
