@@ -1,0 +1,154 @@
+"""Benchmark harness over the BASELINE.json config matrix.
+
+Reproduces the five reference benchmark shapes (docs/Experiments.rst +
+BASELINE.json "configs") on synthetic data at a configurable scale, each
+printing one JSON line in bench.py's schema.  The repo-root ``bench.py``
+remains the driver-run headline (Higgs single-chip); this harness covers
+the rest of the matrix:
+
+    python benchmarks/run.py                 # all configs, SCALE=1
+    python benchmarks/run.py higgs ranking   # subset
+    SCALE=0.1 python benchmarks/run.py       # 10x smaller (CI/smoke)
+
+Configs:
+  higgs      10.5M x 28 dense binary, 255 leaves/bins (Experiments.rst:110)
+  higgs_dp   same, tree_learner=data over all visible devices
+  ranking    LambdaRank, MSLR-like query structure, feature-parallel
+  multiclass Covertype-like 7-class + categoricals, GOSS
+  sparse     Criteo-like wide one-hot sparse, EFB + voting-parallel
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCALE = float(os.environ.get("SCALE", 1.0))
+
+
+def _emit(name, trees, dt, extra=""):
+    ips = trees / dt
+    print(json.dumps({
+        "metric": f"boosting_iters_per_sec ({name}{extra})",
+        "value": round(ips, 4),
+        "unit": "iters/s",
+        "vs_baseline": round(ips / (500.0 / 130.094), 4),
+    }), flush=True)
+
+
+def _train(params, ds, trees, valid=None):
+    import lightgbm_tpu as lgb
+    bst = lgb.Booster(params=params, train_set=ds)
+    bst.update()                      # compile + first tree
+    t0 = time.perf_counter()
+    for _ in range(trees):
+        bst.update()
+    float(np.asarray(bst._gbdt.score).sum())
+    return bst, time.perf_counter() - t0
+
+
+def bench_higgs(tree_learner="serial"):
+    import lightgbm_tpu as lgb
+    n = int(10_500_000 * SCALE)
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, 28).astype(np.float32)
+    w = rng.randn(28) / np.sqrt(28)
+    y = ((X @ w + 0.3 * np.sin(2 * X[:, 0]) * X[:, 1] +
+          0.5 * rng.randn(n)) > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+         "learning_rate": 0.1, "verbosity": -1,
+         "tree_learner": tree_learner}
+    trees = int(os.environ.get("TREES", 25))
+    _, dt = _train(p, lgb.Dataset(X, y, params=p), trees)
+    _emit("higgs" if tree_learner == "serial" else "higgs_dp", trees, dt,
+          f", {n}x28, tl={tree_learner}")
+
+
+def bench_ranking():
+    import lightgbm_tpu as lgb
+    nq = int(3000 * SCALE) or 10
+    per_q = 120
+    n = nq * per_q
+    rng = np.random.RandomState(1)
+    X = rng.randn(n, 64).astype(np.float32)
+    w = rng.randn(64) / 8
+    rel = X @ w + 0.7 * rng.randn(n)
+    group = np.full(nq, per_q)
+    y = np.zeros(n)
+    for q in range(nq):  # per-query 5-level relevance
+        s = rel[q * per_q:(q + 1) * per_q]
+        y[q * per_q:(q + 1) * per_q] = np.digitize(
+            s, np.quantile(s, [0.5, 0.75, 0.9, 0.97]))
+    p = {"objective": "lambdarank", "num_leaves": 255, "max_bin": 255,
+         "learning_rate": 0.1, "verbosity": -1,
+         "tree_learner": "feature"}
+    trees = int(os.environ.get("TREES", 25))
+    ds = lgb.Dataset(X, y, group=group, params=p)
+    _, dt = _train(p, ds, trees)
+    _emit("ranking_lambdarank", trees, dt, f", {nq} queries, tl=feature")
+
+
+def bench_multiclass():
+    import lightgbm_tpu as lgb
+    n = int(581_000 * SCALE) or 5000
+    rng = np.random.RandomState(2)
+    Xn = rng.randn(n, 10).astype(np.float32)
+    cat = rng.randint(0, 40, (n, 2)).astype(np.float32)
+    X = np.concatenate([Xn, cat], axis=1)
+    logits = np.stack([Xn @ (rng.randn(10) / 3) +
+                       (cat[:, 0] % 7 == c) * 1.5 for c in range(7)], 1)
+    y = np.argmax(logits + 0.5 * rng.randn(n, 7), axis=1).astype(np.float64)
+    p = {"objective": "multiclass", "num_class": 7, "num_leaves": 63,
+         "max_bin": 255, "learning_rate": 0.1, "verbosity": -1,
+         "boosting": "goss"}
+    trees = int(os.environ.get("TREES", 10))
+    ds = lgb.Dataset(X, y, categorical_feature=[10, 11], params=p)
+    _, dt = _train(p, ds, trees)
+    _emit("multiclass_goss", trees, dt, f", {n}x12 7-class")
+
+
+def bench_sparse():
+    import scipy.sparse as sp
+    import lightgbm_tpu as lgb
+    n = int(1_000_000 * SCALE) or 10_000
+    f = 2000
+    rng = np.random.RandomState(3)
+    nnz_per_row = 25
+    rows = np.repeat(np.arange(n), nnz_per_row)
+    cols = rng.randint(0, f, n * nnz_per_row)
+    vals = rng.rand(n * nnz_per_row).astype(np.float32) + 0.5
+    X = sp.csr_matrix((vals, (rows, cols)), shape=(n, f))
+    y = ((np.asarray(X[:, :50].sum(axis=1)).ravel() +
+          0.5 * rng.randn(n)) > 12.5).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 127, "max_bin": 255,
+         "learning_rate": 0.1, "verbosity": -1,
+         "tree_learner": "voting"}
+    trees = int(os.environ.get("TREES", 10))
+    ds = lgb.Dataset(X, y, params=p)
+    _, dt = _train(p, ds, trees)
+    _emit("sparse_voting_efb", trees, dt, f", {n}x{f} 98.75%-sparse")
+
+
+ALL = {
+    "higgs": lambda: bench_higgs("serial"),
+    "higgs_dp": lambda: bench_higgs("data"),
+    "ranking": bench_ranking,
+    "multiclass": bench_multiclass,
+    "sparse": bench_sparse,
+}
+
+
+def main():
+    from lightgbm_tpu.utils.log import set_verbosity
+    set_verbosity(-1)
+    which = sys.argv[1:] or list(ALL)
+    for name in which:
+        ALL[name]()
+
+
+if __name__ == "__main__":
+    main()

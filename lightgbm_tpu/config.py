@@ -395,7 +395,6 @@ class Config:
 # Entries are removed as features land; tests assert this list shrinks only.
 _UNIMPLEMENTED_PARAMS: Tuple[str, ...] = (
     "forcedbins_filename",
-    "two_round",
     "pre_partition",
     "deterministic",       # training is deterministic by construction, but
                            # the reference's flag also forces col-wise

@@ -66,8 +66,11 @@ def load_data_file(path: str, params: Optional[Dict[str, Any]] = None
 
     delim = "," if fmt == "csv" else "\t"
     skip = 1 if header else 0
-    raw = np.genfromtxt(path, delimiter=delim, skip_header=skip,
-                        dtype=np.float64)
+    two_round = False  # honor reference aliases (config.h two_round)
+    for key in ("two_round", "two_round_loading", "use_two_round_loading"):
+        if str(params.get(key, "false")).lower() in ("true", "1"):
+            two_round = True
+    raw = _load_dense(path, delim, skip, two_round)
     if raw.ndim == 1:
         raw = raw.reshape(-1, 1)
     names: List[str] = []
@@ -81,6 +84,44 @@ def load_data_file(path: str, params: Optional[Dict[str, Any]] = None
     else:
         names = [f"Column_{i}" for i in range(feats.shape[1])]
     return feats, names, label
+
+
+def _load_dense(path: str, delim: str, skip: int,
+                two_round: bool) -> np.ndarray:
+    """Dense CSV/TSV -> float64 matrix.
+
+    Default: one-shot C-parser read.  ``two_round=true`` (reference
+    config.h two_round + dataset_loader.cpp:902's two-pass low-memory
+    loading) streams the file in bounded chunks into a preallocated
+    array instead of materializing parser intermediates for the whole
+    file — for datasets close to memory size.
+    """
+    try:
+        import pandas as pd
+    except ImportError:           # minimal environments: numpy fallback
+        return np.genfromtxt(path, delimiter=delim, skip_header=skip,
+                             dtype=np.float64)
+    # match genfromtxt's tolerance: '#' comments stripped, common missing
+    # markers coerced to NaN rather than raising
+    kw = dict(sep=delim, header=None, skiprows=skip, dtype=np.float64,
+              comment="#", na_values=["", "NA", "nan", "NULL", "null",
+                                      "?", "N/A", "na"])
+    if not two_round:
+        return pd.read_csv(path, **kw).to_numpy()
+    # pass 1: row count only
+    with open(path) as fh:
+        n = sum(1 for _ in fh) - skip
+    out: Optional[np.ndarray] = None
+    r = 0
+    for chunk in pd.read_csv(path, chunksize=1 << 18, **kw):
+        a = chunk.to_numpy()
+        if out is None:
+            out = np.empty((n, a.shape[1]), np.float64)
+        out[r:r + len(a)] = a
+        r += len(a)
+    if out is None:
+        raise ValueError(f"{path} has no data rows")
+    return out[:r]
 
 
 def _load_libsvm(path: str) -> Tuple[np.ndarray, List[str], np.ndarray]:

@@ -348,7 +348,20 @@ class GBDT:
         return _walk_binned(bins, *tree_args)
 
     def add_valid(self, valid_set: Dataset, name: str) -> None:
+        # a valid set must share the train set's bin mappers (and bundle
+        # layout under EFB) — the binned walk reads TRAIN-space codes
+        # (reference dataset.h:304 alignment check raises the same way)
+        if valid_set is not self.train_set and \
+                getattr(valid_set, "reference", None) is not self.train_set \
+                and not valid_set.constructed:
+            valid_set.reference = self.train_set
         valid_set.construct(self.config)
+        if valid_set is not self.train_set and \
+                valid_set.bin_mappers is not self.train_set.bin_mappers:
+            raise ValueError(
+                "cannot add validation data: it was constructed without "
+                "reference to the training Dataset (different bin "
+                "mappers); pass reference=train_set when creating it")
         if valid_set.num_feature() != self.num_features:
             raise ValueError("validation set feature count differs from train")
         k = self.num_tree_per_iteration

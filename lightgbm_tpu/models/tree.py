@@ -338,17 +338,14 @@ def predict_raw_early_stop(fields, X, margin, *, freq: int, mode: str):
     return out
 
 
-@jax.jit
-def _walk_binned(bins, split_feature, threshold_bin, nan_bin, cat_member,
-                 decision_type, left_child, right_child, leaf_value,
-                 num_leaves):
-    """Vectorized tree walk on BINNED data for one tree.
-
-    bins: (N, F) int; tree arrays as in TreeBatch rows; cat_member is the
-    (L-1, B) categorical LEFT-set membership over bins.
-    Returns (N,) float32 leaf values.
-    """
-    n = bins.shape[0]
+def _walk_impl(fetch_bin, n, split_feature, threshold_bin, nan_bin,
+               cat_member, decision_type, left_child, right_child,
+               leaf_value, num_leaves):
+    """Shared body of the binned tree walkers: ``fetch_bin(nd, f)`` returns
+    each row's FEATURE-space bin code for node feature ``f`` — plain
+    column take for feature-space matrices, bundle-column decode under
+    EFB.  One implementation so walk semantics (NaN routing, categorical
+    membership, default-left) can never diverge between the two."""
     node = jnp.where(num_leaves <= 1, -1, 0) * jnp.ones((n,), jnp.int32)
     bm = cat_member.shape[1]
 
@@ -363,11 +360,12 @@ def _walk_binned(bins, split_feature, threshold_bin, nan_bin, cat_member,
         f = split_feature[nd]
         thr = threshold_bin[nd]
         dt = decision_type[nd]
-        b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        b = fetch_bin(nd, f)
         is_cat = (dt & CAT_MASK) != 0
         dleft = (dt & DEFAULT_LEFT_MASK) != 0
-        # the NaN bin is the feature's last bin, above any real threshold, so
-        # "missing right" is automatic; "missing left" overrides via nan_bin
+        # the NaN bin is the feature's last bin, above any real threshold,
+        # so "missing right" is automatic; "missing left" overrides via
+        # nan_bin
         is_nanbin = b == nan_bin[nd]
         cat_go = cat_member.reshape(-1)[nd * bm + jnp.minimum(b, bm - 1)]
         go_left = jnp.where(is_cat, cat_go,
@@ -383,6 +381,25 @@ def _walk_binned(bins, split_feature, threshold_bin, nan_bin, cat_member,
                      jnp.zeros((n,), jnp.float32))
     node, out = jax.lax.while_loop(cond, body, (node, out0))
     return out
+
+
+@jax.jit
+def _walk_binned(bins, split_feature, threshold_bin, nan_bin, cat_member,
+                 decision_type, left_child, right_child, leaf_value,
+                 num_leaves):
+    """Vectorized tree walk on BINNED data for one tree.
+
+    bins: (N, F) int; tree arrays as in TreeBatch rows; cat_member is the
+    (L-1, B) categorical LEFT-set membership over bins.
+    Returns (N,) float32 leaf values.
+    """
+    def fetch_bin(nd, f):
+        return jnp.take_along_axis(bins, f[:, None],
+                                   axis=1)[:, 0].astype(jnp.int32)
+
+    return _walk_impl(fetch_bin, bins.shape[0], split_feature,
+                      threshold_bin, nan_bin, cat_member, decision_type,
+                      left_child, right_child, leaf_value, num_leaves)
 
 
 @jax.jit
@@ -397,41 +414,15 @@ def _walk_binned_efb(bins, efb_walk, split_feature, threshold_bin, nan_bin,
     from ..efb import make_bundle_decode
     decode = make_bundle_decode(efb_walk)
     f_bundle = efb_walk[1]
-    n = bins.shape[0]
-    node = jnp.where(num_leaves <= 1, -1, 0) * jnp.ones((n,), jnp.int32)
-    bm = cat_member.shape[1]
 
-    def cond(state):
-        node, _ = state
-        return jnp.any(node >= 0)
-
-    def body(state):
-        node, out = state
-        active = node >= 0
-        nd = jnp.maximum(node, 0)
-        f = split_feature[nd]
-        thr = threshold_bin[nd]
-        dt = decision_type[nd]
+    def fetch_bin(nd, f):
         v = jnp.take_along_axis(bins, f_bundle[f][:, None],
                                 axis=1)[:, 0].astype(jnp.int32)
-        b = decode(v, f)
-        is_cat = (dt & CAT_MASK) != 0
-        dleft = (dt & DEFAULT_LEFT_MASK) != 0
-        is_nanbin = b == nan_bin[nd]
-        cat_go = cat_member.reshape(-1)[nd * bm + jnp.minimum(b, bm - 1)]
-        go_left = jnp.where(is_cat, cat_go,
-                            jnp.where(is_nanbin, dleft, b <= thr))
-        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
-        new_node = jnp.where(active, nxt, node)
-        out = jnp.where(active & (new_node < 0),
-                        leaf_value[jnp.maximum(~new_node, 0)], out)
-        return new_node, out
+        return decode(v, f)
 
-    out0 = jnp.where(num_leaves <= 1,
-                     jnp.broadcast_to(leaf_value[0], (n,)),
-                     jnp.zeros((n,), jnp.float32))
-    node, out = jax.lax.while_loop(cond, body, (node, out0))
-    return out
+    return _walk_impl(fetch_bin, bins.shape[0], split_feature,
+                      threshold_bin, nan_bin, cat_member, decision_type,
+                      left_child, right_child, leaf_value, num_leaves)
 
 
 def predict_binned(batch: TreeBatch, bins: jnp.ndarray,

@@ -1,0 +1,88 @@
+"""Text-loader tests (reference src/io/parser.cpp CreateParser detection +
+dataset_loader.cpp two-round loading)."""
+
+import os
+
+import numpy as np
+
+from lightgbm_tpu.io_utils import _detect_format, load_data_file
+
+
+def _write_csv(path, M, lab, header):
+    with open(path, "w") as fh:
+        fh.write(header + "\n")
+        for i in range(len(M)):
+            fh.write(",".join(
+                [str(float(lab[i]))] +
+                ["" if np.isnan(v) else str(float(v)) for v in M[i]]) + "\n")
+
+
+def test_detect_format_colon_header_not_libsvm():
+    assert _detect_format("label,a,b:1,c") == "csv"
+    assert _detect_format("1 2:0.5 7:1.25") == "libsvm"
+    assert _detect_format("0.5\t1.25\t3") == "tsv"
+
+
+def test_dense_loader_nan_and_two_round(tmp_path):
+    rng = np.random.RandomState(0)
+    M = rng.randn(1000, 5)
+    M[rng.rand(*M.shape) < 0.02] = np.nan
+    lab = (rng.rand(1000) > 0.5).astype(float)
+    p = str(tmp_path / "t.csv")
+    _write_csv(p, M, lab, "label,a,b:1,c,d,e")
+    f1, n1, l1 = load_data_file(p, {"header": "true"})
+    f2, n2, l2 = load_data_file(p, {"header": "true", "two_round": "true"})
+    np.testing.assert_array_equal(np.isnan(f1), np.isnan(M))
+    np.testing.assert_allclose(np.nan_to_num(f1), np.nan_to_num(M))
+    np.testing.assert_allclose(np.nan_to_num(f2), np.nan_to_num(f1))
+    np.testing.assert_allclose(l1, lab)
+    np.testing.assert_allclose(l2, lab)
+    assert n1 == ["a", "b:1", "c", "d", "e"] == n2
+
+
+def test_libsvm_loader(tmp_path):
+    p = str(tmp_path / "t.svm")
+    with open(p, "w") as fh:
+        fh.write("1 0:0.5 3:2.0\n0 1:1.5\n1 2:-1.0 3:4.0\n")
+    X, names, y = load_data_file(p, {})
+    np.testing.assert_allclose(y, [1, 0, 1])
+    np.testing.assert_allclose(X, [[0.5, 0, 0, 2.0],
+                                   [0, 1.5, 0, 0],
+                                   [0, 0, -1.0, 4.0]])
+
+
+def test_misaligned_valid_set_raises():
+    """A valid set constructed without reference to the train set has its
+    own bin mappers — add_valid must refuse it (reference dataset.h:304
+    alignment check), not silently evaluate on wrong leaf assignments."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    X, y = rng.randn(300, 4), rng.randn(300)
+    P = {"objective": "regression", "num_leaves": 7, "verbosity": -1}
+    from lightgbm_tpu.config import Config
+    vs = lgb.Dataset(X[:100], y[:100])
+    vs.construct(Config(P))            # standalone mappers
+    try:
+        lgb.train(P, lgb.Dataset(X, y), 2, valid_sets=[vs])
+    except ValueError as e:
+        assert "reference" in str(e)
+    else:
+        raise AssertionError("misaligned valid set was accepted")
+
+
+def test_unreferenced_valid_set_auto_aligns():
+    """An unconstructed valid set without an explicit reference is aligned
+    to the train set automatically (reference engine.py does the same)."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 4)
+    y = X[:, 0] + 0.1 * rng.randn(400)
+    P = {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+         "metric": "l2"}
+    ev = {}
+    bst = lgb.train(P, lgb.Dataset(X, y), 5,
+                    valid_sets=[lgb.Dataset(X[:150], y[:150])],
+                    valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(ev)])
+    ref = float(np.mean((bst.predict(X[:150]) - y[:150]) ** 2))
+    assert abs(ev["v"]["l2"][-1] - ref) < 1e-4 * max(1.0, ref)
