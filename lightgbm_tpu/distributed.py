@@ -33,19 +33,41 @@ _initialized = False
 def init(coordinator_address: Optional[str] = None,
          num_processes: Optional[int] = None,
          process_id: Optional[int] = None,
-         local_device_ids=None) -> None:
+         local_device_ids=None,
+         cpu_collectives: str = "gloo") -> None:
     """Initialize the JAX multi-process runtime (replaces the reference's
     ``Network::Init`` rank-0 handshake, network.cpp:26-43).
 
     On managed TPU slices (GKE/TPU VM) all arguments are optional — JAX
     discovers the topology from the environment; pass them explicitly for
     manual clusters, mirroring machine_list_file + local_listen_port.
+
+    After init, the parallel tree learners work UNCHANGED: their mesh
+    spans all hosts' devices and every process runs the same SPMD driver
+    with the full host-side data — the reference's default distributed
+    mode without ``pre_partition`` (each machine loads all data,
+    dataset_loader.cpp:181 ``LoadFromFile(rank, num_machines)``); device
+    memory shards across hosts even though host memory does not.
+
+    ``cpu_collectives`` selects the cross-process collective backend for
+    CPU clusters (gloo; TPU meshes use ICI/DCN natively).
     """
     global _initialized
     if _initialized:
         log_warning("lightgbm_tpu.distributed.init called twice; ignoring")
         return
     import jax
+    if cpu_collectives:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              cpu_collectives)
+        except AttributeError:
+            # option absent on this jax version; invalid VALUES still
+            # propagate so a typo'd backend fails loudly here rather than
+            # hanging at the first cross-process collective
+            log_warning("this jax version has no "
+                        "jax_cpu_collectives_implementation option; "
+                        "cross-process CPU collectives may be unavailable")
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id,

@@ -61,15 +61,21 @@ def load_data_file(path: str, params: Optional[Dict[str, Any]] = None
             raise ValueError(f"{path} is empty")
     fmt = _detect_format(first.strip())
 
-    if fmt == "libsvm":
-        return _load_libsvm(path)
-
-    delim = "," if fmt == "csv" else "\t"
-    skip = 1 if header else 0
     two_round = False  # honor reference aliases (config.h two_round)
     for key in ("two_round", "two_round_loading", "use_two_round_loading"):
         if str(params.get(key, "false")).lower() in ("true", "1"):
             two_round = True
+
+    if fmt == "libsvm":
+        if two_round:
+            from .utils.log import log_warning
+            log_warning("two_round chunked loading applies to dense "
+                        "CSV/TSV only; the LibSVM parser loads in one "
+                        "pass")
+        return _load_libsvm(path)
+
+    delim = "," if fmt == "csv" else "\t"
+    skip = 1 if header else 0
     raw = _load_dense(path, delim, skip, two_round)
     if raw.ndim == 1:
         raw = raw.reshape(-1, 1)
@@ -101,20 +107,31 @@ def _load_dense(path: str, delim: str, skip: int,
     except ImportError:           # minimal environments: numpy fallback
         return np.genfromtxt(path, delimiter=delim, skip_header=skip,
                              dtype=np.float64)
-    # match genfromtxt's tolerance: '#' comments stripped, common missing
-    # markers coerced to NaN rather than raising
-    kw = dict(sep=delim, header=None, skiprows=skip, dtype=np.float64,
-              comment="#", na_values=["", "NA", "nan", "NULL", "null",
-                                      "?", "N/A", "na"])
+    # match genfromtxt's tolerance: '#' comments stripped, missing markers
+    # and ANY unparseable token coerced to NaN rather than raising (the
+    # slow coerce path only runs when the fast typed parse fails)
+    kw = dict(sep=delim, header=None, skiprows=skip, comment="#",
+              na_values=["", "NA", "nan", "NULL", "null", "?", "N/A", "na"])
+
+    def _to_f64(df):
+        """Clean numeric columns are already float64 after type inference
+        (no copy cost); mixed/object columns go through per-column coerce
+        so junk tokens become NaN like genfromtxt."""
+        try:
+            return df.astype(np.float64).to_numpy()
+        except (ValueError, TypeError):
+            return df.apply(pd.to_numeric, errors="coerce").to_numpy(
+                np.float64)
+
     if not two_round:
-        return pd.read_csv(path, **kw).to_numpy()
+        return _to_f64(pd.read_csv(path, **kw))
     # pass 1: row count only
     with open(path) as fh:
         n = sum(1 for _ in fh) - skip
     out: Optional[np.ndarray] = None
     r = 0
     for chunk in pd.read_csv(path, chunksize=1 << 18, **kw):
-        a = chunk.to_numpy()
+        a = _to_f64(chunk)
         if out is None:
             out = np.empty((n, a.shape[1]), np.float64)
         out[r:r + len(a)] = a

@@ -107,6 +107,27 @@ def _tree_cat_member(tree: Tree) -> jnp.ndarray:
     return jnp.zeros((max(len(tree.split_feature), 1), 1), jnp.bool_)
 
 
+def _mappers_equal(a, b) -> bool:
+    """Bin-mapper alignment by VALUE (reference dataset.h:304 CheckAlign) —
+    identity fails for equal mappers reloaded from the binary dataset
+    cache."""
+    if len(a) != len(b):
+        return False
+    for ma, mb in zip(a, b):
+        if (ma.num_bin != mb.num_bin or
+                ma.is_categorical != mb.is_categorical or
+                ma.missing_type != mb.missing_type):
+            return False
+        if ma.bin_upper_bound is not None or mb.bin_upper_bound is not None:
+            if ma.bin_upper_bound is None or mb.bin_upper_bound is None or \
+                    not np.array_equal(ma.bin_upper_bound,
+                                       mb.bin_upper_bound):
+                return False
+        if ma.cat_to_bin != mb.cat_to_bin:
+            return False
+    return True
+
+
 @jax.jit
 def _update_score_by_leaf(score, row_leaf, leaf_value, shrinkage):
     """score += shrinkage * leaf_value[row_leaf] — training-set score update
@@ -357,7 +378,9 @@ class GBDT:
             valid_set.reference = self.train_set
         valid_set.construct(self.config)
         if valid_set is not self.train_set and \
-                valid_set.bin_mappers is not self.train_set.bin_mappers:
+                valid_set.bin_mappers is not self.train_set.bin_mappers and \
+                not _mappers_equal(valid_set.bin_mappers,
+                                   self.train_set.bin_mappers):
             raise ValueError(
                 "cannot add validation data: it was constructed without "
                 "reference to the training Dataset (different bin "

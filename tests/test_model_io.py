@@ -125,3 +125,21 @@ def test_num_iteration_predict(binary_data):
     p5 = bst.predict(X, num_iteration=5, raw_score=True)
     p10 = bst.predict(X, raw_score=True)
     assert not np.allclose(p5, p10)
+
+
+def test_binary_cache_valid_set_accepted(tmp_path, binary_data):
+    """A valid set built against the train reference, saved to the binary
+    cache and reloaded, has equal-but-not-identical bin mappers — the
+    value-based alignment check (dataset.h:304 CheckAlign analog) must
+    accept it."""
+    X, y = binary_data
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    vs = lgb.Dataset(X[:200], y[:200], reference=ds)
+    vs.construct()
+    path = str(tmp_path / "valid.bin")
+    vs.save_binary(path)
+    vs2 = lgb.Dataset.load_binary(path)
+    bst = lgb.train({**SMALL, "objective": "binary"}, ds, 3,
+                    valid_sets=[vs2])
+    assert bst.num_trees() == 3
