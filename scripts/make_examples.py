@@ -122,8 +122,40 @@ output_model = LightGBM_model.txt
 """)
 
 
+def multiclass():
+    d = os.path.join(ROOT, "multiclass_classification")
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.RandomState(13)
+    n, f = 1800, 12
+    X = rng.randn(n, f)
+    logits = np.stack([X[:, :4] @ (rng.randn(4)) for _ in range(5)], 1)
+    y = np.argmax(logits + 0.8 * rng.randn(n, 5), axis=1).astype(int)
+    write_tsv(os.path.join(d, "multiclass.train"), y[:1400], X[:1400])
+    write_tsv(os.path.join(d, "multiclass.test"), y[1400:], X[1400:])
+    with open(os.path.join(d, "train.conf"), "w") as fh:
+        fh.write("""# multiclass classification example (synthetic data)
+task = train
+objective = multiclass
+num_class = 5
+metric = multi_logloss
+data = multiclass.train
+valid_data = multiclass.test
+num_trees = 30
+learning_rate = 0.15
+num_leaves = 15
+output_model = LightGBM_model.txt
+""")
+    with open(os.path.join(d, "predict.conf"), "w") as fh:
+        fh.write("""task = predict
+data = multiclass.test
+input_model = LightGBM_model.txt
+output_result = LightGBM_predict_result.txt
+""")
+
+
 if __name__ == "__main__":
     binary()
     regression()
     lambdarank()
+    multiclass()
     print(f"examples written under {ROOT}")
