@@ -522,21 +522,24 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
     return jax.jit(grow) if jit else grow
 
 
-def resolve_hist_impl(config: Config, parallel: bool = False) -> str:
+def resolve_hist_impl(config: Config, parallel: bool = False,
+                      wave: bool = False) -> str:
     """Pick the histogram implementation (the analog of the reference's
     col-wise/row-wise autotune, dataset.cpp:659-670, collapsed to a static
     choice: the Pallas MXU kernel on TPU, scatter-add elsewhere).
 
-    ``parallel`` learners run the grower inside shard_map where the Pallas
-    path's transposed layout is not wired yet — they use the XLA onehot
-    formulation on TPU."""
+    The SEQUENTIAL ``parallel`` growers (masked grower under shard_map)
+    use the XLA onehot formulation on TPU — their per-split compaction
+    path has no feature-major layout.  The WAVE grower keeps the Pallas
+    leaf-batched kernel in both serial and shard_map form (``wave=True``;
+    it owns the (F, N) layout natively)."""
     impl = config.tpu_histogram_impl
     if impl == "auto":
         if jax.default_backend() == "tpu":
-            impl = "onehot" if parallel else "pallas"
+            impl = "onehot" if (parallel and not wave) else "pallas"
         else:
             impl = "segment"
-    elif impl == "pallas" and parallel:
+    elif impl == "pallas" and parallel and not wave:
         impl = "onehot"
     return impl
 

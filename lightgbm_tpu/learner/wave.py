@@ -55,7 +55,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                       max_depth: int, split_params, hist_impl: str,
                       any_cat: bool = True, interpret: bool = False,
                       jit: bool = True, wave_size: int = WAVE_SIZE,
-                      efb_dims=None, feature_contri: tuple = ()):
+                      efb_dims=None, feature_contri: tuple = (),
+                      strategy=None):
     """Build the wave single-tree grower.
 
     Returned signature matches the partitioned grower:
@@ -63,6 +64,14 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
     cegb_penalty, efb_arrays, feature_mask) -> GrownTree`` with X_T the
     FEATURE-MAJOR (G, N) bin matrix (bundle-space under EFB), N a multiple
     of the Pallas row block when hist_impl == 'pallas'.
+
+    ``strategy`` hooks the data-parallel mesh in: under shard_map with
+    row-sharded X_T/grad/hess, ``strategy.reduce_hist`` psums each wave's
+    (W, G, Bb, 3) histogram batch and ``reduce_sum`` the root totals —
+    ONE collective per wave instead of the per-split reduce-scatter of
+    the sequential DP learner (data_parallel_tree_learner.cpp:155-173's
+    pattern amortized over up to 25 splits).  Candidate scans then run
+    replicated on every shard with no further communication.
     """
     L = num_leaves
     F = num_features
@@ -89,7 +98,17 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
              monotone: jnp.ndarray, cegb_penalty: jnp.ndarray,
              efb_arrays: tuple, feature_mask: jnp.ndarray) -> GrownTree:
         n = X_T.shape[1]
-        strat = CommStrategy(num_bins, is_cat, has_nan, monotone)
+        if strategy is not None:
+            # shallow per-trace copy: traced array attributes must not
+            # outlive the trace on the learner's long-lived strategy object
+            import copy
+            strat = copy.copy(strategy)
+            strat.num_bins_full = num_bins
+            strat.is_cat_full = is_cat
+            strat.has_nan_full = has_nan
+            strat.monotone_full = monotone
+        else:
+            strat = CommStrategy(num_bins, is_cat, has_nan, monotone)
         strat.cegb_full = cegb_penalty if sp.use_cegb else None
         if feature_contri:
             strat.contri_full = jnp.asarray(feature_contri, jnp.float32)
@@ -112,8 +131,11 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             # loop; XLA cannot hoist it out of lax.while itself)
             bins_rows = jnp.swapaxes(X_T, 0, 1)
 
-        def hist_waves(ch):
-            """(W', G, Bb, 3) histograms of the wave's leaf channels."""
+        def hist_waves(ch, k=W):
+            """(k, G, Bb, 3) histograms of the wave's leaf channels,
+            reduced across row shards (serial: identity).  ``k`` trims the
+            cross-shard reduction to the channels actually used (the root
+            pass needs only channel 0)."""
             if pallas:
                 h = build_histogram_pallas_leaves(X_T, w8, ch, num_bins=Bb,
                                                   interpret=interpret)
@@ -121,7 +143,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 h = build_histogram_leaves(
                     bins_rows, gm, hm, cnt_mask, ch,
                     num_channels=W, num_bins=Bb, impl=hist_impl)
-            return h[:W]
+            return strat.reduce_hist(h[:k])
 
         def feature_col(feat):
             """FEATURE-space bin codes (N,) of one feature (decoded from
@@ -142,8 +164,9 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             return jax.vmap(one)(hists, sums, bounds, depths, pouts)
 
         # ---- root ----
-        root_sum = jnp.stack([jnp.sum(gm), jnp.sum(hm), jnp.sum(cnt_mask)])
-        root_hist = hist_waves(jnp.zeros((n,), jnp.int32))[0]
+        root_sum = strat.reduce_sum(jnp.stack([
+            jnp.sum(gm), jnp.sum(hm), jnp.sum(cnt_mask)]))
+        root_hist = hist_waves(jnp.zeros((n,), jnp.int32), k=1)[0]
         root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
         root_out = _child_out(root_sum[0], root_sum[1], root_sum[2],
                               jnp.asarray(0.0, jnp.float32))

@@ -101,8 +101,31 @@ class DataParallelStrategy(CommStrategy):
                     bound_r, depth, po_r))
 
 
+class WaveDPStrategy(CommStrategy):
+    """Row-sharded strategy for the wave grower: ONE histogram psum per
+    wave (up to 25 splits' smaller children), scans replicated."""
+
+    rows_sharded = True
+
+    def __init__(self, axis_name: str):
+        self.axis_name = axis_name
+        self.monotone_full = None
+
+    def reduce_sum(self, v):
+        return jax.lax.psum(v, self.axis_name)
+
+    def reduce_hist(self, hist):
+        return jax.lax.psum(hist, self.axis_name)
+
+
 class DataParallelTreeLearner:
-    """Host-side wrapper building the shard_map'd grower."""
+    """Host-side wrapper building the shard_map'd grower.
+
+    Two growers: the WAVE grower (TPU default — leaf-batched histograms,
+    one psum per wave, no row movement) and the masked sequential grower
+    with per-split psum_scatter blocks (the reference DP layout,
+    data_parallel_tree_learner.cpp:155-173; used off-TPU and when wave is
+    gated off)."""
 
     name = "data"
 
@@ -115,6 +138,19 @@ class DataParallelTreeLearner:
         self.mesh = get_mesh(int(config.num_devices))
         self.ndev = self.mesh.devices.size
         self.axis = self.mesh.axis_names[0]
+        mode = str(config.tree_grow_mode)
+        impl_wave = resolve_hist_impl(config, parallel=True, wave=True)
+        # same gates as SerialTreeLearner's wave_ok: the wave state carries
+        # the full (L, G, B, 3) histogram pool — fall back to the masked
+        # sequential grower when it would blow the HBM budget
+        self.wave = (int(config.num_leaves) > 2 and
+                     hist_pool_fits(config, num_features, self.max_bins) and
+                     (mode == "wave" or
+                      (mode == "auto" and impl_wave == "pallas")))
+        if self.wave:
+            self._init_wave(config, num_features, num_bins, is_cat, has_nan,
+                            monotone, impl_wave)
+            return
         # pad the feature axis to a multiple of the mesh so psum_scatter
         # blocks are uniform (padded features are trivial: 1 bin, never
         # splittable — the analog of the reference's balanced block layout)
@@ -158,15 +194,74 @@ class DataParallelTreeLearner:
             out_specs=tree_specs,
             check_vma=False))
 
+    def _init_wave(self, config, num_features, num_bins, is_cat, has_nan,
+                   monotone, impl):
+        from ..learner.wave import make_wave_grow_fn
+        self.f_pad = 0
+        self.pallas = impl == "pallas"
+        self.num_bins = jnp.asarray(num_bins, jnp.int32)
+        self.is_cat = jnp.asarray(is_cat, jnp.bool_)
+        self.has_nan = jnp.asarray(has_nan, jnp.bool_)
+        mono_np = monotone if monotone is not None else np.zeros(num_features)
+        self.monotone = jnp.asarray(mono_np, jnp.int32)
+        self._x_src = None
+        strategy = WaveDPStrategy(self.axis)
+        grow_w = make_wave_grow_fn(
+            num_leaves=int(config.num_leaves), num_features=num_features,
+            max_bins=self.max_bins, max_depth=int(config.max_depth),
+            split_params=split_params_from_config(config, num_bins, is_cat),
+            hist_impl=impl, any_cat=bool(np.any(np.asarray(is_cat))),
+            wave_size=int(config.tpu_wave_size), strategy=strategy,
+            jit=False)
+
+        def grow(X_T, g, h, m, nb, ic, hn, mono, fm):
+            cegb = jnp.zeros((num_features,), jnp.float32)
+            return grow_w(X_T, g, h, m, nb, ic, hn, mono, cegb, (), fm)
+
+        tree_specs = GrownTree(
+            split_feature=P(), threshold_bin=P(), nan_bin=P(),
+            cat_member=P(), decision_type=P(), left_child=P(), right_child=P(),
+            split_gain=P(), internal_value=P(), internal_weight=P(),
+            internal_count=P(), leaf_value=P(), leaf_weight=P(),
+            leaf_count=P(), num_leaves=P(), row_leaf=P(self.axis))
+        self._grow = jax.jit(jax.shard_map(
+            grow, mesh=self.mesh,
+            in_specs=(P(None, self.axis), P(self.axis), P(self.axis),
+                      P(self.axis), P(), P(), P(), P(), P()),
+            out_specs=tree_specs,
+            check_vma=False))
+
     def train(self, X_dev: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               sample_mask: jnp.ndarray,
               feature_mask: Optional[jnp.ndarray] = None) -> GrownTree:
         if feature_mask is None:
             feature_mask = jnp.ones((self.num_features,), jnp.bool_)
+        n = X_dev.shape[0]
+        if self.wave:
+            # each shard's rows must satisfy the Pallas row-block contract
+            if self.pallas:
+                from ..ops.histogram_pallas import DEFAULT_ROW_BLOCK
+                quantum = self.ndev * DEFAULT_ROW_BLOCK
+            else:
+                quantum = self.ndev
+            pad = (-n) % quantum
+            if self._x_src is not X_dev:
+                Xp = jnp.pad(X_dev, ((0, pad), (0, 0))) if pad else X_dev
+                self._XpT = jnp.asarray(jnp.swapaxes(Xp, 0, 1))
+                self._x_src = X_dev
+            if pad:
+                grad = jnp.pad(grad, (0, pad))
+                hess = jnp.pad(hess, (0, pad))
+                sample_mask = jnp.pad(sample_mask, (0, pad))
+            grown = self._grow(self._XpT, grad, hess, sample_mask,
+                               self.num_bins, self.is_cat, self.has_nan,
+                               self.monotone, feature_mask)
+            if pad:
+                grown = grown._replace(row_leaf=grown.row_leaf[:n])
+            return grown
         if self.f_pad:
             X_dev = jnp.pad(X_dev, ((0, 0), (0, self.f_pad)))
             feature_mask = jnp.pad(feature_mask, (0, self.f_pad))
-        n = X_dev.shape[0]
         pad = (-n) % self.ndev
         if pad:
             X_dev = jnp.pad(X_dev, ((0, pad), (0, 0)))

@@ -104,3 +104,28 @@ def test_voting_with_many_features():
     # voting restricts aggregated features but must still learn the signal
     order = np.argsort(-p)
     assert y[order[: n // 4]].mean() > 0.8
+
+
+def test_data_parallel_wave_matches_serial_wave(data):
+    """The wave grower under shard_map (one histogram psum per wave) must
+    reproduce the single-device wave grower: psum'd histograms make every
+    shard's candidate scans identical."""
+    X, y = data
+    p = {**SMALL, "objective": "binary", "tree_grow_mode": "wave"}
+    serial = lgb.train(p, lgb.Dataset(X, y), 5).predict(X)
+    dp = lgb.train({**p, "tree_learner": "data"},
+                   lgb.Dataset(X, y), 5).predict(X)
+    np.testing.assert_allclose(dp, serial, atol=2e-5)
+
+
+def test_data_parallel_wave_bagging_multiclass(data):
+    X, y = data
+    rng = np.random.RandomState(3)
+    ym = (rng.rand(len(y)) < 0.3).astype(int) + y.astype(int)
+    p = {**SMALL, "objective": "multiclass", "num_class": 3,
+         "tree_grow_mode": "wave", "bagging_fraction": 0.7,
+         "bagging_freq": 1}
+    serial = lgb.train(p, lgb.Dataset(X, ym.astype(float)), 4).predict(X)
+    dp = lgb.train({**p, "tree_learner": "data"},
+                   lgb.Dataset(X, ym.astype(float)), 4).predict(X)
+    np.testing.assert_allclose(dp, serial, atol=5e-5)
