@@ -169,12 +169,18 @@ class BinMapper:
 
 def find_bin(sample_values: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
              *, total_cnt: Optional[int] = None, is_categorical: bool = False,
-             use_missing: bool = True, zero_as_missing: bool = False) -> BinMapper:
+             use_missing: bool = True, zero_as_missing: bool = False,
+             forced_bounds: Optional[Sequence[float]] = None) -> BinMapper:
     """Construct a BinMapper from a sample of one feature's values
     (reference src/io/bin.cpp BinMapper::FindBin).
 
     ``sample_values`` may contain NaN.  ``total_cnt`` is the full dataset row
     count when the sample is a subsample (affects zero-count accounting).
+    ``forced_bounds`` are mandatory bin upper bounds from
+    ``forcedbins_filename`` (reference dataset_loader.cpp:641
+    ``DatasetLoader::GetForcedBins`` + bin.cpp FindBin forced_upper_bounds):
+    they always appear as boundaries; the greedy boundaries fill the
+    remaining budget.
     """
     sample_values = np.asarray(sample_values, dtype=np.float64).ravel()
     n_sample = len(sample_values)
@@ -233,6 +239,29 @@ def find_bin(sample_values: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
         if zero_cnt == 0 and (len(neg) == 0 or len(pos) == 0):
             boundaries = [b for b in boundaries
                           if not (-ZERO_THRESHOLD <= b <= ZERO_THRESHOLD)] or [np.inf]
+
+    if forced_bounds:
+        # forced boundaries first (truncated to the bin budget — the
+        # reference caps at max_bin), then the zero-bin boundaries (the
+        # dedicated zero/missing bin must survive, bin.cpp
+        # FindBinWithZeroAsOneBin), then greedy boundaries sampled evenly
+        # across the value range to fill the remainder
+        budget = max(max_bin - (1 if missing_type == MissingType.NAN else 0),
+                     2)
+        forced = sorted({float(b) for b in forced_bounds})[:budget - 1]
+        computed = sorted(set(boundaries))
+        keep = set(forced) | {np.inf}
+        for b in computed:
+            if -ZERO_THRESHOLD <= b <= ZERO_THRESHOLD and \
+                    len(keep) < budget:
+                keep.add(float(b))
+        rest = [b for b in computed if float(b) not in keep]
+        need = budget - len(keep)
+        if need > 0 and rest:
+            idx = np.unique(np.linspace(0, len(rest) - 1,
+                                        min(need, len(rest))).astype(int))
+            keep.update(float(rest[i]) for i in idx)
+        boundaries = sorted(keep)
 
     ub = np.asarray(sorted(set(boundaries)), dtype=np.float64)
     num_bin = len(ub)

@@ -149,6 +149,15 @@ class Dataset:
         else:
             # sample rows for bin finding (dataset_loader.cpp:902
             # SampleTextDataFromFile — here rows are already in memory)
+            # forced bin boundaries (dataset_loader.cpp:641 GetForcedBins:
+            # JSON list of {"feature": i, "bin_upper_bound": [...]})
+            forced_bins: Dict[int, list] = {}
+            if getattr(cfg, "forcedbins_filename", ""):
+                import json as _json
+                with open(cfg.forcedbins_filename) as fh:
+                    for ent in _json.load(fh):
+                        forced_bins[int(ent["feature"])] = \
+                            list(ent["bin_upper_bound"])
             self.bin_mappers = []
             for j in range(f):
                 if sparse:
@@ -172,7 +181,8 @@ class Dataset:
                     total_cnt=n,
                     is_categorical=(j in cat_indices),
                     use_missing=cfg.use_missing,
-                    zero_as_missing=cfg.zero_as_missing))
+                    zero_as_missing=cfg.zero_as_missing,
+                    forced_bounds=forced_bins.get(j)))
             # pre-filter trivial features (config.h feature_pre_filter)
             used = [j for j, m in enumerate(self.bin_mappers) if not m.is_trivial]
             if len(used) == 0:

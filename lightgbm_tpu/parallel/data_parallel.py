@@ -181,12 +181,7 @@ class DataParallelTreeLearner:
 
         def grow(X, g, h, m, nb, ic, hn, mono, fm):
             return grow_t(X, None, g, h, m, nb, ic, hn, mono, fm)
-        tree_specs = GrownTree(
-            split_feature=P(), threshold_bin=P(), nan_bin=P(),
-            cat_member=P(), decision_type=P(), left_child=P(), right_child=P(),
-            split_gain=P(), internal_value=P(), internal_weight=P(),
-            internal_count=P(), leaf_value=P(), leaf_weight=P(),
-            leaf_count=P(), num_leaves=P(), row_leaf=P(self.axis))
+        tree_specs = self._tree_specs(self.axis)
         self._grow = jax.jit(jax.shard_map(
             grow, mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis), P(self.axis), P(self.axis),
@@ -194,9 +189,27 @@ class DataParallelTreeLearner:
             out_specs=tree_specs,
             check_vma=False))
 
+    @staticmethod
+    def _tree_specs(axis):
+        return GrownTree(
+            split_feature=P(), threshold_bin=P(), nan_bin=P(),
+            cat_member=P(), decision_type=P(), left_child=P(),
+            right_child=P(), split_gain=P(), internal_value=P(),
+            internal_weight=P(), internal_count=P(), leaf_value=P(),
+            leaf_weight=P(), leaf_count=P(), num_leaves=P(),
+            row_leaf=P(axis))
+
     def _init_wave(self, config, num_features, num_bins, is_cat, has_nan,
                    monotone, impl):
         from ..learner.wave import make_wave_grow_fn
+        from ..utils.log import log_warning
+        if config.extra_trees or config.feature_fraction_bynode < 1.0 or \
+                config.cegb_penalty_split > 0 or \
+                config.cegb_penalty_feature_coupled:
+            log_warning("extra_trees / feature_fraction_bynode / cegb are "
+                        "not applied by the data-parallel wave grower; "
+                        "set tree_grow_mode=partition & tree_learner=serial "
+                        "to use them")
         self.f_pad = 0
         self.pallas = impl == "pallas"
         self.num_bins = jnp.asarray(num_bins, jnp.int32)
@@ -218,12 +231,7 @@ class DataParallelTreeLearner:
             cegb = jnp.zeros((num_features,), jnp.float32)
             return grow_w(X_T, g, h, m, nb, ic, hn, mono, cegb, (), fm)
 
-        tree_specs = GrownTree(
-            split_feature=P(), threshold_bin=P(), nan_bin=P(),
-            cat_member=P(), decision_type=P(), left_child=P(), right_child=P(),
-            split_gain=P(), internal_value=P(), internal_weight=P(),
-            internal_count=P(), leaf_value=P(), leaf_weight=P(),
-            leaf_count=P(), num_leaves=P(), row_leaf=P(self.axis))
+        tree_specs = self._tree_specs(self.axis)
         self._grow = jax.jit(jax.shard_map(
             grow, mesh=self.mesh,
             in_specs=(P(None, self.axis), P(self.axis), P(self.axis),

@@ -99,3 +99,42 @@ def test_bin_to_value_roundtrip():
         lhs = xs <= thr
         rhs = m.value_to_bin(xs) <= b
         assert (lhs == rhs).all()
+
+
+def test_forced_bins(tmp_path):
+    """forcedbins_filename (reference dataset_loader.cpp GetForcedBins):
+    listed boundaries must appear among the feature's bin upper bounds."""
+    import json
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    rng = np.random.RandomState(0)
+    X = rng.rand(2000, 3)
+    y = (X[:, 0] > 0.33).astype(float)
+    fb = str(tmp_path / "forced.json")
+    with open(fb, "w") as fh:
+        json.dump([{"feature": 0, "bin_upper_bound": [0.3, 0.35, 0.4]}], fh)
+    P = {"objective": "binary", "verbosity": -1, "max_bin": 16,
+         "forcedbins_filename": fb}
+    ds = lgb.Dataset(X, y, params=P)
+    ds.construct(Config(P))
+    ub = ds.bin_mappers[0].bin_upper_bound
+    for b in (0.3, 0.35, 0.4):
+        assert np.any(np.isclose(ub, b)), (b, ub)
+    # still trains
+    bst = lgb.train(P, lgb.Dataset(X, y), 3)
+    assert np.isfinite(bst.predict(X[:10])).all()
+
+
+def test_forced_bins_capped_and_zero_bin_preserved():
+    """Forced bounds are capped at max_bin (reference caps too) and the
+    dedicated zero/missing bin survives the merge."""
+    from lightgbm_tpu.binning import find_bin
+    rng = np.random.RandomState(0)
+    v = rng.rand(5000) * 10
+    m = find_bin(v, max_bin=8, forced_bounds=list(np.linspace(0.1, 9.9, 40)))
+    assert m.num_bin <= 9
+    v2 = np.concatenate([np.zeros(1000), rng.rand(4000)])
+    m2 = find_bin(v2, max_bin=8, zero_as_missing=True,
+                  forced_bounds=list(np.linspace(0.1, 0.9, 14)))
+    assert m2.value_to_bin(np.array([0.0]))[0] != \
+        m2.value_to_bin(np.array([0.2]))[0]
