@@ -172,6 +172,10 @@ class GBDT:
     # -- setup ---------------------------------------------------------------
     def _init_train(self, train_set: Dataset) -> None:
         cfg = self.config
+        # params verbosity drives the global log level (reference: the C++
+        # global Log level is set from config at Booster creation)
+        from ..utils.log import set_verbosity
+        set_verbosity(int(cfg.verbosity))
         from ..config import warn_unimplemented_params
         warn_unimplemented_params(cfg)
         train_set.construct(cfg)

@@ -73,8 +73,6 @@ def test_two_process_training_matches_serial(tmp_path, tree_learner):
 
     # serial baseline in THIS process (8-device mesh, single process)
     import lightgbm_tpu as lgb
-    from lightgbm_tpu.utils.log import set_verbosity
-    set_verbosity(-1)
     rng = np.random.RandomState(11)
     n = 700
     X = rng.randn(n, 6)

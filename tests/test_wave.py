@@ -140,3 +140,18 @@ def test_wave_multiclass():
     bst = lgb.train(p, lgb.Dataset(X, y.astype(float)), num_boost_round=8)
     acc = float(np.mean(np.argmax(bst.predict(X), axis=1) == y))
     assert acc > 0.75
+
+
+def test_wave_sample_weights_match_partition():
+    """Row weights ride the gradient/hessian channels (bag mask may be
+    non-0/1); wave_size=1 must still reproduce the sequential order."""
+    rng = np.random.RandomState(9)
+    X = rng.randn(3000, 6).astype(np.float32)
+    y = ((X[:, 0] - 0.5 * X[:, 1]) > 0).astype(np.float64)
+    w = rng.uniform(0.2, 3.0, 3000)
+    pred = {}
+    for mode, ws in (("partition", 16), ("wave", 1)):
+        p = _params(mode, wave=ws)
+        bst = lgb.train(p, lgb.Dataset(X, y, weight=w), num_boost_round=6)
+        pred[mode] = bst.predict(X)
+    np.testing.assert_allclose(pred["wave"], pred["partition"], atol=2e-4)
