@@ -602,8 +602,20 @@ def hist_pool_fits(config: Config, num_features: int, max_bins: int) -> bool:
 
 
 # jitted growers cached by their full static configuration so repeated
-# train() calls (tests, cv folds, sklearn fits) reuse compiled code
+# train() calls (tests, cv folds, sklearn fits) reuse compiled code.
+# Bounded: every live compiled executable holds process memory mappings,
+# and XLA:CPU segfaults when a process exhausts vm.max_map_count — evict
+# oldest growers so long sessions training many distinct configs stay
+# safely below it.
 _GROW_FN_CACHE: dict = {}
+_GROW_FN_CACHE_MAX = 48
+
+
+def _cache_put(key, fn):
+    if len(_GROW_FN_CACHE) >= _GROW_FN_CACHE_MAX:
+        _GROW_FN_CACHE.pop(next(iter(_GROW_FN_CACHE)))
+    _GROW_FN_CACHE[key] = fn
+    return fn
 
 
 class SerialTreeLearner:
@@ -687,13 +699,13 @@ class SerialTreeLearner:
                    impl, any_cat, wave_size, self._efb_dims, feature_contri)
             if key not in _GROW_FN_CACHE:
                 from .wave import make_wave_grow_fn
-                _GROW_FN_CACHE[key] = make_wave_grow_fn(
+                _cache_put(key, make_wave_grow_fn(
                     num_leaves=int(config.num_leaves),
                     num_features=num_features, max_bins=self.max_bins,
                     max_depth=int(config.max_depth),
                     split_params=self.split_params, hist_impl=impl,
                     any_cat=any_cat, wave_size=wave_size,
-                    efb_dims=self._efb_dims, feature_contri=feature_contri)
+                    efb_dims=self._efb_dims, feature_contri=feature_contri))
             self._grow = _GROW_FN_CACHE[key]
         elif self.partitioned:
             key = ("part", int(config.num_leaves), num_features,
@@ -702,25 +714,25 @@ class SerialTreeLearner:
                    interaction_groups, feature_contri)
             if key not in _GROW_FN_CACHE:
                 from .partitioned import make_partitioned_grow_fn
-                _GROW_FN_CACHE[key] = make_partitioned_grow_fn(
+                _cache_put(key, make_partitioned_grow_fn(
                     num_leaves=int(config.num_leaves),
                     num_features=num_features, max_bins=self.max_bins,
                     max_depth=int(config.max_depth),
                     split_params=self.split_params, hist_impl=impl,
                     forced_splits=forced_splits, efb_dims=self._efb_dims,
                     interaction_groups=interaction_groups,
-                    feature_contri=feature_contri)
+                    feature_contri=feature_contri))
         else:
             key = ("serial", int(config.num_leaves), self.max_bins,
                    int(config.max_depth), self.split_params, impl,
                    int(config.tpu_rows_per_chunk), self.use_hist_pool)
             if key not in _GROW_FN_CACHE:
-                _GROW_FN_CACHE[key] = make_grow_fn(
+                _cache_put(key, make_grow_fn(
                     num_leaves=int(config.num_leaves), max_bins=self.max_bins,
                     max_depth=int(config.max_depth),
                     split_params=self.split_params, hist_impl=impl,
                     rows_per_chunk=int(config.tpu_rows_per_chunk),
-                    use_hist_pool=self.use_hist_pool)
+                    use_hist_pool=self.use_hist_pool))
         self._grow = _GROW_FN_CACHE[key]
 
     supports_extras = True  # cegb_penalty / node_key keyword args

@@ -16,9 +16,31 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# Persistent compile cache: ~190 tests trigger hundreds of XLA:CPU
+# compilations in one process; caching them on disk cuts repeat-run time
+# drastically and reduces exposure to rare in-process compiler crashes
+# observed after long compile sequences.
+_cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import numpy as np
 import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jit_cache():
+    """Release compiled executables after each test module.
+
+    XLA:CPU maps every live compiled executable into the process; across
+    ~190 tests the mapping count reaches vm.max_map_count (65530 default)
+    and the NEXT compile segfaults (reproduced deterministically; maps
+    measured at 64.5K right before SIGSEGV).  Clearing jit caches per
+    module unmaps retired executables; the persistent compile cache below
+    makes any re-compile a cheap disk deserialize."""
+    yield
+    jax.clear_caches()
 
 
 @pytest.fixture(scope="session")
