@@ -603,10 +603,11 @@ def hist_pool_fits(config: Config, num_features: int, max_bins: int) -> bool:
 
 # jitted growers cached by their full static configuration so repeated
 # train() calls (tests, cv folds, sklearn fits) reuse compiled code.
-# Bounded: every live compiled executable holds process memory mappings,
-# and XLA:CPU segfaults when a process exhausts vm.max_map_count — evict
-# oldest growers so long sessions training many distinct configs stay
-# safely below it.
+# Bounded LRU: every live compiled executable holds process memory
+# mappings and XLA:CPU segfaults when a process exhausts vm.max_map_count,
+# so the cache drops the least-recently-used growers.  (This bounds the
+# CACHE's contribution only — growers still referenced by live learners
+# keep their executables mapped until those learners are released.)
 _GROW_FN_CACHE: dict = {}
 _GROW_FN_CACHE_MAX = 48
 
@@ -614,6 +615,14 @@ _GROW_FN_CACHE_MAX = 48
 def _cache_put(key, fn):
     if len(_GROW_FN_CACHE) >= _GROW_FN_CACHE_MAX:
         _GROW_FN_CACHE.pop(next(iter(_GROW_FN_CACHE)))
+    _GROW_FN_CACHE[key] = fn
+    return fn
+
+
+def _cache_hit(key):
+    """LRU touch: move the hit entry to the back so cycling workloads
+    (grid search over many configs) do not evict their hottest growers."""
+    fn = _GROW_FN_CACHE.pop(key)
     _GROW_FN_CACHE[key] = fn
     return fn
 
@@ -706,7 +715,7 @@ class SerialTreeLearner:
                     split_params=self.split_params, hist_impl=impl,
                     any_cat=any_cat, wave_size=wave_size,
                     efb_dims=self._efb_dims, feature_contri=feature_contri))
-            self._grow = _GROW_FN_CACHE[key]
+            self._grow = _cache_hit(key)
         elif self.partitioned:
             key = ("part", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
@@ -733,7 +742,7 @@ class SerialTreeLearner:
                     split_params=self.split_params, hist_impl=impl,
                     rows_per_chunk=int(config.tpu_rows_per_chunk),
                     use_hist_pool=self.use_hist_pool))
-        self._grow = _GROW_FN_CACHE[key]
+        self._grow = _cache_hit(key)
 
     supports_extras = True  # cegb_penalty / node_key keyword args
 
