@@ -9,8 +9,12 @@ this on one real TPU chip).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Env knobs: BENCH_ROWS (default 10_500_000 — the BASELINE's true scale),
-BENCH_TREES (default 50), BENCH_LEAVES (255), BENCH_BINS (255).  iters/sec
-is steady-state (compile and first-tree warmup excluded).
+BENCH_TREES (default 50), BENCH_LEAVES (255), BENCH_BINS (255),
+BENCH_QUANT (default 1: int8 quantized-gradient histograms at 254 levels
+with stochastic rounding + exact leaf renewal — the TPU configuration of
+the reference's own use_quantized_grad feature, LightGBM 4.x gradient
+quantization; set 0 for exact bf16 hi/lo histograms).  iters/sec is
+steady-state (compile and first-tree warmup excluded).
 """
 
 import json
@@ -46,6 +50,11 @@ def main() -> None:
         "objective": "binary", "num_leaves": leaves, "max_bin": bins,
         "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1,
     }
+    quant = int(os.environ.get("BENCH_QUANT", 1))
+    if quant:
+        params.update({"use_quantized_grad": True,
+                       "num_grad_quant_bins": 254,
+                       "quant_train_renew_leaf": True})
     ds = lgb.Dataset(X, y, params=params)
     booster = lgb.Booster(params=params, train_set=ds)
 
@@ -61,7 +70,9 @@ def main() -> None:
     iters_per_sec = trees / dt
     print(json.dumps({
         "metric": f"boosting_iters_per_sec (binary, {rows}x{f}, "
-                  f"{leaves} leaves, {bins} bins, {jax.default_backend()})",
+                  f"{leaves} leaves, {bins} bins"
+                  f"{', quantized-grad int8' if quant else ''}, "
+                  f"{jax.default_backend()})",
         "value": round(iters_per_sec, 4),
         "unit": "iters/s",
         "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC, 4),

@@ -206,8 +206,18 @@ _PARAMS: List[ParamSpec] = [
     # wave-incompatible feature (forced splits / interaction constraints /
     # bynode sampling) is active.
     _p("tree_grow_mode", str, "auto"),
-    _p("tpu_wave_size", int, 25, check=">0"),  # capped at kernel's 25
+    # 0 = the kernel maximum (25 leaves/pass exact bf16, 42 quantized i8)
+    _p("tpu_wave_size", int, 0, check=">=0"),
     _p("num_devices", int, 0),               # 0 = all visible devices
+    # --- gradient quantization (config.h use_quantized_grad block;
+    # gradient_discretizer.cpp) — int8 histogram training on the MXU
+    # (ops/histogram_pallas.py build_histogram_pallas_leaves_q8).  Levels
+    # beyond the reference's default 4 are free on the int8 lanes, up to
+    # 254 (clamped to the int8 payload).
+    _p("use_quantized_grad", bool, False),
+    _p("num_grad_quant_bins", int, 4, check=">1"),
+    _p("quant_train_renew_leaf", bool, False),
+    _p("stochastic_rounding", bool, True),
 ]
 
 PARAM_SCHEMA: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}

@@ -114,6 +114,15 @@ class CommStrategy:
     def reduce_sum(self, v):
         return v
 
+    def reduce_max(self, v):
+        """Cross-shard max (quantization scales; DP: pmax)."""
+        return v
+
+    def shard_key(self, key):
+        """Decorrelate the stochastic-rounding PRNG stream per row shard
+        (DP: fold in the axis index)."""
+        return key
+
     def reduce_hist(self, hist):
         """Reduce a freshly built histogram across row shards (DP: psum —
         the analog of data_parallel_tree_learner.cpp:155's ReduceScatter+
@@ -700,12 +709,28 @@ class SerialTreeLearner:
         elif mode == "auto":
             mode = "wave" if (wave_ok and impl == "pallas") else "partition"
         self.grow_mode = mode if self.use_hist_pool else "masked"
+        self.quantized = bool(config.use_quantized_grad) and \
+            self.grow_mode == "wave"
+        if config.use_quantized_grad and not self.quantized:
+            from ..utils.log import log_warning
+            log_warning("use_quantized_grad requires the wave grower "
+                        "(tree_grow_mode=wave/auto on TPU); training "
+                        "with exact gradients instead")
         if self.grow_mode == "wave":
+            from ..ops.quantize import quant_levels
             wave_size = int(config.tpu_wave_size)
             any_cat = bool(np.any(np.asarray(is_cat)))
+            gq_max, hq_max = quant_levels(int(config.num_grad_quant_bins))
+            # in exact mode the quant params don't affect the traced fn —
+            # collapse the cache key so sweeps over them don't recompile
+            qtuple = (self.quantized, gq_max, hq_max,
+                      bool(config.quant_train_renew_leaf),
+                      bool(config.stochastic_rounding)) \
+                if self.quantized else (False,)
             key = ("wave", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
-                   impl, any_cat, wave_size, self._efb_dims, feature_contri)
+                   impl, any_cat, wave_size, self._efb_dims, feature_contri,
+                   qtuple)
             if key not in _GROW_FN_CACHE:
                 from .wave import make_wave_grow_fn
                 _cache_put(key, make_wave_grow_fn(
@@ -714,7 +739,10 @@ class SerialTreeLearner:
                     max_depth=int(config.max_depth),
                     split_params=self.split_params, hist_impl=impl,
                     any_cat=any_cat, wave_size=wave_size,
-                    efb_dims=self._efb_dims, feature_contri=feature_contri))
+                    efb_dims=self._efb_dims, feature_contri=feature_contri,
+                    quantized=self.quantized, gq_max=gq_max, hq_max=hq_max,
+                    renew_leaf=bool(config.quant_train_renew_leaf),
+                    stochastic=bool(config.stochastic_rounding)))
             self._grow = _cache_hit(key)
         elif self.partitioned:
             key = ("part", int(config.num_leaves), num_features,
@@ -750,7 +778,8 @@ class SerialTreeLearner:
               sample_mask: jnp.ndarray,
               feature_mask: Optional[jnp.ndarray] = None,
               cegb_penalty: Optional[jnp.ndarray] = None,
-              node_key: Optional[jnp.ndarray] = None) -> GrownTree:
+              node_key: Optional[jnp.ndarray] = None,
+              quant_key: Optional[jnp.ndarray] = None) -> GrownTree:
         if feature_mask is None:
             feature_mask = jnp.ones((self.num_features,), jnp.bool_)
         if cegb_penalty is None:
@@ -789,10 +818,22 @@ class SerialTreeLearner:
             hess = jnp.pad(hess, (0, pad))
             sample_mask = jnp.pad(sample_mask, (0, pad))
         if self.grow_mode == "wave":
-            grown = self._grow(self._XpT, grad, hess, sample_mask,
-                               self.num_bins, self.is_cat, self.has_nan,
-                               self.monotone, cegb_penalty,
-                               self._efb_args, feature_mask)
+            if self.quantized:
+                if quant_key is None:
+                    # per-call stream so direct callers (no gbdt driver
+                    # threading a per-tree key) still decorrelate the
+                    # stochastic rounding across trees
+                    self._quant_calls = getattr(self, "_quant_calls", 0) + 1
+                    quant_key = jax.random.PRNGKey(self._quant_calls)
+                grown = self._grow(self._XpT, grad, hess, sample_mask,
+                                   self.num_bins, self.is_cat, self.has_nan,
+                                   self.monotone, cegb_penalty,
+                                   self._efb_args, feature_mask, quant_key)
+            else:
+                grown = self._grow(self._XpT, grad, hess, sample_mask,
+                                   self.num_bins, self.is_cat, self.has_nan,
+                                   self.monotone, cegb_penalty,
+                                   self._efb_args, feature_mask)
         else:
             grown = self._grow(self._Xp, grad, hess, sample_mask,
                                self.num_bins, self.is_cat, self.has_nan,

@@ -43,20 +43,24 @@ import jax.numpy as jnp
 
 from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
 from ..ops.histogram import build_histogram_leaves
+from ..ops.quantize import dequant_scales, quantize_wch
 from ..ops.split import BIG, NEG_INF, leaf_output, leaf_output_smoothed
 from .serial import CommStrategy, GrownTree, local_best_candidate
 
-__all__ = ["make_wave_grow_fn", "WAVE_SIZE"]
+__all__ = ["make_wave_grow_fn", "WAVE_SIZE", "Q_WAVE_SIZE"]
 
 from ..ops.histogram_pallas import LEAF_CHANNELS as WAVE_SIZE  # 25/pass
+from ..ops.histogram_pallas import Q_LEAF_CHANNELS as Q_WAVE_SIZE  # 42/pass
 
 
 def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                       max_depth: int, split_params, hist_impl: str,
                       any_cat: bool = True, interpret: bool = False,
-                      jit: bool = True, wave_size: int = WAVE_SIZE,
+                      jit: bool = True, wave_size: int = 0,
                       efb_dims=None, feature_contri: tuple = (),
-                      strategy=None):
+                      strategy=None, quantized: bool = False,
+                      gq_max: int = 127, hq_max: int = 127,
+                      renew_leaf: bool = False, stochastic: bool = True):
     """Build the wave single-tree grower.
 
     Returned signature matches the partitioned grower:
@@ -75,13 +79,15 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
     """
     L = num_leaves
     F = num_features
-    W = max(1, min(int(wave_size), WAVE_SIZE, L - 1))
+    ch_cap = Q_WAVE_SIZE if quantized else WAVE_SIZE
+    W = max(1, min(int(wave_size) or ch_cap, ch_cap, L - 1))
     use_efb = efb_dims is not None
     G, Bb = efb_dims if use_efb else (F, max_bins)
     pallas = hist_impl == "pallas"
     if pallas:
-        from ..ops.histogram_pallas import (build_histogram_pallas_leaves,
-                                            pack_weights8)
+        from ..ops.histogram_pallas import (
+            build_histogram_pallas, build_histogram_pallas_leaves,
+            build_histogram_pallas_leaves_q8, pack_weights8)
 
     sp = split_params
     use_mc = split_params.use_monotone
@@ -96,7 +102,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
              bag_mask: jnp.ndarray, num_bins: jnp.ndarray,
              is_cat: jnp.ndarray, has_nan: jnp.ndarray,
              monotone: jnp.ndarray, cegb_penalty: jnp.ndarray,
-             efb_arrays: tuple, feature_mask: jnp.ndarray) -> GrownTree:
+             efb_arrays: tuple, feature_mask: jnp.ndarray,
+             quant_key: jnp.ndarray = None) -> GrownTree:
         n = X_T.shape[1]
         if strategy is not None:
             # shallow per-trace copy: traced array attributes must not
@@ -124,18 +131,57 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
         hm = (hess * bag_mask).astype(jnp.float32)
         cnt_mask = (bag_mask > 0).astype(jnp.float32)
         if pallas:
-            w8 = pack_weights8(grad, hess, bag_mask)
+            if not quantized:
+                w8 = pack_weights8(grad, hess, bag_mask)
             bins_rows = None
         else:
             # row-major copy made ONCE per grow call (outside the wave
             # loop; XLA cannot hoist it out of lax.while itself)
             bins_rows = jnp.swapaxes(X_T, 0, 1)
 
+        if quantized:
+            # per-tree linear quantization scales from cross-shard maxima
+            # (gradient_discretizer.cpp DiscretizeGradients); every DP
+            # shard derives the same scales, so integer histograms psum
+            # exactly.
+            gmax = strat.reduce_max(jnp.max(jnp.abs(gm)))
+            hmax = strat.reduce_max(jnp.max(hm))
+            g_scale = jnp.maximum(gmax, jnp.float32(1e-30)) / gq_max
+            h_scale = jnp.maximum(hmax, jnp.float32(1e-30)) / hq_max
+            qscales = dequant_scales(g_scale, h_scale)
+            qk = quant_key if quant_key is not None else \
+                jax.random.PRNGKey(0)
+            wch0 = quantize_wch(grad, hess, bag_mask, g_scale, h_scale,
+                                strat.shard_key(qk), gq_max=gq_max,
+                                hq_max=hq_max, stochastic=stochastic)
+
+            def dq(h):
+                """int32 channel sums -> f32 (sum_grad, sum_hess, count)."""
+                return h.astype(jnp.float32) * qscales
+
         def hist_waves(ch, k=W):
             """(k, G, Bb, 3) histograms of the wave's leaf channels,
             reduced across row shards (serial: identity).  ``k`` trims the
             cross-shard reduction to the channels actually used (the root
-            pass needs only channel 0)."""
+            pass needs only channel 0).  Quantized mode returns exact
+            int32 channel sums (dequantize with ``dq``)."""
+            if quantized:
+                if pallas:
+                    wch = wch0.at[:, 3].set(ch.astype(jnp.int8))
+                    h = build_histogram_pallas_leaves_q8(
+                        X_T, wch, num_bins=Bb, interpret=interpret)
+                else:
+                    # off-TPU emulation: f32 sums of integer levels are
+                    # exact while |sum| < 2^24 per bin — ample for the
+                    # CPU/test shards this path serves (the Pallas path
+                    # accumulates true int32 and has no such cap)
+                    h = build_histogram_leaves(
+                        bins_rows, wch0[:, 0].astype(jnp.float32),
+                        wch0[:, 1].astype(jnp.float32),
+                        wch0[:, 2].astype(jnp.float32), ch,
+                        num_channels=W, num_bins=Bb, impl=hist_impl)
+                    h = jnp.round(h).astype(jnp.int32)
+                return strat.reduce_hist(h[:k])
             if pallas:
                 h = build_histogram_pallas_leaves(X_T, w8, ch, num_bins=Bb,
                                                   interpret=interpret)
@@ -164,13 +210,20 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             return jax.vmap(one)(hists, sums, bounds, depths, pouts)
 
         # ---- root ----
-        root_sum = strat.reduce_sum(jnp.stack([
-            jnp.sum(gm), jnp.sum(hm), jnp.sum(cnt_mask)]))
         root_hist = hist_waves(jnp.zeros((n,), jnp.int32), k=1)[0]
+        if quantized:
+            # derive the root totals from the quantized histogram itself
+            # (any bundle's bins sum to the total) so candidate left+right
+            # sums stay consistent with the totals downstream
+            root_sum = dq(root_hist)[0].sum(axis=0)
+        else:
+            root_sum = strat.reduce_sum(jnp.stack([
+                jnp.sum(gm), jnp.sum(hm), jnp.sum(cnt_mask)]))
+        root_hist_f = dq(root_hist) if quantized else root_hist
         root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
         root_out = _child_out(root_sum[0], root_sum[1], root_sum[2],
                               jnp.asarray(0.0, jnp.float32))
-        cand = strat.leaf_candidates(expand_hist(root_hist, root_sum),
+        cand = strat.leaf_candidates(expand_hist(root_hist_f, root_sum),
                                      root_sum, feature_mask, sp,
                                      root_bound, jnp.asarray(0, jnp.int32),
                                      root_out)
@@ -187,8 +240,10 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             "cand_rsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[5]),
             "cand_member": jnp.zeros((L, max_bins), jnp.bool_).at[0].set(
                 cand[6]),
-            "hists": jnp.zeros((L, G, Bb, 3), jnp.float32).at[0].set(
-                root_hist),
+            "hists": jnp.zeros(
+                (L, G, Bb, 3),
+                jnp.int32 if quantized else jnp.float32).at[0].set(
+                    root_hist),
             "split_feature": jnp.full((L - 1,), -1, jnp.int32),
             "threshold_bin": jnp.zeros((L - 1,), jnp.int32),
             "nan_bin": jnp.full((L - 1,), -1, jnp.int32),
@@ -296,7 +351,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             hists2 = jnp.concatenate([hist_l, hist_r])      # (2W, G, Bb, 3)
             sums2 = jnp.concatenate([lsum, rsum])
             totals2 = sums2
-            ex2 = jax.vmap(expand_hist)(hists2, totals2)
+            ex2 = jax.vmap(expand_hist)(
+                dq(hists2) if quantized else hists2, totals2)
             depth2 = jnp.concatenate([child_depth, child_depth])
             lv2 = jnp.concatenate([out_l, out_r])
             cands = many_candidates(ex2, sums2, bounds2, depth2, lv2)
@@ -380,6 +436,43 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             return jnp.logical_not(s["done"]) & (s["num_leaves"] < L)
 
         s = jax.lax.while_loop(cond, body, state)
+
+        if quantized and renew_leaf:
+            # Exact leaf-value renewal (the reference's
+            # quant_train_renew_leaf, gbdt.cpp RenewTreeOutput analog):
+            # one cheap exact pass replaces the quantized leaf sums with
+            # true f32 gradient/hessian sums before outputs are committed.
+            # On the Pallas path this reuses the single-leaf histogram
+            # kernel with row_leaf as a one-feature bin column (cost
+            # ~1/F of a wave pass); off-TPU it is a segment-sum.
+            rl = s["row_leaf"]
+            if pallas:
+                parts = []
+                for c in range((L + 255) // 256):
+                    m = bag_mask * (rl // 256 == c).astype(bag_mask.dtype)
+                    bins1 = (rl % 256).astype(jnp.uint8)[None, :]
+                    parts.append(build_histogram_pallas(
+                        bins1, grad, hess, m, num_bins=256,
+                        interpret=interpret)[0])
+                gh = jnp.concatenate(parts, axis=0)[:L, :2]       # (L, 2)
+            else:
+                gh = jax.ops.segment_sum(
+                    jnp.stack([gm, hm], axis=-1), rl, num_segments=L)
+            gh = strat.reduce_sum(gh)
+            vals = leaf_output(gh[:, 0], gh[:, 1], sp)
+            if use_sm:
+                # path-smoothed outputs blend with the parent chain; renew
+                # against the recorded (pre-renew) value as the parent
+                # proxy — matches the reference's renew-in-place behavior
+                vals = leaf_output_smoothed(gh[:, 0], gh[:, 1],
+                                            s["leaf_count"],
+                                            s["leaf_value"], sp)
+            if use_mc:
+                vals = jnp.clip(vals, s["leaf_mn"], s["leaf_mx"])
+            live = jnp.arange(L, dtype=jnp.int32) < s["num_leaves"]
+            ok = live & (s["leaf_count"] > 0)
+            s["leaf_value"] = jnp.where(ok, vals, s["leaf_value"])
+            s["leaf_weight"] = jnp.where(ok, gh[:, 1], s["leaf_weight"])
 
         return GrownTree(
             split_feature=s["split_feature"],

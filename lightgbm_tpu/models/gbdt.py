@@ -528,6 +528,7 @@ class GBDT:
                 h = hess if k == 1 else hess[:, cid]
                 self._cur_gh = (g, h)
                 extra = {}
+                it = self.iter_ * k + cid
                 if getattr(self.learner, "supports_extras", False):
                     if self._cegb_coupled is not None:
                         extra["cegb_penalty"] = jnp.asarray(
@@ -538,12 +539,16 @@ class GBDT:
                         # ColSampler and ExtraTrees RNGs: row 0 = bynode
                         # sampling (feature_fraction_seed), row 1 =
                         # ExtraTrees thresholds (extra_seed)
-                        it = self.iter_ * k + cid
                         extra["node_key"] = jnp.stack([
                             jax.random.fold_in(jax.random.PRNGKey(
                                 cfg.feature_fraction_seed), it),
                             jax.random.fold_in(jax.random.PRNGKey(
                                 cfg.extra_seed), it)])
+                if getattr(self.learner, "quantized", False):
+                    # per-tree stochastic-rounding stream
+                    # (gradient_discretizer.cpp seeds from config seed)
+                    extra["quant_key"] = jax.random.fold_in(
+                        jax.random.PRNGKey(cfg.seed), it)
                 grown = self.learner.train(self.X_dev, g, h, mask,
                                            feature_mask=fmask, **extra)
                 tree = self._record_tree(grown, cid)

@@ -1,0 +1,71 @@
+"""Gradient quantization for int8 histogram training.
+
+TPU-native analog of the reference's gradient discretizer
+(reference: src/treelearner/gradient_discretizer.cpp DiscretizeGradients,
+include/LightGBM/config.h use_quantized_grad / num_grad_quant_bins /
+quant_train_renew_leaf / stochastic_rounding): per-tree linear scales map
+gradients to signed and hessians to unsigned integer levels with
+stochastic rounding, histograms accumulate exact int32 sums on the MXU
+(ops/histogram_pallas.py build_histogram_pallas_leaves_q8), and split
+gains are computed on the dequantized sums.  Differences from the
+reference, by design:
+
+* levels ride int8 MXU lanes, so up to 127 gradient levels are free —
+  the reference's default ``num_grad_quant_bins=4`` is honored but any
+  value up to 254 is accepted (we clamp levels to the int8 range);
+* the count channel is an exact int32 row count (the reference packs
+  grad/hess as int16 pairs and renormalizes; we keep three lanes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["quant_levels", "quantize_wch", "dequant_scales"]
+
+
+def quant_levels(num_grad_quant_bins: int) -> tuple:
+    """(gq_max, hq_max) integer level bounds for a quant-bin count.
+
+    Gradients are symmetric in [-gq_max, gq_max]; hessians (non-negative)
+    in [0, hq_max].  Both clamp to the int8 payload range."""
+    qb = max(2, int(num_grad_quant_bins))
+    return max(1, min(qb // 2, 127)), max(1, min(qb, 127))
+
+
+@functools.partial(jax.jit, static_argnames=("gq_max", "hq_max",
+                                             "stochastic"))
+def quantize_wch(grad: jnp.ndarray, hess: jnp.ndarray, bag_mask: jnp.ndarray,
+                 g_scale: jnp.ndarray, h_scale: jnp.ndarray,
+                 key: jnp.ndarray, *, gq_max: int, hq_max: int,
+                 stochastic: bool = True) -> jnp.ndarray:
+    """(N, 8) int8 weight rows [g_q, h_q, count, 0, 0, 0, 0, 0].
+
+    ``g_scale``/``h_scale`` are the per-tree dequantization scales
+    (g ~= g_q * g_scale); callers compute them from (cross-shard) maxima
+    so data-parallel shards quantize identically.  Lane 3 (the leaf
+    channel) is left 0 — the wave grower overwrites it per wave.
+    Stochastic rounding ``floor(x + u)`` is unbiased for either sign;
+    with ``stochastic=False`` it degrades to round-half-up.
+    """
+    n = grad.shape[0]
+    gm = (grad * bag_mask) / g_scale
+    hm = (hess * bag_mask) / h_scale
+    if stochastic:
+        ug = jax.random.uniform(jax.random.fold_in(key, 0), (n,))
+        uh = jax.random.uniform(jax.random.fold_in(key, 1), (n,))
+    else:
+        ug = uh = jnp.float32(0.5)
+    g_q = jnp.clip(jnp.floor(gm + ug), -gq_max, gq_max).astype(jnp.int8)
+    h_q = jnp.clip(jnp.floor(hm + uh), 0, hq_max).astype(jnp.int8)
+    cnt = (bag_mask > 0).astype(jnp.int8)
+    z = jnp.zeros_like(cnt)
+    return jnp.stack([g_q, h_q, cnt, z, z, z, z, z], axis=-1)
+
+
+def dequant_scales(g_scale, h_scale):
+    """(3,) f32 multiplier turning int32 channel sums into f32 sums."""
+    return jnp.stack([g_scale, h_scale, jnp.float32(1.0)])

@@ -103,7 +103,7 @@ class DataParallelStrategy(CommStrategy):
 
 class WaveDPStrategy(CommStrategy):
     """Row-sharded strategy for the wave grower: ONE histogram psum per
-    wave (up to 25 splits' smaller children), scans replicated."""
+    wave (up to 25/42 splits' smaller children), scans replicated."""
 
     rows_sharded = True
 
@@ -113,6 +113,15 @@ class WaveDPStrategy(CommStrategy):
 
     def reduce_sum(self, v):
         return jax.lax.psum(v, self.axis_name)
+
+    def reduce_max(self, v):
+        """Global quantization scales: every shard must see the same max
+        (gradient_discretizer scales are global in the reference too)."""
+        return jax.lax.pmax(v, self.axis_name)
+
+    def shard_key(self, key):
+        """Independent stochastic-rounding streams per row shard."""
+        return jax.random.fold_in(key, jax.lax.axis_index(self.axis_name))
 
     def reduce_hist(self, hist):
         return jax.lax.psum(hist, self.axis_name)
@@ -151,6 +160,12 @@ class DataParallelTreeLearner:
             self._init_wave(config, num_features, num_bins, is_cat, has_nan,
                             monotone, impl_wave)
             return
+        self.quantized = False
+        if config.use_quantized_grad:
+            from ..utils.log import log_warning
+            log_warning("use_quantized_grad requires the wave grower; the "
+                        "masked data-parallel grower trains with exact "
+                        "gradients")
         # pad the feature axis to a multiple of the mesh so psum_scatter
         # blocks are uniform (padded features are trivial: 1 bin, never
         # splittable — the analog of the reference's balanced block layout)
@@ -218,6 +233,9 @@ class DataParallelTreeLearner:
         mono_np = monotone if monotone is not None else np.zeros(num_features)
         self.monotone = jnp.asarray(mono_np, jnp.int32)
         self._x_src = None
+        from ..ops.quantize import quant_levels
+        self.quantized = bool(config.use_quantized_grad)
+        gq_max, hq_max = quant_levels(int(config.num_grad_quant_bins))
         strategy = WaveDPStrategy(self.axis)
         grow_w = make_wave_grow_fn(
             num_leaves=int(config.num_leaves), num_features=num_features,
@@ -225,23 +243,35 @@ class DataParallelTreeLearner:
             split_params=split_params_from_config(config, num_bins, is_cat),
             hist_impl=impl, any_cat=bool(np.any(np.asarray(is_cat))),
             wave_size=int(config.tpu_wave_size), strategy=strategy,
-            jit=False)
+            jit=False, quantized=self.quantized, gq_max=gq_max,
+            hq_max=hq_max,
+            renew_leaf=bool(config.quant_train_renew_leaf),
+            stochastic=bool(config.stochastic_rounding))
 
-        def grow(X_T, g, h, m, nb, ic, hn, mono, fm):
-            cegb = jnp.zeros((num_features,), jnp.float32)
-            return grow_w(X_T, g, h, m, nb, ic, hn, mono, cegb, (), fm)
+        if self.quantized:
+            def grow(X_T, g, h, m, nb, ic, hn, mono, fm, qkey):
+                cegb = jnp.zeros((num_features,), jnp.float32)
+                return grow_w(X_T, g, h, m, nb, ic, hn, mono, cegb, (), fm,
+                              qkey)
+            extra_specs = (P(),)
+        else:
+            def grow(X_T, g, h, m, nb, ic, hn, mono, fm):
+                cegb = jnp.zeros((num_features,), jnp.float32)
+                return grow_w(X_T, g, h, m, nb, ic, hn, mono, cegb, (), fm)
+            extra_specs = ()
 
         tree_specs = self._tree_specs(self.axis)
         self._grow = jax.jit(jax.shard_map(
             grow, mesh=self.mesh,
             in_specs=(P(None, self.axis), P(self.axis), P(self.axis),
-                      P(self.axis), P(), P(), P(), P(), P()),
+                      P(self.axis), P(), P(), P(), P(), P()) + extra_specs,
             out_specs=tree_specs,
             check_vma=False))
 
     def train(self, X_dev: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               sample_mask: jnp.ndarray,
-              feature_mask: Optional[jnp.ndarray] = None) -> GrownTree:
+              feature_mask: Optional[jnp.ndarray] = None,
+              quant_key: Optional[jnp.ndarray] = None) -> GrownTree:
         if feature_mask is None:
             feature_mask = jnp.ones((self.num_features,), jnp.bool_)
         n = X_dev.shape[0]
@@ -261,9 +291,17 @@ class DataParallelTreeLearner:
                 grad = jnp.pad(grad, (0, pad))
                 hess = jnp.pad(hess, (0, pad))
                 sample_mask = jnp.pad(sample_mask, (0, pad))
-            grown = self._grow(self._XpT, grad, hess, sample_mask,
-                               self.num_bins, self.is_cat, self.has_nan,
-                               self.monotone, feature_mask)
+            if self.quantized:
+                if quant_key is None:
+                    self._quant_calls = getattr(self, "_quant_calls", 0) + 1
+                    quant_key = jax.random.PRNGKey(self._quant_calls)
+                grown = self._grow(self._XpT, grad, hess, sample_mask,
+                                   self.num_bins, self.is_cat, self.has_nan,
+                                   self.monotone, feature_mask, quant_key)
+            else:
+                grown = self._grow(self._XpT, grad, hess, sample_mask,
+                                   self.num_bins, self.is_cat, self.has_nan,
+                                   self.monotone, feature_mask)
             if pad:
                 grown = grown._replace(row_leaf=grown.row_leaf[:n])
             return grown

@@ -107,6 +107,11 @@ class VotingParallelTreeLearner:
                  num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
                  monotone: Optional[np.ndarray] = None):
         self.config = config
+        if config.use_quantized_grad:
+            from ..utils.log import log_warning
+            log_warning("use_quantized_grad is only applied by the wave "
+                        "grower (serial / tree_learner=data); training "
+                        "with exact gradients")
         self.max_bins = int(max_bins)
         self.num_features = num_features
         self.mesh = get_mesh(int(config.num_devices))

@@ -45,6 +45,10 @@ def main():
     params = {"objective": "binary", "num_leaves": leaves, "max_bin": bins,
               "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1,
               "tree_grow_mode": os.environ.get("GROW_MODE", "auto")}
+    if int(os.environ.get("QUANT", 0)):
+        params.update({"use_quantized_grad": True,
+                       "num_grad_quant_bins": 254,
+                       "quant_train_renew_leaf": True})
     ds = lgb.Dataset(X, y, params=params)
     from lightgbm_tpu.config import Config
     ds.construct(Config(params))
@@ -57,17 +61,17 @@ def main():
     mark("initial score ready")
 
     booster.update()
-    booster._gbdt.score.block_until_ready()
+    float(jnp.sum(booster._gbdt.score))
     mark("first update (compile + run)")
 
     booster.update()
-    booster._gbdt.score.block_until_ready()
+    float(jnp.sum(booster._gbdt.score))
     mark("second update")
 
     t = time.perf_counter()
     for _ in range(trees):
         booster.update()
-    booster._gbdt.score.block_until_ready()
+    float(jnp.sum(booster._gbdt.score))
     dt = time.perf_counter() - t
     mark(f"{trees} steady updates: {dt:.2f}s -> {trees / dt:.3f} iters/s")
 

@@ -1,0 +1,86 @@
+"""Bisect which int8 construct Mosaic rejects, with full error text."""
+import functools
+import sys
+import traceback
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def run(name, kernel, inputs, out_shape):
+    try:
+        out = pl.pallas_call(kernel, out_shape=out_shape)(*inputs)
+        jax.block_until_ready(out)
+        print(f"{name}: OK  sum={np.asarray(out).sum()}")
+    except Exception as e:
+        msg = "".join(traceback.format_exception_only(type(e), e))
+        print(f"{name}: FAIL\n{msg[:2000]}\n---")
+
+
+def main():
+    r, b = 256, 128
+    rng = np.random.RandomState(0)
+    a8 = jnp.asarray(rng.randint(-10, 10, (b, r)).astype(np.int8))
+    w8 = jnp.asarray(rng.randint(-10, 10, (r, 128)).astype(np.int8))
+    u8 = jnp.asarray(rng.randint(0, 255, (8, r)).astype(np.uint8))
+    f32 = jax.ShapeDtypeStruct((b, 128), jnp.float32)
+    i32 = jax.ShapeDtypeStruct((b, 128), jnp.int32)
+
+    # 1. plain i8 x i8 -> i32 dot
+    def k1(a_ref, w_ref, o_ref):
+        o_ref[...] = jax.lax.dot_general(
+            a_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    run("i8 dot -> i32", k1, (a8, w8), i32)
+
+    # 2. u8 compare vs u8 iota -> i8 -> dot
+    def k2(u_ref, w_ref, o_ref):
+        iota = (jax.lax.broadcasted_iota(jnp.int32, (b, r), 0)
+                % 256).astype(jnp.uint8)
+        cols = jnp.repeat(u_ref[...], b // 8, axis=0)
+        onehot = (cols == iota).astype(jnp.int8)
+        o_ref[...] = jax.lax.dot_general(
+            onehot, w_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    run("u8 cmp onehot i8 dot", k2, (u8, w8), i32)
+
+    # 3. i32 compare -> i8 dot (compare in 32-bit, convert)
+    def k3(u_ref, w_ref, o_ref):
+        iota = jax.lax.broadcasted_iota(jnp.int32, (b, r), 0) % 256
+        cols = jnp.repeat(u_ref[...].astype(jnp.int32), b // 8, axis=0)
+        onehot = (cols == iota).astype(jnp.int8)
+        o_ref[...] = jax.lax.dot_general(
+            onehot, w_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    run("i32 cmp -> i8 dot", k3, (u8, w8), i32)
+
+    # 4. i8 x i8 -> f32 dot
+    def k4(a_ref, w_ref, o_ref):
+        o_ref[...] = jax.lax.dot_general(
+            a_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    run("i8 dot -> f32", k4, (a8, w8), f32)
+
+    # 5. i8 elementwise mul then dot
+    def k5(a_ref, w_ref, o_ref):
+        w = w_ref[...] * jnp.int8(2)
+        o_ref[...] = jax.lax.dot_general(
+            a_ref[...], w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    run("i8 mul + dot", k5, (a8, w8), i32)
+
+    # 6. i32 accumulate +=
+    def k6(a_ref, w_ref, o_ref):
+        p = jax.lax.dot_general(
+            a_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        o_ref[...] = jnp.zeros_like(o_ref)
+        o_ref[...] += p
+    run("i32 accum +=", k6, (a8, w8), i32)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""Isolate the q8 dot/accumulate cost: static unroll, group, M shapes."""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+QC = 3
+
+
+def _round_up(x, m):
+    return -(-x // m) * m
+
+
+def make_kernel(mode, f, b, group, ft):
+    """All variants consume (ft, kr) bins + (kr, 8) i8 wch (w3 + ch lane)."""
+    nk = ft // group
+
+    def kern(bins_ref, wch_ref, out_ref):
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        wch = wch_ref[...]                     # (R, 8) i8
+        r = wch.shape[0]
+        ch = wch[:, 3:4].astype(jnp.int32)     # (R, 1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (r, 128), 1)
+        sel = (ch == lane // QC).astype(jnp.int32)
+        w3 = wch[:, :QC].astype(jnp.int32)
+        wtile = jnp.concatenate([w3] * (128 // QC + 1), axis=1)[:, :128]
+        w128 = (wtile * sel).astype(jnp.int8)
+        iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, r), 0) % b
+
+        if mode == "static":
+            for k in range(nk):
+                cols = bins_ref[k * group:(k + 1) * group, :].astype(
+                    jnp.int32)
+                colrep = jnp.repeat(cols, b, axis=0)
+                onehot = (colrep == iota_gb).astype(jnp.int8)
+                part = jax.lax.dot_general(
+                    onehot, w128, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out_ref[k * group * b:(k + 1) * group * b] += part
+        elif mode == "static_dot_only":
+            onehot_c = (iota_gb == 0).astype(jnp.int8)
+            for k in range(nk):
+                part = jax.lax.dot_general(
+                    onehot_c, w128, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out_ref[k * group * b:(k + 1) * group * b] += part
+        elif mode == "static_noacc":
+            # dot results written once, no read-modify-write
+            for k in range(nk):
+                cols = bins_ref[k * group:(k + 1) * group, :].astype(
+                    jnp.int32)
+                colrep = jnp.repeat(cols, b, axis=0)
+                onehot = (colrep == iota_gb).astype(jnp.int8)
+                part = jax.lax.dot_general(
+                    onehot, w128, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+
+                @pl.when(pl.program_id(1) == 0)
+                def _w(part=part, k=k):
+                    out_ref[k * group * b:(k + 1) * group * b] = part
+
+        return
+
+    return kern
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "kr", "mode",
+                                             "group"))
+def q8(bins_t, wch, *, num_bins, kr=1024, mode="static", group=2):
+    f, n = bins_t.shape
+    b = _round_up(num_bins, 64)
+    ft = _round_up(f, max(group, 8))
+    if ft != f:
+        bins_t = jnp.pad(bins_t, ((0, ft - f), (0, 0)))
+    grid = (1, n // kr)
+    out = pl.pallas_call(
+        make_kernel(mode, f, b, group, ft),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ft, kr), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kr, 8), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((ft * b, 128), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * ft * b * n * 128,
+            bytes_accessed=ft * n + n * 8 + ft * b * 512,
+            transcendentals=0),
+    )(bins_t, wch)
+    return out
+
+
+def timeit(fn, *args, reps=5, **kw):
+    out = fn(*args, **kw)
+    _ = np.asarray(jnp.ravel(out)[:1])
+    t0 = time.perf_counter()
+    for _i in range(reps):
+        out = fn(*args, **kw)
+        _ = np.asarray(jnp.ravel(out)[:1])
+    return (time.perf_counter() - t0) / reps, out
+
+
+def main():
+    n, f, b = 4_194_304, 28, 255
+    rng = np.random.RandomState(0)
+    bins = rng.randint(0, b, (f, n)).astype(np.uint8)
+    gq = rng.randint(-127, 128, n).astype(np.int8)
+    hq = rng.randint(0, 128, n).astype(np.int8)
+    ch = rng.randint(-1, 42, n).astype(np.int8)
+    wch = np.stack([gq, hq, np.ones(n, np.int8), ch] +
+                   [np.zeros(n, np.int8)] * 4, axis=-1)
+    wch[ch < 0, :3] = 0
+    bins_d, wch_d = jnp.asarray(bins), jnp.asarray(wch)
+
+    for mode in ("static", "static_dot_only", "static_noacc"):
+        for group, kr in ((2, 1024), (2, 2048), (2, 4096), (4, 1024),
+                          (4, 2048), (8, 1024)):
+            try:
+                t, _ = timeit(q8, bins_d, wch_d, num_bins=b, kr=kr,
+                              mode=mode, group=group)
+                print(f"{mode:16s} g={group} kr={kr:5d}: {t*1e3:8.2f} ms",
+                      flush=True)
+            except Exception as e:
+                print(f"{mode:16s} g={group} kr={kr:5d}: FAIL "
+                      f"{str(e)[:90]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

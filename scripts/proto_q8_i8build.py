@@ -1,0 +1,212 @@
+"""Find a Mosaic-supported all-i8 one-hot build, then time full variants."""
+import functools
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+QC = 3
+
+
+def tiny(name, kernel, inputs, out_shape):
+    try:
+        out = pl.pallas_call(kernel, out_shape=out_shape)(*inputs)
+        _ = np.asarray(jnp.ravel(out)[:1])
+        print(f"  {name}: OK")
+        return True
+    except Exception as e:
+        msg = "".join(traceback.format_exception_only(type(e), e))
+        print(f"  {name}: FAIL {msg.splitlines()[0][:110]}")
+        return False
+
+
+def bisect():
+    r = 256
+    rng = np.random.RandomState(0)
+    u8 = jnp.asarray(rng.randint(0, 255, (8, r)).astype(np.uint8))
+    i32 = jax.ShapeDtypeStruct((256, r), jnp.int32)
+
+    def consume(o_ref, x):
+        o_ref[...] = jnp.sum(x.astype(jnp.int32), axis=0,
+                             keepdims=True) + jnp.zeros(
+                                 o_ref.shape, jnp.int32)
+
+    def k_iota_i8(u_ref, o_ref):
+        io = jax.lax.broadcasted_iota(jnp.int8, (256, r), 0)
+        consume(o_ref, io)
+    tiny("broadcasted_iota i8", k_iota_i8, (u8,), i32)
+
+    def k_iota_cvt(u_ref, o_ref):
+        io = (jax.lax.broadcasted_iota(jnp.int32, (256, r), 0)
+              % 256).astype(jnp.int8)
+        consume(o_ref, io)
+    tiny("iota i32 -> astype i8", k_iota_cvt, (u8,), i32)
+
+    def k_rep_u8(u_ref, o_ref):
+        rep = jnp.repeat(u_ref[...], 32, axis=0)
+        consume(o_ref, rep)
+    tiny("repeat u8", k_rep_u8, (u8,), i32)
+
+    def k_cmp_u8(u_ref, o_ref):
+        rep = jnp.repeat(u_ref[...], 32, axis=0)
+        io = (jax.lax.broadcasted_iota(jnp.int32, (256, r), 0)
+              % 256).astype(jnp.uint8)
+        consume(o_ref, (rep == io).astype(jnp.int8))
+    tiny("cmp u8==u8 -> i8", k_cmp_u8, (u8,), i32)
+
+    def k_cmp_i8(u_ref, o_ref):
+        rep = pltpu.bitcast(jnp.repeat(u_ref[...], 32, axis=0), jnp.int8)
+        io = (jax.lax.broadcasted_iota(jnp.int32, (256, r), 0)
+              % 256).astype(jnp.int8)
+        consume(o_ref, (rep == io).astype(jnp.int8))
+    tiny("bitcast->i8 cmp", k_cmp_i8, (u8,), i32)
+
+    def k_cmp_i8b(u_ref, o_ref):
+        rep = jnp.repeat(u_ref[...].astype(jnp.int8), 32, axis=0)
+        io = (jax.lax.broadcasted_iota(jnp.int32, (256, r), 0)
+              % 256).astype(jnp.int8)
+        consume(o_ref, (rep == io).astype(jnp.int8))
+    tiny("astype u8->i8 cmp", k_cmp_i8b, (u8,), i32)
+
+    def k_where_i8(u_ref, o_ref):
+        rep = jnp.repeat(u_ref[...].astype(jnp.int8), 32, axis=0)
+        io = (jax.lax.broadcasted_iota(jnp.int32, (256, r), 0)
+              % 256).astype(jnp.int8)
+        oh = jnp.where(rep == io, jnp.int8(1), jnp.int8(0))
+        consume(o_ref, oh)
+    tiny("where i8 const", k_where_i8, (u8,), i32)
+
+
+# --- timed full kernels -----------------------------------------------------
+
+def make_kernel(mode, b, group, ft):
+    nk = ft // group
+
+    def kern(bins_ref, wch_ref, out_ref):
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        wch = wch_ref[...]
+        r = wch.shape[0]
+        ch = wch[:, 3:4].astype(jnp.int32)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (r, 128), 1)
+        sel = (ch == lane // QC).astype(jnp.int32)
+        w3 = wch[:, :QC].astype(jnp.int32)
+        wtile = jnp.concatenate([w3] * (128 // QC + 1), axis=1)[:, :128]
+        w128 = (wtile * sel).astype(jnp.int8)
+
+        if mode == "i8":
+            iota_gb = (jax.lax.broadcasted_iota(jnp.int32, (group * b, r),
+                                                0) % b).astype(jnp.int8)
+            for k in range(nk):
+                cols = bins_ref[k * group:(k + 1) * group, :].astype(
+                    jnp.int8)
+                colrep = jnp.repeat(cols, b, axis=0)
+                onehot = (colrep == iota_gb).astype(jnp.int8)
+                part = jax.lax.dot_general(
+                    onehot, w128, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out_ref[k * group * b:(k + 1) * group * b] += part
+        elif mode == "i32":
+            iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, r),
+                                               0) % b
+            for k in range(nk):
+                cols = bins_ref[k * group:(k + 1) * group, :].astype(
+                    jnp.int32)
+                colrep = jnp.repeat(cols, b, axis=0)
+                onehot = (colrep == iota_gb).astype(jnp.int8)
+                part = jax.lax.dot_general(
+                    onehot, w128, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out_ref[k * group * b:(k + 1) * group * b] += part
+        return
+
+    return kern
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "kr", "mode",
+                                             "group"))
+def q8(bins_t, wch, *, num_bins, kr=1024, mode="i8", group=2):
+    f, n = bins_t.shape
+    b = -(-num_bins // 64) * 64
+    ft = -(-f // max(group, 8)) * max(group, 8)
+    if ft != f:
+        bins_t = jnp.pad(bins_t, ((0, ft - f), (0, 0)))
+    grid = (1, n // kr)
+    return pl.pallas_call(
+        make_kernel(mode, b, group, ft),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ft, kr), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kr, 8), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((ft * b, 128), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * ft * b * n * 128,
+            bytes_accessed=ft * n + n * 8 + ft * b * 512,
+            transcendentals=0),
+    )(bins_t, wch)
+
+
+def timeit(fn, *args, reps=5, **kw):
+    out = fn(*args, **kw)
+    _ = np.asarray(jnp.ravel(out)[:1])
+    t0 = time.perf_counter()
+    for _i in range(reps):
+        out = fn(*args, **kw)
+        _ = np.asarray(jnp.ravel(out)[:1])
+    return (time.perf_counter() - t0) / reps, out
+
+
+def main():
+    print("=== op bisect ===")
+    bisect()
+
+    n, f, b = 4_194_304, 28, 255
+    rng = np.random.RandomState(0)
+    bins = rng.randint(0, b, (f, n)).astype(np.uint8)
+    gq = rng.randint(-127, 128, n).astype(np.int8)
+    hq = rng.randint(0, 128, n).astype(np.int8)
+    ch = rng.randint(-1, 42, n).astype(np.int8)
+    wch = np.stack([gq, hq, np.ones(n, np.int8), ch] +
+                   [np.zeros(n, np.int8)] * 4, axis=-1)
+    wch[ch < 0, :3] = 0
+    bins_d, wch_d = jnp.asarray(bins), jnp.asarray(wch)
+
+    print("=== timed ===")
+    for mode in ("i8", "i32"):
+        for group, kr in ((2, 1024), (2, 2048), (4, 1024), (8, 1024),
+                          (8, 2048)):
+            try:
+                t, out = timeit(q8, bins_d, wch_d, num_bins=b, kr=kr,
+                                mode=mode, group=group)
+                print(f"{mode:4s} g={group} kr={kr:5d}: {t*1e3:8.2f} ms",
+                      flush=True)
+            except Exception as e:
+                print(f"{mode:4s} g={group} kr={kr:5d}: FAIL {str(e)[:90]}",
+                      flush=True)
+
+    # correctness of i8 vs i32 mode
+    try:
+        o1 = np.asarray(q8(bins_d, wch_d, num_bins=b, mode="i8"))
+        o2 = np.asarray(q8(bins_d, wch_d, num_bins=b, mode="i32"))
+        print("i8 vs i32 max diff:", np.abs(o1 - o2).max())
+    except Exception as e:
+        print("cmp FAIL", str(e)[:90])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,286 @@
+"""Probe: int8 quantized-gradient leaf-batched histogram kernel variants.
+
+Round-4 perf work (VERDICT item 1a): the bf16 hi/lo leaves kernel packs
+25 leaves x 5 channels into the 128 MXU lanes; quantized int8 gradients
+need only 3 channels (g_q, h_q, count) -> 42 leaves/pass, and the i8
+MXU path runs at 2x the bf16 MAC rate on v5e.  This script measures, on
+the real chip:
+
+  A. current bf16 leaves kernel (baseline)
+  B. i8 kernel, w128 built in-kernel from (ch, w3)
+  C. i8 kernel, w128 precomputed in HBM (N, 128) i8
+  D. i8 kernel variant sweeps (kr, local accumulation)
+
+plus integer exactness vs numpy bincount.
+"""
+import functools
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lightgbm_tpu.ops.histogram_pallas import (
+    build_histogram_pallas_leaves, pack_weights8)
+
+QC = 3                      # channels per leaf: g_q, h_q, count
+QLEAVES = 128 // QC         # 42
+
+
+def _round_up(x, m):
+    return -(-x // m) * m
+
+
+# ---------------------------------------------------------------------------
+# Variant B: i8, w128 built in kernel
+# ---------------------------------------------------------------------------
+
+def _q8_kernel_inbuild(bins_ref, w_ref, ch_ref, out_ref, *, num_features,
+                       num_bins, group, fstep):
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    w = w_ref[...]                      # (R, 4) i8  [g_q, h_q, 1, 0]
+    ch = ch_ref[...]                    # (R, 1) i32
+    r = w.shape[0]
+    b = num_bins
+
+    # i8 elementwise mul is unsupported by Mosaic (probe bisect): do the
+    # select arithmetic in i32 and pack to i8 once.
+    lane = jax.lax.broadcasted_iota(jnp.int32, (r, 128), 1)
+    leaf_of_lane = lane // QC
+    sel = (ch == leaf_of_lane).astype(jnp.int32)         # (R, 128)
+    w3 = w[:, :QC].astype(jnp.int32)
+    wtile = jnp.concatenate([w3] * (128 // QC + 1), axis=1)[:, :128]
+    w128 = (wtile * sel).astype(jnp.int8)
+
+    iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, r), 0) % b
+
+    def do(i, carry):
+        f0 = i * fstep
+        cols_blk = bins_ref[pl.ds(f0, fstep), :].astype(jnp.int32)
+        for k in range(fstep // group):
+            cols = cols_blk[k * group:(k + 1) * group]
+            colrep = jnp.repeat(cols, b, axis=0)
+            onehot = (colrep == iota_gb).astype(jnp.int8)
+            part = jax.lax.dot_general(
+                onehot, w128, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out_ref[pl.ds((f0 + k * group) * b, group * b)] += part
+        return carry
+
+    jax.lax.fori_loop(0, num_features // fstep, do, 0)
+
+
+# ---------------------------------------------------------------------------
+# Variant C: i8, w128 precomputed in HBM
+# ---------------------------------------------------------------------------
+
+def _q8_kernel_pre(bins_ref, w128_ref, out_ref, *, num_features,
+                   num_bins, group, fstep):
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    w128 = w128_ref[...]                # (R, 128) i8
+    r = w128.shape[0]
+    b = num_bins
+    iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, r), 0) % b
+
+    def do(i, carry):
+        f0 = i * fstep
+        cols_blk = bins_ref[pl.ds(f0, fstep), :].astype(jnp.int32)
+        for k in range(fstep // group):
+            cols = cols_blk[k * group:(k + 1) * group]
+            colrep = jnp.repeat(cols, b, axis=0)
+            onehot = (colrep == iota_gb).astype(jnp.int8)
+            part = jax.lax.dot_general(
+                onehot, w128, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out_ref[pl.ds((f0 + k * group) * b, group * b)] += part
+        return carry
+
+    jax.lax.fori_loop(0, num_features // fstep, do, 0)
+
+
+def _plan(f, num_bins):
+    b = _round_up(num_bins, 64)
+    group = next((g for g in (2, 4, 8) if (g * b) % 128 == 0), 1)
+    while group * 2 <= f and group * 2 * b <= 512:
+        group *= 2
+    if group > f or (group * b) % 128 != 0:
+        b = _round_up(num_bins, 128)
+        group = 1
+    fstep = max(group, 8)
+    ft_cap = max(fstep, 8192 // b // fstep * fstep)
+    ft = min(_round_up(f, fstep), ft_cap)
+    f_pad = _round_up(f, ft)
+    return b, group, fstep, ft, f_pad
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "kr"))
+def q8_inbuild(bins_t, w4, ch, *, num_bins, kr=1024):
+    f, n = bins_t.shape
+    b, group, fstep, ft, f_pad = _plan(f, num_bins)
+    if f_pad != f:
+        bins_t = jnp.pad(bins_t, ((0, f_pad - f), (0, 0)))
+    grid = (f_pad // ft, n // kr)
+    out = pl.pallas_call(
+        functools.partial(_q8_kernel_inbuild, num_features=ft, num_bins=b,
+                          group=group, fstep=fstep),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ft, kr), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kr, 4), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kr, 1), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f_pad * b, 128), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * f_pad * b * n * 128,
+            bytes_accessed=f_pad * n + n * 8 + f_pad * b * 512,
+            transcendentals=0),
+    )(bins_t, w4, ch.astype(jnp.int32)[:, None])
+    out = out[:, :QLEAVES * QC].reshape(f_pad, b, QLEAVES, QC)
+    return jnp.transpose(out, (2, 0, 1, 3))[:, :f, :num_bins, :]
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "kr"))
+def q8_pre(bins_t, w128, *, num_bins, kr=1024):
+    f, n = bins_t.shape
+    b, group, fstep, ft, f_pad = _plan(f, num_bins)
+    if f_pad != f:
+        bins_t = jnp.pad(bins_t, ((0, f_pad - f), (0, 0)))
+    grid = (f_pad // ft, n // kr)
+    out = pl.pallas_call(
+        functools.partial(_q8_kernel_pre, num_features=ft, num_bins=b,
+                          group=group, fstep=fstep),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ft, kr), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kr, 128), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f_pad * b, 128), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * f_pad * b * n * 128,
+            bytes_accessed=f_pad * n + n * 128 + f_pad * b * 512,
+            transcendentals=0),
+    )(bins_t, w128)
+    out = out[:, :QLEAVES * QC].reshape(f_pad, b, QLEAVES, QC)
+    return jnp.transpose(out, (2, 0, 1, 3))[:, :f, :num_bins, :]
+
+
+@jax.jit
+def expand_w128(w4, ch):
+    """(N, 128) i8 lane-expanded weights, built once per wave in XLA."""
+    lane = jnp.arange(128, dtype=jnp.int32)
+    sel = (ch[:, None] == (lane // QC)[None, :]).astype(jnp.int8)
+    wtile = jnp.concatenate([w4[:, :QC]] * (128 // QC + 1), axis=1)[:, :128]
+    return wtile * sel
+
+
+def timeit(fn, *args, reps=5, **kw):
+    out = fn(*args, **kw)
+    _ = np.asarray(jnp.ravel(out)[:1])  # force through the axon tunnel
+    t0 = time.perf_counter()
+    for _i in range(reps):
+        out = fn(*args, **kw)
+        _ = np.asarray(jnp.ravel(out)[:1])
+    return (time.perf_counter() - t0) / reps, out
+
+
+def main():
+    n, f, b = 4_194_304, 28, 255
+    rng = np.random.RandomState(0)
+    bins = rng.randint(0, b, (f, n)).astype(np.uint8)
+    gq = rng.randint(-127, 128, n).astype(np.int8)
+    hq = rng.randint(0, 128, n).astype(np.int8)
+    ch = rng.randint(-1, QLEAVES, n).astype(np.int32)
+    w4 = np.stack([gq, hq, np.ones(n, np.int8),
+                   np.zeros(n, np.int8)], axis=-1)
+    w4[ch < 0] = 0
+
+    bins_d = jnp.asarray(bins)
+    w4_d = jnp.asarray(w4)
+    ch_d = jnp.asarray(ch)
+
+    # A. baseline bf16 leaves kernel
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.abs(rng.randn(n)).astype(np.float32)
+    mask = np.ones(n, np.float32)
+    w8 = pack_weights8(jnp.asarray(grad), jnp.asarray(hess),
+                       jnp.asarray(mask))
+    ch25 = np.where(ch >= 25, -1, ch).astype(np.int32)
+    t_a, _ = timeit(build_histogram_pallas_leaves, bins_d, w8,
+                    jnp.asarray(ch25), num_bins=b)
+    print(f"A bf16 leaves (25/pass):      {t_a*1e3:8.2f} ms  "
+          f"({n/t_a/1e9:.2f} Grows/s)", flush=True)
+
+    # B. i8 in-kernel build
+    try:
+        t_b, hist_b = timeit(q8_inbuild, bins_d, w4_d, ch_d, num_bins=b)
+        print(f"B i8 in-kernel (42/pass):     {t_b*1e3:8.2f} ms  "
+              f"({n/t_b/1e9:.2f} Grows/s)", flush=True)
+    except Exception as e:
+        print(f"B FAILED: {type(e).__name__}: {str(e)[:500]}")
+        hist_b = None
+
+    # C. i8 precomputed w128
+    try:
+        t_w, w128_d = timeit(expand_w128, w4_d, ch_d)
+        t_c, hist_c = timeit(q8_pre, bins_d, w128_d, num_bins=b)
+        print(f"C i8 pre-w128 (42/pass):      {t_c*1e3:8.2f} ms  "
+              f"({n/t_c/1e9:.2f} Grows/s)  (+{t_w*1e3:.2f} ms expand)",
+              flush=True)
+    except Exception as e:
+        print(f"C FAILED: {type(e).__name__}: {str(e)[:500]}")
+        hist_c = None
+
+    # kr sweep on the winner
+    for kr in (512, 2048, 4096):
+        try:
+            t, _ = timeit(q8_pre, bins_d, w128_d, num_bins=b, kr=kr)
+            print(f"C kr={kr}:                  {t*1e3:8.2f} ms", flush=True)
+        except Exception as e:
+            print(f"C kr={kr} FAILED: {str(e)[:200]}")
+
+    # exactness: integer histogram vs numpy bincount on a small slice
+    if hist_b is not None or hist_c is not None:
+        sub = slice(0, 65536)
+        hist = np.asarray((hist_b if hist_b is not None else hist_c))
+        ref = np.zeros((QLEAVES, f, b, QC), np.int64)
+        chs = ch[sub]
+        for c, wc in enumerate((gq[sub], hq[sub], np.ones(len(chs)))):
+            for j in range(f):
+                for q in range(QLEAVES):
+                    m = chs == q
+                    ref[q, j, :, c] = np.bincount(
+                        bins[j, sub][m], weights=wc[m].astype(np.float64),
+                        minlength=b)[:b]
+        small = (q8_pre(jnp.asarray(bins[:, sub]), expand_w128(
+            jnp.asarray(w4[sub]), jnp.asarray(chs)), num_bins=b)
+            if hist_c is not None else
+            q8_inbuild(jnp.asarray(bins[:, sub]), jnp.asarray(w4[sub]),
+                       jnp.asarray(chs), num_bins=b))
+        d = np.abs(np.asarray(small).astype(np.int64) - ref).max()
+        print(f"exactness max abs diff vs numpy int: {d}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""Sweep q8 kernel variants: kr/group, dot-only vs build-only split."""
+import functools
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+QC = 3
+QLEAVES = 128 // QC
+
+
+def _round_up(x, m):
+    return -(-x // m) * m
+
+
+def make_kernel(mode):
+    def kern(bins_ref, w_ref, ch_ref, out_ref, *, num_features, num_bins,
+             group, fstep):
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        w = w_ref[...]
+        ch = ch_ref[...]
+        r = w.shape[0]
+        b = num_bins
+        lane = jax.lax.broadcasted_iota(jnp.int32, (r, 128), 1)
+        sel = (ch == lane // QC).astype(jnp.int32)
+        w3 = w[:, :QC].astype(jnp.int32)
+        wtile = jnp.concatenate([w3] * (128 // QC + 1), axis=1)[:, :128]
+        w128 = (wtile * sel).astype(jnp.int8)
+        iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, r), 0) % b
+
+        def do(i, carry):
+            f0 = i * fstep
+            cols_blk = bins_ref[pl.ds(f0, fstep), :].astype(jnp.int32)
+            for k in range(fstep // group):
+                cols = cols_blk[k * group:(k + 1) * group]
+                if mode == "dot_only":
+                    onehot = (iota_gb < 1).astype(jnp.int8)
+                elif mode == "bcast":
+                    c3 = jax.lax.broadcast_in_dim(cols, (group, b, r),
+                                                  (0, 2))
+                    i3 = jax.lax.broadcasted_iota(jnp.int32, (group, b, r),
+                                                  1)
+                    onehot = (c3 == i3).astype(jnp.int8).reshape(
+                        group * b, r)
+                else:
+                    colrep = jnp.repeat(cols, b, axis=0)
+                    onehot = (colrep == iota_gb).astype(jnp.int8)
+                if mode == "build_only":
+                    out_ref[pl.ds((f0 + k * group) * b, group * b)] += (
+                        jnp.sum(onehot.astype(jnp.int32), axis=1,
+                                keepdims=True) +
+                        jnp.zeros((group * b, 128), jnp.int32))
+                else:
+                    part = jax.lax.dot_general(
+                        onehot, w128, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32)
+                    out_ref[pl.ds((f0 + k * group) * b, group * b)] += part
+            return carry
+
+        jax.lax.fori_loop(0, num_features // fstep, do, 0)
+    return kern
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "kr", "mode", "group_ovr"))
+def q8(bins_t, w4, ch, *, num_bins, kr=1024, mode="repeat", group_ovr=0):
+    f, n = bins_t.shape
+    b = _round_up(num_bins, 64)
+    group = group_ovr or 2
+    fstep = max(group, 8)
+    ft_cap = max(fstep, 8192 // b // fstep * fstep)
+    ft = min(_round_up(f, fstep), ft_cap)
+    f_pad = _round_up(f, ft)
+    if f_pad != f:
+        bins_t = jnp.pad(bins_t, ((0, f_pad - f), (0, 0)))
+    grid = (f_pad // ft, n // kr)
+    out = pl.pallas_call(
+        functools.partial(make_kernel(mode), num_features=ft, num_bins=b,
+                          group=group, fstep=fstep),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ft, kr), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kr, 4), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kr, 1), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f_pad * b, 128), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * f_pad * b * n * 128,
+            bytes_accessed=f_pad * n + n * 8 + f_pad * b * 512,
+            transcendentals=0),
+    )(bins_t, w4, ch.astype(jnp.int32)[:, None])
+    return out
+
+
+def timeit(fn, *args, reps=5, **kw):
+    out = fn(*args, **kw)
+    _ = np.asarray(jnp.ravel(out)[:1])
+    t0 = time.perf_counter()
+    for _i in range(reps):
+        out = fn(*args, **kw)
+        _ = np.asarray(jnp.ravel(out)[:1])
+    return (time.perf_counter() - t0) / reps, out
+
+
+def main():
+    n, f, b = 4_194_304, 28, 255
+    rng = np.random.RandomState(0)
+    bins = rng.randint(0, b, (f, n)).astype(np.uint8)
+    gq = rng.randint(-127, 128, n).astype(np.int8)
+    hq = rng.randint(0, 128, n).astype(np.int8)
+    ch = rng.randint(-1, QLEAVES, n).astype(np.int32)
+    w4 = np.stack([gq, hq, np.ones(n, np.int8),
+                   np.zeros(n, np.int8)], axis=-1)
+    w4[ch < 0] = 0
+    bins_d, w4_d, ch_d = jnp.asarray(bins), jnp.asarray(w4), jnp.asarray(ch)
+
+    for mode in ("repeat", "bcast", "dot_only", "build_only"):
+        for kr in (1024, 4096, 8192):
+            try:
+                t, _ = timeit(q8, bins_d, w4_d, ch_d, num_bins=b, kr=kr,
+                              mode=mode)
+                print(f"{mode:11s} kr={kr:5d}: {t*1e3:8.2f} ms", flush=True)
+            except Exception as e:
+                print(f"{mode:11s} kr={kr:5d}: FAIL {str(e)[:120]}",
+                      flush=True)
+    for g in (4, 8):
+        for kr in (4096, 8192):
+            try:
+                t, _ = timeit(q8, bins_d, w4_d, ch_d, num_bins=b, kr=kr,
+                              mode="repeat", group_ovr=g)
+                print(f"group={g} kr={kr:5d}: {t*1e3:8.2f} ms", flush=True)
+            except Exception as e:
+                print(f"group={g} kr={kr:5d}: FAIL {str(e)[:120]}",
+                      flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""In-situ piece timings of the quantized wave grower at Higgs scale.
+
+Amortized timing: each piece runs REPS times inside one dispatch chain
+with a single host sync at the end, so the axon tunnel RTT (~tens of ms)
+is paid once, not per rep.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops.histogram_pallas import (
+    Q_LEAF_CHANNELS, build_histogram_pallas_leaves,
+    build_histogram_pallas_leaves_q8, pack_weights8, pad_rows)
+
+REPS = int(os.environ.get("REPS", 10))
+N = pad_rows(int(os.environ.get("ROWS", 10_500_000)))
+F, B = 28, 256
+
+
+def timed(name, fn, *args, reps=REPS, **kw):
+    out = fn(*args, **kw)
+    _ = float(jnp.ravel(out)[0])          # sync after warmup/compile
+    t0 = time.perf_counter()
+    outs = None
+    for _i in range(reps):
+        outs = fn(*args, **kw)
+    _ = float(jnp.ravel(outs)[0])
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:34s} {dt*1e3:9.2f} ms", flush=True)
+    return dt
+
+
+def main():
+    rng = np.random.RandomState(0)
+    print(f"N={N}", flush=True)
+    bins = jnp.asarray(rng.randint(0, 255, (F, N)).astype(np.uint8))
+    grad = jnp.asarray(rng.randn(N).astype(np.float32))
+    hess = jnp.asarray(np.abs(rng.randn(N)).astype(np.float32))
+    mask = jnp.ones((N,), jnp.float32)
+    ch = jnp.asarray(rng.randint(-1, Q_LEAF_CHANNELS, N).astype(np.int32))
+    gq = rng.randint(-127, 128, N).astype(np.int8)
+    hq = rng.randint(0, 128, N).astype(np.int8)
+    wch_np = np.zeros((N, 8), np.int8)
+    wch_np[:, 0], wch_np[:, 1], wch_np[:, 2] = gq, hq, 1
+    wch = jnp.asarray(wch_np)
+
+    # 1. q8 kernel
+    timed("q8 kernel (42 leaves)",
+          lambda: build_histogram_pallas_leaves_q8(bins, wch, num_bins=255))
+
+    # 2. bf16 kernel
+    w8 = pack_weights8(grad, hess, mask)
+    ch25 = jnp.where(ch >= 25, -1, ch)
+    timed("bf16 kernel (25 leaves)",
+          lambda: build_histogram_pallas_leaves(bins, w8, ch25, num_bins=255))
+
+    # 3. wch channel set
+    timed("wch .at[:,3].set(ch)",
+          jax.jit(lambda w, c: w.at[:, 3].set(c.astype(jnp.int8))), wch, ch)
+
+    # 3b. wch rebuild from stacked lanes
+    timed("wch rebuild stack",
+          jax.jit(lambda c: jnp.stack(
+              [wch[:, 0], wch[:, 1], wch[:, 2], c.astype(jnp.int8)] +
+              [jnp.zeros((N,), jnp.int8)] * 4, axis=-1)), ch)
+
+    # 4. row_leaf update loop (W=42 streaming masked updates)
+    W = Q_LEAF_CHANNELS
+    feat = jnp.asarray(rng.randint(0, F, W).astype(np.int32))
+    thr = jnp.asarray(rng.randint(0, 255, W).astype(np.int32))
+    sel_leaves = jnp.asarray(rng.randint(0, 50, W).astype(np.int32))
+    new_ids = jnp.asarray((np.arange(W) + 51).astype(np.int32))
+
+    @jax.jit
+    def row_update(rl, bins):
+        chv = jnp.full((N,), -1, jnp.int32)
+        for j in range(W):
+            col = jax.lax.dynamic_slice(bins, (feat[j], 0), (1, N))[0]
+            col = col.astype(jnp.int32)
+            go_left = col <= thr[j]
+            upd = rl == sel_leaves[j]
+            chv = jnp.where(upd & go_left, j, chv)
+            rl = jnp.where(upd & jnp.logical_not(go_left), new_ids[j], rl)
+        return rl + chv
+
+    rl0 = jnp.asarray(rng.randint(0, 50, N).astype(np.int32))
+    timed("row_leaf update loop (W=42)", row_update, rl0, bins)
+
+    # 5. quantize_wch per tree
+    from lightgbm_tpu.ops.quantize import quantize_wch
+    timed("quantize_wch", lambda: quantize_wch(
+        grad, hess, mask, jnp.float32(0.01), jnp.float32(0.01),
+        jax.random.PRNGKey(0), gq_max=127, hq_max=127, stochastic=True))
+
+    # 6. renew leaf pass (1-feature histogram)
+    from lightgbm_tpu.ops.histogram_pallas import build_histogram_pallas
+    rl8 = (rl0 % 256).astype(jnp.uint8)[None, :]
+    timed("renew pass (1-feat hist)",
+          lambda: build_histogram_pallas(rl8, grad, hess, mask, num_bins=256))
+
+    # 7. candidate scans: 84 children x (F, B, 3)
+    from lightgbm_tpu.ops.split import SplitParams, best_split_per_feature
+    sp = SplitParams()
+    hists = jnp.asarray(rng.rand(84, F, B, 3).astype(np.float32) * 100)
+    sums = hists.sum(axis=2)[:, 0, :]
+    nb = jnp.full((F,), 255, jnp.int32)
+    ic = jnp.zeros((F,), jnp.bool_)
+    hn = jnp.zeros((F,), jnp.bool_)
+
+    @jax.jit
+    def scans(h, s):
+        def one(hh, ss):
+            fs = best_split_per_feature(hh, ss, nb, ic, hn, sp)
+            return fs.gain.max()
+        return jax.vmap(one)(h, s).sum()
+
+    timed("candidate scans (84 children)", scans, hists, sums)
+
+
+if __name__ == "__main__":
+    main()
