@@ -38,61 +38,202 @@ class MissingType(enum.Enum):
     NAN = 2
 
 
-def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
-                     max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
-    """Greedy equal-frequency bin boundary search over distinct sample values
-    (behavioral equivalent of src/io/bin.cpp:150 GreedyFindBin).
+def _dbl_up(a: float) -> float:
+    """Next representable double above ``a`` (common.h GetDoubleUpperBound;
+    boundary values sit strictly above the midpoint so ValueToBin's
+    left-search puts the midpoint's lower neighbor in the lower bin)."""
+    return float(np.nextafter(a, np.inf))
+
+
+def _greedy_find_bin(distinct_values, counts, max_bin: int, total_cnt: int,
+                     min_data_in_bin: int) -> List[float]:
+    """Greedy equal-frequency boundary search over distinct sample values —
+    exact behavioral mirror of the reference (src/io/bin.cpp:78
+    GreedyFindBin): big-count values get dedicated bins, the running mean
+    bin size re-adapts as bins close, boundaries are the next double above
+    the midpoint, and one-ULP-adjacent boundaries dedupe.
 
     Returns upper bin boundaries; the last boundary is +inf.
     """
-    num_distinct = len(distinct_values)
-    bin_upper: List[float] = []
-    if num_distinct == 0:
+    dv = [float(v) for v in distinct_values]
+    ct = [int(c) for c in counts]
+    nd = len(dv)
+    out: List[float] = []
+    if nd == 0:
         return [np.inf]
-    if num_distinct <= max_bin:
-        # one bin per distinct value, merging forward until min_data_in_bin
-        cur_cnt = 0
-        for i in range(num_distinct - 1):
-            cur_cnt += int(counts[i])
-            if cur_cnt >= min_data_in_bin:
-                bin_upper.append((float(distinct_values[i]) + float(distinct_values[i + 1])) / 2.0)
-                cur_cnt = 0
-        bin_upper.append(np.inf)
-        return bin_upper
+    if nd <= max_bin:
+        cur = 0
+        for i in range(nd - 1):
+            cur += ct[i]
+            if cur >= min_data_in_bin:
+                val = _dbl_up((dv[i] + dv[i + 1]) / 2.0)
+                if not out or val > _dbl_up(out[-1]):
+                    out.append(val)
+                    cur = 0
+        out.append(np.inf)
+        return out
 
-    # more distinct values than bins: greedy packing with "big" value handling
     if min_data_in_bin > 0:
-        max_bin = min(max_bin, max(1, total_cnt // min_data_in_bin))
+        max_bin = max(1, min(max_bin, int(total_cnt) // min_data_in_bin))
     mean_bin_size = total_cnt / max_bin
-    is_big = counts >= mean_bin_size
-    rest_cnt = int(total_cnt - counts[is_big].sum())
-    rest_bins = int(max_bin - is_big.sum())
-    if rest_bins > 0:
-        mean_bin_size = rest_cnt / rest_bins
-
+    is_big = [c >= mean_bin_size for c in ct]
+    rest_bin = max_bin - sum(is_big)
+    rest_cnt = int(total_cnt) - sum(c for c, b in zip(ct, is_big) if b)
+    mean_bin_size = rest_cnt / rest_bin if rest_bin else np.inf
     uppers: List[float] = []
-    lowers: List[float] = [float(distinct_values[0])]
-    cur_cnt = 0
-    for i in range(num_distinct - 1):
+    lowers: List[float] = [dv[0]]
+    cur = 0
+    for i in range(nd - 1):
         if not is_big[i]:
-            rest_cnt -= int(counts[i])
-        cur_cnt += int(counts[i])
-        # close the bin at a big value, before a big value, or when full
-        if is_big[i] or cur_cnt >= mean_bin_size or \
-           (is_big[i + 1] and cur_cnt >= max(1.0, mean_bin_size * 0.5)):
-            uppers.append(float(distinct_values[i]))
-            lowers.append(float(distinct_values[i + 1]))
-            cur_cnt = 0
-            if not is_big[i]:
-                rest_bins -= 1
-                if rest_bins > 0:
-                    mean_bin_size = rest_cnt / rest_bins
+            rest_cnt -= ct[i]
+        cur += ct[i]
+        if is_big[i] or cur >= mean_bin_size or \
+                (is_big[i + 1] and cur >= max(1.0, mean_bin_size * 0.5)):
+            uppers.append(dv[i])
+            lowers.append(dv[i + 1])
             if len(uppers) >= max_bin - 1:
                 break
-    # convert (upper[i], lower[i+1]) pairs to midpoint boundaries
-    bin_upper = [(uppers[i] + lowers[i + 1]) / 2.0 for i in range(len(uppers))]
-    bin_upper.append(np.inf)
-    return bin_upper
+            cur = 0
+            if not is_big[i]:
+                rest_bin -= 1
+                mean_bin_size = rest_cnt / rest_bin if rest_bin else np.inf
+    for i in range(len(uppers)):
+        val = _dbl_up((uppers[i] + lowers[i + 1]) / 2.0)
+        if not out or val > _dbl_up(out[-1]):
+            out.append(val)
+    out.append(np.inf)
+    return out
+
+
+def _distinct_with_zero(vals_sorted: np.ndarray, zero_cnt: int):
+    """Distinct (value, count) pairs with the implicit zero block injected
+    at its sorted position (BinMapper::FindBin's construction,
+    bin.cpp:355-383: the sample carries only |v| > kZeroThreshold values;
+    everything else is the zero block).  One-ULP-adjacent values merge,
+    keeping the larger value."""
+    n = len(vals_sorted)
+    if n == 0:
+        return [0.0], [int(zero_cnt)]
+    new_grp = np.empty(n, bool)
+    new_grp[0] = True
+    if n > 1:
+        new_grp[1:] = vals_sorted[1:] > np.nextafter(vals_sorted[:-1], np.inf)
+    starts = np.flatnonzero(new_grp)
+    ends = np.append(starts[1:], n) - 1
+    dl = vals_sorted[ends].tolist()
+    cl = (np.append(starts[1:], n) - starts).tolist()
+    out_d: List[float] = []
+    out_c: List[int] = []
+    if dl[0] > 0.0 and zero_cnt > 0:
+        out_d.append(0.0)
+        out_c.append(int(zero_cnt))
+    for i, (d, c) in enumerate(zip(dl, cl)):
+        if i > 0 and dl[i - 1] < 0.0 and d > 0.0:
+            # the zero block sits between the signs (inserted even when
+            # empty, like the reference)
+            out_d.append(0.0)
+            out_c.append(int(zero_cnt))
+        out_d.append(float(d))
+        out_c.append(int(c))
+    if dl[-1] < 0.0 and zero_cnt > 0:
+        out_d.append(0.0)
+        out_c.append(int(zero_cnt))
+    return out_d, out_c
+
+
+def _split_zero_counts(distinct, counts):
+    left_cnt_data = cnt_zero = right_cnt_data = 0
+    for d, c in zip(distinct, counts):
+        if d <= -ZERO_THRESHOLD:
+            left_cnt_data += c
+        elif d > ZERO_THRESHOLD:
+            right_cnt_data += c
+        else:
+            cnt_zero += c
+    left_cnt = next((i for i, d in enumerate(distinct)
+                     if d > -ZERO_THRESHOLD), len(distinct))
+    right_start = next((i for i in range(left_cnt, len(distinct))
+                        if distinct[i] > ZERO_THRESHOLD), -1)
+    return left_cnt_data, cnt_zero, right_cnt_data, left_cnt, right_start
+
+
+def _find_bin_zero_as_one(distinct, counts, max_bin: int, total_cnt: int,
+                          min_data_in_bin: int) -> List[float]:
+    """Exact mirror of the reference's FindBinWithZeroAsOneBin
+    (bin.cpp:256): the negative range gets a budget proportional to its
+    row share (floored), its last boundary becomes -kZeroThreshold, the
+    positive range takes whatever budget remains past the zero bin."""
+    left_cnt_data, cnt_zero, right_cnt_data, left_cnt, right_start = \
+        _split_zero_counts(distinct, counts)
+    ub: List[float] = []
+    if left_cnt > 0 and max_bin > 1:
+        left_max_bin = int(left_cnt_data / (total_cnt - cnt_zero) *
+                           (max_bin - 1))
+        left_max_bin = max(1, left_max_bin)
+        ub = _greedy_find_bin(distinct[:left_cnt], counts[:left_cnt],
+                              left_max_bin, left_cnt_data, min_data_in_bin)
+        if ub:
+            ub[-1] = -ZERO_THRESHOLD
+    right_max_bin = max_bin - 1 - len(ub)
+    if right_start >= 0 and right_max_bin > 0:
+        rb = _greedy_find_bin(distinct[right_start:], counts[right_start:],
+                              right_max_bin, right_cnt_data, min_data_in_bin)
+        ub.append(ZERO_THRESHOLD)
+        ub.extend(rb)
+    else:
+        ub.append(np.inf)
+    return ub
+
+
+def _find_bin_predefined(distinct, counts, max_bin: int, total_cnt: int,
+                         min_data_in_bin: int, forced) -> List[float]:
+    """Exact mirror of FindBinWithPredefinedBin (bin.cpp:157): zero-bin
+    boundaries and inf seed the set, forced bounds outside the zero band
+    fill up to the budget, and each inter-bound segment gets greedy
+    sub-bins proportional to its row share."""
+    (left_cnt_data, cnt_zero, right_cnt_data, left_cnt,
+     right_start) = _split_zero_counts(distinct, counts)
+    ub: List[float] = []
+    if max_bin == 2:
+        ub.append(ZERO_THRESHOLD if left_cnt == 0 else -ZERO_THRESHOLD)
+    elif max_bin >= 3:
+        if left_cnt > 0:
+            ub.append(-ZERO_THRESHOLD)
+        if right_start >= 0:
+            ub.append(ZERO_THRESHOLD)
+    ub.append(np.inf)
+    max_to_insert = max_bin - len(ub)
+    num_inserted = 0
+    for b in forced:
+        if num_inserted >= max_to_insert:
+            break
+        if abs(float(b)) > ZERO_THRESHOLD:
+            ub.append(float(b))
+            num_inserted += 1
+    ub.sort()
+    free_bins = max_bin - len(ub)
+    bounds_to_add: List[float] = []
+    value_ind = 0
+    nd = len(distinct)
+    for i in range(len(ub)):
+        cnt_in_bin = 0
+        bin_start = value_ind
+        while value_ind < nd and distinct[value_ind] < ub[i]:
+            cnt_in_bin += counts[value_ind]
+            value_ind += 1
+        bins_remaining = max_bin - len(ub) - len(bounds_to_add)
+        num_sub_bins = int(np.floor(cnt_in_bin * free_bins / total_cnt + 0.5)) \
+            if total_cnt > 0 else 0
+        num_sub_bins = min(num_sub_bins, bins_remaining) + 1
+        if i == len(ub) - 1:
+            num_sub_bins = bins_remaining + 1
+        nb = _greedy_find_bin(distinct[bin_start:value_ind],
+                              counts[bin_start:value_ind], num_sub_bins,
+                              cnt_in_bin, min_data_in_bin)
+        bounds_to_add.extend(nb[:-1])  # last bound is inf
+    ub.extend(bounds_to_add)
+    ub.sort()
+    return ub
 
 
 @dataclass
@@ -111,11 +252,14 @@ class BinMapper:
     most_freq_bin: int = 0
     min_value: float = 0.0
     max_value: float = 0.0
+    # set by the pre-filter when no boundary separates enough rows
+    # (bin.cpp NeedFilter); the feature is dropped like num_bin <= 1
+    forced_trivial: bool = False
 
     @property
     def is_trivial(self) -> bool:
-        """True when the feature carries no split information (num_bin <= 1)."""
-        return self.num_bin <= 1
+        """True when the feature carries no split information."""
+        return self.num_bin <= 1 or self.forced_trivial
 
     # -- quantization --------------------------------------------------------
     def value_to_bin(self, values: np.ndarray) -> np.ndarray:
@@ -170,7 +314,8 @@ class BinMapper:
 def find_bin(sample_values: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
              *, total_cnt: Optional[int] = None, is_categorical: bool = False,
              use_missing: bool = True, zero_as_missing: bool = False,
-             forced_bounds: Optional[Sequence[float]] = None) -> BinMapper:
+             forced_bounds: Optional[Sequence[float]] = None,
+             pre_filter_cnt: int = 1) -> BinMapper:
     """Construct a BinMapper from a sample of one feature's values
     (reference src/io/bin.cpp BinMapper::FindBin).
 
@@ -200,86 +345,76 @@ def find_bin(sample_values: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
         missing_type = MissingType.NONE
         # without use_missing NaNs are folded into zero (bin.cpp FindBin)
 
-    zero_cnt = int(((finite > -ZERO_THRESHOLD) & (finite < ZERO_THRESHOLD)).sum())
-    # rows absent from a feature's sample are zeros in the reference's sparse
-    # sample representation; here the sample is dense so only count sample zeros
-    neg = finite[finite <= -ZERO_THRESHOLD]
-    pos = finite[finite >= ZERO_THRESHOLD]
+    # The reference's per-feature sample holds only |v| > kZeroThreshold
+    # values (dataset_loader.cpp:966); everything else is the implicit
+    # zero block of size total - sample - na.
+    vals = finite[np.abs(finite) > ZERO_THRESHOLD]
+    na_eff = na_cnt if missing_type == MissingType.NAN else 0
+    zero_cnt = int(total_cnt - len(vals) - na_eff)
+    vals = np.sort(vals, kind="stable")
+    distinct, counts = _distinct_with_zero(vals, zero_cnt)
 
-    boundaries: List[float] = []
-    n_non_missing = len(neg) + len(pos) + zero_cnt
-    if n_non_missing == 0:
-        boundaries = [np.inf]
+    if missing_type == MissingType.NAN:
+        mb, tot = max_bin - 1, int(total_cnt) - na_eff
     else:
-        # distribute bins proportionally around the dedicated zero bin
-        # (bin.cpp FindBinWithZeroAsOneBin)
-        budget = max_bin - 1 if missing_type == MissingType.NAN else max_bin
-        budget = max(budget, 2)
-        left_budget = int(round(budget * len(neg) / max(1, n_non_missing)))
-        left_budget = min(max(left_budget, 1 if len(neg) else 0), budget - 1)
-        right_budget = budget - left_budget - 1  # -1 for the zero bin
-        if len(pos) == 0:
-            right_budget = 0
-        left_b: List[float] = []
-        right_b: List[float] = []
-        if len(neg):
-            dv, cnt = np.unique(neg, return_counts=True)
-            left_b = _greedy_find_bin(dv, cnt, left_budget, len(neg), min_data_in_bin)
-            left_b = [b for b in left_b if b < -ZERO_THRESHOLD]
-            left_b.append(-ZERO_THRESHOLD)
-        if len(pos):
-            dv, cnt = np.unique(pos, return_counts=True)
-            right_b = _greedy_find_bin(dv, cnt, max(right_budget, 1), len(pos),
-                                       min_data_in_bin)
-        boundaries = sorted(set(left_b)) + [ZERO_THRESHOLD] + sorted(
-            b for b in right_b if b > ZERO_THRESHOLD)
-        if not np.isinf(boundaries[-1]):
-            boundaries.append(np.inf)
-        # drop the zero boundary if there is nothing on one side and no zeros
-        if zero_cnt == 0 and (len(neg) == 0 or len(pos) == 0):
-            boundaries = [b for b in boundaries
-                          if not (-ZERO_THRESHOLD <= b <= ZERO_THRESHOLD)] or [np.inf]
+        mb, tot = max_bin, int(total_cnt)
+    forced = [float(b) for b in forced_bounds] if forced_bounds else []
+    if forced:
+        ub_list = _find_bin_predefined(distinct, counts, mb, tot,
+                                       min_data_in_bin, forced)
+    else:
+        ub_list = _find_bin_zero_as_one(distinct, counts, mb, tot,
+                                        min_data_in_bin)
+    if missing_type == MissingType.ZERO and len(ub_list) == 2:
+        missing_type = MissingType.NONE
 
-    if forced_bounds:
-        # forced boundaries first (truncated to the bin budget — the
-        # reference caps at max_bin), then the zero-bin boundaries (the
-        # dedicated zero/missing bin must survive, bin.cpp
-        # FindBinWithZeroAsOneBin), then greedy boundaries sampled evenly
-        # across the value range to fill the remainder
-        budget = max(max_bin - (1 if missing_type == MissingType.NAN else 0),
-                     2)
-        forced = sorted({float(b) for b in forced_bounds})[:budget - 1]
-        computed = sorted(set(boundaries))
-        keep = set(forced) | {np.inf}
-        for b in computed:
-            if -ZERO_THRESHOLD <= b <= ZERO_THRESHOLD and \
-                    len(keep) < budget:
-                keep.add(float(b))
-        rest = [b for b in computed if float(b) not in keep]
-        need = budget - len(keep)
-        if need > 0 and rest:
-            idx = np.unique(np.linspace(0, len(rest) - 1,
-                                        min(need, len(rest))).astype(int))
-            keep.update(float(rest[i]) for i in idx)
-        boundaries = sorted(keep)
-
-    ub = np.asarray(sorted(set(boundaries)), dtype=np.float64)
+    ub = np.asarray(ub_list, dtype=np.float64)
     num_bin = len(ub)
     if missing_type == MissingType.NAN:
         num_bin += 1  # trailing NaN bin
+
+    # per-bin sample counts (the reference's cnt_in_bin walk) drive
+    # most_freq_bin; when the winner is not the zero/default bin and the
+    # feature is not sparse enough, the default bin wins (bin.cpp:506-514)
+    cnt_in_bin = np.zeros(num_bin, np.int64)
+    i_bin = 0
+    for d, c in zip(distinct, counts):
+        # `while`, not the reference's single-step `if`: forced bounds can
+        # place two boundaries between consecutive distinct values, and a
+        # single step would misattribute counts across the empty bin
+        while d > ub[i_bin]:
+            i_bin += 1
+        cnt_in_bin[i_bin] += c
+    if missing_type == MissingType.NAN:
+        cnt_in_bin[num_bin - 1] = na_cnt
 
     mapper = BinMapper(
         num_bin=num_bin,
         is_categorical=False,
         missing_type=missing_type,
         bin_upper_bound=ub,
-        min_value=float(finite.min()) if len(finite) else 0.0,
-        max_value=float(finite.max()) if len(finite) else 0.0,
+        min_value=float(distinct[0]),
+        max_value=float(distinct[-1]),
     )
+    # pre-filter: a feature no boundary of which can separate
+    # pre_filter_cnt rows on both sides can never split (bin.cpp:489
+    # NeedFilter; the threshold is min_data_in_leaf scaled to the sample)
+    if num_bin > 1 and pre_filter_cnt > 0:
+        sum_left = 0
+        need = True
+        for i in range(num_bin - 1):
+            sum_left += int(cnt_in_bin[i])
+            if sum_left >= pre_filter_cnt and \
+                    int(total_cnt) - sum_left >= pre_filter_cnt:
+                need = False
+                break
+        mapper.forced_trivial = need
     mapper.default_bin = int(np.searchsorted(ub, 0.0, side="left"))
-    if len(finite):
-        binned = mapper.value_to_bin(sample_values)
-        mapper.most_freq_bin = int(np.bincount(binned, minlength=num_bin).argmax())
+    most_freq = int(cnt_in_bin.argmax())
+    sparse_rate = cnt_in_bin[most_freq] / max(1, int(total_cnt))
+    if most_freq != mapper.default_bin and sparse_rate < 0.8:
+        most_freq = mapper.default_bin  # kSparseThreshold
+    mapper.most_freq_bin = most_freq
     return mapper
 
 

@@ -175,14 +175,22 @@ class Dataset:
                     col_sample = np.concatenate([vals, np.zeros(nz)])
                 else:
                     col_sample = raw[sample_idx, j]
+                # the reference's pre-filter threshold scales
+                # min_data_in_leaf by the sample fraction
+                # (dataset_loader.cpp filter_cnt)
+                # 0 disables the pre-filter (feature_pre_filter=false
+                # keeps even never-splittable features, like the reference)
+                filt = max(1, int(cfg.min_data_in_leaf * len(col_sample) /
+                                  max(1, n))) if cfg.feature_pre_filter else 0
                 self.bin_mappers.append(find_bin(
                     col_sample, max_bin=cfg.max_bin,
                     min_data_in_bin=cfg.min_data_in_bin,
-                    total_cnt=n,
+                    total_cnt=len(col_sample),
                     is_categorical=(j in cat_indices),
                     use_missing=cfg.use_missing,
                     zero_as_missing=cfg.zero_as_missing,
-                    forced_bounds=forced_bins.get(j)))
+                    forced_bounds=forced_bins.get(j),
+                    pre_filter_cnt=filt))
             # pre-filter trivial features (config.h feature_pre_filter)
             used = [j for j, m in enumerate(self.bin_mappers) if not m.is_trivial]
             if len(used) == 0:

@@ -125,9 +125,14 @@ def _load_dense(path: str, delim: str, skip: int,
 
     if not two_round:
         return _to_f64(pd.read_csv(path, **kw))
-    # pass 1: row count only
+    # pass 1: count only parseable data rows (comment/blank lines would
+    # otherwise inflate the preallocation this low-memory mode exists to
+    # bound)
     with open(path) as fh:
-        n = sum(1 for _ in fh) - skip
+        for _ in range(skip):
+            fh.readline()
+        n = sum(1 for line in fh
+                if line.strip() and not line.lstrip().startswith("#"))
     out: Optional[np.ndarray] = None
     r = 0
     for chunk in pd.read_csv(path, chunksize=1 << 18, **kw):
@@ -138,6 +143,10 @@ def _load_dense(path: str, delim: str, skip: int,
         r += len(a)
     if out is None:
         raise ValueError(f"{path} has no data rows")
+    if r < n:
+        # release the slack instead of keeping a view over the larger
+        # buffer alive
+        return np.ascontiguousarray(out[:r])
     return out[:r]
 
 

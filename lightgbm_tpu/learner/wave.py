@@ -87,7 +87,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
     if pallas:
         from ..ops.histogram_pallas import (
             build_histogram_pallas, build_histogram_pallas_leaves,
-            build_histogram_pallas_leaves_q8, pack_weights8)
+            build_histogram_pallas_leaves_q8, pack_weights8,
+            wave_row_update_pallas)
 
     sp = split_params
     use_mc = split_params.use_monotone
@@ -167,7 +168,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             int32 channel sums (dequantize with ``dq``)."""
             if quantized:
                 if pallas:
-                    wch = wch0.at[:, 3].set(ch.astype(jnp.int8))
+                    wch = wch0.at[3].set(ch.astype(jnp.int8))
                     h = build_histogram_pallas_leaves_q8(
                         X_T, wch, num_bins=Bb, interpret=interpret)
                 else:
@@ -176,9 +177,9 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     # CPU/test shards this path serves (the Pallas path
                     # accumulates true int32 and has no such cap)
                     h = build_histogram_leaves(
-                        bins_rows, wch0[:, 0].astype(jnp.float32),
-                        wch0[:, 1].astype(jnp.float32),
-                        wch0[:, 2].astype(jnp.float32), ch,
+                        bins_rows, wch0[0].astype(jnp.float32),
+                        wch0[1].astype(jnp.float32),
+                        wch0[2].astype(jnp.float32), ch,
                         num_channels=W, num_bins=Bb, impl=hist_impl)
                     h = jnp.round(h).astype(jnp.int32)
                 return strat.reduce_hist(h[:k])
@@ -191,13 +192,21 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     num_channels=W, num_bins=Bb, impl=hist_impl)
             return strat.reduce_hist(h[:k])
 
+        # Narrow-dtype fast path for the per-wave row updates: W streaming
+        # passes over N rows dominate after the kernel, so keep the
+        # comparisons in uint8 (bin codes never exceed 254 here, freeing
+        # 255 as the "no NaN bin" sentinel) and the leaf ids in uint8
+        # when the tree fits — 4x less HBM traffic than the int32 form.
+        small_bins = (not use_efb) and max_bins <= 255
+
         def feature_col(feat):
             """FEATURE-space bin codes (N,) of one feature (decoded from
             its bundle column under EFB; efb.make_bundle_decode)."""
             g = f_bundle[feat] if use_efb else feat
-            v = jax.lax.dynamic_slice(X_T, (g, 0), (1, n))[0].astype(
-                jnp.int32)
-            return bundle_decode(v, feat)
+            v = jax.lax.dynamic_slice(X_T, (g, 0), (1, n))[0]
+            if small_bins:
+                return v                                     # uint8
+            return bundle_decode(v.astype(jnp.int32), feat)
 
         def many_candidates(hists, sums, bounds, depths, pouts):
             """Best-split candidates for k leaves in one vmapped scan."""
@@ -210,7 +219,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             return jax.vmap(one)(hists, sums, bounds, depths, pouts)
 
         # ---- root ----
-        root_hist = hist_waves(jnp.zeros((n,), jnp.int32), k=1)[0]
+        root_hist = hist_waves(jnp.zeros((n,), jnp.int8), k=1)[0]
         if quantized:
             # derive the root totals from the quantized histogram itself
             # (any bundle's bins sum to the total) so candidate left+right
@@ -228,8 +237,9 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                                      root_bound, jnp.asarray(0, jnp.int32),
                                      root_out)
 
+        rl_dtype = jnp.uint8 if L <= 256 else jnp.int32
         state = {
-            "row_leaf": jnp.zeros((n,), jnp.int32),
+            "row_leaf": jnp.zeros((n,), rl_dtype),
             "leaf_sum": jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum),
             "leaf_depth": jnp.zeros((L,), jnp.int32),
             "cand_gain": jnp.full((L,), NEG_INF, jnp.float32).at[0].set(cand[0]),
@@ -274,10 +284,14 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             # budget would lock in splits that freshly-created children
             # (whose gains are not yet known) should have outcompeted —
             # the sequential best-first order lets them.  Halving the wave
-            # once budget < 2W adds only ~log2(W) extra waves and closes
-            # most of the quality gap to the exact order.
+            # once budget < 2W closes most of the quality gap to the exact
+            # order; the W//4 floor caps the halving cascade at ~2-3
+            # extra waves (each wave is a full-data histogram pass — a
+            # log2(W)-deep taper costs more wall time than its last few
+            # splits are worth).
+            taper = jnp.maximum(budget // 2, jnp.minimum(W // 4, budget))
             k_eff = jnp.minimum(W, jnp.maximum(
-                1, jnp.where(budget >= 2 * W, budget, budget // 2)))
+                1, jnp.where(budget >= 2 * W, budget, taper)))
             vals, sel_leaves = jax.lax.top_k(s["cand_gain"], W)
             sel = (vals > 0) & (jarange < k_eff)
             prefix = jnp.cumsum(sel.astype(jnp.int32))
@@ -297,23 +311,46 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             fnan = hn_full[feat]
             f_nan_bin = jnp.where(fnan, nb_full[feat] - 1, -1)
 
-            # ---- row_leaf + wave-channel update: W streaming passes ----
+            # ---- row_leaf + wave-channel update ----
             rl = s["row_leaf"]
-            ch = jnp.full((n,), -1, jnp.int32)
-            for j in range(W):
-                col = feature_col(feat[j])
-                if any_cat:
-                    go_left = jnp.where(
-                        fcat[j], member[j][col],
-                        jnp.where(col == f_nan_bin[j], dleft[j],
-                                  col <= thr[j]))
+            if pallas and small_bins and not any_cat:
+                # one fused kernel pass instead of W masked XLA sweeps
+                # (each sweep's fused-loop launch overhead alone costs
+                # ~0.7 ms at 10.5M rows)
+                cols_w = jnp.take(X_T, feat, axis=0)          # (W, N) u8
+                tab = jnp.stack([
+                    thr, f_nan_bin, dleft.astype(jnp.int32),
+                    left_smaller.astype(jnp.int32), sel_leaves, new_ids,
+                    sel.astype(jnp.int32), jnp.zeros_like(thr)])
+                rl_new, ch = wave_row_update_pallas(
+                    cols_w, rl, tab, interpret=interpret)
+                rl = rl_new.astype(rl.dtype)
+            else:
+                ch = jnp.full((n,), -1, jnp.int8)
+                if small_bins:
+                    thr_c = thr.astype(jnp.uint8)
+                    nan_c = jnp.where(f_nan_bin < 0, 255,
+                                      f_nan_bin).astype(jnp.uint8)
                 else:
-                    go_left = jnp.where(col == f_nan_bin[j], dleft[j],
-                                        col <= thr[j])
-                upd = sel[j] & (rl == sel_leaves[j])
-                ch = jnp.where(upd & (go_left == left_smaller[j]), j, ch)
-                rl = jnp.where(upd & jnp.logical_not(go_left), new_ids[j],
-                               rl)
+                    thr_c, nan_c = thr, f_nan_bin
+                sel_c = sel_leaves.astype(rl.dtype)
+                new_c = new_ids.astype(rl.dtype)
+                jidx = jnp.arange(W, dtype=jnp.int8)
+                for j in range(W):
+                    col = feature_col(feat[j])
+                    if any_cat:
+                        go_left = jnp.where(
+                            fcat[j], member[j][col],
+                            jnp.where(col == nan_c[j], dleft[j],
+                                      col <= thr_c[j]))
+                    else:
+                        go_left = jnp.where(col == nan_c[j], dleft[j],
+                                            col <= thr_c[j])
+                    upd = sel[j] & (rl == sel_c[j])
+                    ch = jnp.where(upd & (go_left == left_smaller[j]),
+                                   jidx[j], ch)
+                    rl = jnp.where(upd & jnp.logical_not(go_left),
+                                   new_c[j], rl)
 
             # ---- one kernel pass: all W smaller-child histograms ----
             hist_small = hist_waves(ch)                    # (W, G, Bb, 3)
@@ -445,7 +482,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             # On the Pallas path this reuses the single-leaf histogram
             # kernel with row_leaf as a one-feature bin column (cost
             # ~1/F of a wave pass); off-TPU it is a segment-sum.
-            rl = s["row_leaf"]
+            rl = s["row_leaf"].astype(jnp.int32)
             if pallas:
                 parts = []
                 for c in range((L + 255) // 256):
@@ -453,7 +490,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     bins1 = (rl % 256).astype(jnp.uint8)[None, :]
                     parts.append(build_histogram_pallas(
                         bins1, grad, hess, m, num_bins=256,
-                        interpret=interpret)[0])
+                        interpret=interpret, kr=4096)[0])
                 gh = jnp.concatenate(parts, axis=0)[:L, :2]       # (L, 2)
             else:
                 gh = jax.ops.segment_sum(
@@ -484,6 +521,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             internal_weight=s["internal_weight"],
             internal_count=s["internal_count"], leaf_value=s["leaf_value"],
             leaf_weight=s["leaf_weight"], leaf_count=s["leaf_count"],
-            num_leaves=s["num_leaves"], row_leaf=s["row_leaf"])
+            num_leaves=s["num_leaves"],
+            row_leaf=s["row_leaf"].astype(jnp.int32))
 
     return jax.jit(grow) if jit else grow

@@ -117,12 +117,14 @@ def _hist_kernel(bins_ref, w_ref, out_ref, *, num_features: int,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_bins", "row_block", "interpret"))
+                   static_argnames=("num_bins", "row_block", "interpret",
+                                    "kr"))
 def build_histogram_pallas(bins_t: jnp.ndarray, grad: jnp.ndarray,
                            hess: jnp.ndarray, mask: jnp.ndarray, *,
                            num_bins: int,
                            row_block: int = DEFAULT_ROW_BLOCK,
-                           interpret: bool = False) -> jnp.ndarray:
+                           interpret: bool = False,
+                           kr: int = 0) -> jnp.ndarray:
     """(F, B, 3) histogram over masked rows from feature-major bin codes.
 
     Args:
@@ -168,7 +170,9 @@ def build_histogram_pallas(bins_t: jnp.ndarray, grad: jnp.ndarray,
     f_pad = _round_up(f, ft)  # also a multiple of ``fstep`` and ``group``
     if f_pad != f:
         bins_t = jnp.pad(bins_t, ((0, f_pad - f), (0, 0)))
-    kr = math.gcd(row_block, 1024)
+    # narrow inputs (the 1-feature leaf-refit pass) want larger row blocks:
+    # per-grid-step overhead dominates their tiny per-block compute
+    kr = kr or math.gcd(row_block, 1024)
 
     grid = (f_pad // ft, n // kr)  # row dim innermost
     out = pl.pallas_call(
@@ -371,9 +375,14 @@ def build_histogram_pallas_leaves(bins_t: jnp.ndarray, w8: jnp.ndarray,
 # Mosaic constraints probed on v5e (scripts/proto_q8_*.py): 8-bit compares
 # and 8-bit elementwise multiplies are NOT supported — the one-hot and the
 # lane-expanded weights are built with 32-bit arithmetic and packed to i8
-# right before the dot.  Best measured layout: group=8 features per
-# contraction (M=2048), kr=2048 row blocks — 114 ms vs the bf16 kernel's
-# 157 ms per full pass at 4.19M x 28 x 256, with 42/25 the leaves.
+# right before the dot.  Best measured layout (proto_q8_round2.py at
+# 10.5M x 28 x 256): FEATURE-MAJOR (8, N) weights consumed as a
+# (128, R) right operand with the dot contracting dim 1 of both sides —
+# 72 ms/pass vs 108 ms for the row-major (R, 128) form and 164 ms for
+# the bf16 25-leaf kernel; group=8 features per contraction (M=2048),
+# kr=4096 row blocks.  The feature-major layout also makes the per-wave
+# leaf-channel update a contiguous (N,) row write instead of a strided
+# lane update.
 # ---------------------------------------------------------------------------
 
 
@@ -385,15 +394,15 @@ def _hist_leaves_q8_kernel(bins_ref, wch_ref, out_ref, *, num_features: int,
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    wch = wch_ref[...]                   # (R, 8) i8: g_q, h_q, cnt, ch, 0*4
-    r = wch.shape[0]
+    wch = wch_ref[...]                   # (8, R) i8: g_q, h_q, cnt, ch, 0*4
+    r = wch.shape[1]
     b = num_bins
-    ch = wch[:, 3:4].astype(jnp.int32)   # (R, 1); -1 = inactive
-    lane = jax.lax.broadcasted_iota(jnp.int32, (r, 128), 1)
-    sel = (ch == lane // _QCB).astype(jnp.int32)
-    w3 = wch[:, :_QCB].astype(jnp.int32)
-    wtile = jnp.concatenate([w3] * (128 // _QCB + 1), axis=1)[:, :128]
-    w128 = (wtile * sel).astype(jnp.int8)          # (R, 128)
+    ch = wch[3:4, :].astype(jnp.int32)   # (1, R); -1 = inactive
+    subl = jax.lax.broadcasted_iota(jnp.int32, (128, r), 0)
+    sel = (ch == subl // _QCB).astype(jnp.int32)
+    w3 = wch[:_QCB, :].astype(jnp.int32)           # (3, R)
+    wtile = jnp.concatenate([w3] * (128 // _QCB + 1), axis=0)[:128]
+    w128t = (wtile * sel).astype(jnp.int8)         # (128, R)
     iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, r), 0) % b
 
     for k in range(num_features // group):
@@ -401,7 +410,7 @@ def _hist_leaves_q8_kernel(bins_ref, wch_ref, out_ref, *, num_features: int,
         colrep = jnp.repeat(cols, b, axis=0)                 # (g*B, R)
         onehot = (colrep == iota_gb).astype(jnp.int8)
         part = jax.lax.dot_general(
-            onehot, w128, (((1,), (0,)), ((), ())),
+            onehot, w128t, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.int32)                # (g*B, 128)
         out_ref[k * group * b:(k + 1) * group * b] += part
 
@@ -416,14 +425,15 @@ def build_histogram_pallas_leaves_q8(bins_t: jnp.ndarray, wch: jnp.ndarray,
 
     Args:
       bins_t: (F, N) uint8 bin codes, N a multiple of ``row_block``.
-      wch: (N, 8) int8 rows [g_q, h_q, count, ch, 0, 0, 0, 0]; ch is the
-        leaf channel in [0, Q_LEAF_CHANNELS) or -1 for inactive rows
-        (they contribute nothing regardless of their weight lanes).
+      wch: (8, N) int8 FEATURE-MAJOR rows [g_q, h_q, count, ch, 0*4]; ch
+        is the leaf channel in [0, Q_LEAF_CHANNELS) or -1 for inactive
+        rows (they contribute nothing regardless of their weight lanes).
       num_bins: static global bin count B (<= 256).
     Returns:
       (42, F, B, 3) int32: channel sums (sum g_q, sum h_q, count).
     """
-    f, n = bins_t.shape
+    _, n = wch.shape
+    f = bins_t.shape[0]
     if n % row_block != 0:
         raise ValueError(f"pallas histogram needs N % {row_block} == 0, "
                          f"got N={n} (use pad_rows)")
@@ -441,7 +451,7 @@ def build_histogram_pallas_leaves_q8(bins_t: jnp.ndarray, wch: jnp.ndarray,
     f_pad = _round_up(f, ft)
     if f_pad != f:
         bins_t = jnp.pad(bins_t, ((0, f_pad - f), (0, 0)))
-    kr = math.gcd(row_block, 2048)
+    kr = math.gcd(row_block, 4096)
 
     grid = (f_pad // ft, n // kr)
     out = pl.pallas_call(
@@ -451,7 +461,7 @@ def build_histogram_pallas_leaves_q8(bins_t: jnp.ndarray, wch: jnp.ndarray,
         in_specs=[
             pl.BlockSpec((ft, kr), lambda i, j: (i, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((kr, 8), lambda i, j: (j, 0),
+            pl.BlockSpec((8, kr), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),
@@ -467,3 +477,95 @@ def build_histogram_pallas_leaves_q8(bins_t: jnp.ndarray, wch: jnp.ndarray,
     out = out[:, :Q_LEAF_CHANNELS * _QCB].reshape(f_pad, b,
                                                   Q_LEAF_CHANNELS, _QCB)
     return jnp.transpose(out, (2, 0, 1, 3))[:, :f, :num_bins, :]
+
+
+# ---------------------------------------------------------------------------
+# Wave row update: one fused pass assigning rows to their post-wave leaf
+# and leaf channel.  The XLA form (learner/wave.py's W sequential masked
+# wheres) launches ~W fused loop nests over N rows — per-nest overhead
+# alone costs ~30 ms/wave at 10.5M rows.  Here the W winning feature
+# columns are gathered once (a cheap major-axis take) and ONE kernel
+# sweeps the rows, keeping rl/ch blocks VMEM-resident across the W
+# per-split updates.  Numeric splits only — the categorical membership
+# lookup is a per-row gather Mosaic cannot express; wave.py keeps the XLA
+# path when categorical features or EFB bundles are present.
+# ---------------------------------------------------------------------------
+
+
+def _row_update_kernel(cols_ref, rl_ref, tab_ref, rl_out, ch_out, *,
+                       w: int):
+    rl = rl_ref[...].astype(jnp.int32)            # (8, KRD)
+    ch = jnp.full_like(rl, -1)
+    for j in range(w):
+        col = cols_ref[j].astype(jnp.int32)       # (8, KRD)
+        thr = tab_ref[0, j]
+        nanb = tab_ref[1, j]
+        dlft = tab_ref[2, j]
+        small = tab_ref[3, j]
+        selj = tab_ref[4, j]
+        newid = tab_ref[5, j]
+        act = tab_ref[6, j]
+        # integer-valued go_left: Mosaic cannot broadcast a scalar bool
+        # through a packed vector (i8->i1 trunci), so the select stays in
+        # int32 land and the flags compare as integers
+        go_left = jnp.where(col == nanb, dlft,
+                            (col <= thr).astype(jnp.int32))
+        upd = (rl == selj) & (act > 0)
+        ch = jnp.where(upd & (go_left == small), j, ch)
+        rl = jnp.where(upd & (go_left == 0), newid, rl)
+    rl_out[...] = rl
+    ch_out[...] = ch.astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("row_block", "interpret"))
+def wave_row_update_pallas(cols_w: jnp.ndarray, rl: jnp.ndarray,
+                           tab: jnp.ndarray, *,
+                           row_block: int = DEFAULT_ROW_BLOCK,
+                           interpret: bool = False):
+    """Apply a wave's W numeric splits to every row in one fused pass.
+
+    Args:
+      cols_w: (W, N) uint8 — the wave's winning feature columns
+        (``jnp.take(X_T, feat, axis=0)``), N a multiple of ``row_block``.
+      rl: (N,) integer row->leaf vector (any integer dtype).
+      tab: (8, W) int32 per-split table: rows are [threshold_bin,
+        nan_bin (-1 = none), default_left, left_is_smaller, split_leaf,
+        new_right_id, active, unused].
+    Returns:
+      (rl_new int32 (N,), ch int8 (N,)) — post-wave leaf ids and the
+      smaller-child channel (-1 = row not in any split's smaller child).
+    """
+    w, n = cols_w.shape
+    if n % row_block != 0:
+        raise ValueError(f"wave_row_update needs N % {row_block} == 0, "
+                         f"got N={n}")
+    kr = math.gcd(row_block, 4096)
+    krd = kr // 8
+    nd = n // 8
+    cols3 = cols_w.reshape(w, 8, nd)
+    rl2 = rl.astype(jnp.int32).reshape(8, nd)
+
+    grid = (n // kr,)
+    rl_new, ch = pl.pallas_call(
+        functools.partial(_row_update_kernel, w=w),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((w, 8, krd), lambda i: (0, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, krd), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((8, krd), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, krd), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((8, nd), jnp.int32),
+            jax.ShapeDtypeStruct((8, nd), jnp.int8),
+        ],
+        interpret=interpret,
+    )(cols3, rl2, tab)
+    return rl_new.reshape(n), ch.reshape(n)

@@ -42,12 +42,13 @@ def quantize_wch(grad: jnp.ndarray, hess: jnp.ndarray, bag_mask: jnp.ndarray,
                  g_scale: jnp.ndarray, h_scale: jnp.ndarray,
                  key: jnp.ndarray, *, gq_max: int, hq_max: int,
                  stochastic: bool = True) -> jnp.ndarray:
-    """(N, 8) int8 weight rows [g_q, h_q, count, 0, 0, 0, 0, 0].
+    """(8, N) int8 FEATURE-MAJOR weight rows [g_q, h_q, count, 0, ...].
 
     ``g_scale``/``h_scale`` are the per-tree dequantization scales
     (g ~= g_q * g_scale); callers compute them from (cross-shard) maxima
-    so data-parallel shards quantize identically.  Lane 3 (the leaf
-    channel) is left 0 — the wave grower overwrites it per wave.
+    so data-parallel shards quantize identically.  Row 3 (the leaf
+    channel) is left 0 — the wave grower overwrites it per wave with a
+    contiguous row write (the reason for the feature-major layout).
     Stochastic rounding ``floor(x + u)`` is unbiased for either sign;
     with ``stochastic=False`` it degrades to round-half-up.
     """
@@ -63,7 +64,7 @@ def quantize_wch(grad: jnp.ndarray, hess: jnp.ndarray, bag_mask: jnp.ndarray,
     h_q = jnp.clip(jnp.floor(hm + uh), 0, hq_max).astype(jnp.int8)
     cnt = (bag_mask > 0).astype(jnp.int8)
     z = jnp.zeros_like(cnt)
-    return jnp.stack([g_q, h_q, cnt, z, z, z, z, z], axis=-1)
+    return jnp.stack([g_q, h_q, cnt, z, z, z, z, z], axis=0)
 
 
 def dequant_scales(g_scale, h_scale):

@@ -100,11 +100,14 @@ def leaf_output_smoothed(g, h, cnt, parent_out, params: SplitParams):
     shrinks toward the parent leaf's output by smooth/(n + smooth)."""
     t = _threshold_l1(g, params.lambda_l1)
     out = jnp.where(h + params.lambda_l2 > 0, -t / (h + params.lambda_l2), 0.0)
+    # the reference clips the RAW output to +-max_delta_step first and
+    # blends with the parent after (CalculateSplittedLeafOutput applies the
+    # clip before the USE_SMOOTHING mix) — order matters when both are set
+    if params.max_delta_step > 0.0:
+        out = jnp.clip(out, -params.max_delta_step, params.max_delta_step)
     if params.path_smooth > 0.0:
         f = cnt / (cnt + params.path_smooth)
         out = out * f + parent_out * (1.0 - f)
-    if params.max_delta_step > 0.0:
-        out = jnp.clip(out, -params.max_delta_step, params.max_delta_step)
     return out
 
 
@@ -209,11 +212,13 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
         t = _threshold_l1(s[..., 0], l1)
         h_ = s[..., 1] + l2_eff
         out = jnp.where(h_ > 0, -t / h_, 0.0)
+        # clip the raw output BEFORE the smoothing blend (the reference's
+        # CalculateSplittedLeafOutput order); monotone clamping stays last
+        if params.max_delta_step > 0.0:
+            out = jnp.clip(out, -params.max_delta_step, params.max_delta_step)
         if use_sm:
             fac = s[..., 2] / (s[..., 2] + params.path_smooth)
             out = out * fac + parent_out * (1.0 - fac)
-        if params.max_delta_step > 0.0:
-            out = jnp.clip(out, -params.max_delta_step, params.max_delta_step)
         return jnp.clip(out, mn, mx) if use_mc else out
 
     def dir_gain(left):

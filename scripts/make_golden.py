@@ -1,0 +1,112 @@
+"""Generate reference-binary golden fixtures (tests/golden/).
+
+Runs the REAL LightGBM CLI (built from /root/reference, CPU-only — see
+tests/test_reference_parity.py for the build recipe) on this repo's
+committed example data and on a deterministic synthetic cat+linear
+dataset, and records:
+
+  golden_binary_model.txt    reference-trained model (weighted binary)
+  golden_binary_preds.txt    its predictions on examples binary.test
+  golden_catlin_data.csv     synthetic dataset (40-category feature ->
+                             multi-category bitset splits; linear trees)
+  golden_catlin_model.txt    reference-trained model on it
+  golden_catlin_preds.txt    its predictions on the same rows
+  golden.json                configs + reference-side metrics
+
+Re-run with LGBM_BIN pointing at the reference CLI binary to regenerate.
+The fixtures are committed so the parity tests run without the binary.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLD = os.path.join(REPO, "tests", "golden")
+BIN = os.environ.get("LGBM_BIN", "/tmp/lgbm_build/lightgbm")
+EX = os.path.join(REPO, "examples", "binary_classification")
+
+BINARY_PARAMS = {
+    "objective": "binary", "num_leaves": 31, "num_trees": 20,
+    "learning_rate": 0.1, "min_data_in_leaf": 20, "max_bin": 255,
+    "num_threads": 1, "force_row_wise": "true", "verbosity": -1,
+}
+CATLIN_PARAMS = {
+    "objective": "regression", "num_leaves": 15, "num_trees": 10,
+    "learning_rate": 0.15, "min_data_in_leaf": 20, "max_bin": 63,
+    "categorical_feature": "3,4", "linear_tree": "true",
+    "num_threads": 1, "force_row_wise": "true", "verbosity": -1,
+}
+
+
+def run(task_params):
+    args = [BIN] + [f"{k}={v}" for k, v in task_params.items()]
+    r = subprocess.run(args, capture_output=True, text=True, timeout=300)
+    if r.returncode != 0:
+        raise RuntimeError(f"{args}\n{r.stdout}\n{r.stderr}")
+    return r.stdout
+
+
+def logloss(y, p):
+    p = np.clip(p, 1e-12, 1 - 1e-12)
+    return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+
+
+def auc(y, p):
+    order = np.argsort(p)
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(1, len(p) + 1)
+    npos = y.sum()
+    nneg = len(y) - npos
+    return float((ranks[y > 0].sum() - npos * (npos + 1) / 2) /
+                 (npos * nneg))
+
+
+def main():
+    os.makedirs(GOLD, exist_ok=True)
+    meta = {"binary_params": BINARY_PARAMS, "catlin_params": CATLIN_PARAMS}
+
+    # --- fixture A: weighted binary on the committed example data ---
+    model_a = os.path.join(GOLD, "golden_binary_model.txt")
+    run(dict(BINARY_PARAMS, task="train",
+             data=os.path.join(EX, "binary.train"), output_model=model_a))
+    preds_a = os.path.join(GOLD, "golden_binary_preds.txt")
+    run({"task": "predict", "data": os.path.join(EX, "binary.test"),
+         "input_model": model_a, "output_result": preds_a,
+         "verbosity": -1, "num_threads": 1})
+    test = np.loadtxt(os.path.join(EX, "binary.test"))
+    p = np.loadtxt(preds_a)
+    meta["binary_test_logloss"] = logloss(test[:, 0], p)
+    meta["binary_test_auc"] = auc(test[:, 0], p)
+
+    # --- fixture B: multi-category bitsets + linear trees ---
+    rng = np.random.RandomState(123)
+    n = 2000
+    cat_a = rng.randint(0, 40, n)            # 40 categories -> bitsets
+    cat_b = rng.randint(0, 6, n)
+    num = rng.randn(n, 3)
+    y = (num[:, 0] * 2.0 + np.where(cat_a % 7 < 3, 1.5, -0.5) +
+         0.3 * cat_b + 0.2 * num[:, 1] * num[:, 2] +
+         0.1 * rng.randn(n))
+    data = np.column_stack([y, num, cat_a, cat_b])
+    csv = os.path.join(GOLD, "golden_catlin_data.csv")
+    np.savetxt(csv, data, delimiter=",", fmt="%.8g")
+    model_b = os.path.join(GOLD, "golden_catlin_model.txt")
+    run(dict(CATLIN_PARAMS, task="train", data=csv, output_model=model_b,
+             header="false", label_column=0))
+    preds_b = os.path.join(GOLD, "golden_catlin_preds.txt")
+    run({"task": "predict", "data": csv, "input_model": model_b,
+         "output_result": preds_b, "verbosity": -1, "num_threads": 1,
+         "header": "false", "label_column": 0})
+    pb = np.loadtxt(preds_b)
+    meta["catlin_train_rmse"] = float(np.sqrt(np.mean((pb - y) ** 2)))
+
+    with open(os.path.join(GOLD, "golden.json"), "w") as fh:
+        json.dump(meta, fh, indent=1)
+    print(json.dumps(meta, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,208 @@
+"""Round-2 q8 kernel probes: i16 compare, transposed one-hot, wch layouts."""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lightgbm_tpu.ops.histogram_pallas import build_histogram_pallas_leaves_q8
+
+QC = 3
+
+
+def _round_up(x, m):
+    return -(-x // m) * m
+
+
+def make_kernel(mode, b, group, ft):
+    nk = ft // group
+
+    def kern(bins_ref, wch_ref, out_ref):
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        wch = wch_ref[...]
+        r = wch.shape[0]
+        ch = wch[:, 3:4].astype(jnp.int32)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (r, 128), 1)
+        sel = (ch == lane // QC).astype(jnp.int32)
+        w3 = wch[:, :QC].astype(jnp.int32)
+        wtile = jnp.concatenate([w3] * (128 // QC + 1), axis=1)[:, :128]
+        w128 = (wtile * sel).astype(jnp.int8)
+
+        if mode == "i16":
+            iota_gb = (jax.lax.broadcasted_iota(
+                jnp.int32, (group * b, r), 0) % b).astype(jnp.int16)
+            for k in range(nk):
+                cols = bins_ref[k * group:(k + 1) * group, :].astype(
+                    jnp.int16)
+                colrep = jnp.repeat(cols, b, axis=0)
+                onehot = (colrep == iota_gb).astype(jnp.int8)
+                part = jax.lax.dot_general(
+                    onehot, w128, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out_ref[k * group * b:(k + 1) * group * b] += part
+        elif mode == "tr":
+            # transposed: onehotT (R, B) via lane-iota compare, dot
+            # contracting lhs dim 0 (per feature)
+            iota_l = jax.lax.broadcasted_iota(jnp.int32, (r, b), 1)
+            for k in range(ft):
+                col = bins_ref[k:k + 1, :].astype(jnp.int32)   # (1, R)
+                oht = (col.T == iota_l).astype(jnp.int8)       # (R, B)
+                part = jax.lax.dot_general(
+                    oht, w128, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)          # (B, 128)
+                out_ref[k * b:(k + 1) * b] += part
+        return
+
+    return kern
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "kr", "mode",
+                                             "group"))
+def q8v(bins_t, wch, *, num_bins, kr=2048, mode="i16", group=8):
+    f, n = bins_t.shape
+    b = _round_up(num_bins, 64)
+    ft = _round_up(f, max(group, 8))
+    if ft != f:
+        bins_t = jnp.pad(bins_t, ((0, ft - f), (0, 0)))
+    grid = (1, n // kr)
+    return pl.pallas_call(
+        make_kernel(mode, b, group, ft),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ft, kr), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kr, 8), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((ft * b, 128), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * ft * b * n * 128,
+            bytes_accessed=ft * n + n * 8 + ft * b * 512,
+            transcendentals=0),
+    )(bins_t, wch)
+
+
+# D: feature-major wch (8, N) with rhs-contracting-dim-1 dot
+def make_kernel_fm(b, group, ft):
+    nk = ft // group
+
+    def kern(bins_ref, wch_ref, out_ref):
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        wch = wch_ref[...]                    # (8, R) i8
+        r = wch.shape[1]
+        ch = wch[3:4, :].astype(jnp.int32)    # (1, R)
+        subl = jax.lax.broadcasted_iota(jnp.int32, (128, r), 0)
+        sel = (ch == subl // QC).astype(jnp.int32)
+        w3 = wch[:QC, :].astype(jnp.int32)    # (3, R)
+        wtile = jnp.concatenate([w3] * (128 // QC + 1), axis=0)[:128]
+        w128t = (wtile * sel).astype(jnp.int8)  # (128, R)
+        iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, r), 0) % b
+
+        for k in range(nk):
+            cols = bins_ref[k * group:(k + 1) * group, :].astype(jnp.int32)
+            colrep = jnp.repeat(cols, b, axis=0)
+            onehot = (colrep == iota_gb).astype(jnp.int8)
+            part = jax.lax.dot_general(
+                onehot, w128t, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)     # (g*B, 128)
+            out_ref[k * group * b:(k + 1) * group * b] += part
+        return
+
+    return kern
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "kr", "group"))
+def q8fm(bins_t, wch_fm, *, num_bins, kr=2048, group=8):
+    f, n = bins_t.shape
+    b = _round_up(num_bins, 64)
+    ft = _round_up(f, max(group, 8))
+    if ft != f:
+        bins_t = jnp.pad(bins_t, ((0, ft - f), (0, 0)))
+    grid = (1, n // kr)
+    return pl.pallas_call(
+        make_kernel_fm(b, group, ft),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ft, kr), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, kr), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((ft * b, 128), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * ft * b * n * 128,
+            bytes_accessed=ft * n + n * 8 + ft * b * 512,
+            transcendentals=0),
+    )(bins_t, wch_fm)
+
+
+def timed(name, fn, *args, reps=10, **kw):
+    try:
+        out = fn(*args, **kw)
+        _ = float(jnp.ravel(out)[0])
+    except Exception as e:
+        print(f"{name:28s} FAIL {str(e)[:90]}", flush=True)
+        return None
+    t0 = time.perf_counter()
+    for _i in range(reps):
+        out = fn(*args, **kw)
+    _ = float(jnp.ravel(out)[0])
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:28s} {dt*1e3:9.2f} ms", flush=True)
+    return out
+
+
+def main():
+    n, f, b = 10_502_144, 28, 255
+    rng = np.random.RandomState(0)
+    bins = rng.randint(0, b, (f, n)).astype(np.uint8)
+    gq = rng.randint(-127, 128, n).astype(np.int8)
+    hq = rng.randint(0, 128, n).astype(np.int8)
+    ch = rng.randint(-1, 42, n).astype(np.int8)
+    wch_np = np.stack([gq, hq, np.ones(n, np.int8), ch] +
+                      [np.zeros(n, np.int8)] * 4, axis=-1)
+    wch_np[ch < 0, :3] = 0
+    bins_d = jnp.asarray(bins)
+    wch = jnp.asarray(wch_np)
+    wch_fm = jnp.asarray(wch_np.T.copy())
+
+    ref = timed("A prod q8", build_histogram_pallas_leaves_q8, bins_d, wch,
+                num_bins=b)
+    ofm = timed("D g8 kr2048", q8fm, bins_d, wch_fm, num_bins=b)
+    for g, kr in ((8, 1024), (8, 4096), (4, 2048), (4, 4096), (16, 1024),
+                  (16, 2048), (2, 2048)):
+        timed(f"D g{g} kr{kr}", q8fm, bins_d, wch_fm, num_bins=b, group=g,
+              kr=kr)
+    o16 = otr = None
+
+    # correctness cross-checks on the raw (ft*b, 128) outputs
+    if ref is not None:
+        refq = np.asarray(ref)
+        for name, o in (("B", o16), ("C", otr), ("D", ofm)):
+            if o is None:
+                continue
+            oq = np.asarray(o)[:28 * 256].reshape(28, 256, 128)[
+                :, :255, :126].reshape(28, 255, 42, 3).transpose(2, 0, 1, 3)
+            d = np.abs(oq - refq).max()
+            print(f"{name} max diff vs prod: {d}")
+
+
+if __name__ == "__main__":
+    main()

@@ -46,8 +46,8 @@ def main():
     ch = jnp.asarray(rng.randint(-1, Q_LEAF_CHANNELS, N).astype(np.int32))
     gq = rng.randint(-127, 128, N).astype(np.int8)
     hq = rng.randint(0, 128, N).astype(np.int8)
-    wch_np = np.zeros((N, 8), np.int8)
-    wch_np[:, 0], wch_np[:, 1], wch_np[:, 2] = gq, hq, 1
+    wch_np = np.zeros((8, N), np.int8)
+    wch_np[0], wch_np[1], wch_np[2] = gq, hq, 1
     wch = jnp.asarray(wch_np)
 
     # 1. q8 kernel
@@ -60,15 +60,9 @@ def main():
     timed("bf16 kernel (25 leaves)",
           lambda: build_histogram_pallas_leaves(bins, w8, ch25, num_bins=255))
 
-    # 3. wch channel set
-    timed("wch .at[:,3].set(ch)",
-          jax.jit(lambda w, c: w.at[:, 3].set(c.astype(jnp.int8))), wch, ch)
-
-    # 3b. wch rebuild from stacked lanes
-    timed("wch rebuild stack",
-          jax.jit(lambda c: jnp.stack(
-              [wch[:, 0], wch[:, 1], wch[:, 2], c.astype(jnp.int8)] +
-              [jnp.zeros((N,), jnp.int8)] * 4, axis=-1)), ch)
+    # 3. wch channel set (feature-major: contiguous row write)
+    timed("wch .at[3].set(ch)",
+          jax.jit(lambda w, c: w.at[3].set(c.astype(jnp.int8))), wch, ch)
 
     # 4. row_leaf update loop (W=42 streaming masked updates)
     W = Q_LEAF_CHANNELS
@@ -77,20 +71,24 @@ def main():
     sel_leaves = jnp.asarray(rng.randint(0, 50, W).astype(np.int32))
     new_ids = jnp.asarray((np.arange(W) + 51).astype(np.int32))
 
+    thr8 = thr.astype(jnp.uint8)
+    sel8 = sel_leaves.astype(jnp.uint8)
+    new8 = new_ids.astype(jnp.uint8)
+    jidx = jnp.arange(W, dtype=jnp.int8)
+
     @jax.jit
     def row_update(rl, bins):
-        chv = jnp.full((N,), -1, jnp.int32)
+        chv = jnp.full((N,), -1, jnp.int8)
         for j in range(W):
             col = jax.lax.dynamic_slice(bins, (feat[j], 0), (1, N))[0]
-            col = col.astype(jnp.int32)
-            go_left = col <= thr[j]
-            upd = rl == sel_leaves[j]
-            chv = jnp.where(upd & go_left, j, chv)
-            rl = jnp.where(upd & jnp.logical_not(go_left), new_ids[j], rl)
-        return rl + chv
+            go_left = col <= thr8[j]
+            upd = rl == sel8[j]
+            chv = jnp.where(upd & go_left, jidx[j], chv)
+            rl = jnp.where(upd & jnp.logical_not(go_left), new8[j], rl)
+        return rl.astype(jnp.int32) + chv
 
-    rl0 = jnp.asarray(rng.randint(0, 50, N).astype(np.int32))
-    timed("row_leaf update loop (W=42)", row_update, rl0, bins)
+    rl0 = jnp.asarray(rng.randint(0, 50, N).astype(np.uint8))
+    timed("row_leaf u8 loop (W=42)", row_update, rl0, bins)
 
     # 5. quantize_wch per tree
     from lightgbm_tpu.ops.quantize import quantize_wch

@@ -48,8 +48,8 @@ def test_q8_kernel_interpret_exact():
     hq = rng.randint(0, 128, n).astype(np.int8)
     ch = rng.randint(-1, Q_LEAF_CHANNELS, n).astype(np.int8)
     cnt = (ch >= 0).astype(np.int8)
-    wch = np.zeros((n, 8), np.int8)
-    wch[:, 0], wch[:, 1], wch[:, 2], wch[:, 3] = gq, hq, cnt, ch
+    wch = np.zeros((8, n), np.int8)
+    wch[0], wch[1], wch[2], wch[3] = gq, hq, cnt, ch
 
     hist = np.asarray(build_histogram_pallas_leaves_q8(
         jnp.asarray(bins), jnp.asarray(wch), num_bins=b, interpret=True))
@@ -69,6 +69,41 @@ def test_q8_kernel_interpret_exact():
             np.testing.assert_array_equal(hist[q, j, :, 2], ref_c[:b])
 
 
+def test_wave_row_update_kernel_matches_reference():
+    """Pallas row-update kernel (interpret) == the masked-where loop."""
+    from lightgbm_tpu.ops.histogram_pallas import (pad_rows,
+                                                   wave_row_update_pallas)
+    rng = np.random.RandomState(5)
+    w = 11
+    n = pad_rows(9000)
+    cols = rng.randint(0, 250, (w, n)).astype(np.uint8)
+    rl = rng.randint(0, 60, n).astype(np.int32)
+    thr = rng.randint(0, 250, w)
+    nanb = np.where(rng.rand(w) < 0.5, -1, 249)
+    dleft = rng.randint(0, 2, w)
+    small = rng.randint(0, 2, w)
+    selL = rng.choice(60, w, replace=False)
+    newid = 60 + np.arange(w)
+    act = rng.randint(0, 2, w)
+    tab = np.stack([thr, nanb, dleft, small, selL, newid, act,
+                    np.zeros(w)]).astype(np.int32)
+
+    rl_ref = rl.copy()
+    ch_ref = np.full(n, -1, np.int8)
+    for j in range(w):
+        go_left = np.where(cols[j] == nanb[j], dleft[j] > 0,
+                           cols[j] <= thr[j])
+        upd = (rl_ref == selL[j]) & (act[j] > 0)
+        ch_ref[upd & (go_left == (small[j] > 0))] = j
+        rl_ref[upd & ~go_left] = newid[j]
+
+    rl_new, ch = wave_row_update_pallas(
+        jnp.asarray(cols), jnp.asarray(rl), jnp.asarray(tab),
+        interpret=True)
+    np.testing.assert_array_equal(np.asarray(rl_new), rl_ref)
+    np.testing.assert_array_equal(np.asarray(ch), ch_ref)
+
+
 def test_quantize_wch_levels_and_unbiasedness():
     from lightgbm_tpu.ops.quantize import quant_levels, quantize_wch
     assert quant_levels(4) == (2, 4)
@@ -85,21 +120,21 @@ def test_quantize_wch_levels_and_unbiasedness():
     wch = np.asarray(quantize_wch(
         jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(bag), gs, hs,
         jax.random.PRNGKey(0), gq_max=127, hq_max=127, stochastic=True))
-    assert wch.dtype == np.int8
+    assert wch.dtype == np.int8 and wch.shape == (8, n)
     # stochastic rounding is unbiased: the dequantized mean tracks the
     # true mean well within the quantization noise floor
-    est = wch[:, 0].astype(np.float64).mean() * float(gs)
+    est = wch[0].astype(np.float64).mean() * float(gs)
     assert abs(est - grad.mean()) < 4 * float(gs) / np.sqrt(n) + 1e-6
     # hessian levels in range, counts exact
-    assert wch[:, 1].min() >= 0 and wch[:, 1].max() <= 127
-    assert (wch[:, 2] == 1).all()
+    assert wch[1].min() >= 0 and wch[1].max() <= 127
+    assert (wch[2] == 1).all()
     # masked rows contribute nothing
     bag2 = bag.copy()
     bag2[:1000] = 0.0
     wch2 = np.asarray(quantize_wch(
         jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(bag2), gs, hs,
         jax.random.PRNGKey(0), gq_max=127, hq_max=127, stochastic=True))
-    assert (wch2[:1000, :3] == 0).all()
+    assert (wch2[:3, :1000] == 0).all()
 
 
 def test_quantized_quality_close_to_exact():
