@@ -5,7 +5,8 @@
 from __future__ import annotations
 
 import collections
-from typing import Any, Callable, Dict, List
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
 
 from .utils.log import log_info, log_warning
 
@@ -82,64 +83,100 @@ def reset_parameter(**kwargs: Any) -> Callable:
     return _callback
 
 
+def _metric_tag(name: str) -> str:
+    """Trailing token of a (possibly composite) metric name."""
+    return name.rsplit(" ", 1)[-1]
+
+
+@dataclass
+class _MetricTracker:
+    """Best-so-far state of one (dataset, metric) evaluation stream."""
+    higher_better: bool
+    best_score: float = None
+    best_iter: int = 0
+    snapshot: Any = None  # full eval list at the best iteration
+
+    def observe(self, score: float, iteration: int, results) -> None:
+        if self.snapshot is None or (
+                score > self.best_score if self.higher_better
+                else score < self.best_score):
+            self.best_score = score
+            self.best_iter = iteration
+            self.snapshot = results
+
+
+class _EarlyStopper:
+    """Stateful early-stopping callback: stop when no validation metric
+    improved for ``rounds`` consecutive iterations (the contract of the
+    reference's early_stopping callback, callback.py:147).
+
+    One :class:`_MetricTracker` per evaluation stream; training-set
+    streams update their tracker (so best_score reports them) but never
+    drive the stop decision, and ``first_metric_only`` restricts the
+    decision to streams whose metric name matches the first stream's.
+    """
+
+    order = 30
+
+    def __init__(self, rounds: int, first_metric_only: bool,
+                 verbose: bool) -> None:
+        self.rounds = int(rounds)
+        self.first_metric_only = first_metric_only
+        self.verbose = verbose
+        self.trackers: Optional[List[_MetricTracker]] = None
+        self.active = True
+        self.first_metric_name = ""
+
+    def _start(self, env: CallbackEnv) -> None:
+        results = env.evaluation_result_list
+        self.active = bool(results)
+        if not self.active:
+            if env.params.get("boosting") == "dart":
+                log_warning("Early stopping is not available in dart mode")
+            else:
+                log_warning("For early stopping, at least one dataset and "
+                            "eval metric is required for evaluation")
+            return
+        if self.verbose:
+            log_info(f"Training until validation scores don't improve for "
+                     f"{self.rounds} rounds")
+        # custom fevals may produce composite "prefix metric" names;
+        # streams are matched on the trailing token like the reference
+        self.first_metric_name = _metric_tag(results[0][1])
+        self.trackers = [_MetricTracker(higher_better=hb)
+                         for (_, _, _, hb) in results]
+
+    def _stop(self, tracker: _MetricTracker, reached_end: bool) -> None:
+        if self.verbose:
+            head = ("Did not meet early stopping. Best iteration is:"
+                    if reached_end else "Early stopping, best iteration is:")
+            body = "\t".join(_fmt_eval(x) for x in tracker.snapshot)
+            log_info(f"{head}\n[{tracker.best_iter + 1}]\t{body}")
+        raise EarlyStopException(tracker.best_iter, tracker.snapshot)
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.trackers is None and self.active:
+            self._start(env)
+        if not self.active:
+            return
+        results = env.evaluation_result_list
+        last_iter = env.iteration == env.end_iteration - 1
+        for tracker, (data_name, metric_name, score, _) in zip(
+                self.trackers, results):
+            tracker.observe(score, env.iteration, results)
+            if data_name == "training":
+                continue  # training metrics never trigger stopping
+            if self.first_metric_only and \
+                    _metric_tag(metric_name) != self.first_metric_name:
+                continue
+            if env.iteration - tracker.best_iter >= self.rounds or \
+                    last_iter:
+                self._stop(tracker, reached_end=last_iter and
+                           env.iteration - tracker.best_iter < self.rounds)
+
+
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True) -> Callable:
-    """Early stopping on validation metrics (reference callback.py:147)."""
-    best_score: List[float] = []
-    best_iter: List[int] = []
-    best_score_list: List[Any] = []
-    cmp_op: List[Callable] = []
-    enabled = [True]
-    first_metric = [""]
-
-    def _init(env: CallbackEnv) -> None:
-        enabled[0] = bool(env.evaluation_result_list)
-        if not enabled[0]:
-            log_warning("Early stopping is not available in dart mode"
-                        if env.params.get("boosting") == "dart"
-                        else "For early stopping, at least one dataset and "
-                             "eval metric is required for evaluation")
-            return
-        if verbose:
-            log_info(f"Training until validation scores don't improve for "
-                     f"{stopping_rounds} rounds")
-        first_metric[0] = env.evaluation_result_list[0][1].split(" ")[-1]
-        for res in env.evaluation_result_list:
-            best_iter.append(0)
-            best_score_list.append(None)
-            if res[3]:  # higher is better
-                best_score.append(float("-inf"))
-                cmp_op.append(lambda x, y: x > y)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lambda x, y: x < y)
-
-    def _callback(env: CallbackEnv) -> None:
-        if not best_score:
-            _init(env)
-        if not enabled[0]:
-            return
-        for i, res in enumerate(env.evaluation_result_list):
-            data_name, eval_name, score, _ = res
-            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
-                best_score[i] = score
-                best_iter[i] = env.iteration
-                best_score_list[i] = env.evaluation_result_list
-            if data_name == "training":
-                continue  # training metric never triggers stopping
-            if first_metric_only and eval_name.split(" ")[-1] != first_metric[0]:
-                continue
-            if env.iteration - best_iter[i] >= stopping_rounds:
-                if verbose:
-                    log_info(f"Early stopping, best iteration is:\n"
-                             f"[{best_iter[i] + 1}]\t" + "\t".join(
-                                 _fmt_eval(x) for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            if env.iteration == env.end_iteration - 1:
-                if verbose:
-                    log_info(f"Did not meet early stopping. Best iteration is:"
-                             f"\n[{best_iter[i] + 1}]\t" + "\t".join(
-                                 _fmt_eval(x) for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-    _callback.order = 30
-    return _callback
+    """Early stopping on validation metrics (reference callback.py:147's
+    surface; implementation is the tracker-based :class:`_EarlyStopper`)."""
+    return _EarlyStopper(stopping_rounds, first_metric_only, verbose)

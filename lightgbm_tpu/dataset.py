@@ -132,10 +132,44 @@ class Dataset:
 
         cat_indices = self._resolve_categoricals(feature_names)
 
+        # pre-partitioned multi-process ingest (reference pre_partition +
+        # distributed bin finding, dataset_loader.cpp:1040-1130): each
+        # process holds only ITS row range; bin-finding samples are
+        # allgathered so every rank derives identical mappers, and
+        # metadata is replicated (small next to the sharded features)
+        from . import distributed as _dist
+        dist_rows = (bool(cfg.pre_partition) and _dist.is_initialized()
+                     and _dist.process_count() > 1
+                     and self.reference is None)
+        self.distributed_rows = dist_rows
+        if dist_rows:
+            if sparse:
+                raise NotImplementedError(
+                    "pre_partition with sparse input is not supported yet")
+            if self._group_arg is not None:
+                raise ValueError(
+                    "pre_partition cannot shard query/group data (queries "
+                    "must not straddle partitions); drop pre_partition or "
+                    "the group argument")
+            if cfg.linear_tree:
+                raise NotImplementedError(
+                    "linear_tree with pre_partition is not supported yet")
+
         rng = np.random.RandomState(cfg.data_random_seed)
-        sample_cnt = min(n, int(cfg.bin_construct_sample_cnt))
+        if dist_rows:
+            sample_cnt = min(n, max(1, int(cfg.bin_construct_sample_cnt) //
+                                    _dist.process_count()))
+        else:
+            sample_cnt = min(n, int(cfg.bin_construct_sample_cnt))
         sample_idx = (np.sort(rng.choice(n, size=sample_cnt, replace=False))
                       if sample_cnt < n else np.arange(n))
+        sample_rows_global = None
+        n_total = n
+        if dist_rows:
+            sample_rows_global = _dist.allgather_host(
+                np.asarray(raw[sample_idx], np.float64))
+            n_total = int(_dist.allgather_host(
+                np.asarray([n], np.int32)).sum())
 
         if self.reference is not None:
             ref = self.reference
@@ -173,6 +207,8 @@ class Dataset:
                         if zfrac < 1.0 else sample_cnt
                     nz = min(nz, sample_cnt)
                     col_sample = np.concatenate([vals, np.zeros(nz)])
+                elif sample_rows_global is not None:
+                    col_sample = sample_rows_global[:, j]
                 else:
                     col_sample = raw[sample_idx, j]
                 # the reference's pre-filter threshold scales
@@ -181,7 +217,8 @@ class Dataset:
                 # 0 disables the pre-filter (feature_pre_filter=false
                 # keeps even never-splittable features, like the reference)
                 filt = max(1, int(cfg.min_data_in_leaf * len(col_sample) /
-                                  max(1, n))) if cfg.feature_pre_filter else 0
+                                  max(1, n_total))) \
+                    if cfg.feature_pre_filter else 0
                 self.bin_mappers.append(find_bin(
                     col_sample, max_bin=cfg.max_bin,
                     min_data_in_bin=cfg.min_data_in_bin,
@@ -240,11 +277,56 @@ class Dataset:
             self.raw_used = raw[:, used].astype(np.float32)
         else:
             self.raw_used = None
+        if self.distributed_rows:
+            n = self._finalize_distributed_rows(n)
         self._set_metadata(n)
         self.constructed = True
         if self.free_raw_data:
             self.data = None
         return self
+
+    def _finalize_distributed_rows(self, n_local: int) -> int:
+        """Pad the LOCAL binned shard to the mesh row quantum and
+        replicate the (small) metadata across processes; the feature
+        matrix itself never leaves this process (the point of
+        pre_partition — Experiments.rst:228's 176 GB -> per-machine
+        shards)."""
+        from . import distributed as _dist
+        import jax
+        rb = 4096 if jax.default_backend() == "tpu" else 1
+        quantum = max(1, jax.local_device_count()) * rb
+        lens = _dist.allgather_host(np.asarray([n_local], np.int64)).ravel()
+        pad_to = int(-(-int(lens.max()) // quantum) * quantum)
+        pad = pad_to - n_local
+        if pad:
+            self.X_binned = np.pad(self.X_binned, ((0, pad), (0, 0)))
+
+        def padded(a, fill=0.0):
+            a = np.asarray(a, np.float64).ravel()
+            if len(a) != n_local:
+                raise ValueError(f"metadata length {len(a)} != local rows "
+                                 f"{n_local} under pre_partition")
+            return np.concatenate([a, np.full(pad, fill, np.float64)])
+
+        lab = np.zeros(n_local) if self._label_arg is None \
+            else np.asarray(self._label_arg, np.float64).ravel()
+        w = np.ones(n_local) if self._weight_arg is None \
+            else np.asarray(self._weight_arg, np.float64).ravel()
+        self._label_arg = _dist.allgather_host(padded(lab))
+        # padded rows carry zero weight so objectives/metrics ignore them
+        self._weight_arg = _dist.allgather_host(padded(w))
+        if self._init_score_arg is not None:
+            self._init_score_arg = _dist.allgather_host(
+                padded(self._init_score_arg))
+        self._dist_valid_local = np.concatenate(
+            [np.ones(n_local, np.float32), np.zeros(pad, np.float32)])
+        self._dist_pad_to = pad_to
+        self._dist_global_rows = pad_to * _dist.process_count()
+        log_info(f"pre_partition: rank {_dist.process_index()} holds "
+                 f"{n_local} rows (padded {pad_to}); global "
+                 f"{self._dist_global_rows} across "
+                 f"{_dist.process_count()} processes")
+        return self._dist_global_rows
 
     def _maybe_bundle(self, cfg, raw, sparse, used, mappers, sample_idx, n):
         """Decide + build EFB bundles (dataset.cpp:239 FastFeatureBundling);
@@ -258,8 +340,13 @@ class Dataset:
         # stay singleton (their set-membership decisions read raw bins)
         nondefault = []
         cand = []
+        from .efb import MAX_BUNDLE_BINS
         for jj, m in enumerate(mappers):
             if m.is_categorical:
+                continue
+            if m.num_bin > MAX_BUNDLE_BINS:
+                # a >256-bin feature (max_bin > 256) cannot ride a uint8
+                # bundle column; it stays a standalone uint16 column
                 continue
             j = int(used[jj])
             if sparse:
@@ -399,6 +486,8 @@ class Dataset:
 
     def num_data(self) -> int:
         self._check_constructed()
+        if getattr(self, "distributed_rows", False):
+            return int(self._dist_global_rows)
         return int(self.X_binned.shape[0])
 
     def num_feature(self) -> int:

@@ -92,6 +92,36 @@ def is_initialized() -> bool:
     return _initialized
 
 
+def allgather_host(arr) -> "object":
+    """Concatenate per-process host arrays along axis 0 in rank order.
+
+    The host-side collective behind pre-partitioned ingest (the analog of
+    the reference's BinMapper allgather, dataset_loader.cpp:1040-1130):
+    bin-finding samples and metadata gathered once at Dataset.construct;
+    variable per-rank lengths are handled by a max-pad + trim."""
+    import numpy as np
+    import jax
+    from jax.experimental import multihost_utils
+    arr = np.asarray(arr)
+    if jax.process_count() == 1:
+        return arr
+    if arr.dtype == np.float64:
+        # x64 is disabled in JAX by default, so a float64 array would be
+        # silently rounded to float32 in transit; ship the raw bits as
+        # uint32 pairs instead (bin boundaries and labels must survive
+        # exactly for the serial/distributed parity contract)
+        return allgather_host(arr.view(np.uint32)).view(np.float64)
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.asarray([arr.shape[0]], np.int32))).ravel()
+    m = int(lens.max())
+    if m > arr.shape[0]:
+        pad = np.zeros((m - arr.shape[0],) + arr.shape[1:], arr.dtype)
+        arr = np.concatenate([arr, pad], axis=0)
+    gathered = np.asarray(multihost_utils.process_allgather(arr))
+    return np.concatenate(
+        [gathered[r, :int(lens[r])] for r in range(len(lens))], axis=0)
+
+
 def process_index() -> int:
     import jax
     return jax.process_index()

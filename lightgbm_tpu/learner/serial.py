@@ -532,7 +532,7 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
 
 
 def resolve_hist_impl(config: Config, parallel: bool = False,
-                      wave: bool = False) -> str:
+                      wave: bool = False, max_bins: int = 0) -> str:
     """Pick the histogram implementation (the analog of the reference's
     col-wise/row-wise autotune, dataset.cpp:659-670, collapsed to a static
     choice: the Pallas MXU kernel on TPU, scatter-add elsewhere).
@@ -549,6 +549,13 @@ def resolve_hist_impl(config: Config, parallel: bool = False,
         else:
             impl = "segment"
     elif impl == "pallas" and parallel and not wave:
+        impl = "onehot"
+    if impl == "pallas" and max_bins > 256:
+        from ..utils.log import log_warning
+        log_warning(f"max_bin={max_bins} exceeds the Pallas kernels' uint8 "
+                    "bin range (256); using the XLA onehot histogram path "
+                    "(uint16 bins) — set max_bin<=255 for peak TPU "
+                    "throughput")
         impl = "onehot"
     return impl
 
@@ -674,7 +681,7 @@ class SerialTreeLearner:
         if efb is not None and not self.use_hist_pool:
             raise ValueError("EFB requires the partitioned grower; raise "
                              "histogram_pool_size or disable enable_bundle")
-        impl = resolve_hist_impl(config)
+        impl = resolve_hist_impl(config, max_bins=self.max_bins)
         if not self.use_hist_pool and impl == "pallas":
             # the pool-less fallback grower takes no transposed X and no row
             # padding — downgrade to the XLA onehot formulation (same MXU
@@ -693,18 +700,13 @@ class SerialTreeLearner:
         interaction_groups = tuple(tuple(g) for g in interaction_groups)
         feature_contri = tuple(float(v) for v in feature_contri)
         wave_ok = (self.use_hist_pool and not forced_splits and
-                   not interaction_groups and
-                   self.split_params.feature_fraction_bynode >= 1.0 and
-                   not self.split_params.extra_trees and
                    int(config.num_leaves) > 2)
         mode = str(config.tree_grow_mode)
         if mode == "wave" and not wave_ok:
             from ..utils.log import log_warning
             log_warning("tree_grow_mode=wave is incompatible with forced "
-                        "splits / interaction constraints / bynode "
-                        "sampling / extra_trees / num_leaves<=2 / "
-                        "pool-less growth; falling back to the "
-                        "partitioned grower")
+                        "splits / num_leaves<=2 / pool-less growth; "
+                        "falling back to the partitioned grower")
             mode = "partition"
         elif mode == "auto":
             mode = "wave" if (wave_ok and impl == "pallas") else "partition"
@@ -730,7 +732,7 @@ class SerialTreeLearner:
             key = ("wave", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
                    impl, any_cat, wave_size, self._efb_dims, feature_contri,
-                   qtuple)
+                   qtuple, interaction_groups)
             if key not in _GROW_FN_CACHE:
                 from .wave import make_wave_grow_fn
                 _cache_put(key, make_wave_grow_fn(
@@ -742,7 +744,8 @@ class SerialTreeLearner:
                     efb_dims=self._efb_dims, feature_contri=feature_contri,
                     quantized=self.quantized, gq_max=gq_max, hq_max=hq_max,
                     renew_leaf=bool(config.quant_train_renew_leaf),
-                    stochastic=bool(config.stochastic_rounding)))
+                    stochastic=bool(config.stochastic_rounding),
+                    interaction_groups=interaction_groups))
             self._grow = _cache_hit(key)
         elif self.partitioned:
             key = ("part", int(config.num_leaves), num_features,
@@ -818,6 +821,7 @@ class SerialTreeLearner:
             hess = jnp.pad(hess, (0, pad))
             sample_mask = jnp.pad(sample_mask, (0, pad))
         if self.grow_mode == "wave":
+            kw = {}
             if self.quantized:
                 if quant_key is None:
                     # per-call stream so direct callers (no gbdt driver
@@ -825,15 +829,14 @@ class SerialTreeLearner:
                     # stochastic rounding across trees
                     self._quant_calls = getattr(self, "_quant_calls", 0) + 1
                     quant_key = jax.random.PRNGKey(self._quant_calls)
-                grown = self._grow(self._XpT, grad, hess, sample_mask,
-                                   self.num_bins, self.is_cat, self.has_nan,
-                                   self.monotone, cegb_penalty,
-                                   self._efb_args, feature_mask, quant_key)
-            else:
-                grown = self._grow(self._XpT, grad, hess, sample_mask,
-                                   self.num_bins, self.is_cat, self.has_nan,
-                                   self.monotone, cegb_penalty,
-                                   self._efb_args, feature_mask)
+                kw["quant_key"] = quant_key
+            if self.split_params.feature_fraction_bynode < 1.0 or \
+                    self.split_params.extra_trees:
+                kw["node_key"] = node_key
+            grown = self._grow(self._XpT, grad, hess, sample_mask,
+                               self.num_bins, self.is_cat, self.has_nan,
+                               self.monotone, cegb_penalty,
+                               self._efb_args, feature_mask, **kw)
         else:
             grown = self._grow(self._Xp, grad, hess, sample_mask,
                                self.num_bins, self.is_cat, self.has_nan,

@@ -30,10 +30,13 @@ reproduces the sequential order exactly (tests cross-check this); at
 wave_size=16 the tree can differ near budget exhaustion — quality parity
 is asserted by tests on held-out loss.
 
-Feature gates: forced splits, interaction constraints and by-node feature
-sampling are not traced here — SerialTreeLearner falls back to the
-partitioned grower when they are active.  EFB, monotone constraints, CEGB
-and categorical splits are fully supported.
+Feature gates: forced splits are not traced here — SerialTreeLearner
+falls back to the partitioned grower when they are active.  EFB, monotone
+constraints, CEGB, categorical splits, interaction constraints, by-node
+feature sampling, ExtraTrees random thresholds and quantized-gradient
+histograms are fully supported (the latter four batched per wave with the
+sequential node-id RNG streams, so wave_size=1 reproduces the partitioned
+grower's sampling exactly).
 """
 
 from __future__ import annotations
@@ -60,7 +63,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                       efb_dims=None, feature_contri: tuple = (),
                       strategy=None, quantized: bool = False,
                       gq_max: int = 127, hq_max: int = 127,
-                      renew_leaf: bool = False, stochastic: bool = True):
+                      renew_leaf: bool = False, stochastic: bool = True,
+                      interaction_groups: tuple = ()):
     """Build the wave single-tree grower.
 
     Returned signature matches the partitioned grower:
@@ -93,6 +97,33 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
     sp = split_params
     use_mc = split_params.use_monotone
     use_sm = split_params.path_smooth > 0.0
+    # per-node feature sampling / random thresholds / interaction
+    # constraints, traced per wave (the partitioned grower's node_mask /
+    # node_rand / allowed_features, learner/partitioned.py:96-128, batched
+    # over the wave's 2W children).  Node ids mirror the sequential
+    # numbering (2t, 2t+1 for node t's children; 2L for the root) so
+    # wave_size=1 reproduces the partitioned grower's streams exactly.
+    use_bynode = sp.feature_fraction_bynode < 1.0
+    use_et = sp.extra_trees
+    use_ic = len(interaction_groups) > 0
+    if use_bynode:
+        import math as _math
+        kcnt = max(1, int(_math.ceil(F * sp.feature_fraction_bynode)))
+    if use_ic:
+        import numpy as _np
+        _g = _np.zeros((len(interaction_groups), F), bool)
+        for gi, feats in enumerate(interaction_groups):
+            for ff in feats:
+                if 0 <= ff < F:
+                    _g[gi, ff] = True
+        ic_groups = jnp.asarray(_g)
+
+        def allowed_features(path):
+            """Union of constraint sets containing every feature already
+            used on the branch (col_sampler.hpp GetByNode)."""
+            compat = jnp.logical_not(
+                jnp.any(path[None, :] & jnp.logical_not(ic_groups), axis=1))
+            return jnp.any(ic_groups & compat[:, None], axis=0)
 
     def _child_out(g, h, cnt, parent_out):
         if use_sm:
@@ -104,7 +135,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
              is_cat: jnp.ndarray, has_nan: jnp.ndarray,
              monotone: jnp.ndarray, cegb_penalty: jnp.ndarray,
              efb_arrays: tuple, feature_mask: jnp.ndarray,
-             quant_key: jnp.ndarray = None) -> GrownTree:
+             quant_key: jnp.ndarray = None,
+             node_key: jnp.ndarray = None) -> GrownTree:
         n = X_T.shape[1]
         if strategy is not None:
             # shallow per-trace copy: traced array attributes must not
@@ -208,15 +240,55 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 return v                                     # uint8
             return bundle_decode(v.astype(jnp.int32), feat)
 
-        def many_candidates(hists, sums, bounds, depths, pouts):
-            """Best-split candidates for k leaves in one vmapped scan."""
-            def one(h, s, bd, d, po):
+        def many_candidates(hists, sums, bounds, depths, pouts, fms,
+                            rbs=None):
+            """Best-split candidates for k leaves in one vmapped scan.
+            ``fms`` is the per-child feature mask (k, F); ``rbs`` the
+            per-child ExtraTrees random threshold bins (k, F) or None."""
+            cegb = getattr(strat, "cegb_full", None)
+            contri = getattr(strat, "contri_full", None)
+            if rbs is None:
+                def one(h, s, bd, d, po, fm):
+                    return local_best_candidate(
+                        h, s, nb_full, ic_full, hn_full, fm, sp,
+                        monotone, bd if use_mc else None, d, cegb, contri,
+                        po)
+                return jax.vmap(one)(hists, sums, bounds, depths, pouts,
+                                     fms)
+
+            def one(h, s, bd, d, po, fm, rb):
                 return local_best_candidate(
-                    h, s, nb_full, ic_full, hn_full, feature_mask, sp,
-                    monotone, bd if use_mc else None, d,
-                    getattr(strat, "cegb_full", None),
-                    getattr(strat, "contri_full", None), po)
-            return jax.vmap(one)(hists, sums, bounds, depths, pouts)
+                    h, s, nb_full, ic_full, hn_full, fm, sp,
+                    monotone, bd if use_mc else None, d, cegb, contri,
+                    po, rb)
+            return jax.vmap(one)(hists, sums, bounds, depths, pouts, fms,
+                                 rbs)
+
+        # per-node RNG streams (bynode sampling / ExtraTrees thresholds),
+        # identical on every DP shard (replicated key, identical node ids)
+        if use_bynode or use_et:
+            nk = node_key if node_key is not None else \
+                jnp.zeros((2, 2), jnp.uint32)
+        if use_bynode:
+            def node_mask_many(ids):
+                def one(i):
+                    r = jax.random.uniform(jax.random.fold_in(nk[0], i),
+                                           (F,))
+                    kth = jax.lax.top_k(r, kcnt)[0][-1]
+                    return r >= kth
+                return jax.vmap(one)(ids)
+        if use_et:
+            et_hi = jnp.maximum(
+                jnp.where(ic_full, nb_full - 1, nb_full - 2), 0)
+
+            def node_rand_many(ids):
+                def one(i):
+                    u = jax.random.uniform(jax.random.fold_in(nk[1], i),
+                                           (F,))
+                    return jnp.minimum(
+                        (u * (et_hi + 1).astype(jnp.float32)
+                         ).astype(jnp.int32), et_hi)
+                return jax.vmap(one)(ids)
 
         # ---- root ----
         root_hist = hist_waves(jnp.zeros((n,), jnp.int8), k=1)[0]
@@ -232,10 +304,18 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
         root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
         root_out = _child_out(root_sum[0], root_sum[1], root_sum[2],
                               jnp.asarray(0.0, jnp.float32))
+        rid = jnp.asarray([2 * L], jnp.int32)
+        fm_root = feature_mask
+        if use_ic:
+            fm_root = fm_root & allowed_features(
+                jnp.zeros((F,), jnp.bool_))
+        if use_bynode:
+            fm_root = fm_root & node_mask_many(rid)[0]
+        rb_root = node_rand_many(rid)[0] if use_et else None
         cand = strat.leaf_candidates(expand_hist(root_hist_f, root_sum),
-                                     root_sum, feature_mask, sp,
+                                     root_sum, fm_root, sp,
                                      root_bound, jnp.asarray(0, jnp.int32),
-                                     root_out)
+                                     root_out, rb_root)
 
         rl_dtype = jnp.uint8 if L <= 256 else jnp.int32
         state = {
@@ -274,6 +354,10 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
         if use_mc:
             state["leaf_mn"] = jnp.full((L,), -BIG, jnp.float32)
             state["leaf_mx"] = jnp.full((L,), BIG, jnp.float32)
+        if use_ic:
+            # features used on the path to each leaf (interaction
+            # constraints restrict children to compatible groups)
+            state["leaf_path"] = jnp.zeros((L, F), jnp.bool_)
 
         jarange = jnp.arange(W, dtype=jnp.int32)
 
@@ -392,7 +476,19 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 dq(hists2) if quantized else hists2, totals2)
             depth2 = jnp.concatenate([child_depth, child_depth])
             lv2 = jnp.concatenate([out_l, out_r])
-            cands = many_candidates(ex2, sums2, bounds2, depth2, lv2)
+            fm2 = jnp.broadcast_to(feature_mask, (2 * W, F))
+            if use_ic:
+                child_path = s["leaf_path"][sel_leaves] | \
+                    (jnp.arange(F, dtype=jnp.int32)[None, :] ==
+                     feat[:, None])                          # (W, F)
+                path2 = jnp.concatenate([child_path, child_path])
+                fm2 = fm2 & jax.vmap(allowed_features)(path2)
+            ids2 = jnp.concatenate([2 * node_ids, 2 * node_ids + 1])
+            if use_bynode:
+                fm2 = fm2 & node_mask_many(ids2)
+            rb2 = node_rand_many(ids2) if use_et else None
+            cands = many_candidates(ex2, sums2, bounds2, depth2, lv2, fm2,
+                                    rb2)
             depth_ok = jnp.logical_or(max_depth <= 0, child_depth < max_depth)
             dok2 = jnp.concatenate([depth_ok, depth_ok])
             cg = jnp.where(dok2 & jnp.concatenate([sel, sel]), cands[0],
@@ -424,6 +520,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                                      jnp.concatenate([mn_l, mn_r]))
                 out["leaf_mx"] = sc2(s["leaf_mx"],
                                      jnp.concatenate([mx_l, mx_r]))
+            if use_ic:
+                out["leaf_path"] = sc2(s["leaf_path"], path2)
             out["leaf_value"] = sc2(s["leaf_value"], lv2)
             out["leaf_weight"] = sc2(s["leaf_weight"], sums2[:, 1])
             out["leaf_count"] = sc2(s["leaf_count"], sums2[:, 2])

@@ -191,7 +191,38 @@ class GBDT:
                            bool)
         self.learner = self._create_learner(num_bins, is_cat, has_nan,
                                             self._inner_monotone())
-        self.X_dev = jnp.asarray(train_set.X_binned)
+        import jax as _jx
+        _shards = _jx.device_count() \
+            if cfg.tree_learner in ("data", "voting") else 1
+        if self.num_data > (1 << 24) * _shards and \
+                not cfg.use_quantized_grad:
+            # f32 histogram counts are exact to 2^24 rows PER SHARD
+            # (ops/histogram.py); the quantized path accumulates int32
+            # counts exact to 2^31 (reference data_size_t, meta.h:28)
+            log_warning(f"num_data={self.num_data} exceeds the f32 "
+                        "histogram count channel's 16.7M-rows-per-shard "
+                        "exactness range; set use_quantized_grad=true for "
+                        "exact int32 counts (and faster training) at this "
+                        "scale")
+        if getattr(train_set, "distributed_rows", False):
+            # pre-partitioned ingest: assemble the global row-sharded
+            # matrix from each process's local shard (features never
+            # replicate across hosts)
+            if cfg.tree_learner != "data":
+                raise ValueError("pre_partition-ed training requires "
+                                 "tree_learner=data")
+            import jax as _jax
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+            from ..parallel.mesh import get_mesh as _get_mesh
+            _mesh = _get_mesh(int(cfg.num_devices))
+            _ax = _mesh.axis_names[0]
+            self.X_dev = _jax.make_array_from_process_local_data(
+                NamedSharding(_mesh, _P(_ax)), train_set.X_binned)
+            self._row_valid = _jax.make_array_from_process_local_data(
+                NamedSharding(_mesh, _P(_ax)), train_set._dist_valid_local)
+        else:
+            self.X_dev = jnp.asarray(train_set.X_binned)
+            self._row_valid = None
         self._is_cat_np = is_cat
         # bundle-space tree-walk decode arrays (EFB valid sets / rebuilds)
         # — the standard efb_arrays layout minus exp_map (unused by the
@@ -356,13 +387,14 @@ class GBDT:
                                      interaction_groups=
                                      self._parse_interaction_constraints(),
                                      feature_contri=self._inner_contri())
-        if cfg.forcedsplits_filename or cfg.interaction_constraints:
-            log_warning("forcedsplits_filename / interaction_constraints are "
-                        "applied by the serial learner only; this parallel "
-                        "learner ignores them")
+        if cfg.forcedsplits_filename:
+            log_warning("forcedsplits_filename is applied by the serial "
+                        "learner only; this parallel learner ignores it")
         from ..parallel import create_parallel_learner
-        return create_parallel_learner(cfg, self.num_features, self.max_bins,
-                                       num_bins, is_cat, has_nan, monotone)
+        return create_parallel_learner(
+            cfg, self.num_features, self.max_bins, num_bins, is_cat,
+            has_nan, monotone,
+            interaction_groups=self._parse_interaction_constraints())
 
     def _walk(self, bins, *tree_args):
         """Binned tree walk; routes through the bundle-space decode
@@ -521,6 +553,10 @@ class GBDT:
             finished = True
             fmask = self._feature_mask()
             grad, hess, mask = self._prepare_iter_sampling(grad, hess)
+            if getattr(self, "_row_valid", None) is not None:
+                # pre_partition padding rows never enter a tree (applied
+                # centrally so GOSS's override is covered too)
+                mask = mask * self._row_valid
             self._last_sample_mask = mask
             leaves_this_iter = []
             for cid in range(k):

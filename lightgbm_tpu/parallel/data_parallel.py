@@ -140,15 +140,18 @@ class DataParallelTreeLearner:
 
     def __init__(self, config: Config, num_features: int, max_bins: int,
                  num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
-                 monotone: Optional[np.ndarray] = None):
+                 monotone: Optional[np.ndarray] = None,
+                 interaction_groups: tuple = ()):
         self.config = config
         self.max_bins = int(max_bins)
         self.num_features = num_features
+        self.interaction_groups = tuple(tuple(g) for g in interaction_groups)
         self.mesh = get_mesh(int(config.num_devices))
         self.ndev = self.mesh.devices.size
         self.axis = self.mesh.axis_names[0]
         mode = str(config.tree_grow_mode)
-        impl_wave = resolve_hist_impl(config, parallel=True, wave=True)
+        impl_wave = resolve_hist_impl(config, parallel=True, wave=True,
+                                      max_bins=self.max_bins)
         # same gates as SerialTreeLearner's wave_ok: the wave state carries
         # the full (L, G, B, 3) histogram pool — fall back to the masked
         # sequential grower when it would blow the HBM budget
@@ -161,11 +164,21 @@ class DataParallelTreeLearner:
                             monotone, impl_wave)
             return
         self.quantized = False
+        self.supports_extras = False
         if config.use_quantized_grad:
             from ..utils.log import log_warning
             log_warning("use_quantized_grad requires the wave grower; the "
                         "masked data-parallel grower trains with exact "
                         "gradients")
+        if self.interaction_groups or config.extra_trees or \
+                config.feature_fraction_bynode < 1.0 or \
+                config.cegb_penalty_split > 0 or \
+                config.cegb_penalty_feature_coupled:
+            from ..utils.log import log_warning
+            log_warning("extra_trees / bynode sampling / cegb / interaction"
+                        " constraints under tree_learner=data require the "
+                        "wave grower (tree_grow_mode=wave, or auto on TPU);"
+                        " the masked DP grower ignores them")
         # pad the feature axis to a multiple of the mesh so psum_scatter
         # blocks are uniform (padded features are trivial: 1 bin, never
         # splittable — the analog of the reference's balanced block layout)
@@ -217,14 +230,6 @@ class DataParallelTreeLearner:
     def _init_wave(self, config, num_features, num_bins, is_cat, has_nan,
                    monotone, impl):
         from ..learner.wave import make_wave_grow_fn
-        from ..utils.log import log_warning
-        if config.extra_trees or config.feature_fraction_bynode < 1.0 or \
-                config.cegb_penalty_split > 0 or \
-                config.cegb_penalty_feature_coupled:
-            log_warning("extra_trees / feature_fraction_bynode / cegb are "
-                        "not applied by the data-parallel wave grower; "
-                        "set tree_grow_mode=partition & tree_learner=serial "
-                        "to use them")
         self.f_pad = 0
         self.pallas = impl == "pallas"
         self.num_bins = jnp.asarray(num_bins, jnp.int32)
@@ -233,45 +238,58 @@ class DataParallelTreeLearner:
         mono_np = monotone if monotone is not None else np.zeros(num_features)
         self.monotone = jnp.asarray(mono_np, jnp.int32)
         self._x_src = None
+        self.supports_extras = True
         from ..ops.quantize import quant_levels
         self.quantized = bool(config.use_quantized_grad)
+        sp = split_params_from_config(config, num_bins, is_cat)
+        self.split_params = sp
+        self._use_node_key = sp.feature_fraction_bynode < 1.0 or \
+            sp.extra_trees
         gq_max, hq_max = quant_levels(int(config.num_grad_quant_bins))
         strategy = WaveDPStrategy(self.axis)
         grow_w = make_wave_grow_fn(
             num_leaves=int(config.num_leaves), num_features=num_features,
             max_bins=self.max_bins, max_depth=int(config.max_depth),
-            split_params=split_params_from_config(config, num_bins, is_cat),
+            split_params=sp,
             hist_impl=impl, any_cat=bool(np.any(np.asarray(is_cat))),
             wave_size=int(config.tpu_wave_size), strategy=strategy,
             jit=False, quantized=self.quantized, gq_max=gq_max,
             hq_max=hq_max,
             renew_leaf=bool(config.quant_train_renew_leaf),
-            stochastic=bool(config.stochastic_rounding))
+            stochastic=bool(config.stochastic_rounding),
+            interaction_groups=self.interaction_groups)
 
-        if self.quantized:
-            def grow(X_T, g, h, m, nb, ic, hn, mono, fm, qkey):
-                cegb = jnp.zeros((num_features,), jnp.float32)
-                return grow_w(X_T, g, h, m, nb, ic, hn, mono, cegb, (), fm,
-                              qkey)
-            extra_specs = (P(),)
-        else:
-            def grow(X_T, g, h, m, nb, ic, hn, mono, fm):
-                cegb = jnp.zeros((num_features,), jnp.float32)
-                return grow_w(X_T, g, h, m, nb, ic, hn, mono, cegb, (), fm)
-            extra_specs = ()
+        # cegb penalties and the quantization/bynode keys ride replicated
+        # extra operands; arity depends on the static config
+        nq = int(self.quantized)
+        nn = int(self._use_node_key)
+
+        def grow(X_T, g, h, m, nb, ic, hn, mono, fm, cegb, *keys):
+            kw = {}
+            ki = 0
+            if nq:
+                kw["quant_key"] = keys[ki]
+                ki += 1
+            if nn:
+                kw["node_key"] = keys[ki]
+            return grow_w(X_T, g, h, m, nb, ic, hn, mono, cegb, (), fm,
+                          **kw)
 
         tree_specs = self._tree_specs(self.axis)
         self._grow = jax.jit(jax.shard_map(
             grow, mesh=self.mesh,
             in_specs=(P(None, self.axis), P(self.axis), P(self.axis),
-                      P(self.axis), P(), P(), P(), P(), P()) + extra_specs,
+                      P(self.axis), P(), P(), P(), P(), P(), P()) +
+            (P(),) * (nq + nn),
             out_specs=tree_specs,
             check_vma=False))
 
     def train(self, X_dev: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               sample_mask: jnp.ndarray,
               feature_mask: Optional[jnp.ndarray] = None,
-              quant_key: Optional[jnp.ndarray] = None) -> GrownTree:
+              quant_key: Optional[jnp.ndarray] = None,
+              cegb_penalty: Optional[jnp.ndarray] = None,
+              node_key: Optional[jnp.ndarray] = None) -> GrownTree:
         if feature_mask is None:
             feature_mask = jnp.ones((self.num_features,), jnp.bool_)
         n = X_dev.shape[0]
@@ -291,17 +309,22 @@ class DataParallelTreeLearner:
                 grad = jnp.pad(grad, (0, pad))
                 hess = jnp.pad(hess, (0, pad))
                 sample_mask = jnp.pad(sample_mask, (0, pad))
+            if cegb_penalty is None:
+                cegb_penalty = jnp.zeros((self.num_features,), jnp.float32)
+            keys = []
             if self.quantized:
                 if quant_key is None:
                     self._quant_calls = getattr(self, "_quant_calls", 0) + 1
                     quant_key = jax.random.PRNGKey(self._quant_calls)
-                grown = self._grow(self._XpT, grad, hess, sample_mask,
-                                   self.num_bins, self.is_cat, self.has_nan,
-                                   self.monotone, feature_mask, quant_key)
-            else:
-                grown = self._grow(self._XpT, grad, hess, sample_mask,
-                                   self.num_bins, self.is_cat, self.has_nan,
-                                   self.monotone, feature_mask)
+                keys.append(quant_key)
+            if self._use_node_key:
+                if node_key is None:
+                    node_key = jnp.zeros((2, 2), jnp.uint32)
+                keys.append(node_key)
+            grown = self._grow(self._XpT, grad, hess, sample_mask,
+                               self.num_bins, self.is_cat, self.has_nan,
+                               self.monotone, feature_mask, cegb_penalty,
+                               *keys)
             if pad:
                 grown = grown._replace(row_leaf=grown.row_leaf[:n])
             return grown

@@ -20,7 +20,7 @@ __all__ = ["plot_importance", "plot_split_value_histogram", "plot_metric",
            "plot_tree", "create_tree_digraph"]
 
 
-def _check_not_tuple_of_2_elements(obj: Any, obj_name: str) -> None:
+def _require_pair(obj: Any, obj_name: str) -> None:
     if not isinstance(obj, (list, tuple)) or len(obj) != 2:
         raise TypeError(f"{obj_name} must be a list or tuple of 2 elements")
 
@@ -76,7 +76,7 @@ def plot_importance(booster, ax=None, height: float = 0.2,
 
     if ax is None:
         if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
+            _require_pair(figsize, "figsize")
         _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
 
     ylocs = np.arange(len(values))
@@ -88,12 +88,12 @@ def plot_importance(booster, ax=None, height: float = 0.2,
     ax.set_yticks(ylocs)
     ax.set_yticklabels(labels)
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
+        _require_pair(xlim, "xlim")
     else:
         xlim = (0, max(values) * 1.1)
     ax.set_xlim(xlim)
     if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
+        _require_pair(ylim, "ylim")
     else:
         ylim = (-1, len(values))
     ax.set_ylim(ylim)
@@ -144,15 +144,15 @@ def plot_split_value_histogram(booster, feature, bins=None, ax=None,
 
     if ax is None:
         if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
+            _require_pair(figsize, "figsize")
         _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
     ax.bar(centred, hist, align="center",
            width=width_coef * (bin_edges[1] - bin_edges[0]), **kwargs)
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
+        _require_pair(xlim, "xlim")
         ax.set_xlim(xlim)
     if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
+        _require_pair(ylim, "ylim")
     else:
         ylim = (0, max(hist) * 1.1)
     ax.set_ylim(ylim)
@@ -193,7 +193,7 @@ def plot_metric(booster, metric: Optional[str] = None,
 
     if ax is None:
         if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
+            _require_pair(figsize, "figsize")
         _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
 
     if dataset_names is None:
@@ -225,12 +225,12 @@ def plot_metric(booster, metric: Optional[str] = None,
         ax.plot(x_, results, label=name)
     ax.legend(loc="best")
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
+        _require_pair(xlim, "xlim")
     else:
         xlim = (0, num_iteration)
     ax.set_xlim(xlim)
     if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
+        _require_pair(ylim, "ylim")
     else:
         range_result = max_result - min_result
         ylim = (min_result - range_result * 0.2,
@@ -362,7 +362,7 @@ def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None, dpi=None,
         raise ImportError("You must install matplotlib to plot tree.") from e
     if ax is None:
         if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
+            _require_pair(figsize, "figsize")
         _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
 
     graph = create_tree_digraph(booster=booster, tree_index=tree_index,

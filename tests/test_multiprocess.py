@@ -81,3 +81,61 @@ def test_two_process_training_matches_serial(tmp_path, tree_learner):
                         "min_data_in_leaf": 5, "verbosity": -1},
                        lgb.Dataset(X, y), 5).predict(X)
     np.testing.assert_allclose(p0, serial, atol=2e-5)
+
+
+_WORKER_PREPART = textwrap.dedent("""
+    import sys
+    rank = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_num_cpu_devices", 2)
+    import lightgbm_tpu as lgb
+    lgb.distributed.init(coordinator_address="127.0.0.1:" + port,
+                         num_processes=2, process_id=rank)
+    import numpy as np
+    from lightgbm_tpu.utils.log import set_verbosity
+    set_verbosity(-1)
+    rng = np.random.RandomState(11)
+    n = 700
+    X = rng.randn(n, 6)
+    y = ((X[:, 0] + 0.5 * X[:, 1] - X[:, 2] ** 2 * 0.2) > 0).astype(float)
+    # each rank loads ONLY its row range (pre-partitioned files)
+    lo, hi = (0, 350) if rank == 0 else (350, 700)
+    P = {{"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+          "verbosity": -1, "tree_learner": "data", "pre_partition": True}}
+    bst = lgb.train(P, lgb.Dataset(X[lo:hi], y[lo:hi]), 5)
+    np.save(f"{{outdir}}/ppred_{{rank}}.npy", bst.predict(X))
+""")
+
+
+def test_two_process_pre_partition_matches_full(tmp_path):
+    """Disjoint per-process shards (pre_partition) + distributed bin
+    finding reproduce full-data training (dataset_loader.cpp:1040's
+    per-rank FindBin + allgather contract)."""
+    script = str(tmp_path / "worker_pp.py")
+    with open(script, "w") as fh:
+        fh.write(_WORKER_PREPART.format(repo=REPO))
+    port = str(_free_port())
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS="")
+    procs = [subprocess.Popen(
+        [sys.executable, script, str(r), port, str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    outs = [p.communicate(timeout=420)[0].decode() for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+
+    p0 = np.load(tmp_path / "ppred_0.npy")
+    p1 = np.load(tmp_path / "ppred_1.npy")
+    np.testing.assert_allclose(p0, p1, atol=1e-7)
+
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(11)
+    n = 700
+    X = rng.randn(n, 6)
+    y = ((X[:, 0] + 0.5 * X[:, 1] - X[:, 2] ** 2 * 0.2) > 0).astype(float)
+    serial = lgb.train({"objective": "binary", "num_leaves": 7,
+                        "min_data_in_leaf": 5, "verbosity": -1},
+                       lgb.Dataset(X, y), 5).predict(X)
+    np.testing.assert_allclose(p0, serial, atol=2e-4)

@@ -129,3 +129,66 @@ def test_data_parallel_wave_bagging_multiclass(data):
     dp = lgb.train({**p, "tree_learner": "data"},
                    lgb.Dataset(X, ym.astype(float)), 4).predict(X)
     np.testing.assert_allclose(dp, serial, atol=5e-5)
+
+
+@pytest.mark.parametrize("extra", [
+    {"extra_trees": True},
+    {"feature_fraction_bynode": 0.5},
+    {"cegb_tradeoff": 0.5, "cegb_penalty_split": 0.05},
+    {"interaction_constraints": "[0,3],[1,2]"},
+])
+def test_dp_wave_extras_match_serial_wave(extra, data):
+    """The round-4 DP-wave feature completion: extra_trees / bynode
+    sampling / CEGB / interaction constraints under tree_learner=data
+    reproduce the serial wave grower exactly (replicated node-key
+    streams, identical node ids; parallel_tree_learner.h:54's 'DP wraps
+    the serial learner' contract)."""
+    X, y = data
+    preds = {}
+    for tl in ("serial", "data"):
+        bst = lgb.train({**SMALL, "objective": "binary",
+                         "tree_learner": tl, "tree_grow_mode": "wave",
+                         **extra}, lgb.Dataset(X, y), 5)
+        preds[tl] = bst.predict(X)
+    np.testing.assert_allclose(preds["data"], preds["serial"], atol=2e-5)
+
+
+def test_wave_extras_quality_vs_partitioned(data):
+    """Serial wave with per-node sampling stays quality-par with the
+    partitioned grower's implementation of the same features."""
+    X, y = data
+    ll = {}
+    for mode in ("wave", "partition"):
+        bst = lgb.train({**SMALL, "objective": "binary",
+                         "tree_grow_mode": mode, "extra_trees": True,
+                         "feature_fraction_bynode": 0.7,
+                         "interaction_constraints": "[0,1,3],[2,4,5]"},
+                        lgb.Dataset(X, y), 8)
+        pred = np.clip(bst.predict(X), 1e-9, 1 - 1e-9)
+        ll[mode] = -np.mean(y * np.log(pred) + (1 - y) * np.log(1 - pred))
+    assert ll["wave"] < ll["partition"] * 1.15 + 5e-3
+
+
+def test_wave_interaction_constraints_respected(data):
+    """Trees grown by the wave grower never mix features across
+    constraint groups on one branch."""
+    X, y = data
+    bst = lgb.train({**SMALL, "objective": "binary",
+                     "tree_grow_mode": "wave",
+                     "interaction_constraints": "[0,3],[1,2],[4,5]"},
+                    lgb.Dataset(X, y), 6)
+    groups = [{0, 3}, {1, 2}, {4, 5}]
+    for tree in bst._gbdt.models:
+        nl = int(tree.num_leaves)
+        if nl <= 1:
+            continue
+        # walk root->leaf paths collecting used features
+        def walk(node, used):
+            f = int(tree.split_feature[node])
+            used = used | {f}
+            assert any(used <= g for g in groups), used
+            for child in (int(tree.left_child[node]),
+                          int(tree.right_child[node])):
+                if child >= 0:
+                    walk(child, used)
+        walk(0, set())
