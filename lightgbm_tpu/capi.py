@@ -56,15 +56,30 @@ def _get(handle: int):
         raise ValueError(f"invalid handle {handle}")
 
 
+_abi_errors = [False]
+
+
+def strict_abi(enable: bool = True) -> None:
+    """Select the error mode.  Default (False): exceptions PROPAGATE —
+    in-process Python callers get real stack traces (the reference's own
+    Python wrapper raises on nonzero codes, basic.py _safe_call).
+    ``strict_abi(True)`` restores the raw ABI contract: -1 +
+    ``LGBM_GetLastError`` (c_api.cpp API_BEGIN/API_END), for code that
+    ports the ctypes call pattern verbatim."""
+    _abi_errors[0] = bool(enable)
+
+
 def _api(fn):
-    """Error contract: 0 on success, -1 + LGBM_GetLastError on failure
-    (reference c_api.cpp API_BEGIN/API_END)."""
+    """0 on success; failures raise (default) or return -1 under
+    ``strict_abi(True)`` — see :func:`strict_abi`."""
     def wrapper(*args, **kwargs):
         try:
             return fn(*args, **kwargs)
         except Exception as e:  # noqa: BLE001 — the ABI swallows into -1
             _last_error[0] = f"{type(e).__name__}: {e}"
-            return -1, None
+            if _abi_errors[0]:
+                return -1, None
+            raise
     wrapper.__name__ = fn.__name__
     wrapper.__doc__ = fn.__doc__
     return wrapper
@@ -73,6 +88,17 @@ def _api(fn):
 def LGBM_GetLastError() -> str:
     """reference c_api.h:46."""
     return _last_error[0]
+
+
+def _check_stream_complete(ds) -> None:
+    """A streaming dataset must be fully pushed before first use —
+    training on the zero-filled allocation would be silently wrong."""
+    filled = getattr(ds, "_stream_filled", None)
+    if filled is not None and not ds.constructed and \
+            filled < len(ds.data):
+        raise ValueError(
+            f"streaming dataset incomplete: {filled} of {len(ds.data)} "
+            "rows pushed (LGBM_DatasetPushRows*)")
 
 
 def _parse_params(parameters: Optional[str]) -> Dict[str, Any]:
@@ -114,6 +140,68 @@ def LGBM_DatasetCreateFromFile(filename: str, parameters: str = "",
     ds = Dataset(filename, reference=ref, params=params)
     ds.construct(Config(params) if ref is None else None)
     return 0, _register(ds)
+
+
+@_api
+def LGBM_DatasetCreateByReference(reference: int, num_total_row: int):
+    """Streaming ingestion step 1 (c_api.h:232 DatasetCreateByReference):
+    allocate an empty dataset aligned to a constructed reference; fill it
+    with LGBM_DatasetPushRows* and it bins lazily on first use."""
+    ref = _get(reference)
+    if not ref.constructed:
+        ref.construct(Config(ref.params))
+    buf = np.zeros((int(num_total_row), ref.num_total_features), np.float64)
+    ds = Dataset(buf, reference=ref, params=dict(ref.params),
+                 free_raw_data=False)
+    ds._stream_filled = 0
+    return 0, _register(ds)
+
+
+@_api
+def LGBM_DatasetPushRows(dataset: int, data, start_row: int):
+    """Streaming ingestion step 2 (c_api.h:66 DatasetPushRows): copy a
+    dense row block into [start_row, start_row+nrow)."""
+    ds = _get(dataset)
+    if ds.constructed:
+        raise ValueError("cannot push rows into a dataset already used "
+                         "for training/validation")
+    rows = np.asarray(data, np.float64)
+    if rows.ndim == 1:
+        rows = rows.reshape(1, -1)
+    ds.data[int(start_row):int(start_row) + len(rows)] = rows
+    ds._stream_filled = max(getattr(ds, "_stream_filled", 0),
+                            int(start_row) + len(rows))
+    return 0, None
+
+
+@_api
+def LGBM_DatasetPushRowsByCSR(dataset: int, csr_block, start_row: int):
+    """Streaming ingestion of one sparse row block (c_api.h:105
+    DatasetPushRowsByCSR); only the pushed block densifies."""
+    ds = _get(dataset)
+    if ds.constructed:
+        raise ValueError("cannot push rows into a dataset already used "
+                         "for training/validation")
+    block = np.asarray(csr_block.todense()
+                       if hasattr(csr_block, "todense") else csr_block,
+                       np.float64)
+    ds.data[int(start_row):int(start_row) + len(block),
+            :block.shape[1]] = block
+    ds._stream_filled = max(getattr(ds, "_stream_filled", 0),
+                            int(start_row) + len(block))
+    return 0, None
+
+
+@_api
+def LGBM_DatasetGetSubset(handle: int, used_row_indices,
+                          parameters: str = ""):
+    """Row subset sharing the parent's bin mappers (c_api.h:286)."""
+    ds = _get(handle)
+    _check_stream_complete(ds)
+    if not ds.constructed:
+        ds.construct(Config(ds.params))
+    idx = np.asarray(used_row_indices, np.int64)
+    return 0, _register(ds.subset(idx))
 
 
 @_api
@@ -171,6 +259,7 @@ def LGBM_DatasetSaveBinary(handle: int, filename: str):
 
 @_api
 def LGBM_BoosterCreate(train_data: int, parameters: str = ""):
+    _check_stream_complete(_get(train_data))
     ds = _get(train_data)
     bst = Booster(params=_parse_params(parameters), train_set=ds)
     return 0, _register(bst)
@@ -197,6 +286,7 @@ def LGBM_BoosterFree(handle: int):
 
 @_api
 def LGBM_BoosterAddValidData(handle: int, valid_data: int):
+    _check_stream_complete(_get(valid_data))
     bst = _get(handle)
     bst.add_valid(_get(valid_data), f"valid_{len(bst._gbdt.valid_sets)}")
     return 0, None
@@ -304,20 +394,86 @@ def LGBM_BoosterPredictForMat(handle: int, data, predict_type: int = 0,
     return 0, out
 
 
+def _predict_kwargs(predict_type, start_iteration, num_iteration):
+    return dict(
+        start_iteration=start_iteration,
+        num_iteration=None if num_iteration < 0 else num_iteration,
+        raw_score=predict_type == C_API_PREDICT_RAW_SCORE,
+        pred_leaf=predict_type == C_API_PREDICT_LEAF_INDEX,
+        pred_contrib=predict_type == C_API_PREDICT_CONTRIB)
+
+
 @_api
 def LGBM_BoosterPredictForCSR(handle: int, csr, predict_type: int = 0,
                               start_iteration: int = 0,
                               num_iteration: int = -1,
                               parameter: str = ""):
+    """Sparse prediction.  Rows densify in bounded chunks only — a
+    Bosch-shaped CSR never materializes as one dense matrix
+    (c_api.h:896 PredictForCSR)."""
     bst = _get(handle)
-    out = bst.predict(np.asarray(csr.todense()),
-                      start_iteration=start_iteration,
-                      num_iteration=None if num_iteration < 0 else
-                      num_iteration,
-                      raw_score=predict_type == C_API_PREDICT_RAW_SCORE,
-                      pred_leaf=predict_type == C_API_PREDICT_LEAF_INDEX,
-                      pred_contrib=predict_type == C_API_PREDICT_CONTRIB)
-    return 0, out
+    kw = _predict_kwargs(predict_type, start_iteration, num_iteration)
+    n, f = csr.shape
+    step = max(1024, (1 << 24) // max(1, f))
+    if n <= step:
+        return 0, bst.predict(np.asarray(csr.todense()), **kw)
+    parts = [bst.predict(np.asarray(csr[lo:lo + step].todense()), **kw)
+             for lo in range(0, n, step)]
+    return 0, np.concatenate(parts, axis=0)
+
+
+@_api
+def LGBM_BoosterPredictForMatSingleRow(handle: int, row,
+                                       predict_type: int = 0,
+                                       start_iteration: int = 0,
+                                       num_iteration: int = -1,
+                                       parameter: str = ""):
+    """Single-row fast path (c_api.h:1018 PredictForMatSingleRow)."""
+    bst = _get(handle)
+    kw = _predict_kwargs(predict_type, start_iteration, num_iteration)
+    out = bst.predict(np.asarray(row, np.float64).reshape(1, -1), **kw)
+    return 0, np.asarray(out)[0]
+
+
+@_api
+def LGBM_BoosterPredictForCSRSingleRow(handle: int, csr_row,
+                                       predict_type: int = 0,
+                                       start_iteration: int = 0,
+                                       num_iteration: int = -1,
+                                       parameter: str = ""):
+    """Single sparse row (c_api.h:961 PredictForCSRSingleRow)."""
+    bst = _get(handle)
+    kw = _predict_kwargs(predict_type, start_iteration, num_iteration)
+    dense = np.asarray(csr_row.todense()).reshape(1, -1)
+    return 0, np.asarray(bst.predict(dense, **kw))[0]
+
+
+@_api
+def LGBM_BoosterPredictForFile(handle: int, data_filename: str,
+                               data_has_header: bool = False,
+                               predict_type: int = 0,
+                               start_iteration: int = 0,
+                               num_iteration: int = -1,
+                               parameter: str = "",
+                               result_filename: str =
+                               "LightGBM_predict_result.txt"):
+    """File -> prediction file (c_api.h:858 PredictForFile; the CLI's
+    task=predict body, application.cpp Predict)."""
+    bst = _get(handle)
+    params = _parse_params(parameter)
+    if data_has_header:
+        params.setdefault("header", True)
+    from .io_utils import load_data_file
+    X, _, _ = load_data_file(data_filename, params)
+    kw = _predict_kwargs(predict_type, start_iteration, num_iteration)
+    out = np.atleast_1d(np.asarray(bst.predict(np.asarray(X), **kw)))
+    with open(result_filename, "w") as fh:
+        if out.ndim == 1:
+            fh.write("\n".join(f"{v:.18g}" for v in out) + "\n")
+        else:
+            for r in out:
+                fh.write("\t".join(f"{v:.18g}" for v in r) + "\n")
+    return 0, None
 
 
 @_api

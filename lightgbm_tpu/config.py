@@ -407,7 +407,6 @@ class Config:
 # construction (fixed seeds, static schedules, no atomics), which satisfies
 # the flag's contract without a switch.
 _UNIMPLEMENTED_PARAMS: Tuple[str, ...] = (
-    "pre_partition",
     "cegb_penalty_feature_lazy",
 )
 

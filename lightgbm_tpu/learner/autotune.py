@@ -1,0 +1,86 @@
+"""One-shot histogram-implementation autotune.
+
+The reference times its col-wise vs row-wise histogram construction on
+the first iteration and keeps the winner (reference: src/io/dataset.cpp
+:659-670 ``ShareStates`` force_col_wise/force_row_wise timing).  The TPU
+analog choice is the Pallas MXU kernel vs the XLA onehot formulation:
+the static table in ``resolve_hist_impl`` is right for benchmark-scale
+shapes, but small or oddly-shaped datasets (tiny N, very wide F, tiny
+max_bin) can go either way — so when the binned matrix is small enough
+that two extra compiles are cheap, time both on the REAL data once and
+cache the winner per (N, F, B) shape.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Tuple
+
+import numpy as np
+
+# shape -> winning impl, process-lifetime cache
+_CACHE: Dict[Tuple[int, int, int], str] = {}
+
+# above this many binned cells the static choice (pallas on TPU) is
+# reliably right and the probe's compile time isn't worth it
+AUTOTUNE_MAX_CELLS = 1 << 22
+
+
+def pick_hist_impl(X_binned: np.ndarray, max_bins: int,
+                   candidates=("pallas", "onehot"), reps: int = 3) -> str:
+    """Time one full histogram build per candidate impl on the actual
+    data shapes; return the faster (ties -> first candidate)."""
+    import jax
+    import jax.numpy as jnp
+    n, f = X_binned.shape
+    key = (n, f, int(max_bins))
+    hit = _CACHE.get(key)
+    if hit in candidates:
+        return hit
+
+    rng = np.random.RandomState(0)
+    grad = jnp.asarray(rng.randn(n).astype(np.float32))
+    hess = jnp.asarray(np.abs(rng.randn(n)).astype(np.float32))
+    mask = jnp.ones((n,), jnp.float32)
+    times = {}
+    for impl in candidates:
+        try:
+            if impl == "pallas":
+                from ..ops.histogram_pallas import (build_histogram_pallas,
+                                                    pad_rows)
+                n_pad = pad_rows(n)
+                bins_t = jnp.asarray(
+                    np.pad(X_binned, ((0, n_pad - n), (0, 0))).T.copy())
+                gp = jnp.pad(grad, (0, n_pad - n))
+                hp = jnp.pad(hess, (0, n_pad - n))
+                mp = jnp.pad(mask, (0, n_pad - n))
+
+                def run():
+                    return build_histogram_pallas(bins_t, gp, hp, mp,
+                                                  num_bins=int(max_bins))
+            else:
+                from ..ops.histogram import build_histogram
+                bins_d = jnp.asarray(X_binned)
+
+                def run(impl=impl):
+                    return build_histogram(bins_d, grad, hess, mask,
+                                           num_bins=int(max_bins),
+                                           impl=impl)
+
+            out = run()                       # compile + warm
+            _ = float(jnp.ravel(out)[0])
+            t0 = time.perf_counter()
+            for _i in range(reps):
+                out = run()
+            _ = float(jnp.ravel(out)[0])
+            times[impl] = (time.perf_counter() - t0) / reps
+        except Exception:  # noqa: BLE001 — a failing impl simply loses
+            times[impl] = float("inf")
+    win = min(candidates, key=lambda i: times[i])
+    from ..utils.log import log_info
+    log_info("histogram autotune at shape "
+             f"({n}, {f}, {max_bins}): " +
+             ", ".join(f"{k}={v * 1e3:.2f}ms" for k, v in times.items()) +
+             f" -> {win}")
+    _CACHE[key] = win
+    return win

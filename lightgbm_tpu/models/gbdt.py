@@ -189,10 +189,26 @@ class GBDT:
         is_cat = np.array([m.is_categorical for m in mappers], bool)
         has_nan = np.array([m.missing_type == MissingType.NAN for m in mappers],
                            bool)
+        learner_cfg = cfg
+        if (cfg.tpu_histogram_impl == "auto" and
+                jax.default_backend() == "tpu" and
+                train_set.X_binned.size <= (1 << 22) and
+                self.max_bins <= 256 and
+                cfg.tree_learner in ("serial", "")):
+            # small shapes: time pallas vs onehot on the real data once
+            # (dataset.cpp:659-670's ShareStates timing, TPU analog);
+            # large shapes keep the measured static choice.  The winner
+            # goes to a COPY so the user's 'auto' survives param
+            # round-trips.
+            from ..learner.autotune import pick_hist_impl
+            import copy as _copy
+            learner_cfg = _copy.copy(cfg)
+            learner_cfg.tpu_histogram_impl = pick_hist_impl(
+                train_set.X_binned, self.max_bins)
         self.learner = self._create_learner(num_bins, is_cat, has_nan,
-                                            self._inner_monotone())
-        import jax as _jx
-        _shards = _jx.device_count() \
+                                            self._inner_monotone(),
+                                            cfg=learner_cfg)
+        _shards = jax.device_count() \
             if cfg.tree_learner in ("data", "voting") else 1
         if self.num_data > (1 << 24) * _shards and \
                 not cfg.use_quantized_grad:
@@ -211,14 +227,13 @@ class GBDT:
             if cfg.tree_learner != "data":
                 raise ValueError("pre_partition-ed training requires "
                                  "tree_learner=data")
-            import jax as _jax
             from jax.sharding import NamedSharding, PartitionSpec as _P
             from ..parallel.mesh import get_mesh as _get_mesh
             _mesh = _get_mesh(int(cfg.num_devices))
             _ax = _mesh.axis_names[0]
-            self.X_dev = _jax.make_array_from_process_local_data(
+            self.X_dev = jax.make_array_from_process_local_data(
                 NamedSharding(_mesh, _P(_ax)), train_set.X_binned)
-            self._row_valid = _jax.make_array_from_process_local_data(
+            self._row_valid = jax.make_array_from_process_local_data(
                 NamedSharding(_mesh, _P(_ax)), train_set._dist_valid_local)
         else:
             self.X_dev = jnp.asarray(train_set.X_binned)
@@ -376,8 +391,9 @@ class GBDT:
                 groups.append(tuple(sorted(set(feats))))
         return tuple(groups)
 
-    def _create_learner(self, num_bins, is_cat, has_nan, monotone=None):
-        cfg = self.config
+    def _create_learner(self, num_bins, is_cat, has_nan, monotone=None,
+                        cfg=None):
+        cfg = cfg if cfg is not None else self.config
         if cfg.tree_learner == "serial" or cfg.num_machines <= 1 and \
                 cfg.tree_learner not in ("data", "feature", "voting"):
             return SerialTreeLearner(cfg, self.num_features, self.max_bins,
@@ -413,6 +429,24 @@ class GBDT:
                 and not valid_set.constructed:
             valid_set.reference = self.train_set
         valid_set.construct(self.config)
+        if getattr(self, "_row_valid", None) is not None and \
+                valid_set is not self.train_set:
+            # pre_partition training evaluates valid metrics per process
+            # with NO cross-process reduction; every rank must therefore
+            # hold the SAME (replicated) validation data, or metric-driven
+            # decisions (early stopping) would diverge and desync the
+            # collectives.  Checked by label checksum across ranks.
+            from .. import distributed as _dist
+            lab = valid_set.metadata.label
+            sig = np.asarray([0.0 if lab is None else float(lab.sum()),
+                              0.0 if lab is None else float(len(lab))],
+                             np.float64)
+            sigs = _dist.allgather_host(sig).reshape(-1, 2)
+            if not np.allclose(sigs, sigs[0]):
+                raise ValueError(
+                    "under pre_partition every process must pass the SAME "
+                    "validation data (metrics are evaluated per process); "
+                    "got differing label checksums across ranks")
         if valid_set is not self.train_set and \
                 valid_set.bin_mappers is not self.train_set.bin_mappers and \
                 not _mappers_equal(valid_set.bin_mappers,

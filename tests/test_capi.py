@@ -69,9 +69,91 @@ def test_fields_and_custom_update(data):
 
 
 def test_error_contract():
-    code, _ = capi.LGBM_BoosterCreate(99999, "")
-    assert code == -1
-    assert "handle" in capi.LGBM_GetLastError()
+    # default mode: exceptions propagate with real stack traces
+    with pytest.raises(ValueError):
+        capi.LGBM_BoosterCreate(99999, "")
+    # ABI-strict mode restores the -1 + GetLastError contract
+    capi.strict_abi(True)
+    try:
+        code, _ = capi.LGBM_BoosterCreate(99999, "")
+        assert code == -1
+        assert "handle" in capi.LGBM_GetLastError()
+    finally:
+        capi.strict_abi(False)
+
+
+def test_streaming_push_rows_matches_from_mat(data):
+    """PushRows ingestion == direct from-mat construction
+    (c_api.h:66-270 streaming contract)."""
+    X, y = data
+    _, ref = capi.LGBM_DatasetCreateFromMat(
+        X, "objective=binary verbosity=-1 num_leaves=7", label=y)
+    _, sh = capi.LGBM_DatasetCreateByReference(ref, len(X))
+    for lo in range(0, len(X), 150):
+        code, _ = capi.LGBM_DatasetPushRows(sh, X[lo:lo + 150], lo)
+        assert code == 0
+    capi.LGBM_DatasetSetField(sh, "label", y)
+
+    preds = {}
+    for name, dh in (("mat", ref), ("stream", sh)):
+        _, bh = capi.LGBM_BoosterCreate(
+            dh, "objective=binary num_leaves=7 verbosity=-1")
+        for _ in range(5):
+            capi.LGBM_BoosterUpdateOneIter(bh)
+        _, preds[name] = capi.LGBM_BoosterPredictForMat(bh, X)
+    np.testing.assert_allclose(preds["stream"], preds["mat"], atol=1e-7)
+
+
+def test_push_rows_by_csr_and_sparse_predict(data):
+    import scipy.sparse as sp
+    X, y = data
+    Xs = X.copy()
+    Xs[np.abs(Xs) < 0.8] = 0.0
+    csr = sp.csr_matrix(Xs)
+    _, ref = capi.LGBM_DatasetCreateFromMat(
+        Xs, "objective=binary verbosity=-1 num_leaves=7", label=y)
+    _, sh = capi.LGBM_DatasetCreateByReference(ref, len(X))
+    for lo in range(0, len(X), 128):
+        capi.LGBM_DatasetPushRowsByCSR(sh, csr[lo:lo + 128], lo)
+    capi.LGBM_DatasetSetField(sh, "label", y)
+    _, bh = capi.LGBM_BoosterCreate(
+        sh, "objective=binary num_leaves=7 verbosity=-1")
+    for _ in range(4):
+        capi.LGBM_BoosterUpdateOneIter(bh)
+    _, dense_pred = capi.LGBM_BoosterPredictForMat(bh, Xs)
+    _, sparse_pred = capi.LGBM_BoosterPredictForCSR(bh, csr)
+    np.testing.assert_allclose(sparse_pred, dense_pred, atol=1e-7)
+    _, one = capi.LGBM_BoosterPredictForCSRSingleRow(bh, csr[3])
+    np.testing.assert_allclose(one, dense_pred[3], atol=1e-7)
+
+
+def test_single_row_subset_and_file_predict(data, tmp_path):
+    X, y = data
+    _, dh = capi.LGBM_DatasetCreateFromMat(
+        X, "objective=binary verbosity=-1", label=y)
+    _, bh = capi.LGBM_BoosterCreate(
+        dh, "objective=binary num_leaves=7 verbosity=-1")
+    for _ in range(4):
+        capi.LGBM_BoosterUpdateOneIter(bh)
+    _, full = capi.LGBM_BoosterPredictForMat(bh, X)
+    _, single = capi.LGBM_BoosterPredictForMatSingleRow(bh, X[7])
+    np.testing.assert_allclose(single, full[7], atol=1e-9)
+
+    # subset shares bin mappers
+    idx = np.arange(0, 200)
+    code, sub = capi.LGBM_DatasetGetSubset(dh, idx)
+    assert code == 0
+    assert capi.LGBM_DatasetGetNumData(sub) == (0, 200)
+
+    # file prediction round trip
+    f = tmp_path / "rows.csv"
+    np.savetxt(f, np.column_stack([y, X]), delimiter="\t")
+    out = tmp_path / "preds.txt"
+    code, _ = capi.LGBM_BoosterPredictForFile(
+        bh, str(f), result_filename=str(out))
+    assert code == 0
+    got = np.loadtxt(out)
+    np.testing.assert_allclose(got, full, rtol=1e-6, atol=1e-7)
 
 
 def test_predict_types(data):

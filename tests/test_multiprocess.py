@@ -38,6 +38,13 @@ _WORKER = textwrap.dedent("""
     y = ((X[:, 0] + 0.5 * X[:, 1] - X[:, 2] ** 2 * 0.2) > 0).astype(float)
     P = {{"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
           "verbosity": -1, "tree_learner": tl}}
+    if tl == "data":
+        # also run the wave grower (quantized, deterministic rounding)
+        # cross-process before the masked-grower run
+        PW = dict(P, tree_grow_mode="wave", use_quantized_grad=True,
+                  stochastic_rounding=False, quant_train_renew_leaf=True)
+        bw = lgb.train(PW, lgb.Dataset(X, y), 3)
+        np.save(f"{{outdir}}/wpred_{{rank}}.npy", bw.predict(X))
     bst = lgb.train(P, lgb.Dataset(X, y), 5)
     np.save(f"{{outdir}}/pred_{{rank}}.npy", bst.predict(X))
 """)
@@ -51,7 +58,7 @@ def _free_port():
     return port
 
 
-@pytest.mark.parametrize("tree_learner", ["data"])
+@pytest.mark.parametrize("tree_learner", ["data", "feature", "voting"])
 def test_two_process_training_matches_serial(tmp_path, tree_learner):
     script = str(tmp_path / "worker.py")
     with open(script, "w") as fh:
@@ -70,6 +77,11 @@ def test_two_process_training_matches_serial(tmp_path, tree_learner):
     p0 = np.load(tmp_path / "pred_0.npy")
     p1 = np.load(tmp_path / "pred_1.npy")
     np.testing.assert_allclose(p0, p1, atol=1e-7)  # ranks agree exactly
+    if tree_learner == "data":
+        w0 = np.load(tmp_path / "wpred_0.npy")
+        w1 = np.load(tmp_path / "wpred_1.npy")
+        np.testing.assert_allclose(w0, w1, atol=1e-7)
+        assert np.isfinite(w0).all()
 
     # serial baseline in THIS process (8-device mesh, single process)
     import lightgbm_tpu as lgb

@@ -182,3 +182,17 @@ def test_leaf_output():
     params2 = SplitParams(lambda_l1=1.0, lambda_l2=0.0, max_delta_step=0.5)
     out2 = leaf_output(jnp.asarray(4.0), jnp.asarray(3.0), params2)
     np.testing.assert_allclose(float(out2), -0.5)  # clipped
+
+
+def test_hist_impl_autotune_times_both():
+    """ShareStates-style one-shot timing on real shapes
+    (learner/autotune.py; dataset.cpp:659-670 analog)."""
+    import numpy as np
+    from lightgbm_tpu.learner.autotune import _CACHE, pick_hist_impl
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, 63, (2000, 5)).astype(np.uint8)
+    win = pick_hist_impl(X, 63, candidates=("onehot", "segment"))
+    assert win in ("onehot", "segment")
+    assert (2000, 5, 63) in _CACHE
+    # cached second call returns instantly with the same answer
+    assert pick_hist_impl(X, 63, candidates=("onehot", "segment")) == win

@@ -66,7 +66,8 @@ def test_unimplemented_param_warns(capsys):
     rng = np.random.RandomState(0)
     X, y = rng.randn(120, 3), rng.randn(120)
     lgb.train({"objective": "regression", "verbosity": 1,
-               "pre_partition": True, "metric": "l2"},
+               "cegb_penalty_feature_lazy": [1.0, 0.0, 0.0],
+               "metric": "l2"},
               lgb.Dataset(X, y), 2)
     out = capsys.readouterr().out
-    assert "pre_partition" in out and "NOT implemented" in out
+    assert "cegb_penalty_feature_lazy" in out and "NOT implemented" in out
