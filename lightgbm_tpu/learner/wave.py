@@ -200,9 +200,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             int32 channel sums (dequantize with ``dq``)."""
             if quantized:
                 if pallas:
-                    wch = wch0.at[3].set(ch.astype(jnp.int8))
                     h = build_histogram_pallas_leaves_q8(
-                        X_T, wch, num_bins=Bb, interpret=interpret)
+                        X_T, wch0, ch, num_bins=Bb, interpret=interpret)
                 else:
                     # off-TPU emulation: f32 sums of integer levels are
                     # exact while |sum| < 2^24 per bin — ample for the

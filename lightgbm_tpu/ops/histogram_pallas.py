@@ -386,18 +386,18 @@ def build_histogram_pallas_leaves(bins_t: jnp.ndarray, w8: jnp.ndarray,
 # ---------------------------------------------------------------------------
 
 
-def _hist_leaves_q8_kernel(bins_ref, wch_ref, out_ref, *, num_features: int,
-                           num_bins: int, group: int):
+def _hist_leaves_q8_kernel(bins_ref, wch_ref, ch_ref, out_ref, *,
+                           num_features: int, num_bins: int, group: int):
     """Accumulate (F*B, 128) lane-packed int32 leaf histograms over one
     row block (42 leaves x 3 int8 channels in the 128-lane dimension)."""
     @pl.when(pl.program_id(1) == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    wch = wch_ref[...]                   # (8, R) i8: g_q, h_q, cnt, ch, 0*4
+    wch = wch_ref[...]                   # (8, R) i8: g_q, h_q, cnt, 0*5
     r = wch.shape[1]
     b = num_bins
-    ch = wch[3:4, :].astype(jnp.int32)   # (1, R); -1 = inactive
+    ch = ch_ref[...].astype(jnp.int32)   # (1, R); -1 = inactive
     subl = jax.lax.broadcasted_iota(jnp.int32, (128, r), 0)
     sel = (ch == subl // _QCB).astype(jnp.int32)
     w3 = wch[:_QCB, :].astype(jnp.int32)           # (3, R)
@@ -418,16 +418,18 @@ def _hist_leaves_q8_kernel(bins_ref, wch_ref, out_ref, *, num_features: int,
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "row_block", "interpret"))
 def build_histogram_pallas_leaves_q8(bins_t: jnp.ndarray, wch: jnp.ndarray,
-                                     *, num_bins: int,
+                                     ch: jnp.ndarray, *, num_bins: int,
                                      row_block: int = DEFAULT_ROW_BLOCK,
                                      interpret: bool = False) -> jnp.ndarray:
     """(Q_LEAF_CHANNELS, F, B, 3) int32 histograms of 42 leaf channels.
 
     Args:
       bins_t: (F, N) uint8 bin codes, N a multiple of ``row_block``.
-      wch: (8, N) int8 FEATURE-MAJOR rows [g_q, h_q, count, ch, 0*4]; ch
-        is the leaf channel in [0, Q_LEAF_CHANNELS) or -1 for inactive
-        rows (they contribute nothing regardless of their weight lanes).
+      wch: (8, N) int8 FEATURE-MAJOR rows [g_q, h_q, count, 0*5] —
+        static per tree (quantize once; no per-wave rewrite).
+      ch: (N,) int8 leaf channel in [0, Q_LEAF_CHANNELS), or -1 for
+        inactive rows (they contribute nothing regardless of their
+        weight lanes).
       num_bins: static global bin count B (<= 256).
     Returns:
       (42, F, B, 3) int32: channel sums (sum g_q, sum h_q, count).
@@ -463,16 +465,18 @@ def build_histogram_pallas_leaves_q8(bins_t: jnp.ndarray, wch: jnp.ndarray,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((8, kr), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, kr), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((f_pad * b, 128), jnp.int32),
         cost_estimate=pl.CostEstimate(
             flops=2 * f_pad * b * n * 128,
-            bytes_accessed=f_pad * n + n * 8 + f_pad * b * 512,
+            bytes_accessed=f_pad * n + n * 9 + f_pad * b * 512,
             transcendentals=0),
         interpret=interpret,
-    )(bins_t, wch)
+    )(bins_t, wch, ch.astype(jnp.int8).reshape(1, n))
 
     out = out[:, :Q_LEAF_CHANNELS * _QCB].reshape(f_pad, b,
                                                   Q_LEAF_CHANNELS, _QCB)

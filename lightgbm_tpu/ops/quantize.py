@@ -46,9 +46,9 @@ def quantize_wch(grad: jnp.ndarray, hess: jnp.ndarray, bag_mask: jnp.ndarray,
 
     ``g_scale``/``h_scale`` are the per-tree dequantization scales
     (g ~= g_q * g_scale); callers compute them from (cross-shard) maxima
-    so data-parallel shards quantize identically.  Row 3 (the leaf
-    channel) is left 0 — the wave grower overwrites it per wave with a
-    contiguous row write (the reason for the feature-major layout).
+    so data-parallel shards quantize identically.  The result is static
+    for the whole tree — the per-wave leaf channel rides a separate
+    (N,) int8 kernel input, so this buffer is never rewritten.
     Stochastic rounding ``floor(x + u)`` is unbiased for either sign;
     with ``stochastic=False`` it degrades to round-half-up.
     """

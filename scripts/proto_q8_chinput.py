@@ -1,0 +1,135 @@
+"""Probe: leaf-channel as a separate (1, N) i8 kernel input vs the
+per-wave wch row write."""
+import functools
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lightgbm_tpu.ops.histogram_pallas import build_histogram_pallas_leaves_q8
+
+QC = 3
+
+
+def _round_up(x, m):
+    return -(-x // m) * m
+
+
+def make_kernel(b, group, ft):
+    nk = ft // group
+
+    def kern(bins_ref, w_ref, ch_ref, out_ref):
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        w = w_ref[...]                        # (8, R) i8 (static channels)
+        ch = ch_ref[...].astype(jnp.int32)    # (1, R)
+        r = w.shape[1]
+        subl = jax.lax.broadcasted_iota(jnp.int32, (128, r), 0)
+        sel = (ch == subl // QC).astype(jnp.int32)
+        w3 = w[:QC, :].astype(jnp.int32)
+        wtile = jnp.concatenate([w3] * (128 // QC + 1), axis=0)[:128]
+        w128t = (wtile * sel).astype(jnp.int8)
+        iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, r), 0) % b
+        for k in range(nk):
+            cols = bins_ref[k * group:(k + 1) * group, :].astype(jnp.int32)
+            colrep = jnp.repeat(cols, b, axis=0)
+            onehot = (colrep == iota_gb).astype(jnp.int8)
+            part = jax.lax.dot_general(
+                onehot, w128t, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out_ref[k * group * b:(k + 1) * group * b] += part
+        return
+
+    return kern
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "kr", "group"))
+def q8_chin(bins_t, w_fm, ch, *, num_bins, kr=4096, group=8):
+    f, n = bins_t.shape
+    b = _round_up(num_bins, 64)
+    ft = _round_up(f, max(group, 8))
+    if ft != f:
+        bins_t = jnp.pad(bins_t, ((0, ft - f), (0, 0)))
+    grid = (1, n // kr)
+    return pl.pallas_call(
+        make_kernel(b, group, ft),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ft, kr), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, kr), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, kr), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((ft * b, 128), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * ft * b * n * 128,
+            bytes_accessed=ft * n + n * 9 + ft * b * 512,
+            transcendentals=0),
+    )(bins_t, w_fm, ch)
+
+
+def timed(name, fn, *args, reps=10, **kw):
+    try:
+        out = fn(*args, **kw)
+        _ = float(jnp.ravel(out)[0])
+    except Exception as e:
+        print(f"{name:28s} FAIL {str(e)[:90]}", flush=True)
+        return None
+    t0 = time.perf_counter()
+    for _i in range(reps):
+        out = fn(*args, **kw)
+    _ = float(jnp.ravel(out)[0])
+    print(f"{name:28s} {(time.perf_counter()-t0)/reps*1e3:9.2f} ms",
+          flush=True)
+    return out
+
+
+def main():
+    n, f, b = 10_502_144, 28, 255
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, b, (f, n)).astype(np.uint8))
+    ch_np = rng.randint(-1, 42, n).astype(np.int8)
+    wch_np = np.zeros((8, n), np.int8)
+    wch_np[0] = rng.randint(-127, 128, n)
+    wch_np[1] = rng.randint(0, 128, n)
+    wch_np[2] = 1
+    wch_np[3] = ch_np
+    wch = jnp.asarray(wch_np)
+    w_static = jnp.asarray(np.concatenate([wch_np[:3], np.zeros((5, n),
+                                                                np.int8)]))
+    ch = jnp.asarray(ch_np)[None, :]
+
+    # A: production (ch inside wch) + the .at[3].set cost it implies
+    @jax.jit
+    def prod_with_set(w, c):
+        w2 = w.at[3].set(c[0])
+        return build_histogram_pallas_leaves_q8(bins, w2[:3] * 0 + w2, c[0], num_bins=b)
+    timed("A prod (set + kernel)", prod_with_set, wch, ch)
+    timed("A2 prod kernel only",
+          lambda: build_histogram_pallas_leaves_q8(bins, wch, jnp.asarray(ch_np), num_bins=b))
+
+    # B: ch as separate (1, N) input — no per-wave wch write at all
+    o = timed("B ch-input kernel", q8_chin, bins, w_static, ch, num_bins=b)
+    if o is not None:
+        ref = build_histogram_pallas_leaves_q8(bins, wch, jnp.asarray(ch_np), num_bins=b)
+        got = np.asarray(o)[:f * 256].reshape(f, 256, 128)[
+            :, :b, :126].reshape(f, b, 42, 3).transpose(2, 0, 1, 3)
+        print("max diff vs prod:", np.abs(got - np.asarray(ref)).max())
+
+
+if __name__ == "__main__":
+    main()

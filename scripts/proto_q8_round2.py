@@ -184,7 +184,7 @@ def main():
     wch_fm = jnp.asarray(wch_np.T.copy())
 
     ref = timed("A prod q8", build_histogram_pallas_leaves_q8, bins_d, wch,
-                num_bins=b)
+                jnp.asarray(ch), num_bins=b)
     o16 = timed("B i16 cmp g8 kr2048", q8v, bins_d, wch, num_bins=b,
                 mode="i16")
     timed("B i16 cmp g8 kr1024", q8v, bins_d, wch, num_bins=b, mode="i16",

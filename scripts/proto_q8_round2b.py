@@ -184,7 +184,7 @@ def main():
     wch_fm = jnp.asarray(wch_np.T.copy())
 
     ref = timed("A prod q8", build_histogram_pallas_leaves_q8, bins_d, wch,
-                num_bins=b)
+                jnp.asarray(ch), num_bins=b)
     ofm = timed("D g8 kr2048", q8fm, bins_d, wch_fm, num_bins=b)
     for g, kr in ((8, 1024), (8, 4096), (4, 2048), (4, 4096), (16, 1024),
                   (16, 2048), (2, 2048)):

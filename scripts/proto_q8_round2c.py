@@ -184,7 +184,7 @@ def main():
     wch_fm = jnp.asarray(wch_np.T.copy())
 
     ref = timed("A prod q8", build_histogram_pallas_leaves_q8, bins_d, wch,
-                num_bins=b)
+                jnp.asarray(ch), num_bins=b)
     ofm = timed("D g8 kr4096", q8fm, bins_d, wch_fm, num_bins=b, kr=4096)
     for g, kr in ((8, 8192), (4, 8192), (2, 4096), (16, 4096)):
         timed(f"D g{g} kr{kr}", q8fm, bins_d, wch_fm, num_bins=b, group=g,

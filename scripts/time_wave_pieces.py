@@ -49,20 +49,18 @@ def main():
     wch_np = np.zeros((8, N), np.int8)
     wch_np[0], wch_np[1], wch_np[2] = gq, hq, 1
     wch = jnp.asarray(wch_np)
+    ch8 = jnp.asarray(rng.randint(-1, Q_LEAF_CHANNELS, N).astype(np.int8))
 
     # 1. q8 kernel
     timed("q8 kernel (42 leaves)",
-          lambda: build_histogram_pallas_leaves_q8(bins, wch, num_bins=255))
+          lambda: build_histogram_pallas_leaves_q8(bins, wch, ch8,
+                                                   num_bins=255))
 
     # 2. bf16 kernel
     w8 = pack_weights8(grad, hess, mask)
     ch25 = jnp.where(ch >= 25, -1, ch)
     timed("bf16 kernel (25 leaves)",
           lambda: build_histogram_pallas_leaves(bins, w8, ch25, num_bins=255))
-
-    # 3. wch channel set (feature-major: contiguous row write)
-    timed("wch .at[3].set(ch)",
-          jax.jit(lambda w, c: w.at[3].set(c.astype(jnp.int8))), wch, ch)
 
     # 4. row_leaf update loop (W=42 streaming masked updates)
     W = Q_LEAF_CHANNELS

@@ -49,10 +49,11 @@ def test_q8_kernel_interpret_exact():
     ch = rng.randint(-1, Q_LEAF_CHANNELS, n).astype(np.int8)
     cnt = (ch >= 0).astype(np.int8)
     wch = np.zeros((8, n), np.int8)
-    wch[0], wch[1], wch[2], wch[3] = gq, hq, cnt, ch
+    wch[0], wch[1], wch[2] = gq, hq, cnt
 
     hist = np.asarray(build_histogram_pallas_leaves_q8(
-        jnp.asarray(bins), jnp.asarray(wch), num_bins=b, interpret=True))
+        jnp.asarray(bins), jnp.asarray(wch), jnp.asarray(ch), num_bins=b,
+        interpret=True))
     assert hist.shape == (Q_LEAF_CHANNELS, f, b, 3)
     assert hist.dtype == np.int32
 
