@@ -35,12 +35,13 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib_path = os.path.join(cache, "libbinning.so")
     if (not os.path.exists(lib_path) or
             os.path.getmtime(lib_path) < os.path.getmtime(src)):
+        tmp = f"{lib_path}.{os.getpid()}.tmp"  # per-pid: no build races
         try:
             subprocess.run(
                 ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-                 src, "-o", lib_path + ".tmp"],
+                 src, "-o", tmp],
                 check=True, capture_output=True, timeout=120)
-            os.replace(lib_path + ".tmp", lib_path)
+            os.replace(tmp, lib_path)
         except Exception:
             return None
     try:
@@ -65,6 +66,44 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _TRIED = True
         _LIB = _build_and_load()
     return _LIB
+
+
+def build_capi_shim() -> Optional[str]:
+    """Compile the native ``LGBM_*`` ABI shim (native/capi_shim.cc) and
+    return the shared-library path, or None if the toolchain/headers are
+    unavailable.  The shim exports the reference's out-pointer calling
+    convention (c_api.h) as real C symbols backed by the embedded
+    interpreter; dlopen it from C/C++/ctypes and call LGBM_* directly.
+    """
+    import sysconfig
+    src = os.path.join(_NATIVE_DIR, "capi_shim.cc")
+    if not os.path.exists(src):
+        return None
+    cache = os.path.join(tempfile.gettempdir(),
+                         f"lgbm_tpu_native_{os.getuid()}")
+    os.makedirs(cache, exist_ok=True)
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    # python version in the name: a shim linked against another
+    # libpython must never be reused after an interpreter upgrade
+    lib_path = os.path.join(cache, f"liblightgbm_tpu_capi-py{ver}.so")
+    if (os.path.exists(lib_path) and
+            os.path.getmtime(lib_path) >= os.path.getmtime(src)):
+        return lib_path
+    tmp = f"{lib_path}.{os.getpid()}.tmp"  # per-pid: no build races
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
+           f"-I{inc}", "-o", tmp]
+    if libdir:
+        cmd += [f"-L{libdir}", f"-Wl,-rpath,{libdir}"]
+    cmd += [f"-lpython{ver}"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(tmp, lib_path)
+    except Exception:
+        return None
+    return lib_path
 
 
 def _ptr(a: np.ndarray, ct):

@@ -1,0 +1,389 @@
+// Native LGBM_* ABI shim: real extern "C" symbols with the reference's
+// out-pointer calling convention (reference: include/LightGBM/c_api.h),
+// backed by this framework's in-process Python surface
+// (lightgbm_tpu/capi.py) through an embedded CPython interpreter.
+//
+// Design: every exported function is a thin relay — scalars, strings and
+// RAW POINTER ADDRESSES cross into a Python helper prelude (defined
+// below) which wraps the addresses with ctypes+numpy, calls
+// lightgbm_tpu.capi, and writes results back through the caller's out
+// pointers.  Handles are the Python registry's integer ids cast to
+// void*.  The -1 + LGBM_GetLastError error contract is preserved
+// (strict ABI mode scoped around each helper call, so the in-process
+// Python capi's raise-by-default mode is untouched).
+//
+// Lifecycle: if a Python interpreter already exists in the process (the
+// common embedding test: ctypes.CDLL from Python), it is reused via
+// PyGILState; otherwise one is initialized and its GIL released so any
+// thread may call in.
+//
+// Build: utils/native.py build_capi_shim() —
+//   g++ -O2 -shared -fPIC capi_shim.cc $(python3-config --includes
+//   --ldflags --embed) -o liblightgbm_tpu_capi.so
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_mu;
+PyObject* g_helpers = nullptr;  // module dict holding the prelude
+// thread-local like the reference's last-error storage, so concurrent
+// callers never race on the message buffer
+thread_local std::string g_last_error = "ok";
+
+const char* safe_utf8(PyObject* s, const char* fallback) {
+  const char* c = s ? PyUnicode_AsUTF8(s) : nullptr;
+  if (c == nullptr) {
+    PyErr_Clear();
+    return fallback;
+  }
+  return c;
+}
+
+const char kPrelude[] = R"PY(
+import ctypes
+import numpy as np
+import lightgbm_tpu.capi as capi
+
+_DT = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+
+
+def _wrap(fn):
+    """-1 codes for the C surface: exceptions are caught HERE, so the
+    in-process Python capi keeps its raise-by-default mode untouched
+    (no global flag flip; safe under concurrent in-process users)."""
+    def inner(*args):
+        try:
+            return fn(*args)
+        except Exception as e:  # noqa: BLE001 — the ABI swallows into -1
+            capi._last_error[0] = f"{type(e).__name__}: {e}"
+            return (-1, 0, 0)
+    return inner
+
+
+def _mat(addr, data_type, nrow, ncol, is_row_major):
+    n = int(nrow) * int(ncol)
+    dt = _DT[int(data_type)]
+    buf = (ctypes.c_char * (n * np.dtype(dt).itemsize)).from_address(addr)
+    a = np.frombuffer(buf, dtype=dt, count=n)
+    return a.reshape((nrow, ncol)) if is_row_major else \
+        a.reshape((ncol, nrow)).T
+
+
+def _vec(addr, data_type, n):
+    dt = _DT[int(data_type)]
+    buf = (ctypes.c_char * (int(n) * np.dtype(dt).itemsize)).from_address(
+        addr)
+    return np.frombuffer(buf, dtype=dt, count=int(n))
+
+
+def _err():
+    return capi.LGBM_GetLastError()
+
+
+def dataset_from_mat(addr, data_type, nrow, ncol, is_row_major, params,
+                     ref):
+    X = np.array(_mat(addr, data_type, nrow, ncol, is_row_major),
+                 np.float64)
+    code, h = capi.LGBM_DatasetCreateFromMat(
+        X, params, reference=(ref or None))
+    return code, (h or 0)
+
+
+def dataset_set_field(handle, name, addr, num_element, data_type):
+    v = np.array(_vec(addr, data_type, num_element))
+    code, _ = capi.LGBM_DatasetSetField(handle, name, v)
+    return code, 0
+
+
+def dataset_free(handle):
+    code, _ = capi.LGBM_DatasetFree(handle)
+    return code, 0
+
+
+def booster_create(train_handle, params):
+    code, h = capi.LGBM_BoosterCreate(train_handle, params)
+    return code, (h or 0)
+
+
+def booster_from_modelfile(filename):
+    code, h = capi.LGBM_BoosterCreateFromModelfile(filename)
+    if code != 0:
+        return code, 0, 0
+    code2, it = capi.LGBM_BoosterGetCurrentIteration(h)
+    return code, (h or 0), (it or 0)
+
+
+def booster_update(handle):
+    code, fin = capi.LGBM_BoosterUpdateOneIter(handle)
+    return code, int(bool(fin))
+
+
+def booster_save(handle, start_iteration, num_iteration, filename):
+    code, _ = capi.LGBM_BoosterSaveModel(handle, filename,
+                                         start_iteration, num_iteration)
+    return code, 0
+
+
+def booster_free(handle):
+    code, _ = capi.LGBM_BoosterFree(handle)
+    return code, 0
+
+
+def booster_predict_into(handle, addr, data_type, nrow, ncol,
+                         is_row_major, predict_type, start_iteration,
+                         num_iteration, out_addr):
+    X = np.array(_mat(addr, data_type, nrow, ncol, is_row_major),
+                 np.float64)
+    code, out = capi.LGBM_BoosterPredictForMat(
+        handle, X, predict_type, start_iteration, num_iteration)
+    if code != 0:
+        return code, 0
+    out = np.atleast_1d(np.asarray(out, np.float64)).ravel()
+    np.copyto(_vec(out_addr, 1, len(out)), out)
+    return 0, len(out)
+
+
+for _n in ("dataset_from_mat", "dataset_set_field", "dataset_free",
+           "booster_create", "booster_from_modelfile", "booster_update",
+           "booster_save", "booster_free", "booster_predict_into"):
+    globals()[_n] = _wrap(globals()[_n])
+)PY";
+
+// Run one helper and unpack its (code, value...) tuple.  Caller holds
+// the GIL.
+PyObject* call_helper(const char* name, PyObject* args) {
+  PyObject* fn = PyDict_GetItemString(g_helpers, name);  // borrowed
+  if (fn == nullptr) {
+    g_last_error = std::string("helper missing: ") + name;
+    return nullptr;
+  }
+  PyObject* res = PyObject_CallObject(fn, args);
+  if (res == nullptr) {
+    PyObject *t, *v, *tb;
+    PyErr_Fetch(&t, &v, &tb);
+    PyObject* s = v ? PyObject_Str(v) : nullptr;
+    g_last_error = safe_utf8(s, "python exception");
+    Py_XDECREF(s);
+    Py_XDECREF(t);
+    Py_XDECREF(v);
+    Py_XDECREF(tb);
+    return nullptr;
+  }
+  return res;
+}
+
+bool fetch_py_error() {
+  // after a strict-ABI -1 the message lives in capi.LGBM_GetLastError
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = call_helper("_err", args);
+  Py_DECREF(args);
+  if (res != nullptr) {
+    if (PyUnicode_Check(res))
+      g_last_error = safe_utf8(res, "unavailable error message");
+    Py_DECREF(res);
+  }
+  return true;
+}
+
+int ensure_python() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  static bool owns_interp = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    owns_interp = true;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 0;
+  if (g_helpers == nullptr) {
+    PyObject* mod = PyModule_New("lightgbm_tpu_capi_shim");
+    PyObject* dict = PyModule_GetDict(mod);
+    PyDict_SetItemString(dict, "__builtins__", PyEval_GetBuiltins());
+    PyObject* res = PyRun_String(kPrelude, Py_file_input, dict, dict);
+    if (res == nullptr) {
+      PyObject *t, *v, *tb;
+      PyErr_Fetch(&t, &v, &tb);
+      PyObject* s = v ? PyObject_Str(v) : nullptr;
+      g_last_error = safe_utf8(
+          s, "failed to initialize lightgbm_tpu shim prelude");
+      Py_XDECREF(s);
+      Py_XDECREF(t);
+      Py_XDECREF(v);
+      Py_XDECREF(tb);
+      rc = -1;
+    } else {
+      Py_DECREF(res);
+      Py_INCREF(dict);
+      g_helpers = dict;
+    }
+    Py_DECREF(mod);
+  }
+  PyGILState_Release(gil);
+  if (owns_interp) {
+    // release the GIL the embedded init left held so any thread can
+    // PyGILState_Ensure later; do this exactly once
+    static bool released = false;
+    if (!released) {
+      released = true;
+      PyEval_SaveThread();
+    }
+  }
+  return rc;
+}
+
+// Relay returning `code` and writing up to two int64 outputs.
+int relay(const char* helper, PyObject* args, int64_t* out1,
+          int64_t* out2) {
+  if (ensure_python() != 0) {
+    Py_XDECREF(args);
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int code = -1;
+  PyObject* res = call_helper(helper, args);
+  Py_XDECREF(args);
+  if (res != nullptr && PyTuple_Check(res) && PyTuple_Size(res) >= 1) {
+    code = (int)PyLong_AsLong(PyTuple_GetItem(res, 0));
+    if (code == 0) {
+      if (out1 != nullptr && PyTuple_Size(res) >= 2)
+        *out1 = PyLong_AsLongLong(PyTuple_GetItem(res, 1));
+      if (out2 != nullptr && PyTuple_Size(res) >= 3)
+        *out2 = PyLong_AsLongLong(PyTuple_GetItem(res, 2));
+    } else {
+      fetch_py_error();
+    }
+  }
+  Py_XDECREF(res);
+  PyGILState_Release(gil);
+  return code;
+}
+
+PyObject* build_args(const char* fmt, ...) {
+  // must hold no GIL assumptions: ensure_python() first, then GIL
+  va_list ap;
+  va_start(ap, fmt);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* args = Py_VaBuildValue(fmt, ap);
+  PyGILState_Release(gil);
+  va_end(ap);
+  return args;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                              int32_t nrow, int32_t ncol,
+                              int is_row_major, const char* parameters,
+                              DatasetHandle reference,
+                              DatasetHandle* out) {
+  if (ensure_python() != 0) return -1;
+  PyObject* args = build_args(
+      "(LiiiisL)", (long long)(intptr_t)data, data_type, (int)nrow,
+      (int)ncol, is_row_major, parameters ? parameters : "",
+      (long long)(intptr_t)reference);
+  int64_t h = 0;
+  int code = relay("dataset_from_mat", args, &h, nullptr);
+  if (code == 0 && out != nullptr) *out = (DatasetHandle)(intptr_t)h;
+  return code;
+}
+
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element,
+                         int type) {
+  if (ensure_python() != 0) return -1;
+  PyObject* args = build_args(
+      "(LsLii)", (long long)(intptr_t)handle, field_name,
+      (long long)(intptr_t)field_data, num_element, type);
+  return relay("dataset_set_field", args, nullptr, nullptr);
+}
+
+int LGBM_DatasetFree(DatasetHandle handle) {
+  if (ensure_python() != 0) return -1;
+  PyObject* args = build_args("(L)", (long long)(intptr_t)handle);
+  return relay("dataset_free", args, nullptr, nullptr);
+}
+
+int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out) {
+  if (ensure_python() != 0) return -1;
+  PyObject* args = build_args("(Ls)", (long long)(intptr_t)train_data,
+                              parameters ? parameters : "");
+  int64_t h = 0;
+  int code = relay("booster_create", args, &h, nullptr);
+  if (code == 0 && out != nullptr) *out = (BoosterHandle)(intptr_t)h;
+  return code;
+}
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  if (ensure_python() != 0) return -1;
+  PyObject* args = build_args("(s)", filename ? filename : "");
+  int64_t h = 0, it = 0;
+  int code = relay("booster_from_modelfile", args, &h, &it);
+  if (code == 0) {
+    if (out != nullptr) *out = (BoosterHandle)(intptr_t)h;
+    if (out_num_iterations != nullptr) *out_num_iterations = (int)it;
+  }
+  return code;
+}
+
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
+  if (ensure_python() != 0) return -1;
+  PyObject* args = build_args("(L)", (long long)(intptr_t)handle);
+  int64_t fin = 0;
+  int code = relay("booster_update", args, &fin, nullptr);
+  if (code == 0 && is_finished != nullptr) *is_finished = (int)fin;
+  return code;
+}
+
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration,
+                          int feature_importance_type,
+                          const char* filename) {
+  (void)feature_importance_type;
+  if (ensure_python() != 0) return -1;
+  PyObject* args = build_args(
+      "(Liis)", (long long)(intptr_t)handle, start_iteration,
+      num_iteration, filename ? filename : "");
+  return relay("booster_save", args, nullptr, nullptr);
+}
+
+int LGBM_BoosterFree(BoosterHandle handle) {
+  if (ensure_python() != 0) return -1;
+  PyObject* args = build_args("(L)", (long long)(intptr_t)handle);
+  return relay("booster_free", args, nullptr, nullptr);
+}
+
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result) {
+  (void)parameter;
+  if (ensure_python() != 0) return -1;
+  PyObject* args = build_args(
+      "(LLiiiiiiiL)", (long long)(intptr_t)handle,
+      (long long)(intptr_t)data, data_type, (int)nrow, (int)ncol,
+      is_row_major, predict_type, start_iteration, num_iteration,
+      (long long)(intptr_t)out_result);
+  int64_t n = 0;
+  int code = relay("booster_predict_into", args, &n, nullptr);
+  if (code == 0 && out_len != nullptr) *out_len = n;
+  return code;
+}
+
+}  // extern "C"

@@ -1,0 +1,128 @@
+"""Native LGBM_* ABI shim tests (native/capi_shim.cc).
+
+The shim exports real C symbols with the reference's out-pointer
+calling convention (include/LightGBM/c_api.h); here it is dlopen'd via
+ctypes and driven exactly the way reference ctypes bindings drive the
+real liblightgbm — raw double* matrices in, handles and result buffers
+out.  Inside this test process the shim reuses the already-running
+interpreter through PyGILState."""
+
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.utils.native import build_capi_shim
+
+_SHIM = build_capi_shim()
+
+pytestmark = pytest.mark.skipif(
+    _SHIM is None, reason="native toolchain/python headers unavailable")
+
+
+def _load():
+    lib = ctypes.CDLL(_SHIM)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    lib.LGBM_DatasetCreateFromMat.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.LGBM_DatasetSetField.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_int]
+    lib.LGBM_BoosterCreate.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.LGBM_BoosterUpdateOneIter.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+    lib.LGBM_BoosterPredictForMat.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double)]
+    lib.LGBM_BoosterSaveModel.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p]
+    lib.LGBM_BoosterCreateFromModelfile.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_void_p)]
+    return lib
+
+
+def test_native_abi_train_predict_roundtrip(tmp_path):
+    lib = _load()
+    rng = np.random.RandomState(4)
+    X = np.ascontiguousarray(rng.randn(300, 4))
+    y = np.ascontiguousarray(
+        (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32))
+
+    dh = ctypes.c_void_p()
+    code = lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 300, 4, 1,
+        b"objective=binary verbosity=-1 min_data_in_leaf=5", None,
+        ctypes.byref(dh))
+    assert code == 0, lib.LGBM_GetLastError()
+    code = lib.LGBM_DatasetSetField(
+        dh, b"label", y.ctypes.data_as(ctypes.c_void_p), 300, 0)
+    assert code == 0, lib.LGBM_GetLastError()
+
+    bh = ctypes.c_void_p()
+    code = lib.LGBM_BoosterCreate(
+        dh, b"objective=binary num_leaves=7 verbosity=-1 "
+            b"min_data_in_leaf=5", ctypes.byref(bh))
+    assert code == 0, lib.LGBM_GetLastError()
+    fin = ctypes.c_int(0)
+    for _ in range(5):
+        assert lib.LGBM_BoosterUpdateOneIter(bh, ctypes.byref(fin)) == 0
+
+    out = np.zeros(300, np.float64)
+    out_len = ctypes.c_int64(0)
+    code = lib.LGBM_BoosterPredictForMat(
+        bh, X.ctypes.data_as(ctypes.c_void_p), 1, 300, 4, 1, 0, 0, -1,
+        b"", ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert code == 0, lib.LGBM_GetLastError()
+    assert out_len.value == 300
+    assert np.isfinite(out).all() and 0 < out.mean() < 1
+    # the model learned something
+    auc_ord = np.argsort(out)
+    assert y[auc_ord[-50:]].mean() > y[auc_ord[:50]].mean()
+
+    # model file round trip through the ABI, checked against python API
+    model = str(tmp_path / "native_model.txt").encode()
+    assert lib.LGBM_BoosterSaveModel(bh, 0, -1, 0, model) == 0
+    it = ctypes.c_int(0)
+    bh2 = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreateFromModelfile(
+        model, ctypes.byref(it), ctypes.byref(bh2)) == 0
+    assert it.value == 5
+    out2 = np.zeros(300, np.float64)
+    assert lib.LGBM_BoosterPredictForMat(
+        bh2, X.ctypes.data_as(ctypes.c_void_p), 1, 300, 4, 1, 0, 0, -1,
+        b"", ctypes.byref(out_len),
+        out2.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    np.testing.assert_allclose(out2, out, rtol=1e-9)
+
+    import lightgbm_tpu as lgb
+    py_pred = lgb.Booster(model_file=model.decode()).predict(X)
+    np.testing.assert_allclose(out, py_pred, rtol=1e-7, atol=1e-9)
+
+    # float32 column-major input path
+    X32 = np.asfortranarray(X.astype(np.float32))
+    out3 = np.zeros(300, np.float64)
+    assert lib.LGBM_BoosterPredictForMat(
+        bh, X32.ctypes.data_as(ctypes.c_void_p), 0, 300, 4, 0, 0, 0, -1,
+        b"", ctypes.byref(out_len),
+        out3.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    np.testing.assert_allclose(out3, out, rtol=1e-5, atol=1e-6)
+
+    # error contract through the ABI
+    bad = ctypes.c_void_p()
+    code = lib.LGBM_BoosterCreate(ctypes.c_void_p(99999), b"",
+                                  ctypes.byref(bad))
+    assert code == -1
+    assert b"handle" in lib.LGBM_GetLastError()
+
+    assert lib.LGBM_BoosterFree(bh) == 0
+    assert lib.LGBM_BoosterFree(bh2) == 0
+    assert lib.LGBM_DatasetFree(dh) == 0
