@@ -228,3 +228,28 @@ def test_quantized_warns_and_falls_back_off_wave(capsys):
                 verbosity=1)
     bst = lgb.train(p, lgb.Dataset(X, y), num_boost_round=3)
     assert np.isfinite(bst.predict(X)).all()
+
+
+def test_quantized_with_efb_sparse():
+    """Quantized histograms over EFB bundle columns (bundle-space bins
+    feed the q8 kernel emulation; sparse ingest stays sparse)."""
+    import scipy.sparse as sp
+    rng = np.random.RandomState(9)
+    n = 3000
+    # 8 one-hot groups of 5 mutually-exclusive columns: truly disjoint
+    # sparsity, the shape EFB exists for
+    cats = rng.randint(0, 5, (n, 8))
+    Xd = np.zeros((n, 40))
+    for g in range(8):
+        Xd[np.arange(n), g * 5 + cats[:, g]] = rng.rand(n) + 0.5
+    y = ((Xd[:, 0] + Xd[:, 7] - Xd[:, 12] + 0.3 * rng.randn(n)) > 0.2
+         ).astype(np.float64)
+    X = sp.csr_matrix(Xd)
+    p = _params(use_quantized_grad=True, num_grad_quant_bins=254,
+                quant_train_renew_leaf=True, num_leaves=15)
+    bst = lgb.train(p, lgb.Dataset(X, y), 8)
+    assert bst._gbdt.train_set.efb is not None, "EFB should engage"
+    ll_q = _logloss(y, bst.predict(Xd))
+    bste = lgb.train(_params(num_leaves=15), lgb.Dataset(X, y), 8)
+    ll_e = _logloss(y, bste.predict(Xd))
+    assert ll_q < ll_e * 1.08 + 1e-3
