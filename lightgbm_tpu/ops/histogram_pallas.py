@@ -222,7 +222,8 @@ def build_histogram_pallas(bins_t: jnp.ndarray, grad: jnp.ndarray,
 @jax.jit
 def pack_weights8(grad: jnp.ndarray, hess: jnp.ndarray,
                   mask: jnp.ndarray) -> jnp.ndarray:
-    """(N, 8) bf16 weight rows [g_hi, g_lo, h_hi, h_lo, count, 0, 0, 0].
+    """(8, N) bf16 FEATURE-MAJOR weight rows [g_hi, g_lo, h_hi, h_lo,
+    count, 0, 0, 0].
 
     Precompute once per tree: gradients do not change across waves, only
     the per-row leaf channel does.  ``mask`` may carry bagging weights
@@ -235,38 +236,41 @@ def pack_weights8(grad: jnp.ndarray, hess: jnp.ndarray,
     h_hi, h_lo = _split_hi_lo(hm)
     z = jnp.zeros_like(g_hi)
     return jnp.stack([g_hi, g_lo, h_hi, h_lo,
-                      (mask > 0).astype(jnp.bfloat16), z, z, z], axis=-1)
+                      (mask > 0).astype(jnp.bfloat16), z, z, z], axis=0)
 
 
 def _hist_leaves_kernel(bins_ref, w_ref, ch_ref, out_ref, *,
                         num_features: int, num_bins: int, group: int,
                         fstep: int):
     """Accumulate (F*B, 128) lane-packed leaf histograms over one row
-    block (25 leaves x 5 channels in the 128-lane dimension)."""
+    block (25 leaves x 5 channels in the 128-lane dimension).
+
+    Same feature-major rhs-transposed form as the q8 kernel (the dot
+    contracts dim 1 of BOTH operands) — measured 120 ms vs 165 ms for
+    the row-major lhs-major form at 10.5M x 28 x 256."""
     @pl.when(pl.program_id(1) == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    w = w_ref[...]                      # (R, 8) bf16
-    ch = ch_ref[...]                    # (R, 1) int32
-    r = w.shape[0]
+    w = w_ref[...]                      # (8, R) bf16 feature-major
+    ch = ch_ref[...]                    # (1, R) int32
+    r = w.shape[1]
     b = num_bins
 
-    # Expand (R, 8) weights into (R, 128): lane l carries weight channel
-    # l%_CB iff this row's leaf channel == l//_CB.  All arithmetic — Mosaic
-    # cannot relayout i1 masks between lane-/sublane-replicated operands,
-    # so the equality select is ``relu(1 - |ch - leaf_of_lane|)`` (exactly
-    # 1.0 on match, 0.0 otherwise for integer distances) and the channel
-    # tiling is a lane concatenate (sliced to 128; the last 128 - 25*5 = 3
-    # lanes select leaf 25 which no row carries -> zero).  Pure VPU work,
-    # no gather.
-    lane = jax.lax.broadcasted_iota(jnp.int32, (r, 128), 1)
-    leaf_of_lane = lane // _CB
-    d = (ch - leaf_of_lane).astype(jnp.float32)     # (R, 128) via broadcast
+    # Expand (8, R) weights into (128, R): sublane l carries weight
+    # channel l%_CB iff the row's leaf channel == l//_CB.  All arithmetic
+    # — Mosaic cannot relayout i1 masks between replicated operand
+    # orientations, so the equality select is ``relu(1 - |ch - leaf|)``
+    # (exactly 1.0 on match for integer distances); channel tiling is a
+    # sublane concatenate sliced to 128 (the last 3 sublanes select leaf
+    # 25 which no row carries -> zero).  Pure VPU work, no gather.
+    subl = jax.lax.broadcasted_iota(jnp.int32, (128, r), 0)
+    leaf_of_subl = subl // _CB
+    d = (ch - leaf_of_subl).astype(jnp.float32)     # (128, R) broadcast
     sel = jnp.maximum(0.0, 1.0 - jnp.abs(d)).astype(jnp.bfloat16)
-    w5 = w[:, :_CB]
-    wtile = jnp.concatenate([w5] * (128 // _CB + 1), axis=1)[:, :128]
-    w128 = wtile * sel
+    w5 = w[:_CB, :]
+    wtile = jnp.concatenate([w5] * (128 // _CB + 1), axis=0)[:128]
+    w128t = wtile * sel                              # (128, R)
 
     iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, r), 0) % b
 
@@ -278,7 +282,7 @@ def _hist_leaves_kernel(bins_ref, w_ref, ch_ref, out_ref, *,
             colrep = jnp.repeat(cols, b, axis=0)                 # (g*B, R)
             onehot = (colrep == iota_gb).astype(jnp.bfloat16)
             part = jax.lax.dot_general(
-                onehot, w128, (((1,), (0,)), ((), ())),
+                onehot, w128t, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)              # (g*B, 128)
             out_ref[pl.ds((f0 + k * group) * b, group * b)] += part
         return carry
@@ -296,8 +300,8 @@ def build_histogram_pallas_leaves(bins_t: jnp.ndarray, w8: jnp.ndarray,
 
     Args:
       bins_t: (F, N) integer bin codes, N a multiple of ``row_block``.
-      w8: (N, 8) bf16 weight rows from :func:`pack_weights8`.
-      ch: (N,) int32 leaf channel in [0, LEAF_CHANNELS), or -1 for rows
+      w8: (8, N) bf16 FEATURE-MAJOR weight rows from :func:`pack_weights8`.
+      ch: (N,) integer leaf channel in [0, LEAF_CHANNELS), or -1 for rows
         that belong to no batched leaf (they contribute nothing).
       num_bins: static global bin count B.
     """
@@ -307,25 +311,25 @@ def build_histogram_pallas_leaves(bins_t: jnp.ndarray, w8: jnp.ndarray,
                          f"got N={n} (use pad_rows)")
     b = _round_up(num_bins, 64)
     group = next((g for g in (2, 4, 8) if (g * b) % 128 == 0), 1)
-    while group * 2 <= f and group * 2 * b <= 512:
+    while group * 2 <= f and group * 2 * b <= 1024:
         group *= 2
     if group > f or (group * b) % 128 != 0:
         b = _round_up(num_bins, 128)
         group = 1
 
-    ch2 = ch.astype(jnp.int32)[:, None]                    # (N, 1)
+    ch2 = ch.astype(jnp.int32).reshape(1, n)               # (1, N)
 
     # The (ft*b, 128) f32 accumulator must stay well inside VMEM next to
-    # the bins / weight blocks; 8192 sublanes (4 MiB) measured best at
-    # Higgs scale (one feature tile for F=28/B=256: 229 ms vs 257 ms with
-    # two tiles; kr/group sweeps were flat within 15%).
+    # the bins / weight blocks (cap 8192 sublanes); kr=4096 + M<=1024
+    # measured best for the bf16 form at Higgs scale (proto_bf16_fm.py:
+    # 120 ms vs 165 ms for the old row-major kr=1024 layout).
     fstep = max(group, 8)
     ft_cap = max(fstep, 8192 // b // fstep * fstep)
     ft = min(_round_up(f, fstep), ft_cap)
     f_pad = _round_up(f, ft)
     if f_pad != f:
         bins_t = jnp.pad(bins_t, ((0, f_pad - f), (0, 0)))
-    kr = math.gcd(row_block, 1024)
+    kr = math.gcd(row_block, 4096)
 
     grid = (f_pad // ft, n // kr)
     out = pl.pallas_call(
@@ -335,9 +339,9 @@ def build_histogram_pallas_leaves(bins_t: jnp.ndarray, w8: jnp.ndarray,
         in_specs=[
             pl.BlockSpec((ft, kr), lambda i, j: (i, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((kr, _C), lambda i, j: (j, 0),
+            pl.BlockSpec((_C, kr), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((kr, 1), lambda i, j: (j, 0),
+            pl.BlockSpec((1, kr), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),

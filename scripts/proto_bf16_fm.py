@@ -1,0 +1,150 @@
+"""Probe: feature-major rhs-T layout for the EXACT bf16 leaves kernel
+(mirror of the q8 win; the default non-quantized path).
+
+HISTORICAL NOTE: the production kernel ADOPTED this layout (commit
+after this probe measured 120 ms vs 165 ms), so "A prod bf16" now
+measures the same feature-major form as B — the 165 ms row-major
+baseline lives only in PERF.md / git history."""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lightgbm_tpu.ops.histogram_pallas import (
+    build_histogram_pallas_leaves, pack_weights8, _split_hi_lo)
+
+CB = 5  # g_hi, g_lo, h_hi, h_lo, count
+LEAVES = 128 // CB
+
+
+def _round_up(x, m):
+    return -(-x // m) * m
+
+
+def make_kernel(b, group, ft):
+    nk = ft // group
+
+    def kern(bins_ref, w_ref, ch_ref, out_ref):
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        w = w_ref[...]                        # (8, R) bf16 feature-major
+        ch = ch_ref[...].astype(jnp.int32)    # (1, R)
+        r = w.shape[1]
+        subl = jax.lax.broadcasted_iota(jnp.int32, (128, r), 0)
+        sel = (ch == subl // CB).astype(jnp.bfloat16)
+        w5 = w[:CB, :]
+        wtile = jnp.concatenate([w5] * (128 // CB + 1), axis=0)[:128]
+        w128t = wtile * sel                   # (128, R) bf16
+        iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, r), 0) % b
+        for k in range(nk):
+            cols = bins_ref[k * group:(k + 1) * group, :].astype(jnp.int32)
+            colrep = jnp.repeat(cols, b, axis=0)
+            onehot = (colrep == iota_gb).astype(jnp.bfloat16)
+            part = jax.lax.dot_general(
+                onehot, w128t, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            out_ref[k * group * b:(k + 1) * group * b] += part
+        return
+
+    return kern
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "kr", "group"))
+def bf16_fm(bins_t, w_fm, ch, *, num_bins, kr=2048, group=4):
+    f, n = bins_t.shape
+    b = _round_up(num_bins, 64)
+    ft = _round_up(f, max(group, 8))
+    if ft != f:
+        bins_t = jnp.pad(bins_t, ((0, ft - f), (0, 0)))
+    grid = (1, n // kr)
+    return pl.pallas_call(
+        make_kernel(b, group, ft),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ft, kr), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, kr), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, kr), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ft * b, 128), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((ft * b, 128), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * ft * b * n * 128,
+            bytes_accessed=ft * n + n * 17 + ft * b * 512,
+            transcendentals=0),
+    )(bins_t, w_fm, ch)
+
+
+def timed(name, fn, *args, reps=10, **kw):
+    try:
+        out = fn(*args, **kw)
+        _ = float(jnp.ravel(out)[0])
+    except Exception as e:
+        print(f"{name:26s} FAIL {str(e)[:90]}", flush=True)
+        return None
+    t0 = time.perf_counter()
+    for _i in range(reps):
+        out = fn(*args, **kw)
+    _ = float(jnp.ravel(out)[0])
+    print(f"{name:26s} {(time.perf_counter()-t0)/reps*1e3:9.2f} ms",
+          flush=True)
+    return out
+
+
+def main():
+    n, f, b = 10_502_144, 28, 255
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, b, (f, n)).astype(np.uint8))
+    grad = jnp.asarray(rng.randn(n).astype(np.float32))
+    hess = jnp.asarray(np.abs(rng.randn(n)).astype(np.float32))
+    mask = jnp.ones((n,), jnp.float32)
+    ch_np = rng.randint(-1, LEAVES, n).astype(np.int8)
+    ch25 = jnp.asarray(ch_np.astype(np.int32))
+
+    w8 = pack_weights8(grad, hess, mask)      # (8, N) feature-major
+    t_base = timed("A prod bf16 (25/pass)",
+                   lambda: build_histogram_pallas_leaves(
+                       bins, w8, ch25, num_bins=b))
+
+    @jax.jit
+    def pack_fm(grad, hess, mask):
+        gm = grad * mask
+        hm = hess * mask
+        g_hi, g_lo = _split_hi_lo(gm)
+        h_hi, h_lo = _split_hi_lo(hm)
+        z = jnp.zeros_like(g_hi)
+        return jnp.stack([g_hi, g_lo, h_hi, h_lo,
+                          (mask > 0).astype(jnp.bfloat16), z, z, z], axis=0)
+
+    w_fm = pack_fm(grad, hess, mask)
+    ch1 = jnp.asarray(ch_np)[None, :]
+    for g, kr in ((4, 2048), (2, 2048), (4, 4096), (8, 2048), (2, 4096),
+                  (8, 4096)):
+        o = timed(f"B fm rhsT g{g} kr{kr}", bf16_fm, bins, w_fm, ch1,
+                  num_bins=b, group=g, kr=kr)
+        if o is not None and g == 4 and kr == 2048:
+            ref = build_histogram_pallas_leaves(bins, w8, ch25, num_bins=b)
+            got = np.asarray(o)[:f * 256].reshape(f, 256, 128)[
+                :, :b, :125].reshape(f, b, 25, 5)
+            hist = np.stack([got[..., 0] + got[..., 1],
+                             got[..., 2] + got[..., 3],
+                             got[..., 4]], axis=-1).transpose(2, 0, 1, 3)
+            print("max diff vs prod:",
+                  np.abs(hist - np.asarray(ref)).max())
+
+
+if __name__ == "__main__":
+    main()

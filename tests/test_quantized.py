@@ -70,6 +70,45 @@ def test_q8_kernel_interpret_exact():
             np.testing.assert_array_equal(hist[q, j, :, 2], ref_c[:b])
 
 
+def test_bf16_leaves_kernel_interpret_exact():
+    """Exact bf16 hi/lo leaves kernel (interpret) == numpy bincount to
+    f32 precision — guards the feature-major rhs-T layout."""
+    from lightgbm_tpu.ops.histogram_pallas import (
+        LEAF_CHANNELS, build_histogram_pallas_leaves, pack_weights8,
+        pad_rows)
+    rng = np.random.RandomState(2)
+    f, b = 5, 64
+    n = pad_rows(5000)
+    bins = rng.randint(0, b, (f, n)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.abs(rng.randn(n)).astype(np.float32)
+    mask = (rng.rand(n) < 0.8).astype(np.float32)
+    ch = rng.randint(-1, LEAF_CHANNELS, n).astype(np.int32)
+
+    w8 = pack_weights8(jnp.asarray(grad), jnp.asarray(hess),
+                       jnp.asarray(mask))
+    assert np.asarray(w8).shape == (8, n)
+    hist = np.asarray(build_histogram_pallas_leaves(
+        jnp.asarray(bins), w8, jnp.asarray(ch), num_bins=b,
+        interpret=True))
+    assert hist.shape == (LEAF_CHANNELS, f, b, 3)
+    gm = (grad * mask).astype(np.float64)
+    hm = (hess * mask).astype(np.float64)
+    for q in (0, LEAF_CHANNELS - 1):
+        m = ch == q
+        for j in (0, f - 1):
+            ref_g = np.bincount(bins[j][m], weights=gm[m], minlength=b)
+            ref_h = np.bincount(bins[j][m], weights=hm[m], minlength=b)
+            ref_c = np.bincount(bins[j][m],
+                                weights=(mask[m] > 0).astype(np.float64),
+                                minlength=b)
+            np.testing.assert_allclose(hist[q, j, :, 0], ref_g[:b],
+                                       rtol=1e-5, atol=1e-4)
+            np.testing.assert_allclose(hist[q, j, :, 1], ref_h[:b],
+                                       rtol=1e-5, atol=1e-4)
+            np.testing.assert_array_equal(hist[q, j, :, 2], ref_c[:b])
+
+
 def test_wave_row_update_kernel_matches_reference():
     """Pallas row-update kernel (interpret) == the masked-where loop."""
     from lightgbm_tpu.ops.histogram_pallas import (pad_rows,
