@@ -292,3 +292,26 @@ def test_quantized_with_efb_sparse():
     bste = lgb.train(_params(num_leaves=15), lgb.Dataset(X, y), 8)
     ll_e = _logloss(y, bste.predict(Xd))
     assert ll_q < ll_e * 1.08 + 1e-3
+
+
+def test_quantized_multiclass_and_dart():
+    rng = np.random.RandomState(12)
+    n = 2500
+    X = rng.randn(n, 6).astype(np.float32)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.4).astype(int)
+         ).astype(np.float64)
+    p = _params(objective="multiclass", num_class=3,
+                use_quantized_grad=True, num_grad_quant_bins=254,
+                quant_train_renew_leaf=True, num_leaves=15)
+    bst = lgb.train(p, lgb.Dataset(X, y), 6)
+    proba = bst.predict(X)
+    assert proba.shape == (n, 3)
+    assert np.allclose(proba.sum(1), 1.0, atol=1e-5)
+    acc = (proba.argmax(1) == y).mean()
+    assert acc > 0.7, acc
+
+    yb = (y > 0).astype(np.float64)
+    pd = _params(boosting="dart", use_quantized_grad=True,
+                 num_grad_quant_bins=254, num_leaves=15)
+    bd = lgb.train(pd, lgb.Dataset(X, yb), 8)
+    assert np.isfinite(bd.predict(X)).all()
