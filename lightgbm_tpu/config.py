@@ -406,9 +406,7 @@ class Config:
 # `deterministic` is intentionally absent: training is deterministic by
 # construction (fixed seeds, static schedules, no atomics), which satisfies
 # the flag's contract without a switch.
-_UNIMPLEMENTED_PARAMS: Tuple[str, ...] = (
-    "cegb_penalty_feature_lazy",
-)
+_UNIMPLEMENTED_PARAMS: Tuple[str, ...] = ()
 
 
 def warn_unimplemented_params(config: "Config") -> None:

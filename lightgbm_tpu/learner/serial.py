@@ -579,11 +579,8 @@ def split_params_from_config(config: Config,
         np.any(np.asarray(is_cat) &
                (np.asarray(num_bins) > int(config.max_cat_to_onehot))))
     use_cegb = bool(config.cegb_penalty_split > 0.0 or
-                    config.cegb_penalty_feature_coupled)
-    if config.cegb_penalty_feature_lazy:
-        from ..utils.log import log_warning
-        log_warning("cegb_penalty_feature_lazy is not implemented (split "
-                    "and coupled penalties are); ignoring")
+                    config.cegb_penalty_feature_coupled or
+                    config.cegb_penalty_feature_lazy)
     return SplitParams(
         lambda_l1=float(config.lambda_l1),
         lambda_l2=float(config.lambda_l2),
@@ -652,7 +649,7 @@ class SerialTreeLearner:
                  monotone: Optional[np.ndarray] = None,
                  forced_splits: tuple = (), efb=None,
                  interaction_groups: tuple = (),
-                 feature_contri: tuple = ()):
+                 feature_contri: tuple = (), cegb_lazy: tuple = ()):
         self.config = config
         self.efb = efb
         if efb is not None:
@@ -699,6 +696,7 @@ class SerialTreeLearner:
         forced_splits = tuple(tuple(f) for f in forced_splits)
         interaction_groups = tuple(tuple(g) for g in interaction_groups)
         feature_contri = tuple(float(v) for v in feature_contri)
+        cegb_lazy = tuple(float(v) for v in cegb_lazy)
         wave_ok = (self.use_hist_pool and not forced_splits and
                    int(config.num_leaves) > 2)
         mode = str(config.tree_grow_mode)
@@ -711,6 +709,12 @@ class SerialTreeLearner:
         elif mode == "auto":
             mode = "wave" if (wave_ok and impl == "pallas") else "partition"
         self.grow_mode = mode if self.use_hist_pool else "masked"
+        self._use_lazy = bool(cegb_lazy) and self.grow_mode == "wave"
+        self._lazy_used = None
+        if cegb_lazy and self.grow_mode != "wave":
+            from ..utils.log import log_warning
+            log_warning("cegb_penalty_feature_lazy is applied by the wave "
+                        "grower only; this grower ignores it")
         self.quantized = bool(config.use_quantized_grad) and \
             self.grow_mode == "wave"
         if config.use_quantized_grad and not self.quantized:
@@ -732,7 +736,7 @@ class SerialTreeLearner:
             key = ("wave", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
                    impl, any_cat, wave_size, self._efb_dims, feature_contri,
-                   qtuple, interaction_groups)
+                   qtuple, interaction_groups, cegb_lazy)
             if key not in _GROW_FN_CACHE:
                 from .wave import make_wave_grow_fn
                 _cache_put(key, make_wave_grow_fn(
@@ -745,7 +749,8 @@ class SerialTreeLearner:
                     quantized=self.quantized, gq_max=gq_max, hq_max=hq_max,
                     renew_leaf=bool(config.quant_train_renew_leaf),
                     stochastic=bool(config.stochastic_rounding),
-                    interaction_groups=interaction_groups))
+                    interaction_groups=interaction_groups,
+                    cegb_lazy=cegb_lazy))
             self._grow = _cache_hit(key)
         elif self.partitioned:
             key = ("part", int(config.num_leaves), num_features,
@@ -805,6 +810,7 @@ class SerialTreeLearner:
         else:
             n_pad = n
         if self._x_src is not X_dev:  # strong ref: ids can be recycled
+            self._lazy_used = None  # fresh data -> fresh used bitmap
             Xp = jnp.pad(X_dev, ((0, n_pad - n), (0, 0))) \
                 if n_pad != n else X_dev
             if self.grow_mode == "wave":
@@ -833,10 +839,23 @@ class SerialTreeLearner:
             if self.split_params.feature_fraction_bynode < 1.0 or \
                     self.split_params.extra_trees:
                 kw["node_key"] = node_key
-            grown = self._grow(self._XpT, grad, hess, sample_mask,
-                               self.num_bins, self.is_cat, self.has_nan,
-                               self.monotone, cegb_penalty,
-                               self._efb_args, feature_mask, **kw)
+            if self._use_lazy:
+                # the used-feature bitmap persists across trees (the
+                # reference's feature_used_in_data_ lives for the whole
+                # training run)
+                if self._lazy_used is None or \
+                        self._lazy_used.shape[1] != n_pad:
+                    self._lazy_used = jnp.zeros(
+                        (self.num_features, n_pad), jnp.bool_)
+                kw["lazy_used"] = self._lazy_used
+            out = self._grow(self._XpT, grad, hess, sample_mask,
+                             self.num_bins, self.is_cat, self.has_nan,
+                             self.monotone, cegb_penalty,
+                             self._efb_args, feature_mask, **kw)
+            if self._use_lazy:
+                grown, self._lazy_used = out
+            else:
+                grown = out
         else:
             grown = self._grow(self._Xp, grad, hess, sample_mask,
                                self.num_bins, self.is_cat, self.has_nan,

@@ -64,7 +64,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                       strategy=None, quantized: bool = False,
                       gq_max: int = 127, hq_max: int = 127,
                       renew_leaf: bool = False, stochastic: bool = True,
-                      interaction_groups: tuple = ()):
+                      interaction_groups: tuple = (),
+                      cegb_lazy: tuple = ()):
     """Build the wave single-tree grower.
 
     Returned signature matches the partitioned grower:
@@ -106,6 +107,16 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
     use_bynode = sp.feature_fraction_bynode < 1.0
     use_et = sp.extra_trees
     use_ic = len(interaction_groups) > 0
+    # CEGB lazy feature costs (cost_effective_gradient_boosting.hpp
+    # CalculateOndemandCosts): penalty[f] per row in the candidate leaf
+    # whose feature f has not yet been computed (used by any split on the
+    # row's path).  The wave grower keeps rows in original order, so the
+    # per-(feature, child) unused counts are small matvecs against the
+    # (F, N) used bitmap.  ``cegb_lazy`` arrives pre-scaled by
+    # cegb_tradeoff (like the coupled penalties).
+    use_lazy = len(cegb_lazy) > 0
+    if use_lazy:
+        lazy_pen = jnp.asarray(cegb_lazy, jnp.float32)       # (F,)
     if use_bynode:
         import math as _math
         kcnt = max(1, int(_math.ceil(F * sp.feature_fraction_bynode)))
@@ -136,7 +147,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
              monotone: jnp.ndarray, cegb_penalty: jnp.ndarray,
              efb_arrays: tuple, feature_mask: jnp.ndarray,
              quant_key: jnp.ndarray = None,
-             node_key: jnp.ndarray = None) -> GrownTree:
+             node_key: jnp.ndarray = None,
+             lazy_used: jnp.ndarray = None):
         n = X_T.shape[1]
         if strategy is not None:
             # shallow per-trace copy: traced array attributes must not
@@ -240,12 +252,31 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             return bundle_decode(v.astype(jnp.int32), feat)
 
         def many_candidates(hists, sums, bounds, depths, pouts, fms,
-                            rbs=None):
+                            rbs=None, cegb2=None):
             """Best-split candidates for k leaves in one vmapped scan.
             ``fms`` is the per-child feature mask (k, F); ``rbs`` the
-            per-child ExtraTrees random threshold bins (k, F) or None."""
+            per-child ExtraTrees random threshold bins (k, F) or None;
+            ``cegb2`` an optional per-child (k, F) CEGB penalty vector
+            (lazy costs) overriding the shared one."""
             cegb = getattr(strat, "cegb_full", None)
             contri = getattr(strat, "contri_full", None)
+            if cegb2 is not None:
+                if rbs is None:
+                    def one(h, s, bd, d, po, fm, cg):
+                        return local_best_candidate(
+                            h, s, nb_full, ic_full, hn_full, fm, sp,
+                            monotone, bd if use_mc else None, d, cg,
+                            contri, po)
+                    return jax.vmap(one)(hists, sums, bounds, depths,
+                                         pouts, fms, cegb2)
+
+                def one(h, s, bd, d, po, fm, cg, rb):
+                    return local_best_candidate(
+                        h, s, nb_full, ic_full, hn_full, fm, sp,
+                        monotone, bd if use_mc else None, d, cg, contri,
+                        po, rb)
+                return jax.vmap(one)(hists, sums, bounds, depths, pouts,
+                                     fms, cegb2, rbs)
             if rbs is None:
                 def one(h, s, bd, d, po, fm):
                     return local_best_candidate(
@@ -311,6 +342,10 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
         if use_bynode:
             fm_root = fm_root & node_mask_many(rid)[0]
         rb_root = node_rand_many(rid)[0] if use_et else None
+        if use_lazy:
+            # every root row is unused for every feature
+            base = strat.cegb_full if strat.cegb_full is not None else 0.0
+            strat.cegb_full = base + lazy_pen * root_sum[2]
         cand = strat.leaf_candidates(expand_hist(root_hist_f, root_sum),
                                      root_sum, fm_root, sp,
                                      root_bound, jnp.asarray(0, jnp.int32),
@@ -357,6 +392,15 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             # features used on the path to each leaf (interaction
             # constraints restrict children to compatible groups)
             state["leaf_path"] = jnp.zeros((L, F), jnp.bool_)
+        if use_lazy:
+            # per-(feature, row) "already computed" bitmap — PERSISTENT
+            # across trees like the reference's feature_used_in_data_
+            # bitset (it is allocated once per training run and never
+            # cleared); the learner threads it through every grow call.
+            # Kept as bool (1 byte per cell) — bit-packing would cut HBM
+            # 8x for very wide lazy-penalized datasets.
+            state["used"] = lazy_used if lazy_used is not None \
+                else jnp.zeros((F, n), jnp.bool_)
 
         jarange = jnp.arange(W, dtype=jnp.int32)
 
@@ -396,6 +440,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
 
             # ---- row_leaf + wave-channel update ----
             rl = s["row_leaf"]
+            rl_old = rl
             if pallas and small_bins and not any_cat:
                 # one fused kernel pass instead of W masked XLA sweeps
                 # (each sweep's fused-loop launch overhead alone costs
@@ -486,8 +531,52 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             if use_bynode:
                 fm2 = fm2 & node_mask_many(ids2)
             rb2 = node_rand_many(ids2) if use_et else None
+            cegb2 = None
+            if use_lazy:
+                # 1) mark the wave's split features as computed for every
+                # parent row (the reference marks the split leaf's rows,
+                # cost_effective_gradient_boosting.hpp:111-121) BEFORE the
+                # children scans, which must see the updated bitmap
+                used_b = s["used"]
+                slz = sel_leaves.astype(rl_old.dtype)
+                in_bag = bag_mask > 0
+                for j in range(W):
+                    # only in-bag rows: the reference marks via the
+                    # bagged DataPartition's GetIndexOnLeaf
+                    m = sel[j] & (rl_old == slz[j]) & in_bag
+                    used_b = used_b.at[feat[j]].set(used_b[feat[j]] | m)
+                # 2) per-(feature, child) unused counts: grouped matvecs
+                # against the bitmap (counts are exact: 0/1 bf16 products,
+                # f32 accumulation)
+                live2 = jnp.concatenate([sel, sel])
+                cid2 = jnp.where(live2, jnp.concatenate(
+                    [sel_leaves, new_ids]), -2)
+                pad_c = (-cid2.shape[0]) % 7
+                if pad_c:
+                    cid2 = jnp.concatenate(
+                        [cid2, jnp.full((pad_c,), -2, cid2.dtype)])
+                used_f = used_b.astype(jnp.bfloat16)
+                # out-of-bag rows are invisible to the counts (sums2
+                # totals are bagged counts too)
+                rl32 = jnp.where(in_bag, rl.astype(jnp.int32), -9)
+
+                def cnt_group(cids):
+                    m = (rl32[None, :] == cids[:, None]).astype(
+                        jnp.bfloat16)                         # (7, N)
+                    return jax.lax.dot_general(
+                        used_f, m, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)   # (F, 7)
+
+                used_cnt = jax.lax.map(cnt_group, cid2.reshape(-1, 7))
+                used_cnt = jnp.moveaxis(used_cnt, 0, 1).reshape(
+                    F, -1)[:, :2 * W]                         # (F, 2W)
+                used_cnt = strat.reduce_sum(used_cnt)
+                unused = jnp.maximum(sums2[:, 2][None, :] - used_cnt, 0.0)
+                base = cegb_penalty if sp.use_cegb else \
+                    jnp.zeros((F,), jnp.float32)
+                cegb2 = base[None, :] + (lazy_pen[:, None] * unused).T
             cands = many_candidates(ex2, sums2, bounds2, depth2, lv2, fm2,
-                                    rb2)
+                                    rb2, cegb2)
             depth_ok = jnp.logical_or(max_depth <= 0, child_depth < max_depth)
             dok2 = jnp.concatenate([depth_ok, depth_ok])
             cg = jnp.where(dok2 & jnp.concatenate([sel, sel]), cands[0],
@@ -521,6 +610,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                                      jnp.concatenate([mx_l, mx_r]))
             if use_ic:
                 out["leaf_path"] = sc2(s["leaf_path"], path2)
+            if use_lazy:
+                out["used"] = used_b
             out["leaf_value"] = sc2(s["leaf_value"], lv2)
             out["leaf_weight"] = sc2(s["leaf_weight"], sums2[:, 1])
             out["leaf_count"] = sc2(s["leaf_count"], sums2[:, 2])
@@ -608,7 +699,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             s["leaf_value"] = jnp.where(ok, vals, s["leaf_value"])
             s["leaf_weight"] = jnp.where(ok, gh[:, 1], s["leaf_weight"])
 
-        return GrownTree(
+        tree_out = GrownTree(
             split_feature=s["split_feature"],
             threshold_bin=s["threshold_bin"],
             nan_bin=s["nan_bin"], cat_member=s["cat_member"],
@@ -620,5 +711,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             leaf_weight=s["leaf_weight"], leaf_count=s["leaf_count"],
             num_leaves=s["num_leaves"],
             row_leaf=s["row_leaf"].astype(jnp.int32))
+        if use_lazy:
+            return tree_out, s["used"]
+        return tree_out
 
     return jax.jit(grow) if jit else grow

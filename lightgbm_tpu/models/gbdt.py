@@ -252,11 +252,13 @@ class GBDT:
         # host-side across trees (per-tree granularity)
         self._cegb_coupled = None
         serial = isinstance(self.learner, SerialTreeLearner)
+        supports_extras = serial or getattr(self.learner,
+                                            "supports_extras", False)
         if cfg.cegb_penalty_feature_coupled or cfg.cegb_penalty_split > 0:
-            if not serial:
-                log_warning("CEGB penalties are applied by the serial "
-                            "learner only; this parallel learner ignores "
-                            "them")
+            if not supports_extras:
+                log_warning("CEGB penalties are applied by the serial and "
+                            "data-parallel(wave) learners only; this "
+                            "learner ignores them")
             elif cfg.cegb_penalty_feature_coupled:
                 full = np.zeros(train_set.num_total_features, np.float64)
                 cpl = cfg.cegb_penalty_feature_coupled
@@ -265,9 +267,10 @@ class GBDT:
                                       float(cfg.cegb_tradeoff))
                 self._cegb_used = np.zeros(self.num_features, bool)
                 self._defer_trees = False  # used-set updates per tree
-        if cfg.feature_fraction_bynode < 1.0 and not serial:
+        if cfg.feature_fraction_bynode < 1.0 and not supports_extras:
             log_warning("feature_fraction_bynode is applied by the serial "
-                        "learner only; this parallel learner ignores it")
+                        "and data-parallel(wave) learners only; this "
+                        "learner ignores it")
         self._linear = bool(cfg.linear_tree)
         if self._linear and self.name != "gbdt":
             log_warning(f"linear_tree is not supported with "
@@ -363,6 +366,20 @@ class GBDT:
                 q.append((node["right"], new_id))
         return tuple(out)
 
+    def _inner_cegb_lazy(self) -> tuple:
+        """cegb_penalty_feature_lazy mapped to inner features, pre-scaled
+        by cegb_tradeoff (like the coupled penalties)."""
+        lz = self.config.cegb_penalty_feature_lazy
+        if not lz:
+            return ()
+        full = np.zeros(self.train_set.num_total_features, np.float64)
+        full[:len(lz)] = [float(v) for v in lz]
+        inner = full[self.train_set.used_feature_map] * \
+            float(self.config.cegb_tradeoff)
+        if not np.any(inner):
+            return ()  # numerically a no-op: skip the bitmap machinery
+        return tuple(float(v) for v in inner)
+
     def _inner_contri(self) -> tuple:
         """config.feature_contri (original column indexing) -> per-inner-
         feature gain multipliers (feature_histogram.hpp:94 penalty)."""
@@ -402,7 +419,8 @@ class GBDT:
                                      efb=self.train_set.efb,
                                      interaction_groups=
                                      self._parse_interaction_constraints(),
-                                     feature_contri=self._inner_contri())
+                                     feature_contri=self._inner_contri(),
+                                     cegb_lazy=self._inner_cegb_lazy())
         if cfg.forcedsplits_filename:
             log_warning("forcedsplits_filename is applied by the serial "
                         "learner only; this parallel learner ignores it")
@@ -410,7 +428,8 @@ class GBDT:
         return create_parallel_learner(
             cfg, self.num_features, self.max_bins, num_bins, is_cat,
             has_nan, monotone,
-            interaction_groups=self._parse_interaction_constraints())
+            interaction_groups=self._parse_interaction_constraints(),
+            cegb_lazy=self._inner_cegb_lazy())
 
     def _walk(self, bins, *tree_args):
         """Binned tree walk; routes through the bundle-space decode

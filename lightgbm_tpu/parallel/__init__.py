@@ -18,7 +18,8 @@ from .mesh import get_mesh
 
 
 def create_parallel_learner(config, num_features, max_bins, num_bins, is_cat,
-                            has_nan, monotone=None, interaction_groups=()):
+                            has_nan, monotone=None, interaction_groups=(),
+                            cegb_lazy=()):
     """Factory (reference tree_learner.h:104 TreeLearner::CreateTreeLearner
     dispatching on tree_learner type)."""
     kind = config.tree_learner
@@ -31,10 +32,12 @@ def create_parallel_learner(config, num_features, max_bins, num_bins, is_cat,
         raise ValueError(f"Unknown tree_learner: {kind}")
     if kind == "data":
         return cls(config, num_features, max_bins, num_bins, is_cat,
-                   has_nan, monotone, interaction_groups=interaction_groups)
-    if interaction_groups:
+                   has_nan, monotone, interaction_groups=interaction_groups,
+                   cegb_lazy=cegb_lazy)
+    if interaction_groups or cegb_lazy:
         from ..utils.log import log_warning
-        log_warning("interaction_constraints are applied by the serial and "
-                    "data-parallel learners only; this learner ignores them")
+        log_warning("interaction_constraints / cegb_penalty_feature_lazy "
+                    "are applied by the serial and data-parallel learners "
+                    "only; this learner ignores them")
     return cls(config, num_features, max_bins, num_bins, is_cat, has_nan,
                monotone)

@@ -141,11 +141,13 @@ class DataParallelTreeLearner:
     def __init__(self, config: Config, num_features: int, max_bins: int,
                  num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
                  monotone: Optional[np.ndarray] = None,
-                 interaction_groups: tuple = ()):
+                 interaction_groups: tuple = (),
+                 cegb_lazy: tuple = ()):
         self.config = config
         self.max_bins = int(max_bins)
         self.num_features = num_features
         self.interaction_groups = tuple(tuple(g) for g in interaction_groups)
+        self.cegb_lazy = tuple(float(v) for v in cegb_lazy)
         self.mesh = get_mesh(int(config.num_devices))
         self.ndev = self.mesh.devices.size
         self.axis = self.mesh.axis_names[0]
@@ -170,7 +172,8 @@ class DataParallelTreeLearner:
             log_warning("use_quantized_grad requires the wave grower; the "
                         "masked data-parallel grower trains with exact "
                         "gradients")
-        if self.interaction_groups or config.extra_trees or \
+        if self.interaction_groups or self.cegb_lazy or \
+                config.extra_trees or \
                 config.feature_fraction_bynode < 1.0 or \
                 config.cegb_penalty_split > 0 or \
                 config.cegb_penalty_feature_coupled:
@@ -257,32 +260,40 @@ class DataParallelTreeLearner:
             hq_max=hq_max,
             renew_leaf=bool(config.quant_train_renew_leaf),
             stochastic=bool(config.stochastic_rounding),
-            interaction_groups=self.interaction_groups)
+            interaction_groups=self.interaction_groups,
+            cegb_lazy=self.cegb_lazy)
 
-        # cegb penalties and the quantization/bynode keys ride replicated
-        # extra operands; arity depends on the static config
+        # cegb penalties, the quantization/bynode keys and the persistent
+        # lazy-CEGB bitmap ride extra operands; arity is static config
         nq = int(self.quantized)
         nn = int(self._use_node_key)
+        nl = int(bool(self.cegb_lazy))
 
-        def grow(X_T, g, h, m, nb, ic, hn, mono, fm, cegb, *keys):
+        def grow(X_T, g, h, m, nb, ic, hn, mono, fm, cegb, *rest):
             kw = {}
             ki = 0
             if nq:
-                kw["quant_key"] = keys[ki]
+                kw["quant_key"] = rest[ki]
                 ki += 1
             if nn:
-                kw["node_key"] = keys[ki]
+                kw["node_key"] = rest[ki]
+                ki += 1
+            if nl:
+                kw["lazy_used"] = rest[ki]
             return grow_w(X_T, g, h, m, nb, ic, hn, mono, cegb, (), fm,
                           **kw)
 
         tree_specs = self._tree_specs(self.axis)
+        out_specs = (tree_specs, P(None, self.axis)) if nl else tree_specs
         self._grow = jax.jit(jax.shard_map(
             grow, mesh=self.mesh,
             in_specs=(P(None, self.axis), P(self.axis), P(self.axis),
                       P(self.axis), P(), P(), P(), P(), P(), P()) +
-            (P(),) * (nq + nn),
-            out_specs=tree_specs,
+            (P(),) * (nq + nn) +
+            ((P(None, self.axis),) if nl else ()),
+            out_specs=out_specs,
             check_vma=False))
+        self._lazy_used = None
 
     def train(self, X_dev: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               sample_mask: jnp.ndarray,
@@ -305,6 +316,7 @@ class DataParallelTreeLearner:
                 Xp = jnp.pad(X_dev, ((0, pad), (0, 0))) if pad else X_dev
                 self._XpT = jnp.asarray(jnp.swapaxes(Xp, 0, 1))
                 self._x_src = X_dev
+                self._lazy_used = None  # fresh data -> fresh bitmap
             if pad:
                 grad = jnp.pad(grad, (0, pad))
                 hess = jnp.pad(hess, (0, pad))
@@ -321,10 +333,21 @@ class DataParallelTreeLearner:
                 if node_key is None:
                     node_key = jnp.zeros((2, 2), jnp.uint32)
                 keys.append(node_key)
-            grown = self._grow(self._XpT, grad, hess, sample_mask,
-                               self.num_bins, self.is_cat, self.has_nan,
-                               self.monotone, feature_mask, cegb_penalty,
-                               *keys)
+            if self.cegb_lazy:
+                n_pad_all = self._XpT.shape[1]
+                if self._lazy_used is None or \
+                        self._lazy_used.shape[1] != n_pad_all:
+                    self._lazy_used = jnp.zeros(
+                        (self.num_features, n_pad_all), jnp.bool_)
+                keys.append(self._lazy_used)
+            out = self._grow(self._XpT, grad, hess, sample_mask,
+                             self.num_bins, self.is_cat, self.has_nan,
+                             self.monotone, feature_mask, cegb_penalty,
+                             *keys)
+            if self.cegb_lazy:
+                grown, self._lazy_used = out
+            else:
+                grown = out
             if pad:
                 grown = grown._replace(row_leaf=grown.row_leaf[:n])
             return grown

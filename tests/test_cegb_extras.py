@@ -172,3 +172,66 @@ def test_pred_early_stop_multiclass():
                       pred_early_stop_margin=0.5)
     # class decisions overwhelmingly agree even with early exits
     assert (np.argmax(es2, 1) == np.argmax(full, 1)).mean() > 0.95
+
+
+def test_cegb_feature_lazy_discourages_new_features(data):
+    """cegb_penalty_feature_lazy charges per row whose feature was never
+    computed on its path (CalculateOndemandCosts): a prohibitive lazy
+    penalty on every feature kills all splits; a penalty on one feature
+    steers trees away from it; zero penalties change nothing."""
+    X, y = data
+    base = {**P, "tree_grow_mode": "wave"}
+
+    # prohibitive penalty everywhere -> no split clears the gain bar
+    bst = lgb.train({**base, "cegb_tradeoff": 1.0,
+                     "cegb_penalty_feature_lazy": [1e6] * X.shape[1]},
+                    lgb.Dataset(X, y), 3)
+    assert np.allclose(np.var(bst.predict(X)), 0.0, atol=1e-12)
+
+    # penalty on feature 0 only -> its importance collapses
+    free = lgb.train(base, lgb.Dataset(X, y), 8)
+    pen = lgb.train({**base, "cegb_tradeoff": 1.0,
+                     "cegb_penalty_feature_lazy":
+                     [50.0] + [0.0] * (X.shape[1] - 1)},
+                    lgb.Dataset(X, y), 8)
+    imp_free = free.feature_importance("split")
+    imp_pen = pen.feature_importance("split")
+    assert imp_free[0] > 0
+    assert imp_pen[0] < imp_free[0]
+
+    # zero lazy penalties are a no-op
+    zero = lgb.train({**base, "cegb_tradeoff": 1.0,
+                      "cegb_penalty_feature_lazy": [0.0] * X.shape[1]},
+                     lgb.Dataset(X, y), 8)
+    np.testing.assert_allclose(zero.predict(X), free.predict(X), atol=2e-5)
+
+
+def test_cegb_feature_lazy_dp_matches_serial(data):
+    X, y = data
+    kw = {**P, "tree_grow_mode": "wave", "cegb_tradeoff": 0.8,
+          "cegb_penalty_feature_lazy": [0.2] * X.shape[1]}
+    ps = lgb.train(kw, lgb.Dataset(X, y), 5).predict(X)
+    pd_ = lgb.train({**kw, "tree_learner": "data"},
+                    lgb.Dataset(X, y), 5).predict(X)
+    np.testing.assert_allclose(pd_, ps, atol=2e-5)
+
+
+def test_cegb_feature_lazy_bitmap_persists_across_trees(data):
+    """The used-feature bitmap lives for the whole training run (the
+    reference's feature_used_in_data_ is allocated once and never
+    cleared), so features paid for in tree 1 are free in tree 2."""
+    X, y = data
+    ds = lgb.Dataset(X, y, params={**P, "tree_grow_mode": "wave",
+                                   "cegb_tradeoff": 1.0,
+                                   "cegb_penalty_feature_lazy":
+                                   [0.05] * X.shape[1]})
+    bst = lgb.Booster(params={**P, "tree_grow_mode": "wave",
+                              "cegb_tradeoff": 1.0,
+                              "cegb_penalty_feature_lazy":
+                              [0.05] * X.shape[1]}, train_set=ds)
+    bst.update()
+    used1 = int(np.asarray(bst._gbdt.learner._lazy_used).sum())
+    bst.update()
+    used2 = int(np.asarray(bst._gbdt.learner._lazy_used).sum())
+    assert used1 > 0
+    assert used2 >= used1  # never cleared between trees
