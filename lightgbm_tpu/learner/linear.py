@@ -78,12 +78,20 @@ def _moments(Xr, grad, hess, bag, row_leaf, leaf_feat, leaf_fmask, k1):
         hw = sl(hess) * w
         gw = sl(grad) * w
         A2 = (A[:, :, None] * A[:, None, :]).reshape(_CHUNK, k1 * k1)
+        # Precision.HIGHEST: the TPU MXU rounds f32 operands to bf16 at
+        # DEFAULT precision, which corrupts the normal equations (the
+        # weighted one-hot and the feature products are full-precision
+        # values, unlike the histogram kernels' exact 0/1 + hi/lo
+        # channels); the f32 passes cost ~6x but the moments are a tiny
+        # fraction of tree time
         M = M + jax.lax.dot_general(
             (onehot * hw[:, None]).T, A2, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).reshape(L, k1, k1)
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST).reshape(L, k1, k1)
         b = b + jax.lax.dot_general(
             (onehot * gw[:, None]).T, A, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
         cnt = cnt + jnp.sum(onehot * w[:, None], axis=0)
         return M, b, cnt
 
