@@ -893,8 +893,18 @@ class GBDT:
         k = self.num_tree_per_iteration
         end = len(self.models) if num_iteration is None else min(
             len(self.models), (start + num_iteration) * k)
+        cache = getattr(self, "_predict_cache", None)
+        key = (start * k, end)
+        if cache is not None and key in cache:
+            return cache[key]
         trees = self.models[start * k:end]
-        return TreeBatch(trees) if trees else None
+        batch = TreeBatch(trees) if trees else None
+        if cache is not None:
+            # per-predict-call memo only (set up by the chunk loop); the
+            # model is immutable across one call's chunks, so no
+            # invalidation hazard
+            cache[key] = batch
+        return batch
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 start_iteration: int = 0,
@@ -906,6 +916,32 @@ class GBDT:
         X = np.asarray(X, np.float32)
         if X.ndim == 1:
             X = X.reshape(1, -1)
+        # bound the device working set: very large batches walk in row
+        # chunks (the reference predicts row blocks too,
+        # gbdt_prediction.cpp).  The dense walk's temporaries scale with
+        # rows x num_leaves, so the chunk shrinks for wide models; the
+        # TreeBatch is built once per outer call, not per chunk.
+        chunk = min(1 << 20,
+                    max(1 << 14, (1 << 28) //
+                        max(int(self.config.num_leaves), 256)))
+        if X.shape[0] > chunk:
+            own_cache = getattr(self, "_predict_cache", None) is None
+            if own_cache:
+                self._predict_cache = {}
+            try:
+                parts = [self.predict(
+                    X[lo:lo + chunk], raw_score=raw_score,
+                    start_iteration=start_iteration,
+                    num_iteration=num_iteration, pred_leaf=pred_leaf,
+                    pred_contrib=pred_contrib,
+                    pred_early_stop=pred_early_stop,
+                    pred_early_stop_freq=pred_early_stop_freq,
+                    pred_early_stop_margin=pred_early_stop_margin)
+                    for lo in range(0, X.shape[0], chunk)]
+            finally:
+                if own_cache:
+                    self._predict_cache = None
+            return np.concatenate(parts, axis=0)
         # map raw columns to inner (used) features
         used = self.train_set.used_feature_map if self.train_set is not None \
             else np.arange(X.shape[1])
@@ -942,9 +978,17 @@ class GBDT:
             else:
                 # class c's trees are at indices i*k + c
                 cols = []
+                cache = getattr(self, "_predict_cache", None)
                 for c in range(k):
                     sel = [t for t in range(t0, t1) if t % k == c]
-                    sub = TreeBatch([self.models[t] for t in sel]) if sel else None
+                    ck = ("mc", c, t0, t1)
+                    if cache is not None and ck in cache:
+                        sub = cache[ck]
+                    else:
+                        sub = TreeBatch([self.models[t] for t in sel]) \
+                            if sel else None
+                        if cache is not None:
+                            cache[ck] = sub
                     cols.append(np.asarray(predict_raw(sub, Xd)) if sub is not None
                                 else np.zeros(X.shape[0], np.float32))
                 raw = np.stack(cols, axis=1)

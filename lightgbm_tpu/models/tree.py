@@ -286,6 +286,43 @@ class TreeBatch:
         self.leaf_fmask = jnp.asarray(lfmask)
         self.linear_flag = jnp.asarray(lflag)
 
+        # Dense-walk path matrices (the MXU inference formulation,
+        # _walk_raw_dense): path_dir[n, l] = +1 when node n sits on leaf
+        # l's root path expecting a LEFT decision, -1 expecting RIGHT;
+        # a row's leaf is the unique l whose satisfied-condition count
+        # S = dec @ path_dir + plen_right equals the path length.  Leaf
+        # slots beyond num_leaves get an unreachable path length.
+        self.has_cat = any(bool(np.bitwise_and(
+            np.asarray(t.decision_type[:max(t.num_leaves - 1, 0)],
+                       np.uint8), CAT_MASK).any()) for t in trees)
+        pd = np.zeros((len(trees), max(ml - 1, 1), ml), np.int8)
+        pr = np.zeros((len(trees), ml), np.float32)
+        pt = np.full((len(trees), ml), 1e9, np.float32)
+        for ti, t in enumerate(trees):
+            if t.num_leaves <= 1:
+                pt[ti, 0] = 0.0
+                continue
+            lc = np.asarray(t.left_child)
+            rc = np.asarray(t.right_child)
+            work = [(0, [])]
+            while work:
+                node, path = work.pop()
+                for child, d in ((int(lc[node]), 1), (int(rc[node]), -1)):
+                    p2 = path + [(node, d)]
+                    if child < 0:
+                        leaf = ~child
+                        if leaf < ml:
+                            for nn_, dd in p2:
+                                pd[ti, nn_, leaf] = dd
+                            pr[ti, leaf] = float(
+                                sum(1 for _, dd in p2 if dd < 0))
+                            pt[ti, leaf] = float(len(p2))
+                    else:
+                        work.append((child, p2))
+        self.path_dir = jnp.asarray(pd)
+        self.plen_right = jnp.asarray(pr)
+        self.plen_total = jnp.asarray(pt)
+
     def as_tuple(self):
         return (self.split_feature, self.threshold_bin, self.nan_bin,
                 self.cat_member, self.decision_type, self.left_child,
@@ -493,22 +530,121 @@ def _walk_raw(X, split_feature, threshold, cat_words, decision_type,
     return out, leaf
 
 
+def _walk_raw_dense(X, split_feature, threshold, decision_type, path_dir,
+                    plen_right, plen_total, leaf_value, want_leaf=True):
+    """Matmul-form tree walk for one (categorical-free) tree: the
+    feature lookup is a one-hot contraction on the MXU (exact f32 via
+    Precision.HIGHEST — a bf16-rounded value could flip a near-threshold
+    decision) and the leaf resolution is a satisfied-condition count
+    against the host-built path matrices.  Replaces the depth-deep
+    gather loop of :func:`_walk_raw`, which is ~1000x slower on TPU
+    (per-row gathers are the slow primitive; matmuls are free)."""
+    f_count = X.shape[1]
+    onehot = (jnp.arange(f_count, dtype=jnp.int32)[:, None] ==
+              split_feature[None, :]).astype(jnp.float32)       # (F, Nn)
+    # NaNs poison a one-hot contraction (0 * NaN = NaN), so the values
+    # ride sanitized and the NaN indicator takes its own exact 0/1 matmul
+    Xz = jnp.nan_to_num(X)
+    P = jax.lax.dot_general(Xz, onehot, (((1,), (0,)), ((), ())),
+                            precision=jax.lax.Precision.HIGHEST)
+    isn = jax.lax.dot_general(jnp.isnan(X).astype(jnp.float32), onehot,
+                              (((1,), (0,)), ((), ()))) > 0.5
+    dt = decision_type
+    dleft = (dt & DEFAULT_LEFT_MASK) != 0
+    miss_nan = (dt & (3 << 2)) == MISSING_NAN
+    # P is already 0.0 at NaN cells (nan_to_num upstream), which is the
+    # non-miss_nan fallback value; miss_nan nodes take default_left.
+    # 0/1 decisions and +-1 path directions are bf16-exact; the S matmul
+    # accumulates in f32, so the equality test stays exact
+    dec = jnp.where(isn & miss_nan[None, :], dleft[None, :],
+                    P <= threshold[None, :]).astype(jnp.bfloat16)
+    # S counts satisfied path conditions: 0/1 x (+-1) products are
+    # bf16-exact and the f32 accumulation of <=Nn terms is exact, so the
+    # equality test below is safe at default matmul precision
+    S = jax.lax.dot_general(dec, path_dir.astype(jnp.bfloat16),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32) + \
+        plen_right[None, :]
+    hit = S == plen_total[None, :]                              # (N, L)
+    out = jnp.sum(jnp.where(hit, leaf_value[None, :], 0.0), axis=1)
+    if not want_leaf:
+        return out, None
+    leaf = jnp.argmax(hit, axis=1).astype(jnp.int32)
+    return out, leaf
+
+
+def _linear_leaf_eval(X, val, leaf, lin_fields):
+    """Linear-leaf evaluation with the NaN fallback (tree.cpp
+    PredictionFunLinear) — shared by the dense and sequential walks."""
+    lconst, lcoef, lfeat, lfmask, lflag = lin_fields
+    rf = lfeat[leaf]
+    rm = lfmask[leaf]
+    vals = jnp.take_along_axis(X, rf, axis=1)
+    nan_row = jnp.any(jnp.isnan(vals) & (rm > 0), axis=1)
+    vals = jnp.where(rm > 0, jnp.nan_to_num(vals), 0.0)
+    lin = lconst[leaf] + jnp.sum(lcoef[leaf] * vals, axis=1)
+    use_lin = (lflag > 0) & jnp.logical_not(nan_row)
+    return jnp.where(use_lin, lin, val)
+
+
+@functools.partial(jax.jit, static_argnames=("has_linear",))
+def _predict_dense_scan(X, fields, lin_fields=None, has_linear=False):
+    """Jitted tree-scan over the dense walk (one compiled program per
+    (shape, tree-count) instead of per-op eager dispatch)."""
+    if not has_linear:
+        def body(carry, tf):
+            return carry + _walk_raw_dense(X, *tf, want_leaf=False)[0], None
+        out, _ = jax.lax.scan(body, jnp.zeros((X.shape[0],), jnp.float32),
+                              fields)
+        return out
+
+    def body_lin(carry, tf):
+        tree_fields, lf = tf
+        val, leaf = _walk_raw_dense(X, *tree_fields)
+        return carry + _linear_leaf_eval(X, val, leaf, lf), None
+
+    out, _ = jax.lax.scan(body_lin, jnp.zeros((X.shape[0],), jnp.float32),
+                          (fields, lin_fields))
+    return out
+
+
 def predict_raw(batch: TreeBatch, X: jnp.ndarray,
                 start_iteration: int = 0,
                 num_iteration: Optional[int] = None) -> jnp.ndarray:
     """Ensemble raw-score prediction on raw features
     (reference gbdt_prediction.cpp:PredictRaw; linear-leaf evaluation per
-    tree.cpp PredictionFunLinear with NaN fallback)."""
+    tree.cpp PredictionFunLinear with NaN fallback).  Categorical-free
+    ensembles take the dense MXU walk; categorical trees keep the
+    sequential walk (their bitset membership is a per-row gather)."""
     t_end = batch.num_trees if num_iteration is None else min(
         start_iteration + num_iteration, batch.num_trees)
+    if batch.max_leaves <= 1:
+        # all-stump ensemble: the prediction is the constants' sum (the
+        # walks' node arrays are empty at ml == 1)
+        const = jnp.sum(batch.leaf_value[start_iteration:t_end, 0])
+        return jnp.full((X.shape[0],), const, jnp.float32)
+    dense = not batch.has_cat
+    if dense:
+        fields = (batch.split_feature, batch.threshold,
+                  batch.decision_type, batch.path_dir, batch.plen_right,
+                  batch.plen_total, batch.leaf_value)
+        sliced = tuple(a[start_iteration:t_end] for a in fields)
+        if not batch.has_linear:
+            return _predict_dense_scan(X, sliced)
+        lin_sliced = tuple(
+            a[start_iteration:t_end] for a in
+            (batch.leaf_const, batch.leaf_coef, batch.leaf_feat,
+             batch.leaf_fmask, batch.linear_flag))
+        return _predict_dense_scan(X, sliced, lin_sliced, has_linear=True)
     fields = (batch.split_feature, batch.threshold, batch.cat_words,
-              batch.decision_type, batch.left_child, batch.right_child,
-              batch.leaf_value, batch.num_leaves)
+              batch.decision_type, batch.left_child,
+              batch.right_child, batch.leaf_value, batch.num_leaves)
+    walk = lambda x, tf: _walk_raw(x, *tf)
     sliced = tuple(a[start_iteration:t_end] for a in fields)
 
     if not batch.has_linear:
         def body(carry, tree_fields):
-            return carry + _walk_raw(X, *tree_fields)[0], None
+            return carry + walk(X, tree_fields)[0], None
 
         out, _ = jax.lax.scan(body, jnp.zeros((X.shape[0],), jnp.float32),
                               sliced)
@@ -519,16 +655,9 @@ def predict_raw(batch: TreeBatch, X: jnp.ndarray,
                         batch.leaf_fmask, batch.linear_flag))
 
     def body_lin(carry, tf):
-        tree_fields, (lconst, lcoef, lfeat, lfmask, lflag) = tf
-        val, leaf = _walk_raw(X, *tree_fields)
-        rf = lfeat[leaf]
-        rm = lfmask[leaf]
-        vals = jnp.take_along_axis(X, rf, axis=1)
-        nan_row = jnp.any(jnp.isnan(vals) & (rm > 0), axis=1)
-        vals = jnp.where(rm > 0, jnp.nan_to_num(vals), 0.0)
-        lin = lconst[leaf] + jnp.sum(lcoef[leaf] * vals, axis=1)
-        use_lin = (lflag > 0) & jnp.logical_not(nan_row)
-        return carry + jnp.where(use_lin, lin, val), None
+        tree_fields, lf = tf
+        val, leaf = walk(X, tree_fields)
+        return carry + _linear_leaf_eval(X, val, leaf, lf), None
 
     out, _ = jax.lax.scan(body_lin, jnp.zeros((X.shape[0],), jnp.float32),
                           (sliced, lin_fields))
