@@ -421,6 +421,58 @@ def _walk_impl(fetch_bin, n, split_feature, threshold_bin, nan_bin,
 
 
 @jax.jit
+def _walk_binned_dense(bins, split_feature, threshold_bin, nan_bin,
+                       decision_type, left_child, right_child, leaf_value,
+                       num_leaves):
+    """Dense matmul walk on BINNED data for one (categorical-free,
+    non-EFB) tree whose arrays live on device (the deferred grown trees
+    driving valid-set score updates).  The path matrices are built
+    on-device with a single pass over the nodes — valid because the
+    growers allocate child node ids AFTER their parents — then the leaf
+    resolution is the same satisfied-condition count as
+    :func:`_walk_raw_dense`.  Replaces a depth-deep gather walk."""
+    nn = left_child.shape[0]                      # L-1 (static)
+    L = leaf_value.shape[0]
+    n = bins.shape[0]
+
+    # (rebuilt per call — ~nn tiny scatter steps, negligible next to the
+    # walk; hoist per tree if many valid sets ever make it show up)
+    def build(i, carry):
+        pathmat, leaf_dir, plen_r, plen_t = carry
+        active = i < num_leaves - 1
+        base = pathmat[i]
+        for child, d in ((left_child[i], 1), (right_child[i], -1)):
+            vec = base.at[i].set(jnp.int8(d))
+            isleaf = child < 0
+            nidx = jnp.where(active & jnp.logical_not(isleaf), child, nn)
+            pathmat = pathmat.at[nidx].set(vec, mode="drop")
+            lidx = jnp.where(active & isleaf, ~child, L)
+            leaf_dir = leaf_dir.at[:, lidx].set(vec, mode="drop")
+            plen_r = plen_r.at[lidx].set(
+                jnp.sum((vec == -1).astype(jnp.float32)), mode="drop")
+            plen_t = plen_t.at[lidx].set(
+                jnp.sum((vec != 0).astype(jnp.float32)), mode="drop")
+        return pathmat, leaf_dir, plen_r, plen_t
+
+    pathmat0 = jnp.zeros((nn, nn), jnp.int8)
+    leaf_dir0 = jnp.zeros((nn, L), jnp.int8)
+    plen_r0 = jnp.zeros((L,), jnp.float32)
+    plen_t0 = jnp.full((L,), 1e9, jnp.float32)
+    _, leaf_dir, plen_r, plen_t = jax.lax.fori_loop(
+        0, nn, build, (pathmat0, leaf_dir0, plen_r0, plen_t0))
+
+    P = _onehot_feature_lookup(bins.astype(jnp.float32), split_feature)
+    dleft = (decision_type & DEFAULT_LEFT_MASK) != 0
+    dec = jnp.where(P == nan_bin[None, :].astype(jnp.float32),
+                    dleft[None, :],
+                    P <= threshold_bin[None, :]).astype(jnp.bfloat16)
+    out, _ = _dense_leaf_out(dec, leaf_dir, plen_r, plen_t, leaf_value,
+                             want_leaf=False)
+    return jnp.where(num_leaves <= 1,
+                     jnp.broadcast_to(leaf_value[0], (n,)), out)
+
+
+@jax.jit
 def _walk_binned(bins, split_feature, threshold_bin, nan_bin, cat_member,
                  decision_type, left_child, right_child, leaf_value,
                  num_leaves):
@@ -530,6 +582,33 @@ def _walk_raw(X, split_feature, threshold, cat_words, decision_type,
     return out, leaf
 
 
+def _onehot_feature_lookup(V, split_feature):
+    """(N, Nn) per-node feature values via a one-hot contraction.
+    Precision.HIGHEST: bf16-rounded values could flip near-threshold
+    decisions (and uint16 bin codes exceed bf16's exact range)."""
+    f_count = V.shape[1]
+    onehot = (jnp.arange(f_count, dtype=jnp.int32)[:, None] ==
+              split_feature[None, :]).astype(jnp.float32)
+    return jax.lax.dot_general(V, onehot, (((1,), (0,)), ((), ())),
+                               precision=jax.lax.Precision.HIGHEST)
+
+
+def _dense_leaf_out(dec, path_dir, plen_right, plen_total, leaf_value,
+                    want_leaf=True):
+    """Leaf resolution by satisfied-path-condition count.  0/1 decisions
+    and +-1 directions are bf16-exact and the matmul accumulates in f32,
+    so the equality test is exact."""
+    S = jax.lax.dot_general(dec, path_dir.astype(jnp.bfloat16),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32) + \
+        plen_right[None, :]
+    hit = S == plen_total[None, :]
+    out = jnp.sum(jnp.where(hit, leaf_value[None, :], 0.0), axis=1)
+    if not want_leaf:
+        return out, None
+    return out, jnp.argmax(hit, axis=1).astype(jnp.int32)
+
+
 def _walk_raw_dense(X, split_feature, threshold, decision_type, path_dir,
                     plen_right, plen_total, leaf_value, want_leaf=True):
     """Matmul-form tree walk for one (categorical-free) tree: the
@@ -539,16 +618,11 @@ def _walk_raw_dense(X, split_feature, threshold, decision_type, path_dir,
     against the host-built path matrices.  Replaces the depth-deep
     gather loop of :func:`_walk_raw`, which is ~1000x slower on TPU
     (per-row gathers are the slow primitive; matmuls are free)."""
-    f_count = X.shape[1]
-    onehot = (jnp.arange(f_count, dtype=jnp.int32)[:, None] ==
-              split_feature[None, :]).astype(jnp.float32)       # (F, Nn)
     # NaNs poison a one-hot contraction (0 * NaN = NaN), so the values
     # ride sanitized and the NaN indicator takes its own exact 0/1 matmul
-    Xz = jnp.nan_to_num(X)
-    P = jax.lax.dot_general(Xz, onehot, (((1,), (0,)), ((), ())),
-                            precision=jax.lax.Precision.HIGHEST)
-    isn = jax.lax.dot_general(jnp.isnan(X).astype(jnp.float32), onehot,
-                              (((1,), (0,)), ((), ()))) > 0.5
+    P = _onehot_feature_lookup(jnp.nan_to_num(X), split_feature)
+    isn = _onehot_feature_lookup(jnp.isnan(X).astype(jnp.float32),
+                                 split_feature) > 0.5
     dt = decision_type
     dleft = (dt & DEFAULT_LEFT_MASK) != 0
     miss_nan = (dt & (3 << 2)) == MISSING_NAN

@@ -128,3 +128,37 @@ def test_dense_walk_matches_sequential_walk():
             _, leaf_d = _walk_raw_dense(Xd, *tfd)
             np.testing.assert_array_equal(np.asarray(leaf_d),
                                           seq_leaves[t])
+
+
+def test_binned_dense_walk_matches_sequential():
+    """On-device path-matrix walk == the sequential binned walk for
+    grower-produced trees (incl. the NaN bin)."""
+    import jax.numpy as jnp
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.models.tree import _walk_binned, _walk_binned_dense
+
+    rng = np.random.RandomState(9)
+    X = rng.randn(3000, 5).astype(np.float32)
+    X[rng.rand(3000, 5) < 0.1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) - np.nan_to_num(X[:, 1]) > 0).astype(
+        np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, y), 5)
+    gb = bst._gbdt
+    bins = gb.X_dev
+    assert gb._walk_dense_ok
+    for tree in gb.models:
+        args = (jnp.asarray(tree.split_feature),
+                jnp.asarray(tree.threshold_bin),
+                jnp.asarray(tree.nan_bin),
+                jnp.zeros((len(tree.split_feature), 1), jnp.bool_),
+                jnp.asarray(tree.decision_type.astype(np.int32)),
+                jnp.asarray(tree.left_child),
+                jnp.asarray(tree.right_child),
+                jnp.asarray(tree.leaf_value.astype(np.float32)),
+                jnp.asarray(tree.num_leaves, jnp.int32))
+        seq = np.asarray(_walk_binned(bins, *args))
+        dense = np.asarray(_walk_binned_dense(
+            bins, *(args[:3] + args[4:])))
+        np.testing.assert_allclose(dense, seq, rtol=1e-6, atol=1e-7)
