@@ -137,7 +137,8 @@ def _update_score_by_leaf(score, row_leaf, leaf_value, shrinkage):
 
 
 from .tree import (_walk_binned,  # tree walk for valid-set score updates
-                   _walk_binned_dense, _walk_binned_efb)
+                   _walk_binned_dense, _walk_binned_dense_efb,
+                   _walk_binned_efb)
 
 
 class GBDT:
@@ -209,9 +210,8 @@ class GBDT:
                                             self._inner_monotone(),
                                             cfg=learner_cfg)
         # dense binned walk gate: per-node categorical membership needs a
-        # gather, and EFB needs the bundle decode
-        self._walk_dense_ok = (train_set.efb is None and
-                               not bool(np.any(is_cat)))
+        # gather (EFB bundles decode elementwise and are fine)
+        self._walk_dense_ok = not bool(np.any(is_cat))
         _shards = jax.device_count() \
             if cfg.tree_learner in ("data", "voting") else 1
         if self.num_data > (1 << 24) * _shards and \
@@ -441,6 +441,10 @@ class GBDT:
         reference carry BUNDLE columns).  Categorical-free non-EFB
         datasets take the dense matmul walk (no per-row gathers)."""
         if self._efb_walk is not None:
+            if getattr(self, "_walk_dense_ok", False):
+                (sf, tb, nb, _cm, dt, lc, rc, lv, nl) = tree_args
+                return _walk_binned_dense_efb(bins, self._efb_walk, sf, tb,
+                                              nb, dt, lc, rc, lv, nl)
             return _walk_binned_efb(bins, self._efb_walk, *tree_args)
         if getattr(self, "_walk_dense_ok", False):
             (sf, tb, nb, _cm, dt, lc, rc, lv, nl) = tree_args

@@ -420,23 +420,13 @@ def _walk_impl(fetch_bin, n, split_feature, threshold_bin, nan_bin,
     return out
 
 
-@jax.jit
-def _walk_binned_dense(bins, split_feature, threshold_bin, nan_bin,
-                       decision_type, left_child, right_child, leaf_value,
-                       num_leaves):
-    """Dense matmul walk on BINNED data for one (categorical-free,
-    non-EFB) tree whose arrays live on device (the deferred grown trees
-    driving valid-set score updates).  The path matrices are built
-    on-device with a single pass over the nodes — valid because the
-    growers allocate child node ids AFTER their parents — then the leaf
-    resolution is the same satisfied-condition count as
-    :func:`_walk_raw_dense`.  Replaces a depth-deep gather walk."""
-    nn = left_child.shape[0]                      # L-1 (static)
-    L = leaf_value.shape[0]
-    n = bins.shape[0]
+def _device_path_matrices(left_child, right_child, num_leaves, L):
+    """Path matrices built ON DEVICE with one pass over the node arrays
+    (valid because the growers allocate child node ids after their
+    parents).  Rebuilt per call — ~L tiny scatter steps, negligible next
+    to the walk."""
+    nn = left_child.shape[0]
 
-    # (rebuilt per call — ~nn tiny scatter steps, negligible next to the
-    # walk; hoist per tree if many valid sets ever make it show up)
     def build(i, carry):
         pathmat, leaf_dir, plen_r, plen_t = carry
         active = i < num_leaves - 1
@@ -460,8 +450,35 @@ def _walk_binned_dense(bins, split_feature, threshold_bin, nan_bin,
     plen_t0 = jnp.full((L,), 1e9, jnp.float32)
     _, leaf_dir, plen_r, plen_t = jax.lax.fori_loop(
         0, nn, build, (pathmat0, leaf_dir0, plen_r0, plen_t0))
+    return leaf_dir, plen_r, plen_t
 
+
+@jax.jit
+def _walk_binned_dense(bins, split_feature, threshold_bin, nan_bin,
+                       decision_type, left_child, right_child, leaf_value,
+                       num_leaves):
+    """Dense matmul walk on BINNED data for one (categorical-free,
+    non-EFB) tree whose arrays live on device (the deferred grown trees
+    driving valid-set score updates).  The path matrices are built
+    on-device with a single pass over the nodes — valid because the
+    growers allocate child node ids AFTER their parents — then the leaf
+    resolution is the same satisfied-condition count as
+    :func:`_walk_raw_dense`.  Replaces a depth-deep gather walk."""
     P = _onehot_feature_lookup(bins.astype(jnp.float32), split_feature)
+    return _binned_dense_from_codes(P, threshold_bin, nan_bin,
+                                    decision_type, left_child,
+                                    right_child, leaf_value, num_leaves)
+
+
+def _binned_dense_from_codes(P, threshold_bin, nan_bin, decision_type,
+                             left_child, right_child, leaf_value,
+                             num_leaves):
+    """Shared tail of the dense binned walks: decision + path-count leaf
+    resolution from per-node FEATURE-space bin codes ``P`` (N, Nn)."""
+    n = P.shape[0]
+    L = leaf_value.shape[0]
+    leaf_dir, plen_r, plen_t = _device_path_matrices(
+        left_child, right_child, num_leaves, L)
     dleft = (decision_type & DEFAULT_LEFT_MASK) != 0
     dec = jnp.where(P == nan_bin[None, :].astype(jnp.float32),
                     dleft[None, :],
@@ -470,6 +487,25 @@ def _walk_binned_dense(bins, split_feature, threshold_bin, nan_bin,
                              want_leaf=False)
     return jnp.where(num_leaves <= 1,
                      jnp.broadcast_to(leaf_value[0], (n,)), out)
+
+
+@jax.jit
+def _walk_binned_dense_efb(bins, efb_walk, split_feature, threshold_bin,
+                           nan_bin, decision_type, left_child, right_child,
+                           leaf_value, num_leaves):
+    """Dense binned walk over an EFB-BUNDLED matrix: each node's bundle
+    column rides the one-hot lookup, then the SAME decode closure the
+    growers use (efb.make_bundle_decode, broadcast over (N, Nn)) maps
+    bundle codes to feature space — no per-row gathers."""
+    from ..efb import make_bundle_decode
+    _, f_bundle, *_rest = efb_walk
+    Pb = _onehot_feature_lookup(bins.astype(jnp.float32),
+                                f_bundle[split_feature])
+    Pf = make_bundle_decode(efb_walk)(
+        Pb.astype(jnp.int32), split_feature[None, :]).astype(jnp.float32)
+    return _binned_dense_from_codes(Pf, threshold_bin, nan_bin,
+                                    decision_type, left_child,
+                                    right_child, leaf_value, num_leaves)
 
 
 @jax.jit

@@ -162,3 +162,40 @@ def test_binned_dense_walk_matches_sequential():
         dense = np.asarray(_walk_binned_dense(
             bins, *(args[:3] + args[4:])))
         np.testing.assert_allclose(dense, seq, rtol=1e-6, atol=1e-7)
+
+
+def test_efb_dense_binned_walk_matches_sequential():
+    """EFB bundle-space dense walk == the sequential EFB walk."""
+    import jax.numpy as jnp
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.models.tree import (_walk_binned_dense_efb,
+                                          _walk_binned_efb)
+
+    rng = np.random.RandomState(11)
+    n = 4000
+    cats = rng.randint(0, 5, (n, 8))
+    X = np.zeros((n, 40), np.float32)
+    for g in range(8):
+        X[np.arange(n), g * 5 + cats[:, g]] = rng.rand(n) + 0.5
+    y = ((X[:, 0] + X[:, 7] - X[:, 12] > 0.8)).astype(np.float64)
+    import scipy.sparse as sp
+    bst = lgb.train({"objective": "binary", "num_leaves": 31, "max_bin": 63,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(sp.csr_matrix(X), y), 4)
+    gb = bst._gbdt
+    assert gb._efb_walk is not None and gb._walk_dense_ok
+    bins = gb.X_dev
+    for tree in gb.models:
+        args = (jnp.asarray(tree.split_feature),
+                jnp.asarray(tree.threshold_bin),
+                jnp.asarray(tree.nan_bin),
+                jnp.zeros((len(tree.split_feature), 1), jnp.bool_),
+                jnp.asarray(tree.decision_type.astype(np.int32)),
+                jnp.asarray(tree.left_child),
+                jnp.asarray(tree.right_child),
+                jnp.asarray(tree.leaf_value.astype(np.float32)),
+                jnp.asarray(tree.num_leaves, jnp.int32))
+        seq = np.asarray(_walk_binned_efb(bins, gb._efb_walk, *args))
+        dense = np.asarray(_walk_binned_dense_efb(
+            bins, gb._efb_walk, *(args[:3] + args[4:])))
+        np.testing.assert_allclose(dense, seq, rtol=1e-6, atol=1e-7)
