@@ -343,9 +343,24 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             fm_root = fm_root & node_mask_many(rid)[0]
         rb_root = node_rand_many(rid)[0] if use_et else None
         if use_lazy:
-            # every root row is unused for every feature
+            # Charge only rows whose feature bit is still unset in the
+            # PERSISTENT used bitmap (cost_effective_gradient_boosting.hpp
+            # CalculateOndemandCosts): from the second tree on, features
+            # already materialized by earlier trees' splits cost nothing
+            # for those rows.  used_root[f] = in-bag rows with bit set.
+            # Like cnt_group below, the f32-accumulated 0/1 dot is exact
+            # to 2^24 counted rows per shard; beyond that the lazy cost
+            # degrades gracefully (it only biases split selection).
             base = strat.cegb_full if strat.cegb_full is not None else 0.0
-            strat.cegb_full = base + lazy_pen * root_sum[2]
+            used0 = lazy_used if lazy_used is not None \
+                else jnp.zeros((F, n), jnp.bool_)
+            used_root = strat.reduce_sum(jax.lax.dot_general(
+                used0.astype(jnp.bfloat16),
+                (bag_mask > 0).astype(jnp.bfloat16)[None, :],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)[:, 0])       # (F,)
+            strat.cegb_full = base + lazy_pen * jnp.maximum(
+                root_sum[2] - used_root, 0.0)
         cand = strat.leaf_candidates(expand_hist(root_hist_f, root_sum),
                                      root_sum, fm_root, sp,
                                      root_bound, jnp.asarray(0, jnp.int32),
@@ -546,8 +561,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     m = sel[j] & (rl_old == slz[j]) & in_bag
                     used_b = used_b.at[feat[j]].set(used_b[feat[j]] | m)
                 # 2) per-(feature, child) unused counts: grouped matvecs
-                # against the bitmap (counts are exact: 0/1 bf16 products,
-                # f32 accumulation)
+                # against the bitmap (0/1 bf16 products, f32 accumulation
+                # — exact to 2^24 counted rows per shard)
                 live2 = jnp.concatenate([sel, sel])
                 cid2 = jnp.where(live2, jnp.concatenate(
                     [sel_leaves, new_ids]), -2)

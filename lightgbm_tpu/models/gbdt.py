@@ -224,6 +224,19 @@ class GBDT:
                         "exactness range; set use_quantized_grad=true for "
                         "exact int32 counts (and faster training) at this "
                         "scale")
+        if cfg.use_quantized_grad:
+            # int32 g_q/h_q channel sums overflow once one bin can hold
+            # more than 2^31/gq_max quantized units per shard (the count
+            # channel alone is exact to 2^31); warn at the per-shard bound
+            from ..ops.quantize import quant_levels
+            _gq = max(quant_levels(int(cfg.num_grad_quant_bins)))
+            if self.num_data > (1 << 31) // _gq * _shards:
+                log_warning(
+                    f"num_data={self.num_data} exceeds the quantized "
+                    f"histogram's int32 channel-sum exactness bound "
+                    f"(2^31/{_gq} rows per shard at num_grad_quant_bins="
+                    f"{cfg.num_grad_quant_bins}); lower num_grad_quant_bins "
+                    "or shard rows across more devices")
         if getattr(train_set, "distributed_rows", False):
             # pre-partitioned ingest: assemble the global row-sharded
             # matrix from each process's local shard (features never

@@ -372,9 +372,12 @@ def build_histogram_pallas_leaves(bins_t: jnp.ndarray, w8: jnp.ndarray,
 # construction), so 42 leaves share one pass vs the bf16 kernel's 25, and
 # the i8 MXU path runs at twice the bf16 MAC rate on v5e.  Histogram
 # subtraction (parent - child) is exact integer arithmetic — strictly
-# better conditioned than the reference's f64 CPU path — and the count
-# channel is exact to 2^31 rows/shard (the bf16 kernel's f32 counts cap at
-# 2^24, ops/histogram.py).
+# better conditioned than the reference's f64 CPU path.  Exactness bounds
+# per int32 accumulator bin: the count channel (weight 1) is exact to 2^31
+# rows/shard; the g_q/h_q channels (weights up to gq_max/hq_max) are exact
+# to 2^31/gq_max rows landing in ONE bin per shard (~16.9M rows at 127
+# levels — gbdt.py warns past the bound).  The bf16 kernel's f32 counts
+# cap at 2^24 (ops/histogram.py).
 #
 # Mosaic constraints probed on v5e (scripts/proto_q8_*.py): 8-bit compares
 # and 8-bit elementwise multiplies are NOT supported — the one-hot and the
