@@ -13,9 +13,15 @@ Gain / leaf-output closed forms follow feature_histogram.hpp:
   leaf_gain(G, H)    = ThresholdL1(G)^2 / (H + l2)
   output(G, H)       = -ThresholdL1(G) / (H + l2)   (clipped by max_delta_step)
 
-Histograms are (F, B, 3) float32 with channels (sum_grad, sum_hess, count);
-our histograms keep every bin (no most-frequent-bin elision), so the
+Histograms arrive as (F, B, 3) float32 with channels (sum_grad, sum_hess,
+count); our histograms keep every bin (no most-frequent-bin elision), so the
 reference's ``Dataset::FixHistogram`` restore step is unnecessary.
+
+Layout: internally the scan runs CHANNEL-SPLIT — three (F, B) planes with
+the bin axis in the TPU lane dimension — because a trailing size-3 axis
+would tile at 3/128 lane occupancy and make every cumsum/compare ~40x
+slower than the arithmetic warrants.  The (F, B, 3) interface stays (it is
+the histogram pool's storage layout); the transpose happens once at entry.
 """
 
 from __future__ import annotations
@@ -60,6 +66,7 @@ class SplitParams(NamedTuple):
     cegb_penalty_split: float = 0.0
     feature_fraction_bynode: float = 1.0  # ColSampler by-node sampling
     extra_trees: bool = False  # one random threshold per feature per node
+    any_cat: bool = True       # trace the categorical split search at all
 
 BIG = 1e30  # "unbounded" leaf-output constraint sentinel
 
@@ -195,196 +202,224 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
         # threshold per feature per node instead of the full bin scan
         thr_valid = thr_valid & (bins_r == rand_bins[:, None])
 
+    # channel-split planes (F, B) — bins ride the lane dimension
+    hg, hh, hc = hist[..., 0], hist[..., 1], hist[..., 2]
     # zero out bins beyond each feature's true range so cumsums are clean
-    hist_m = jnp.where(real_bin[:, :, None], hist, 0.0)
-    nan_slice = jnp.where(has_nan[:, None, None],
-                          jnp.take_along_axis(hist, jnp.broadcast_to(
-                              nan_bin[:, :, None], (f, 1, 3)), axis=1),
-                          jnp.zeros((f, 1, 3), hist.dtype))      # (F, 1, 3)
-    nan_sum = nan_slice[:, 0, :]                                  # (F, 3)
+    hg_m = jnp.where(real_bin, hg, 0.0)
+    hh_m = jnp.where(real_bin, hh, 0.0)
+    hc_m = jnp.where(real_bin, hc, 0.0)
 
-    cum = jnp.cumsum(hist_m, axis=1)                              # (F, B, 3)
-    total = parent_sum[None, :]                                   # (1, 3)
+    def at_bin(a, idx):
+        """(F,) gather of one bin per feature from an (F, B) plane."""
+        return jnp.take_along_axis(a, idx[:, None], 1)[:, 0]
 
-    def clamped_out(s, l2_eff):
+    hn_f = has_nan[:, None]                                      # (F, 1)
+    nan_g = jnp.where(hn_f, jnp.take_along_axis(hg, nan_bin, 1), 0.0)
+    nan_h = jnp.where(hn_f, jnp.take_along_axis(hh, nan_bin, 1), 0.0)
+    nan_c = jnp.where(hn_f, jnp.take_along_axis(hc, nan_bin, 1), 0.0)
+
+    cum_g = jnp.cumsum(hg_m, axis=1)                             # (F, B)
+    cum_h = jnp.cumsum(hh_m, axis=1)
+    cum_c = jnp.cumsum(hc_m, axis=1)
+    tot_g, tot_h, tot_c = parent_sum[0], parent_sum[1], parent_sum[2]
+
+    def clamped_out(sg, sh, sc, l2_eff):
         """Split-child output with smoothing and/or constraint clamping
         (CalculateSplittedLeafOutput USE_SMOOTHING / USE_MC)."""
-        t = _threshold_l1(s[..., 0], l1)
-        h_ = s[..., 1] + l2_eff
+        t = _threshold_l1(sg, l1)
+        h_ = sh + l2_eff
         out = jnp.where(h_ > 0, -t / h_, 0.0)
         # clip the raw output BEFORE the smoothing blend (the reference's
         # CalculateSplittedLeafOutput order); monotone clamping stays last
         if params.max_delta_step > 0.0:
             out = jnp.clip(out, -params.max_delta_step, params.max_delta_step)
         if use_sm:
-            fac = s[..., 2] / (s[..., 2] + params.path_smooth)
+            fac = sc / (sc + params.path_smooth)
             out = out * fac + parent_out * (1.0 - fac)
         return jnp.clip(out, mn, mx) if use_mc else out
 
-    def dir_gain(left):
-        right = total[:, None, :] - left
-        ok = ((left[..., 2] >= min_cnt) & (right[..., 2] >= min_cnt) &
-              (left[..., 1] >= min_h) & (right[..., 1] >= min_h) & thr_valid)
+    def dir_gain(lg, lh, lc):
+        rg, rh, rc = tot_g - lg, tot_h - lh, tot_c - lc
+        ok = ((lc >= min_cnt) & (rc >= min_cnt) &
+              (lh >= min_h) & (rh >= min_h) & thr_valid)
         if use_out:
             # constrained/smoothed outputs (GetSplitGains USE_MC /
             # USE_SMOOTHING branches, feature_histogram.hpp): gain is
             # evaluated at the actually-deliverable output
-            out_l = clamped_out(left, l2)
-            out_r = clamped_out(right, l2)
-            gl = _gain_given_output(left[..., 0], left[..., 1], out_l, l1, l2)
-            gr = _gain_given_output(right[..., 0], right[..., 1], out_r, l1, l2)
+            out_l = clamped_out(lg, lh, lc, l2)
+            out_r = clamped_out(rg, rh, rc, l2)
+            gl = _gain_given_output(lg, lh, out_l, l1, l2)
+            gr = _gain_given_output(rg, rh, out_r, l1, l2)
             if use_mc:
                 viol = (((mono > 0) & (out_l > out_r)) |
                         ((mono < 0) & (out_l < out_r)))
                 ok = ok & jnp.logical_not(viol)
         else:
-            gl = _leaf_gain(left[..., 0], left[..., 1], l1, l2)
-            gr = _leaf_gain(right[..., 0], right[..., 1], l1, l2)
+            gl = _leaf_gain(lg, lh, l1, l2)
+            gr = _leaf_gain(rg, rh, l1, l2)
         g = gl + gr - min_gain_shift
         if use_mc and params.monotone_penalty > 0.0:
             pen = monotone_penalty_factor(depth, params.monotone_penalty)
             g = jnp.where(mono != 0, g * pen, g)
-        return jnp.where(ok & (g > 0), g, NEG_INF), left
+        return jnp.where(ok & (g > 0), g, NEG_INF)
 
     # numerical, missing->right (left = cum of real bins up to b)
-    gain_r, left_r = dir_gain(cum)
+    gain_r = dir_gain(cum_g, cum_h, cum_c)
     # numerical, missing->left (NaN bin joins the left side)
-    gain_l, left_l = dir_gain(cum + nan_slice)
-    gain_l = jnp.where(has_nan[:, None], gain_l, NEG_INF)
+    gain_l = dir_gain(cum_g + nan_g, cum_h + nan_h, cum_c + nan_c)
+    gain_l = jnp.where(hn_f, gain_l, NEG_INF)
 
-    def take_bin(arr, idx):
-        return jnp.take_along_axis(arr, idx[:, None, None].repeat(3, 2), 1)[:, 0, :]
+    if params.any_cat:
+        # ---- categorical one-vs-rest: category bin b goes left, rest right
+        # (feature_histogram.hpp:278 one-hot branch; cat_l2 regularizes)
+        cat_l2 = l2 + params.cat_l2
+        crg, crh, crc = tot_g - hg_m, tot_h - hh_m, tot_c - hc_m
+        if use_out:  # clamp/smooth outputs (no direction check for cats)
+            c_out_l = clamped_out(hg_m, hh_m, hc_m, cat_l2)
+            c_out_r = clamped_out(crg, crh, crc, cat_l2)
+            cgl = _gain_given_output(hg_m, hh_m, c_out_l, l1, cat_l2)
+            cgr = _gain_given_output(crg, crh, c_out_r, l1, cat_l2)
+        else:
+            cgl = _leaf_gain(hg_m, hh_m, l1, cat_l2)
+            cgr = _leaf_gain(crg, crh, l1, cat_l2)
+        cat_ok = ((hc_m >= min_cnt) & (crc >= min_cnt) &
+                  (hh_m >= min_h) & (crh >= min_h) & real_bin)
+        if use_et:  # one random category per node (USE_RAND one-hot branch)
+            cat_ok = cat_ok & (bins_r == rand_bins[:, None])
+        cat_gain = cgl + cgr - min_gain_shift
+        cat_gain = jnp.where(cat_ok & (cat_gain > 0), cat_gain, NEG_INF)
+        oh_bin = jnp.argmax(cat_gain, axis=1)
+        oh_gain = at_bin(cat_gain, oh_bin)
+        oh_member = jax.nn.one_hot(oh_bin, b, dtype=jnp.bool_)
+        oh_left = jnp.stack([at_bin(hg_m, oh_bin), at_bin(hh_m, oh_bin),
+                             at_bin(hc_m, oh_bin)], axis=-1)
 
-    # ---- categorical one-vs-rest: category bin b goes left, rest right
-    # (feature_histogram.hpp:278 one-hot branch; cat_l2 adds regularization)
-    cat_l2 = l2 + params.cat_l2
-    cat_left = hist_m
-    cat_right = total[:, None, :] - cat_left
-    if use_out:  # clamp/smooth outputs (no direction check for cats)
-        c_out_l = clamped_out(cat_left, cat_l2)
-        c_out_r = clamped_out(cat_right, cat_l2)
-        cgl = _gain_given_output(cat_left[..., 0], cat_left[..., 1], c_out_l,
-                                 l1, cat_l2)
-        cgr = _gain_given_output(cat_right[..., 0], cat_right[..., 1], c_out_r,
-                                 l1, cat_l2)
+        # ---- categorical sorted-subset search (feature_histogram.hpp:278
+        # non-onehot branch): categories ordered by sum_grad/(sum_hess +
+        # cat_smooth); prefix subsets scanned from BOTH ends, up to
+        # max_cat_threshold categories; the LEFT child takes the subset.
+        if params.use_cat_subset:
+            mdpg = float(params.min_data_per_group)
+            # candidate categories: count >= cat_smooth (the reference
+            # reuses cat_smooth as the per-category min count filter)
+            cat_valid = real_bin & (hc_m >= params.cat_smooth)
+            ratio = jnp.where(cat_valid,
+                              hg_m / (hh_m + params.cat_smooth), BIG)
+            order = jnp.argsort(ratio, axis=1, stable=True)      # (F, B)
+            rank = jnp.zeros((f, b), jnp.int32).at[
+                jnp.arange(f)[:, None], order].set(
+                jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[None, :],
+                                 (f, b)))
+            used = jnp.sum(cat_valid, axis=1).astype(jnp.int32)  # (F,)
+            pos = jnp.arange(b, dtype=jnp.int32)[None, :]        # (1, B)
+            pos_used = pos < used[:, None]
+
+            def fwd_bwd(plane):
+                """Forward/backward ratio-ordered prefix cumsums of one
+                channel plane."""
+                sh = jnp.take_along_axis(plane, order, axis=1)
+                sh = jnp.where(pos_used, sh, 0.0)
+                cumf = jnp.cumsum(sh, axis=1)                    # (F, B)
+                total_used = cumf[:, -1:]
+                # prefix of the (i+1) LARGEST ratios =
+                #   total_used - cumf[used-2-i]
+                bidx = used[:, None] - 2 - pos                   # (F, B)
+                tb = jnp.take_along_axis(cumf, jnp.clip(bidx, 0, b - 1), 1)
+                cumb = total_used - jnp.where(bidx >= 0, tb, 0.0)
+                return cumf, cumb
+
+            cumf_g, cumb_g = fwd_bwd(hg_m)
+            cumf_h, cumb_h = fwd_bwd(hh_m)
+            cumf_c, cumb_c = fwd_bwd(hc_m)
+
+            max_pos = jnp.minimum(jnp.minimum(params.max_cat_threshold,
+                                              (used[:, None] + 1) // 2),
+                                  used[:, None])                 # (F, 1)
+            pos_ok = pos < max_pos
+            if use_et:  # one random subset size per node (USE_RAND)
+                pos_ok = pos_ok & (pos == rand_bins[:, None] %
+                                   jnp.maximum(max_pos, 1))
+
+            def subset_gain(lg, lh, lc):
+                rg, rh, rc = tot_g - lg, tot_h - lh, tot_c - lc
+                # group spacing: the reference only evaluates a position
+                # once >= min_data_per_group rows accumulated since the
+                # last evaluated one; approximated here as crossing a
+                # multiple of min_data_per_group in the prefix count
+                gcross = jnp.floor(lc / mdpg)
+                gprev = jnp.concatenate([jnp.full((f, 1), -1.0),
+                                         gcross[:, :-1]], axis=1)
+                ok = (pos_ok & (lc >= min_cnt) & (lh >= min_h) &
+                      (rc >= jnp.maximum(min_cnt, mdpg)) &
+                      (rh >= min_h) & (gcross > gprev))
+                if use_out:
+                    o_l = clamped_out(lg, lh, lc, cat_l2)
+                    o_r = clamped_out(rg, rh, rc, cat_l2)
+                    gl_ = _gain_given_output(lg, lh, o_l, l1, cat_l2)
+                    gr_ = _gain_given_output(rg, rh, o_r, l1, cat_l2)
+                else:
+                    gl_ = _leaf_gain(lg, lh, l1, cat_l2)
+                    gr_ = _leaf_gain(rg, rh, l1, cat_l2)
+                g = gl_ + gr_ - min_gain_shift
+                return jnp.where(ok & (g > 0), g, NEG_INF)
+
+            gain_f = subset_gain(cumf_g, cumf_h, cumf_c)
+            gain_bk = subset_gain(cumb_g, cumb_h, cumb_c)
+            f_pos = jnp.argmax(gain_f, axis=1)
+            f_best = at_bin(gain_f, f_pos)
+            b_pos = jnp.argmax(gain_bk, axis=1)
+            b_best = at_bin(gain_bk, b_pos)
+            use_bk = b_best > f_best
+            sub_gain = jnp.where(use_bk, b_best, f_best)
+            sub_pos = jnp.where(use_bk, b_pos, f_pos)
+            sub_left = jnp.where(
+                use_bk[:, None],
+                jnp.stack([at_bin(cumb_g, b_pos), at_bin(cumb_h, b_pos),
+                           at_bin(cumb_c, b_pos)], axis=-1),
+                jnp.stack([at_bin(cumf_g, f_pos), at_bin(cumf_h, f_pos),
+                           at_bin(cumf_c, f_pos)], axis=-1))
+            # membership: forward -> ranks [0, pos]; backward -> the top
+            # (pos+1) ranks of the used range
+            sub_member = jnp.where(
+                use_bk[:, None],
+                (rank >= used[:, None] - 1 - sub_pos[:, None]) &
+                (rank < used[:, None]),
+                rank <= sub_pos[:, None])
+
+            use_subset = is_cat & (num_bins > params.max_cat_to_onehot)
+            cat_best_gain = jnp.where(use_subset, sub_gain, oh_gain)
+            cat_member = jnp.where(use_subset[:, None], sub_member, oh_member)
+            cat_left_sum = jnp.where(use_subset[:, None], sub_left, oh_left)
+        else:
+            cat_best_gain = oh_gain
+            cat_member = oh_member
+            cat_left_sum = oh_left
     else:
-        cgl = _leaf_gain(cat_left[..., 0], cat_left[..., 1], l1, cat_l2)
-        cgr = _leaf_gain(cat_right[..., 0], cat_right[..., 1], l1, cat_l2)
-    cat_ok = ((cat_left[..., 2] >= min_cnt) & (cat_right[..., 2] >= min_cnt) &
-              (cat_left[..., 1] >= min_h) & (cat_right[..., 1] >= min_h) & real_bin)
-    if use_et:  # one random category per node (USE_RAND one-hot branch)
-        cat_ok = cat_ok & (bins_r == rand_bins[:, None])
-    cat_gain = cgl + cgr - min_gain_shift
-    cat_gain = jnp.where(cat_ok & (cat_gain > 0), cat_gain, NEG_INF)
-    oh_bin = jnp.argmax(cat_gain, axis=1)
-    oh_gain = jnp.take_along_axis(cat_gain, oh_bin[:, None], 1)[:, 0]
-    oh_member = jax.nn.one_hot(oh_bin, b, dtype=jnp.bool_)
-    oh_left = take_bin(hist_m, oh_bin)
-
-    # ---- categorical sorted-subset search (feature_histogram.hpp:278
-    # non-onehot branch): categories ordered by sum_grad/(sum_hess +
-    # cat_smooth); prefix subsets scanned from BOTH ends, up to
-    # max_cat_threshold categories; the LEFT child takes the subset.
-    if params.use_cat_subset:
-        mdpg = float(params.min_data_per_group)
-        counts = hist_m[..., 2]
-        # candidate categories: count >= cat_smooth (the reference reuses
-        # cat_smooth as the per-category min count filter)
-        cat_valid = real_bin & (counts >= params.cat_smooth)
-        ratio = jnp.where(cat_valid,
-                          hist_m[..., 0] / (hist_m[..., 1] + params.cat_smooth),
-                          BIG)
-        order = jnp.argsort(ratio, axis=1, stable=True)          # (F, B)
-        rank = jnp.zeros((f, b), jnp.int32).at[
-            jnp.arange(f)[:, None], order].set(
-            jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[None, :], (f, b)))
-        used = jnp.sum(cat_valid, axis=1).astype(jnp.int32)      # (F,)
-        sh = jnp.take_along_axis(hist_m, order[:, :, None], axis=1)
-        pos = jnp.arange(b, dtype=jnp.int32)[None, :]            # (1, B)
-        sh = jnp.where(pos[:, :, None] < used[:, None, None], sh, 0.0)
-        cumf = jnp.cumsum(sh, axis=1)                            # (F, B, 3)
-        total_used = cumf[:, -1:, :]
-        # prefix of the (i+1) LARGEST ratios = total_used - cumf[used-2-i]
-        bidx = used[:, None] - 2 - pos                           # (F, B)
-        tb = jnp.take_along_axis(
-            cumf, jnp.clip(bidx, 0, b - 1)[:, :, None], axis=1)
-        cumb = total_used - jnp.where((bidx >= 0)[:, :, None], tb, 0.0)
-
-        max_pos = jnp.minimum(jnp.minimum(params.max_cat_threshold,
-                                          (used[:, None] + 1) // 2),
-                              used[:, None])                     # (F, 1)
-        pos_ok = pos < max_pos
-        if use_et:  # one random subset size per node (USE_RAND)
-            pos_ok = pos_ok & (pos == rand_bins[:, None] %
-                               jnp.maximum(max_pos, 1))
-
-        def subset_gain(left):
-            right = total[:, None, :] - left
-            # group spacing: the reference only evaluates a position once
-            # >= min_data_per_group rows accumulated since the last
-            # evaluated one; approximated here as crossing a multiple of
-            # min_data_per_group in the prefix count
-            gcross = jnp.floor(left[..., 2] / mdpg)
-            gprev = jnp.concatenate([jnp.full((f, 1), -1.0),
-                                     gcross[:, :-1]], axis=1)
-            ok = (pos_ok & (left[..., 2] >= min_cnt) &
-                  (left[..., 1] >= min_h) &
-                  (right[..., 2] >= jnp.maximum(min_cnt, mdpg)) &
-                  (right[..., 1] >= min_h) & (gcross > gprev))
-            if use_out:
-                o_l = clamped_out(left, cat_l2)
-                o_r = clamped_out(right, cat_l2)
-                gl_ = _gain_given_output(left[..., 0], left[..., 1], o_l,
-                                         l1, cat_l2)
-                gr_ = _gain_given_output(right[..., 0], right[..., 1], o_r,
-                                         l1, cat_l2)
-            else:
-                gl_ = _leaf_gain(left[..., 0], left[..., 1], l1, cat_l2)
-                gr_ = _leaf_gain(right[..., 0], right[..., 1], l1, cat_l2)
-            g = gl_ + gr_ - min_gain_shift
-            return jnp.where(ok & (g > 0), g, NEG_INF)
-
-        gain_f = subset_gain(cumf)
-        gain_bk = subset_gain(cumb)
-        f_pos = jnp.argmax(gain_f, axis=1)
-        f_best = jnp.take_along_axis(gain_f, f_pos[:, None], 1)[:, 0]
-        b_pos = jnp.argmax(gain_bk, axis=1)
-        b_best = jnp.take_along_axis(gain_bk, b_pos[:, None], 1)[:, 0]
-        use_bk = b_best > f_best
-        sub_gain = jnp.where(use_bk, b_best, f_best)
-        sub_pos = jnp.where(use_bk, b_pos, f_pos)
-        sub_left = jnp.where(use_bk[:, None],
-                             take_bin(cumb, b_pos), take_bin(cumf, f_pos))
-        # membership: forward -> ranks [0, pos]; backward -> the top
-        # (pos+1) ranks of the used range
-        sub_member = jnp.where(
-            use_bk[:, None],
-            (rank >= used[:, None] - 1 - sub_pos[:, None]) &
-            (rank < used[:, None]),
-            rank <= sub_pos[:, None])
-
-        use_subset = is_cat & (num_bins > params.max_cat_to_onehot)
-        cat_best_gain = jnp.where(use_subset, sub_gain, oh_gain)
-        cat_member = jnp.where(use_subset[:, None], sub_member, oh_member)
-        cat_left_sum = jnp.where(use_subset[:, None], sub_left, oh_left)
-    else:
-        cat_best_gain = oh_gain
-        cat_member = oh_member
-        cat_left_sum = oh_left
+        # no categorical features in the dataset: the scan skips the
+        # one-vs-rest/subset machinery entirely (is_cat is all-False, so
+        # these dummies are never selected)
+        cat_best_gain = jnp.full((f,), NEG_INF, hist.dtype)
+        cat_member = jnp.zeros((f, b), jnp.bool_)
+        cat_left_sum = jnp.zeros((f, 3), hist.dtype)
 
     # ---- numerical best over (bin, direction); categorical by mode ----
     best_r_bin = jnp.argmax(gain_r, axis=1)
-    best_r_gain = jnp.take_along_axis(gain_r, best_r_bin[:, None], 1)[:, 0]
+    best_r_gain = at_bin(gain_r, best_r_bin)
     best_l_bin = jnp.argmax(gain_l, axis=1)
-    best_l_gain = jnp.take_along_axis(gain_l, best_l_bin[:, None], 1)[:, 0]
+    best_l_gain = at_bin(gain_l, best_l_bin)
 
     use_left = best_l_gain > best_r_gain
     num_gain = jnp.where(use_left, best_l_gain, best_r_gain)
     num_thr = jnp.where(use_left, best_l_bin, best_r_bin).astype(jnp.int32)
 
-    left_num = jnp.where(use_left[:, None],
-                         take_bin(cum, best_l_bin) + nan_sum,
-                         take_bin(cum, best_r_bin))
+    num_bin_pick = jnp.where(use_left, best_l_bin, best_r_bin)
+    left_num = jnp.stack([at_bin(cum_g, num_bin_pick),
+                          at_bin(cum_h, num_bin_pick),
+                          at_bin(cum_c, num_bin_pick)], axis=-1)
+    left_num = left_num + jnp.where(
+        use_left[:, None],
+        jnp.concatenate([nan_g, nan_h, nan_c], axis=1), 0.0)
+
     is_cat_b = is_cat[:, None]
     gain = jnp.where(is_cat, cat_best_gain, num_gain)
     if params.use_cegb:
@@ -405,7 +440,7 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     cat_thr = jnp.argmax(cat_member, axis=1).astype(jnp.int32)
     thr = jnp.where(is_cat, cat_thr, num_thr)
     left_sum = jnp.where(is_cat_b, cat_left_sum, left_num)
-    right_sum = total - left_sum
+    right_sum = parent_sum[None, :] - left_sum
 
     return FeatureSplits(
         gain=gain,
