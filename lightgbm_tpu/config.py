@@ -208,6 +208,17 @@ _PARAMS: List[ParamSpec] = [
     _p("tree_grow_mode", str, "auto"),
     # 0 = the kernel maximum (25 leaves/pass exact bf16, 42 quantized i8)
     _p("tpu_wave_size", int, 0, check=">=0"),
+    # speculative ramp (learner/wave.py): grow a provisional subtree on a
+    # row subsample, verify it against ONE full-data multi-channel
+    # histogram pass, and commit every provisional split whose exact gain
+    # is within tpu_spec_tolerance of that node's exact best — the
+    # frontier ramp (1 -> 2 -> 4 ... leaves) collapses from ~log2(W)
+    # full-data passes into one.  Exactness: every committed split's
+    # gain/sums are computed from full data; the subsample only GUESSES
+    # which splits to precompute.  Applies on the serial Pallas wave path
+    # for numeric-only datasets with num_leaves >= 3*wave_size.
+    _p("tpu_speculative_ramp", bool, True),
+    _p("tpu_spec_tolerance", float, 0.1, check=">=0.0"),
     _p("num_devices", int, 0),               # 0 = all visible devices
     # --- gradient quantization (config.h use_quantized_grad block;
     # gradient_discretizer.cpp) — int8 histogram training on the MXU

@@ -46,7 +46,8 @@ import jax.numpy as jnp
 
 from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
 from ..ops.histogram import build_histogram
-from ..ops.split import BIG, NEG_INF, leaf_output, leaf_output_smoothed
+from ..ops.split import (BIG, NEG_INF, _leaf_gain, leaf_output,
+                         leaf_output_smoothed)
 from .serial import CommStrategy, GrownTree
 
 __all__ = ["make_partitioned_grow_fn", "PART_ROW_BLOCK"]
@@ -455,7 +456,19 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                 rsum_f = s["leaf_sum"][best_leaf] - lsum_f
                 lsum = jnp.where(is_forced, lsum_f, lsum)
                 rsum = jnp.where(is_forced, rsum_f, rsum)
-                bgain = jnp.where(is_forced, 0.0, bgain)
+                # record the forced split's REAL gain (scan-scale), not 0
+                psum_f = s["leaf_sum"][best_leaf]
+                gain_f = (_leaf_gain(lsum_f[0], lsum_f[1],
+                                     split_params.lambda_l1,
+                                     split_params.lambda_l2) +
+                          _leaf_gain(rsum_f[0], rsum_f[1],
+                                     split_params.lambda_l1,
+                                     split_params.lambda_l2) -
+                          _leaf_gain(psum_f[0], psum_f[1],
+                                     split_params.lambda_l1,
+                                     split_params.lambda_l2) -
+                          split_params.min_gain_to_split)
+                bgain = jnp.where(is_forced, gain_f, bgain)
                 do = jnp.where(is_forced,
                                s["leaf_seg"][best_leaf] > 0, do)
             psum_ = s["leaf_sum"][best_leaf]

@@ -566,12 +566,10 @@ def split_params_from_config(config: Config,
                              ) -> SplitParams:
     mc = config.monotone_constraints or []
     use_mc = any(int(v) != 0 for v in mc)
-    if use_mc and config.monotone_constraints_method not in ("basic",):
-        from ..utils.log import log_warning
-        log_warning(f"monotone_constraints_method="
-                    f"'{config.monotone_constraints_method}' is not "
-                    f"implemented; falling back to 'basic' (safe but more "
-                    f"conservative bounds)")
+    # monotone_constraints_method is a GROWER-level choice: the wave
+    # growers implement 'intermediate' (region-box contiguity propagation,
+    # learner/wave.py); other growers warn and use 'basic' — the warnings
+    # are emitted where the grower is picked.
     # the sorted-subset categorical search is traced in only when some
     # categorical feature exceeds the one-hot threshold
     use_cat_subset = bool(
@@ -601,7 +599,30 @@ def split_params_from_config(config: Config,
         cegb_tradeoff=float(config.cegb_tradeoff),
         cegb_penalty_split=float(config.cegb_penalty_split),
         feature_fraction_bynode=float(config.feature_fraction_bynode),
-        extra_trees=bool(config.extra_trees))
+        extra_trees=bool(config.extra_trees),
+        any_cat=bool(is_cat is None or np.any(np.asarray(is_cat))))
+
+
+def resolve_monotone_method(config: Config, use_mc: bool,
+                            wave: bool) -> bool:
+    """Pick the intermediate-constraint flag for a grower and warn about
+    downgrades (reference monotone_constraints.hpp:514/:856 — 'advanced'
+    falls back to 'intermediate' on the wave growers; non-wave growers
+    fall back to 'basic')."""
+    method = str(config.monotone_constraints_method)
+    if not use_mc or method == "basic":
+        return False
+    from ..utils.log import log_warning
+    if not wave:
+        log_warning(f"monotone_constraints_method='{method}' requires the "
+                    "wave grower; falling back to 'basic' (safe but more "
+                    "conservative bounds)")
+        return False
+    if method == "advanced":
+        log_warning("monotone_constraints_method='advanced' is not "
+                    "implemented; using 'intermediate' (less constraining "
+                    "than basic, more than advanced)")
+    return True
 
 
 def hist_pool_fits(config: Config, num_features: int, max_bins: int) -> bool:
@@ -697,18 +718,20 @@ class SerialTreeLearner:
         interaction_groups = tuple(tuple(g) for g in interaction_groups)
         feature_contri = tuple(float(v) for v in feature_contri)
         cegb_lazy = tuple(float(v) for v in cegb_lazy)
-        wave_ok = (self.use_hist_pool and not forced_splits and
-                   int(config.num_leaves) > 2)
+        wave_ok = (self.use_hist_pool and int(config.num_leaves) > 2)
         mode = str(config.tree_grow_mode)
         if mode == "wave" and not wave_ok:
             from ..utils.log import log_warning
-            log_warning("tree_grow_mode=wave is incompatible with forced "
-                        "splits / num_leaves<=2 / pool-less growth; "
+            log_warning("tree_grow_mode=wave is incompatible with "
+                        "num_leaves<=2 / pool-less growth; "
                         "falling back to the partitioned grower")
             mode = "partition"
         elif mode == "auto":
             mode = "wave" if (wave_ok and impl == "pallas") else "partition"
         self.grow_mode = mode if self.use_hist_pool else "masked"
+        if self.grow_mode != "wave":
+            resolve_monotone_method(config, self.split_params.use_monotone,
+                                    wave=False)
         self._use_lazy = bool(cegb_lazy) and self.grow_mode == "wave"
         self._lazy_used = None
         if cegb_lazy and self.grow_mode != "wave":
@@ -733,10 +756,15 @@ class SerialTreeLearner:
                       bool(config.quant_train_renew_leaf),
                       bool(config.stochastic_rounding)) \
                 if self.quantized else (False,)
+            spec_ramp = bool(config.tpu_speculative_ramp)
+            spec_tol = float(config.tpu_spec_tolerance)
+            mc_inter = resolve_monotone_method(
+                config, self.split_params.use_monotone, wave=True)
             key = ("wave", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
                    impl, any_cat, wave_size, self._efb_dims, feature_contri,
-                   qtuple, interaction_groups, cegb_lazy)
+                   qtuple, interaction_groups, cegb_lazy, spec_ramp,
+                   spec_tol, forced_splits, mc_inter)
             if key not in _GROW_FN_CACHE:
                 from .wave import make_wave_grow_fn
                 _cache_put(key, make_wave_grow_fn(
@@ -750,7 +778,9 @@ class SerialTreeLearner:
                     renew_leaf=bool(config.quant_train_renew_leaf),
                     stochastic=bool(config.stochastic_rounding),
                     interaction_groups=interaction_groups,
-                    cegb_lazy=cegb_lazy))
+                    cegb_lazy=cegb_lazy, spec_ramp=spec_ramp,
+                    spec_tol=spec_tol, forced_splits=forced_splits,
+                    mc_inter=mc_inter))
             self._grow = _cache_hit(key)
         elif self.partitioned:
             key = ("part", int(config.num_leaves), num_features,

@@ -30,8 +30,8 @@ reproduces the sequential order exactly (tests cross-check this); at
 wave_size=16 the tree can differ near budget exhaustion — quality parity
 is asserted by tests on held-out loss.
 
-Feature gates: forced splits are not traced here — SerialTreeLearner
-falls back to the partitioned grower when they are active.  EFB, monotone
+Forced splits (serial_tree_learner.cpp:450 ForceSplits) are applied as
+pre-committed waves before gain-driven growth.  EFB, monotone
 constraints, CEGB, categorical splits, interaction constraints, by-node
 feature sampling, ExtraTrees random thresholds and quantized-gradient
 histograms are fully supported (the latter four batched per wave with the
@@ -47,7 +47,8 @@ import jax.numpy as jnp
 from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
 from ..ops.histogram import build_histogram_leaves
 from ..ops.quantize import dequant_scales, quantize_wch
-from ..ops.split import BIG, NEG_INF, leaf_output, leaf_output_smoothed
+from ..ops.split import (BIG, NEG_INF, _leaf_gain, leaf_output,
+                         leaf_output_smoothed)
 from .serial import CommStrategy, GrownTree, local_best_candidate
 
 __all__ = ["make_wave_grow_fn", "WAVE_SIZE", "Q_WAVE_SIZE"]
@@ -65,7 +66,11 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                       gq_max: int = 127, hq_max: int = 127,
                       renew_leaf: bool = False, stochastic: bool = True,
                       interaction_groups: tuple = (),
-                      cegb_lazy: tuple = ()):
+                      cegb_lazy: tuple = (), spec_ramp: bool = False,
+                      spec_tol: float = 0.1,
+                      spec_subsample: int = 1 << 19,
+                      forced_splits: tuple = (),
+                      mc_inter: bool = False):
     """Build the wave single-tree grower.
 
     Returned signature matches the partitioned grower:
@@ -117,6 +122,50 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
     use_lazy = len(cegb_lazy) > 0
     if use_lazy:
         lazy_pen = jnp.asarray(cegb_lazy, jnp.float32)       # (F,)
+    # Speculative ramp eligibility (all static).  The frontier ramp
+    # (1 -> 2 -> 4 -> ... leaves) costs ~log2(W) full-data histogram
+    # passes with most lanes idle; when eligible, grow() instead grows a
+    # provisional <=W-leaf subtree on a row subsample, verifies it with
+    # ONE full-data W-channel pass, and commits every provisional split
+    # whose EXACT full-data gain is within ``spec_tol`` of that node's
+    # exact best split.  Exactness: committed gains/sums/hists all come
+    # from the full-data channel sums — the subsample only chooses which
+    # histograms to precompute; a bad guess costs a skipped commit, never
+    # a wrong number.  Gated to the serial Pallas numeric path (the shapes
+    # the flagship benchmark runs); every other configuration keeps the
+    # plain ramp.
+    use_spec = (spec_ramp and hist_impl == "pallas" and not any_cat and
+                not use_efb and max_bins <= 255 and not use_mc and
+                not use_sm and not use_ic and not use_bynode and
+                not use_et and not use_lazy and not sp.use_cegb and
+                strategy is None and max_depth <= 0 and
+                not feature_contri and W >= 2 and L >= 3 * W and
+                not forced_splits)
+    # Forced splits (serial_tree_learner.cpp:450 ForceSplits): the
+    # BFS-ordered (leaf, inner feature, threshold bin) triples are applied
+    # as PRE-COMMITTED waves before gain-driven growth — statically
+    # grouped so no wave splits a leaf created (or already split) in the
+    # same wave, which keeps the sequential right-child numbering
+    # identical to the triples' BFS next_id assignment.  Child sums come
+    # from the parent's pooled histogram, so forced waves reuse the exact
+    # per-wave machinery (row update, one kernel pass, subtraction,
+    # children scans) with only split SELECTION overridden.
+    forced_waves: list = []
+    if forced_splits:
+        nf = min(len(forced_splits), L - 1)
+        cur: list = []
+        blocked: set = set()
+        nl_sim = 1
+        for (leaf_, f_, b_) in forced_splits[:nf]:
+            if leaf_ in blocked or len(cur) == W:
+                forced_waves.append(cur)
+                cur, blocked = [], set()
+            cur.append((leaf_, f_, b_))
+            blocked.add(leaf_)     # split once per wave
+            blocked.add(nl_sim)    # fresh right child: next wave only
+            nl_sim += 1
+        if cur:
+            forced_waves.append(cur)
     if use_bynode:
         import math as _math
         kcnt = max(1, int(_math.ceil(F * sp.feature_fraction_bynode)))
@@ -320,134 +369,423 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                          ).astype(jnp.int32), et_hi)
                 return jax.vmap(one)(ids)
 
-        # ---- root ----
-        root_hist = hist_waves(jnp.zeros((n,), jnp.int8), k=1)[0]
-        if quantized:
-            # derive the root totals from the quantized histogram itself
-            # (any bundle's bins sum to the total) so candidate left+right
-            # sums stay consistent with the totals downstream
-            root_sum = dq(root_hist)[0].sum(axis=0)
-        else:
-            root_sum = strat.reduce_sum(jnp.stack([
-                jnp.sum(gm), jnp.sum(hm), jnp.sum(cnt_mask)]))
-        root_hist_f = dq(root_hist) if quantized else root_hist
-        root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
-        root_out = _child_out(root_sum[0], root_sum[1], root_sum[2],
-                              jnp.asarray(0.0, jnp.float32))
-        rid = jnp.asarray([2 * L], jnp.int32)
-        fm_root = feature_mask
-        if use_ic:
-            fm_root = fm_root & allowed_features(
-                jnp.zeros((F,), jnp.bool_))
-        if use_bynode:
-            fm_root = fm_root & node_mask_many(rid)[0]
-        rb_root = node_rand_many(rid)[0] if use_et else None
-        if use_lazy:
-            # Charge only rows whose feature bit is still unset in the
-            # PERSISTENT used bitmap (cost_effective_gradient_boosting.hpp
-            # CalculateOndemandCosts): from the second tree on, features
-            # already materialized by earlier trees' splits cost nothing
-            # for those rows.  used_root[f] = in-bag rows with bit set.
-            # Like cnt_group below, the f32-accumulated 0/1 dot is exact
-            # to 2^24 counted rows per shard; beyond that the lazy cost
-            # degrades gracefully (it only biases split selection).
-            base = strat.cegb_full if strat.cegb_full is not None else 0.0
-            used0 = lazy_used if lazy_used is not None \
-                else jnp.zeros((F, n), jnp.bool_)
-            used_root = strat.reduce_sum(jax.lax.dot_general(
-                used0.astype(jnp.bfloat16),
-                (bag_mask > 0).astype(jnp.bfloat16)[None, :],
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)[:, 0])       # (F,)
-            strat.cegb_full = base + lazy_pen * jnp.maximum(
-                root_sum[2] - used_root, 0.0)
-        cand = strat.leaf_candidates(expand_hist(root_hist_f, root_sum),
-                                     root_sum, fm_root, sp,
-                                     root_bound, jnp.asarray(0, jnp.int32),
-                                     root_out, rb_root)
-
         rl_dtype = jnp.uint8 if L <= 256 else jnp.int32
-        state = {
-            "row_leaf": jnp.zeros((n,), rl_dtype),
-            "leaf_sum": jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum),
-            "leaf_depth": jnp.zeros((L,), jnp.int32),
-            "cand_gain": jnp.full((L,), NEG_INF, jnp.float32).at[0].set(cand[0]),
-            "cand_feat": jnp.zeros((L,), jnp.int32).at[0].set(cand[1]),
-            "cand_bin": jnp.zeros((L,), jnp.int32).at[0].set(cand[2]),
-            "cand_dleft": jnp.zeros((L,), jnp.bool_).at[0].set(cand[3]),
-            "cand_lsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[4]),
-            "cand_rsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[5]),
-            "cand_member": jnp.zeros((L, max_bins), jnp.bool_).at[0].set(
-                cand[6]),
-            "hists": jnp.zeros(
-                (L, G, Bb, 3),
-                jnp.int32 if quantized else jnp.float32).at[0].set(
-                    root_hist),
-            "split_feature": jnp.full((L - 1,), -1, jnp.int32),
-            "threshold_bin": jnp.zeros((L - 1,), jnp.int32),
-            "nan_bin": jnp.full((L - 1,), -1, jnp.int32),
-            "cat_member": jnp.zeros((L - 1, max_bins), jnp.bool_),
-            "decision_type": jnp.zeros((L - 1,), jnp.int32),
-            "left_child": jnp.zeros((L - 1,), jnp.int32),
-            "right_child": jnp.zeros((L - 1,), jnp.int32),
-            "split_gain": jnp.zeros((L - 1,), jnp.float32),
-            "internal_value": jnp.zeros((L - 1,), jnp.float32),
-            "internal_weight": jnp.zeros((L - 1,), jnp.float32),
-            "internal_count": jnp.zeros((L - 1,), jnp.float32),
-            "leaf_value": jnp.zeros((L,), jnp.float32).at[0].set(root_out),
-            "leaf_weight": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[1]),
-            "leaf_count": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[2]),
-            "num_leaves": jnp.asarray(1, jnp.int32),
-            "done": jnp.asarray(False),
-        }
-        if use_mc:
-            state["leaf_mn"] = jnp.full((L,), -BIG, jnp.float32)
-            state["leaf_mx"] = jnp.full((L,), BIG, jnp.float32)
-        if use_ic:
-            # features used on the path to each leaf (interaction
-            # constraints restrict children to compatible groups)
-            state["leaf_path"] = jnp.zeros((L, F), jnp.bool_)
-        if use_lazy:
-            # per-(feature, row) "already computed" bitmap — PERSISTENT
-            # across trees like the reference's feature_used_in_data_
-            # bitset (it is allocated once per training run and never
-            # cleared); the learner threads it through every grow call.
-            # Kept as bool (1 byte per cell) — bit-packing would cut HBM
-            # 8x for very wide lazy-penalized datasets.
-            state["used"] = lazy_used if lazy_used is not None \
-                else jnp.zeros((F, n), jnp.bool_)
+        nonlocal_dbg: dict = {}
+
+        def _spec_state():
+            """Speculative-ramp initial state: provisional subtree from a
+            row subsample, verified and committed against one full-data
+            W-channel histogram pass (see make_wave_grow_fn docnotes).
+            Replaces the root pass + the first ~log2(W) ramp waves."""
+            import math as _m
+            Kc, K1 = W, W - 1
+            # -- statically-strided row subsample (weights carry bagging/
+            # GOSS masks, so out-of-bag rows contribute nothing) --
+            stride = max(1, n // max(int(spec_subsample), 4096))
+            n_ss = max((n // stride) // 4096 * 4096, 4096)
+            X_ss = X_T[:, ::stride][:, :n_ss]
+            w_src = wch0 if quantized else w8
+            w_ss = w_src[:, ::stride][:, :n_ss]
+            nan_of = jnp.where(hn_full, nb_full - 1, -1)       # (F,)
+            fm_k = jnp.broadcast_to(feature_mask, (Kc, F))
+            jar = jnp.arange(Kc, dtype=jnp.int32)
+            zb_k = jnp.zeros((Kc, 2), jnp.float32)
+            zd_k = jnp.zeros((Kc,), jnp.int32)
+
+            def dqh(h):
+                return dq(h) if quantized else h
+
+            # -- provisional growth on the subsample: each wave histograms
+            # EVERY current prov leaf (rl_ss doubles as the channel id),
+            # scans, and splits all positive-gain leaves up to capacity --
+            rl_ss = jnp.zeros((n_ss,), jnp.uint8)
+            nlp = jnp.asarray(1, jnp.int32)
+            pfeat = jnp.zeros((K1,), jnp.int32)
+            pthr = jnp.zeros((K1,), jnp.int32)
+            pnan = jnp.full((K1,), -1, jnp.int32)
+            pdl = jnp.zeros((K1,), jnp.int32)
+            pleaf = jnp.zeros((K1,), jnp.int32)
+            pact = jnp.zeros((K1,), jnp.bool_)
+            ppar = jnp.full((K1,), -1, jnp.int32)
+            owner = jnp.full((Kc,), -1, jnp.int32)
+            Lm = jnp.zeros((K1, Kc), jnp.bool_)   # left-descendant leaves
+            Rm = jnp.zeros((K1, Kc), jnp.bool_)   # right-descendant leaves
+            tabs = []
+            for _t in range(max(1, int(_m.ceil(_m.log2(Kc))))):
+                if quantized:
+                    h_ss = build_histogram_pallas_leaves_q8(
+                        X_ss, w_ss, rl_ss.astype(jnp.int8), num_bins=Bb,
+                        interpret=interpret)[:Kc]
+                else:
+                    h_ss = build_histogram_pallas_leaves(
+                        X_ss, w_ss, rl_ss.astype(jnp.int8), num_bins=Bb,
+                        interpret=interpret)[:Kc]
+                hfs = dqh(h_ss)                              # (Kc, G, Bb, 3)
+                sums_pl = hfs[:, 0].sum(axis=1)              # (Kc, 3)
+                lvp = leaf_output(sums_pl[:, 0], sums_pl[:, 1], sp)
+                cnds = many_candidates(
+                    jax.vmap(expand_hist)(hfs, sums_pl), sums_pl,
+                    zb_k, zd_k, lvp, fm_k)
+                g = jnp.where(jar < nlp, cnds[0], NEG_INF)
+                vals, sel_l = jax.lax.top_k(g, Kc)
+                sel = (vals > 0) & (jar < Kc - nlp)
+                prefix = jnp.cumsum(sel.astype(jnp.int32))
+                newids = nlp + prefix - 1
+                nodeids = (nlp - 1) + prefix - 1
+                feat_s = cnds[1][sel_l]
+                thr_s = cnds[2][sel_l]
+                dl_s = cnds[3][sel_l].astype(jnp.int32)
+                fnan_s = nan_of[feat_s]
+                nidx = jnp.where(sel, nodeids, K1)
+                pfeat = pfeat.at[nidx].set(feat_s, mode="drop")
+                pthr = pthr.at[nidx].set(thr_s, mode="drop")
+                pnan = pnan.at[nidx].set(fnan_s, mode="drop")
+                pdl = pdl.at[nidx].set(dl_s, mode="drop")
+                pleaf = pleaf.at[nidx].set(sel_l, mode="drop")
+                pact = pact.at[nidx].set(sel, mode="drop")
+                ppar = ppar.at[nidx].set(owner[sel_l], mode="drop")
+                # descendant propagation: nodes holding leaf r gain leaf s
+                A = jnp.zeros((Kc, Kc), jnp.int32).at[
+                    jnp.where(sel, sel_l, Kc),
+                    jnp.where(sel, newids, Kc)].set(1, mode="drop")
+                Lm = Lm | (Lm.astype(jnp.int32) @ A > 0)
+                Rm = Rm | (Rm.astype(jnp.int32) @ A > 0)
+                oh_l = jax.nn.one_hot(sel_l, Kc, dtype=jnp.bool_)
+                oh_r = jax.nn.one_hot(newids, Kc, dtype=jnp.bool_)
+                Lm = Lm.at[nidx].set(oh_l, mode="drop")
+                Rm = Rm.at[nidx].set(oh_r, mode="drop")
+                owner = owner.at[jnp.where(sel, sel_l, Kc)].set(
+                    nodeids, mode="drop")
+                owner = owner.at[jnp.where(sel, newids, Kc)].set(
+                    nodeids, mode="drop")
+                feats_cl = jnp.clip(feat_s, 0, F - 1)
+                tab = jnp.stack([
+                    thr_s, fnan_s, dl_s, jnp.ones((Kc,), jnp.int32),
+                    sel_l, newids, sel.astype(jnp.int32),
+                    jnp.zeros((Kc,), jnp.int32)])
+                cols_ss = jnp.take(X_ss, feats_cl, axis=0)
+                rl2, _ = wave_row_update_pallas(cols_ss, rl_ss, tab,
+                                                interpret=interpret)
+                rl_ss = rl2.astype(jnp.uint8)
+                tabs.append((tab, feats_cl))
+                nlp = nlp + prefix[-1]
+
+            # -- route ALL rows through the provisional tree (same
+            # per-wave fused kernel the real row update uses, so the
+            # partition matches how committed splits will route) --
+            rl_full = jnp.zeros((n,), jnp.uint8)
+            for tab, feats_cl in tabs:
+                cols = jnp.take(X_T, feats_cl, axis=0)
+                rlf, _ = wave_row_update_pallas(cols, rl_full, tab,
+                                                interpret=interpret)
+                rl_full = rlf.astype(jnp.uint8)
+
+            # -- ONE full-data pass: exact per-prov-leaf channel sums --
+            h_ch = hist_waves(rl_full.astype(jnp.int8), k=Kc)
+            hf_ch = dqh(h_ch)
+            leaf_tot = hf_ch[:, 0].sum(axis=1)               # (Kc, 3)
+
+            # -- exact node aggregates + commit tests --
+            lt3 = Lm.astype(jnp.float32) @ leaf_tot          # (K1, 3)
+            rt3 = Rm.astype(jnp.float32) @ leaf_tot
+            pt3 = lt3 + rt3
+            Dn = Lm | Rm
+            H_node = jnp.einsum("jl,lgbc->jgbc",
+                                Dn.astype(hf_ch.dtype), hf_ch)
+            lvn = leaf_output(pt3[:, 0], pt3[:, 1], sp)
+            bg = many_candidates(
+                jax.vmap(expand_hist)(H_node, pt3), pt3,
+                jnp.zeros((K1, 2), jnp.float32),
+                jnp.zeros((K1,), jnp.int32), lvn,
+                jnp.broadcast_to(feature_mask, (K1, F)))[0]
+
+            def lg3(s3):
+                return _leaf_gain(s3[:, 0], s3[:, 1],
+                                  sp.lambda_l1, sp.lambda_l2)
+
+            pg = lg3(lt3) + lg3(rt3) - (lg3(pt3) + sp.min_gain_to_split)
+            okc = ((lt3[:, 2] >= sp.min_data_in_leaf) &
+                   (rt3[:, 2] >= sp.min_data_in_leaf) &
+                   (lt3[:, 1] >= sp.min_sum_hessian_in_leaf) &
+                   (rt3[:, 1] >= sp.min_sum_hessian_in_leaf))
+            test = (pact & okc & (pg > 0) &
+                    (pg >= (1.0 - spec_tol) * jnp.maximum(bg, 0.0)))
+            comm = jnp.zeros((K1,), jnp.bool_)
+            for j in range(K1):  # parents precede children by construction
+                pok = jnp.where(ppar[j] < 0, True,
+                                comm[jnp.maximum(ppar[j], 0)])
+                comm = comm.at[j].set(pok & test[j])
+
+            # -- replay committed nodes into the wave-state arrays (same
+            # leaf/node numbering convention as the wave body: left child
+            # keeps the split leaf's id, right child takes the next
+            # fresh id; child slots encode leaves as -(leaf+1)) --
+            s_map = jnp.zeros((Kc,), jnp.int32)   # prov leaf -> state leaf
+            depth_pl = jnp.zeros((Kc,), jnp.int32)
+            nl_run = jnp.asarray(1, jnp.int32)
+            sf = jnp.full((L - 1,), -1, jnp.int32)
+            tb_ = jnp.zeros((L - 1,), jnp.int32)
+            nb_ = jnp.full((L - 1,), -1, jnp.int32)
+            dt_ = jnp.zeros((L - 1,), jnp.int32)
+            lc_ = jnp.zeros((L - 1,), jnp.int32)
+            rc_ = jnp.zeros((L - 1,), jnp.int32)
+            sg_ = jnp.zeros((L - 1,), jnp.float32)
+            iv_ = jnp.zeros((L - 1,), jnp.float32)
+            iw_ = jnp.zeros((L - 1,), jnp.float32)
+            ic_ = jnp.zeros((L - 1,), jnp.float32)
+            for j in range(K1):
+                cj = comm[j]
+                sl = s_map[pleaf[j]]
+                new_leaf = nl_run
+                nid = nl_run - 1
+                enc = -(sl + 1)
+                lc_ = jnp.where(cj & (lc_ == enc), nid, lc_)
+                rc_ = jnp.where(cj & (rc_ == enc), nid, rc_)
+                nidx = jnp.where(cj, nid, L - 1)
+                sf = sf.at[nidx].set(pfeat[j], mode="drop")
+                tb_ = tb_.at[nidx].set(pthr[j], mode="drop")
+                nb_ = nb_.at[nidx].set(pnan[j], mode="drop")
+                dt_ = dt_.at[nidx].set(
+                    jnp.where(pdl[j] > 0, DEFAULT_LEFT_MASK, 0) |
+                    jnp.where(pnan[j] >= 0, MISSING_NAN, 0), mode="drop")
+                lc_ = lc_.at[nidx].set(enc, mode="drop")
+                rc_ = rc_.at[nidx].set(-(new_leaf + 1), mode="drop")
+                sg_ = sg_.at[nidx].set(pg[j], mode="drop")
+                iv_ = iv_.at[nidx].set(
+                    leaf_output(pt3[j, 0], pt3[j, 1], sp), mode="drop")
+                iw_ = iw_.at[nidx].set(pt3[j, 1], mode="drop")
+                ic_ = ic_.at[nidx].set(pt3[j, 2], mode="drop")
+                s_map = jnp.where(cj & Rm[j], new_leaf, s_map)
+                depth_pl = jnp.where(cj & Dn[j], depth_pl + 1, depth_pl)
+                nl_run = nl_run + cj.astype(jnp.int32)
+
+            import os as _os
+            if _os.environ.get("LGBM_TPU_SPEC_DEBUG"):
+                # debug-only (axon cannot host-callback): smuggle the
+                # commit/prov counts out through the last split_gain slot,
+                # which a 255-leaf debug tree then exposes to the host
+                nonlocal_dbg["spec_counts"] = jnp.stack(
+                    [nlp, jnp.sum(comm.astype(jnp.int32))])
+
+            # -- pools + frontier candidates --
+            rl0 = jnp.take(s_map, rl_full.astype(jnp.int32))
+            hists0 = jnp.zeros(
+                (L, G, Bb, 3), h_ch.dtype).at[s_map].add(h_ch[:Kc])
+            lsum0 = jnp.zeros((L, 3), jnp.float32).at[s_map].add(leaf_tot)
+            ldep0 = jnp.zeros((L,), jnp.int32).at[s_map].set(depth_pl)
+            live = jnp.arange(L, dtype=jnp.int32) < nl_run
+            lval0 = jnp.where(live, leaf_output(lsum0[:, 0], lsum0[:, 1],
+                                                sp), 0.0)
+            cnds0 = many_candidates(
+                jax.vmap(expand_hist)(dqh(hists0[:Kc]), lsum0[:Kc]),
+                lsum0[:Kc], zb_k, ldep0[:Kc], lval0[:Kc], fm_k)
+            cg0 = jnp.where(jar < nl_run, cnds0[0], NEG_INF)
+            return {
+                "row_leaf": rl0.astype(rl_dtype),
+                "leaf_sum": lsum0,
+                "leaf_depth": ldep0,
+                "cand_gain": jnp.full((L,), NEG_INF,
+                                      jnp.float32).at[:Kc].set(cg0),
+                "cand_feat": jnp.zeros((L,), jnp.int32).at[:Kc].set(
+                    cnds0[1]),
+                "cand_bin": jnp.zeros((L,), jnp.int32).at[:Kc].set(
+                    cnds0[2]),
+                "cand_dleft": jnp.zeros((L,), jnp.bool_).at[:Kc].set(
+                    cnds0[3]),
+                "cand_lsum": jnp.zeros((L, 3), jnp.float32).at[:Kc].set(
+                    cnds0[4]),
+                "cand_rsum": jnp.zeros((L, 3), jnp.float32).at[:Kc].set(
+                    cnds0[5]),
+                "cand_member": jnp.zeros((L, max_bins),
+                                         jnp.bool_).at[:Kc].set(cnds0[6]),
+                "hists": hists0,
+                "split_feature": sf, "threshold_bin": tb_, "nan_bin": nb_,
+                "cat_member": jnp.zeros((L - 1, max_bins), jnp.bool_),
+                "decision_type": dt_, "left_child": lc_, "right_child": rc_,
+                "split_gain": sg_, "internal_value": iv_,
+                "internal_weight": iw_, "internal_count": ic_,
+                "leaf_value": lval0,
+                "leaf_weight": jnp.where(live, lsum0[:, 1], 0.0),
+                "leaf_count": jnp.where(live, lsum0[:, 2], 0.0),
+                "num_leaves": nl_run,
+                "done": jnp.asarray(False),
+            }
+
+        if use_spec:
+            state = _spec_state()
+        else:
+            # ---- root ----
+            root_hist = hist_waves(jnp.zeros((n,), jnp.int8), k=1)[0]
+            if quantized:
+                # derive the root totals from the quantized histogram itself
+                # (any bundle's bins sum to the total) so candidate left+right
+                # sums stay consistent with the totals downstream
+                root_sum = dq(root_hist)[0].sum(axis=0)
+            else:
+                root_sum = strat.reduce_sum(jnp.stack([
+                    jnp.sum(gm), jnp.sum(hm), jnp.sum(cnt_mask)]))
+            root_hist_f = dq(root_hist) if quantized else root_hist
+            root_bound = jnp.asarray([-BIG, BIG], jnp.float32)
+            root_out = _child_out(root_sum[0], root_sum[1], root_sum[2],
+                                  jnp.asarray(0.0, jnp.float32))
+            rid = jnp.asarray([2 * L], jnp.int32)
+            fm_root = feature_mask
+            if use_ic:
+                fm_root = fm_root & allowed_features(
+                    jnp.zeros((F,), jnp.bool_))
+            if use_bynode:
+                fm_root = fm_root & node_mask_many(rid)[0]
+            rb_root = node_rand_many(rid)[0] if use_et else None
+            if use_lazy:
+                # Charge only rows whose feature bit is still unset in the
+                # PERSISTENT used bitmap (cost_effective_gradient_boosting.hpp
+                # CalculateOndemandCosts): from the second tree on, features
+                # already materialized by earlier trees' splits cost nothing
+                # for those rows.  used_root[f] = in-bag rows with bit set.
+                # Like cnt_group below, the f32-accumulated 0/1 dot is exact
+                # to 2^24 counted rows per shard; beyond that the lazy cost
+                # degrades gracefully (it only biases split selection).
+                base = strat.cegb_full if strat.cegb_full is not None else 0.0
+                used0 = lazy_used if lazy_used is not None \
+                    else jnp.zeros((F, n), jnp.bool_)
+                used_root = strat.reduce_sum(jax.lax.dot_general(
+                    used0.astype(jnp.bfloat16),
+                    (bag_mask > 0).astype(jnp.bfloat16)[None, :],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)[:, 0])       # (F,)
+                strat.cegb_full = base + lazy_pen * jnp.maximum(
+                    root_sum[2] - used_root, 0.0)
+            cand = strat.leaf_candidates(expand_hist(root_hist_f, root_sum),
+                                         root_sum, fm_root, sp,
+                                         root_bound, jnp.asarray(0, jnp.int32),
+                                         root_out, rb_root)
+
+            state = {
+                "row_leaf": jnp.zeros((n,), rl_dtype),
+                "leaf_sum": jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum),
+                "leaf_depth": jnp.zeros((L,), jnp.int32),
+                "cand_gain": jnp.full((L,), NEG_INF, jnp.float32).at[0].set(cand[0]),
+                "cand_feat": jnp.zeros((L,), jnp.int32).at[0].set(cand[1]),
+                "cand_bin": jnp.zeros((L,), jnp.int32).at[0].set(cand[2]),
+                "cand_dleft": jnp.zeros((L,), jnp.bool_).at[0].set(cand[3]),
+                "cand_lsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[4]),
+                "cand_rsum": jnp.zeros((L, 3), jnp.float32).at[0].set(cand[5]),
+                "cand_member": jnp.zeros((L, max_bins), jnp.bool_).at[0].set(
+                    cand[6]),
+                "hists": jnp.zeros(
+                    (L, G, Bb, 3),
+                    jnp.int32 if quantized else jnp.float32).at[0].set(
+                        root_hist),
+                "split_feature": jnp.full((L - 1,), -1, jnp.int32),
+                "threshold_bin": jnp.zeros((L - 1,), jnp.int32),
+                "nan_bin": jnp.full((L - 1,), -1, jnp.int32),
+                "cat_member": jnp.zeros((L - 1, max_bins), jnp.bool_),
+                "decision_type": jnp.zeros((L - 1,), jnp.int32),
+                "left_child": jnp.zeros((L - 1,), jnp.int32),
+                "right_child": jnp.zeros((L - 1,), jnp.int32),
+                "split_gain": jnp.zeros((L - 1,), jnp.float32),
+                "internal_value": jnp.zeros((L - 1,), jnp.float32),
+                "internal_weight": jnp.zeros((L - 1,), jnp.float32),
+                "internal_count": jnp.zeros((L - 1,), jnp.float32),
+                "leaf_value": jnp.zeros((L,), jnp.float32).at[0].set(root_out),
+                "leaf_weight": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[1]),
+                "leaf_count": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[2]),
+                "num_leaves": jnp.asarray(1, jnp.int32),
+                "done": jnp.asarray(False),
+            }
+            if use_mc:
+                state["leaf_mn"] = jnp.full((L,), -BIG, jnp.float32)
+                state["leaf_mx"] = jnp.full((L,), BIG, jnp.float32)
+                if mc_inter:
+                    # per-leaf bin-space region boxes for the geometric
+                    # contiguity test of the intermediate constraints
+                    state["leaf_lo"] = jnp.zeros((L, F), jnp.int32)
+                    state["leaf_hi"] = jnp.broadcast_to(
+                        (nb_full - 1).astype(jnp.int32)[None, :],
+                        (L, F)).copy()
+            if use_ic:
+                # features used on the path to each leaf (interaction
+                # constraints restrict children to compatible groups)
+                state["leaf_path"] = jnp.zeros((L, F), jnp.bool_)
+            if use_lazy:
+                # per-(feature, row) "already computed" bitmap — PERSISTENT
+                # across trees like the reference's feature_used_in_data_
+                # bitset (it is allocated once per training run and never
+                # cleared); the learner threads it through every grow call.
+                # Kept as bool (1 byte per cell) — bit-packing would cut HBM
+                # 8x for very wide lazy-penalized datasets.
+                state["used"] = lazy_used if lazy_used is not None \
+                    else jnp.zeros((F, n), jnp.bool_)
 
         jarange = jnp.arange(W, dtype=jnp.int32)
 
-        def body(s):
+        def body(s, forced=None):
             nl0 = s["num_leaves"]
-            budget = L - nl0
-            # Endgame taper: committing a full wave close to the leaf
-            # budget would lock in splits that freshly-created children
-            # (whose gains are not yet known) should have outcompeted —
-            # the sequential best-first order lets them.  Halving the wave
-            # once budget < 2W closes most of the quality gap to the exact
-            # order; the W//4 floor caps the halving cascade at ~2-3
-            # extra waves (each wave is a full-data histogram pass — a
-            # log2(W)-deep taper costs more wall time than its last few
-            # splits are worth).
-            taper = jnp.maximum(budget // 2, jnp.minimum(W // 4, budget))
-            k_eff = jnp.minimum(W, jnp.maximum(
-                1, jnp.where(budget >= 2 * W, budget, taper)))
-            vals, sel_leaves = jax.lax.top_k(s["cand_gain"], W)
-            sel = (vals > 0) & (jarange < k_eff)
+            if forced is None:
+                budget = L - nl0
+                # Endgame taper: committing a full wave close to the leaf
+                # budget would lock in splits that freshly-created children
+                # (whose gains are not yet known) should have outcompeted —
+                # the sequential best-first order lets them.  Halving the
+                # wave once budget < 2W closes most of the quality gap to
+                # the exact order; the W//4 floor caps the halving cascade
+                # at ~2-3 extra waves (each wave is a full-data histogram
+                # pass — a log2(W)-deep taper costs more wall time than
+                # its last few splits are worth).
+                taper = jnp.maximum(budget // 2, jnp.minimum(W // 4, budget))
+                k_eff = jnp.minimum(W, jnp.maximum(
+                    1, jnp.where(budget >= 2 * W, budget, taper)))
+                vals, sel_leaves = jax.lax.top_k(s["cand_gain"], W)
+                sel = (vals > 0) & (jarange < k_eff)
+                feat = s["cand_feat"][sel_leaves]          # (W,)
+                thr = s["cand_bin"][sel_leaves]
+                dleft = s["cand_dleft"][sel_leaves]
+                lsum = s["cand_lsum"][sel_leaves]          # (W, 3)
+                rsum = s["cand_rsum"][sel_leaves]
+                member = s["cand_member"][sel_leaves]      # (W, B)
+                psum_ = s["leaf_sum"][sel_leaves]
+            else:
+                # forced wave: fixed (leaf, feature, bin) applied
+                # regardless of gain; child sums read from the parent's
+                # pooled histogram (the partitioned grower's ForceSplits
+                # override, learner/partitioned.py:440, batched)
+                import numpy as _np
+                k = len(forced)
+                pad = [(0, 0, 0)] * (W - k)
+                trip = _np.asarray(list(forced) + pad, _np.int32)
+                sel_leaves = jnp.asarray(trip[:, 0])
+                feat = jnp.asarray(trip[:, 1])
+                thr = jnp.asarray(trip[:, 2])
+                psum_ = s["leaf_sum"][jnp.asarray(trip[:, 0])]
+                # empty forced leaves are skipped like the partitioned
+                # grower's `do = leaf_seg > 0` gate (degenerate forcing
+                # files route all rows one way; the reference stops
+                # forcing such subtrees too)
+                sel = jnp.asarray(_np.arange(W) < k) & (psum_[:, 2] > 0)
+                dleft = jnp.zeros((W,), jnp.bool_)
+                member = jnp.zeros((W, max_bins), jnp.bool_)
+                ph = s["hists"][sel_leaves]
+                phf = dq(ph) if quantized else ph
+                exh = jax.vmap(expand_hist)(phf, psum_)    # (W, F, B, 3)
+                fh = exh[jnp.arange(W), feat]              # (W, B, 3)
+                csum = jnp.cumsum(fh, axis=1)
+                lsum = csum[jnp.arange(W),
+                            jnp.clip(thr, 0, max_bins - 1)]
+                rsum = psum_ - lsum
+                # record the forced split's REAL gain (the reference's
+                # ForceSplits computes a full SplitInfo for the forced
+                # threshold), on the scan's shifted-gain scale
+                vals = (_leaf_gain(lsum[:, 0], lsum[:, 1],
+                                   sp.lambda_l1, sp.lambda_l2) +
+                        _leaf_gain(rsum[:, 0], rsum[:, 1],
+                                   sp.lambda_l1, sp.lambda_l2) -
+                        _leaf_gain(psum_[:, 0], psum_[:, 1],
+                                   sp.lambda_l1, sp.lambda_l2) -
+                        sp.min_gain_to_split)
             prefix = jnp.cumsum(sel.astype(jnp.int32))
             total_new = prefix[-1]
             new_ids = nl0 + prefix - 1                     # valid where sel
             node_ids = (nl0 - 1) + prefix - 1              # node index
-
-            feat = s["cand_feat"][sel_leaves]              # (W,)
-            thr = s["cand_bin"][sel_leaves]
-            dleft = s["cand_dleft"][sel_leaves]
-            lsum = s["cand_lsum"][sel_leaves]              # (W, 3)
-            rsum = s["cand_rsum"][sel_leaves]
-            member = s["cand_member"][sel_leaves]          # (W, B)
-            psum_ = s["leaf_sum"][sel_leaves]
             left_smaller = lsum[:, 2] <= rsum[:, 2]        # (W,)
             fcat = ic_full[feat]
             fnan = hn_full[feat]
@@ -509,7 +847,94 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             parent_lv = s["leaf_value"][sel_leaves]
             out_l = _child_out(lsum[:, 0], lsum[:, 1], lsum[:, 2], parent_lv)
             out_r = _child_out(rsum[:, 0], rsum[:, 1], rsum[:, 2], parent_lv)
-            if use_mc:
+            if use_mc and mc_inter:
+                # Intermediate constraints (monotone_constraints.hpp:514
+                # IntermediateLeafConstraints): children are bounded by
+                # the SIBLING'S OUTPUT instead of the midpoint, and the
+                # new outputs propagate to every geometrically contiguous
+                # leaf.  The reference finds contiguous leaves by walking
+                # up the tree and filtering thresholds
+                # (GoUpToFindLeavesToUpdate / GoDownToFindLeavesToUpdate);
+                # here each leaf carries its bin-space region box
+                # (leaf_lo/leaf_hi), and contiguity is the EXACT geometric
+                # test — regions overlapping in every feature except one
+                # monotone feature where they are disjoint and ordered.
+                # The wave's W splits are refined sequentially over the
+                # SMALL (L,)-sized arrays (one histogram pass still serves
+                # the whole wave), so later slots see earlier slots'
+                # tightened bounds — within-wave batching stays safe.
+                mn_all, mx_all = s["leaf_mn"], s["leaf_mx"]
+                lo_all, hi_all = s["leaf_lo"], s["leaf_hi"]
+                out_l2 = jnp.zeros((W,), jnp.float32)
+                out_r2 = jnp.zeros((W,), jnp.float32)
+                bnd_l = jnp.zeros((W, 2), jnp.float32)
+                bnd_r = jnp.zeros((W, 2), jnp.float32)
+                inc_row = (monotone > 0)[None, :]
+                dec_row = (monotone < 0)[None, :]
+                for j in range(W):
+                    act = sel[j]
+                    p = sel_leaves[j]
+                    fj = feat[j]
+                    mj = jnp.where(fcat[j], 0, monotone[fj])
+                    pmn, pmx = mn_all[p], mx_all[p]
+                    ol = jnp.clip(out_l[j], pmn, pmx)
+                    orr = jnp.clip(out_r[j], pmn, pmx)
+                    # bounds tightened by earlier slots can cross a stale
+                    # candidate's outputs; collapse to the shared boundary
+                    # (monotone-safe, zero-gain degenerate split)
+                    cross = ((mj > 0) & (ol > orr)) | ((mj < 0) & (ol < orr))
+                    midj = (ol + orr) / 2.0
+                    ol = jnp.where(cross, jnp.clip(midj, pmn, pmx), ol)
+                    orr = jnp.where(cross, jnp.clip(midj, pmn, pmx), orr)
+                    # child entries (UpdateConstraintsWithOutputs)
+                    mn_lj = jnp.where(mj < 0, jnp.maximum(pmn, orr), pmn)
+                    mx_lj = jnp.where(mj > 0, jnp.minimum(pmx, orr), pmx)
+                    mn_rj = jnp.where(mj > 0, jnp.maximum(pmn, ol), pmn)
+                    mx_rj = jnp.where(mj < 0, jnp.minimum(pmx, ol), pmx)
+                    # child regions (categorical splits keep the parent box
+                    # — no feature-order relation between cat children)
+                    lo_p, hi_p = lo_all[p], hi_all[p]
+                    num_j = jnp.logical_not(fcat[j])
+                    hi_l = jnp.where(num_j, hi_p.at[fj].set(thr[j]), hi_p)
+                    lo_r = jnp.where(num_j,
+                                     lo_p.at[fj].set(thr[j] + 1), lo_p)
+                    for c_lo, c_hi, c_out in ((lo_p, hi_l, ol),
+                                              (lo_r, hi_p, orr)):
+                        inter = (lo_all <= c_hi[None, :]) & \
+                            (hi_all >= c_lo[None, :])          # (L, F)
+                        nfail = jnp.sum(jnp.logical_not(inter), axis=1)
+                        onlyf = (nfail == 1)[:, None] & \
+                            jnp.logical_not(inter)
+                        below = onlyf & (hi_all < c_lo[None, :])
+                        above = onlyf & (lo_all > c_hi[None, :])
+                        capmax = jnp.any((below & inc_row) |
+                                         (above & dec_row), axis=1)
+                        capmin = jnp.any((above & inc_row) |
+                                         (below & dec_row), axis=1)
+                        mx_all = jnp.where(act & capmax,
+                                           jnp.minimum(mx_all, c_out),
+                                           mx_all)
+                        mn_all = jnp.where(act & capmin,
+                                           jnp.maximum(mn_all, c_out),
+                                           mn_all)
+                    pj = jnp.where(act, p, L)
+                    rj = jnp.where(act, new_ids[j], L)
+                    mn_all = mn_all.at[pj].set(mn_lj, mode="drop") \
+                                   .at[rj].set(mn_rj, mode="drop")
+                    mx_all = mx_all.at[pj].set(mx_lj, mode="drop") \
+                                   .at[rj].set(mx_rj, mode="drop")
+                    hi_all = hi_all.at[pj].set(hi_l, mode="drop") \
+                                   .at[rj].set(hi_p, mode="drop")
+                    lo_all = lo_all.at[rj].set(lo_r, mode="drop")
+                    out_l2 = out_l2.at[j].set(ol)
+                    out_r2 = out_r2.at[j].set(orr)
+                    bnd_l = bnd_l.at[j].set(jnp.stack([mn_lj, mx_lj]))
+                    bnd_r = bnd_r.at[j].set(jnp.stack([mn_rj, mx_rj]))
+                out_l, out_r = out_l2, out_r2
+                mn_l, mx_l = bnd_l[:, 0], bnd_l[:, 1]
+                mn_r, mx_r = bnd_r[:, 0], bnd_r[:, 1]
+                bounds2 = jnp.concatenate([bnd_l, bnd_r])   # (2W, 2)
+            elif use_mc:
                 p_mn = s["leaf_mn"][sel_leaves]
                 p_mx = s["leaf_mx"][sel_leaves]
                 out_l = jnp.clip(out_l, p_mn, p_mx)
@@ -618,7 +1043,14 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             out["cand_lsum"] = sc2(s["cand_lsum"], cands[4])
             out["cand_rsum"] = sc2(s["cand_rsum"], cands[5])
             out["cand_member"] = sc2(s["cand_member"], cands[6])
-            if use_mc:
+            if use_mc and mc_inter:
+                # the sequential refinement already wrote child entries
+                # AND propagated caps to contiguous leaves
+                out["leaf_mn"] = mn_all
+                out["leaf_mx"] = mx_all
+                out["leaf_lo"] = lo_all
+                out["leaf_hi"] = hi_all
+            elif use_mc:
                 out["leaf_mn"] = sc2(s["leaf_mn"],
                                      jnp.concatenate([mn_l, mn_r]))
                 out["leaf_mx"] = sc2(s["leaf_mx"],
@@ -675,6 +1107,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
         def cond(s):
             return jnp.logical_not(s["done"]) & (s["num_leaves"] < L)
 
+        for fw in forced_waves:   # pre-committed ForceSplits prefix
+            state = body(state, forced=fw)
         s = jax.lax.while_loop(cond, body, state)
 
         if quantized and renew_leaf:
@@ -714,6 +1148,9 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             s["leaf_value"] = jnp.where(ok, vals, s["leaf_value"])
             s["leaf_weight"] = jnp.where(ok, gh[:, 1], s["leaf_weight"])
 
+        if "spec_counts" in nonlocal_dbg:
+            s["split_gain"] = s["split_gain"].at[-2:].set(
+                nonlocal_dbg["spec_counts"].astype(jnp.float32))
         tree_out = GrownTree(
             split_feature=s["split_feature"],
             threshold_bin=s["threshold_bin"],

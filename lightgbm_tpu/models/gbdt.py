@@ -438,15 +438,13 @@ class GBDT:
                                      self._parse_interaction_constraints(),
                                      feature_contri=self._inner_contri(),
                                      cegb_lazy=self._inner_cegb_lazy())
-        if cfg.forcedsplits_filename:
-            log_warning("forcedsplits_filename is applied by the serial "
-                        "learner only; this parallel learner ignores it")
         from ..parallel import create_parallel_learner
         return create_parallel_learner(
             cfg, self.num_features, self.max_bins, num_bins, is_cat,
             has_nan, monotone,
             interaction_groups=self._parse_interaction_constraints(),
-            cegb_lazy=self._inner_cegb_lazy())
+            cegb_lazy=self._inner_cegb_lazy(),
+            forced_splits=self._parse_forced_splits())
 
     def _walk(self, bins, *tree_args):
         """Binned tree walk; routes through the bundle-space decode
@@ -1145,6 +1143,68 @@ class GBDT:
         # the loaded first tree already carries any boost-from-average bias
         self._pending_bias[:] = 0.0
         self._rebuild_scores()
+
+    def merge_from(self, other: "GBDT") -> None:
+        """Append another booster's trees to this model
+        (reference c_api.h:489 LGBM_BoosterMerge; GBDT::MergeFrom).
+        Thresholds re-bin against THIS dataset's mappers so the appended
+        trees join the binned score/walk paths."""
+        k = self.num_tree_per_iteration
+        ok = getattr(other, "num_tree_per_iteration", 1)
+        if ok != k:
+            raise ValueError(f"cannot merge: {ok} trees/iteration vs {k}")
+        merged = self.models + [self._align_loaded_tree(t)
+                                for t in other.models]
+        self.models = merged
+        self.iter_ = len(self._models_list) // max(k, 1)
+        self._rebuild_scores()
+
+    def shuffle_models(self, start_iter: int = 0,
+                       end_iter: int = -1) -> None:
+        """Shuffle tree-iteration order in [start_iter, end_iter)
+        (reference c_api.h:497 LGBM_BoosterShuffleModels;
+        GBDT::ShuffleModels) — used by the refit flow to decorrelate."""
+        k = max(self.num_tree_per_iteration, 1)
+        models = self.models
+        n_iter = len(models) // k
+        s = max(0, int(start_iter))
+        e = n_iter if end_iter <= 0 else min(int(end_iter), n_iter)
+        if e - s <= 1:
+            return
+        order = np.arange(n_iter)
+        rng = np.random.RandomState(int(self.config.seed) + 1)
+        mid = order[s:e].copy()
+        rng.shuffle(mid)
+        order[s:e] = mid
+        self.models = [models[i * k + j] for i in order for j in range(k)]
+        self._rebuild_scores()
+
+    def reset_train_data(self, new_train: Dataset) -> None:
+        """Swap the training dataset under the existing model (reference
+        GBDT::ResetTrainingData; c_api.h:478).  The new dataset aligns to
+        this model's bin mappers (construct-with-reference), every
+        data-dependent piece rebuilds through the normal setup path, the
+        trees re-align, and scores rebuild — continued training then
+        proceeds on the new rows."""
+        if not new_train.constructed and new_train.reference is None \
+                and self.train_set is not None:
+            new_train.reference = self.train_set
+        self._flush_trees()
+        models = self._models_list
+        valid_state = (self.valid_sets, self.valid_scores,
+                       self.valid_metrics)
+        self._init_train(new_train)   # construct + upload + learner +
+        #                               objective/metric re-init + score0
+        self.valid_sets, self.valid_scores, self.valid_metrics = valid_state
+        if models:
+            k = max(self.num_tree_per_iteration, 1)
+            self._pending = []
+            self._models_list = [self._align_loaded_tree(t) for t in models]
+            self.iter_ = len(self._models_list) // k
+            # the loaded first tree already carries any boost-from-average
+            # bias (same contract as init_from_model)
+            self._pending_bias[:] = 0.0
+            self._rebuild_scores()
 
     def refit_trees(self, source: "GBDT", leaf_preds: np.ndarray) -> None:
         """Re-learn every loaded tree's leaf values on THIS dataset with the

@@ -19,7 +19,7 @@ from .mesh import get_mesh
 
 def create_parallel_learner(config, num_features, max_bins, num_bins, is_cat,
                             has_nan, monotone=None, interaction_groups=(),
-                            cegb_lazy=()):
+                            cegb_lazy=(), forced_splits=()):
     """Factory (reference tree_learner.h:104 TreeLearner::CreateTreeLearner
     dispatching on tree_learner type)."""
     kind = config.tree_learner
@@ -33,11 +33,11 @@ def create_parallel_learner(config, num_features, max_bins, num_bins, is_cat,
     if kind == "data":
         return cls(config, num_features, max_bins, num_bins, is_cat,
                    has_nan, monotone, interaction_groups=interaction_groups,
-                   cegb_lazy=cegb_lazy)
-    if interaction_groups or cegb_lazy:
+                   cegb_lazy=cegb_lazy, forced_splits=forced_splits)
+    if interaction_groups or cegb_lazy or forced_splits:
         from ..utils.log import log_warning
-        log_warning("interaction_constraints / cegb_penalty_feature_lazy "
-                    "are applied by the serial and data-parallel learners "
-                    "only; this learner ignores them")
+        log_warning("interaction_constraints / cegb_penalty_feature_lazy / "
+                    "forcedsplits_filename are applied by the serial and "
+                    "data-parallel learners only; this learner ignores them")
     return cls(config, num_features, max_bins, num_bins, is_cat, has_nan,
                monotone)

@@ -142,12 +142,13 @@ class DataParallelTreeLearner:
                  num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
                  monotone: Optional[np.ndarray] = None,
                  interaction_groups: tuple = (),
-                 cegb_lazy: tuple = ()):
+                 cegb_lazy: tuple = (), forced_splits: tuple = ()):
         self.config = config
         self.max_bins = int(max_bins)
         self.num_features = num_features
         self.interaction_groups = tuple(tuple(g) for g in interaction_groups)
         self.cegb_lazy = tuple(float(v) for v in cegb_lazy)
+        self.forced_splits = tuple(tuple(f) for f in forced_splits)
         self.mesh = get_mesh(int(config.num_devices))
         self.ndev = self.mesh.devices.size
         self.axis = self.mesh.axis_names[0]
@@ -172,6 +173,16 @@ class DataParallelTreeLearner:
             log_warning("use_quantized_grad requires the wave grower; the "
                         "masked data-parallel grower trains with exact "
                         "gradients")
+        if self.forced_splits:
+            from ..utils.log import log_warning
+            log_warning("forcedsplits_filename is applied by the DP-wave "
+                        "grower only; the masked data-parallel grower "
+                        "ignores it")
+        from ..learner.serial import (resolve_monotone_method,
+                                      split_params_from_config as _spc)
+        resolve_monotone_method(config, _spc(config, num_bins,
+                                             is_cat).use_monotone,
+                                wave=False)
         if self.interaction_groups or self.cegb_lazy or \
                 config.extra_trees or \
                 config.feature_fraction_bynode < 1.0 or \
@@ -246,6 +257,9 @@ class DataParallelTreeLearner:
         self.quantized = bool(config.use_quantized_grad)
         sp = split_params_from_config(config, num_bins, is_cat)
         self.split_params = sp
+        from ..learner.serial import resolve_monotone_method
+        mc_inter = resolve_monotone_method(config, sp.use_monotone,
+                                           wave=True)
         self._use_node_key = sp.feature_fraction_bynode < 1.0 or \
             sp.extra_trees
         gq_max, hq_max = quant_levels(int(config.num_grad_quant_bins))
@@ -261,7 +275,8 @@ class DataParallelTreeLearner:
             renew_leaf=bool(config.quant_train_renew_leaf),
             stochastic=bool(config.stochastic_rounding),
             interaction_groups=self.interaction_groups,
-            cegb_lazy=self.cegb_lazy)
+            cegb_lazy=self.cegb_lazy, forced_splits=self.forced_splits,
+            mc_inter=mc_inter)
 
         # cegb penalties, the quantization/bynode keys and the persistent
         # lazy-CEGB bitmap ride extra operands; arity is static config

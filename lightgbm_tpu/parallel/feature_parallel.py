@@ -134,6 +134,11 @@ class FeatureParallelTreeLearner:
         strategy = FeatureParallelStrategy(self.axis, self.f_local,
                                            self.num_bins, self.is_cat,
                                            self.has_nan)
+        from ..learner.serial import resolve_monotone_method
+        resolve_monotone_method(
+            config, bool(config.monotone_constraints and
+                         any(int(v) for v in config.monotone_constraints)),
+            wave=False)
         grow_t = make_grow_fn(
             num_leaves=int(config.num_leaves), max_bins=self.max_bins,
             max_depth=int(config.max_depth),

@@ -123,6 +123,12 @@ class VotingParallelTreeLearner:
         self.monotone = jnp.asarray(
             monotone if monotone is not None else np.zeros(num_features),
             jnp.int32)
+        from ..learner.serial import resolve_monotone_method
+        resolve_monotone_method(
+            config, bool(config.monotone_constraints and
+                         any(int(v) for v in
+                             config.monotone_constraints)),
+            wave=False)
         sp = split_params_from_config(config, num_bins, is_cat)
         local_sp = sp._replace(
             min_data_in_leaf=max(1, sp.min_data_in_leaf // self.ndev),

@@ -1,0 +1,39 @@
+"""A/B the speculative ramp at scale in ONE process (controls tunnel drift)."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax.numpy as jnp
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import set_verbosity
+
+set_verbosity(-1)
+rows = int(os.environ.get("ROWS", 6_000_000))
+rng = np.random.RandomState(0)
+f = 28
+X = rng.randn(rows, f).astype(np.float32)
+w = rng.randn(f) / np.sqrt(f)
+y = ((X @ w + 0.3*np.sin(2*X[:,0])*X[:,1] + rng.randn(rows)*0.5) > 0).astype(np.float64)
+
+def mk(spec):
+    p = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+         "learning_rate": 0.1, "verbosity": -1,
+         "use_quantized_grad": True, "num_grad_quant_bins": 254,
+         "quant_train_renew_leaf": True, "tpu_speculative_ramp": spec}
+    ds = lgb.Dataset(X, y, params=p)
+    b = lgb.Booster(params=p, train_set=ds)
+    b.update(); b.update()
+    float(jnp.sum(b._gbdt.score))
+    return b
+
+def run(b, k=6):
+    t0 = time.perf_counter()
+    for _ in range(k):
+        b.update()
+    float(jnp.sum(b._gbdt.score))
+    return k / (time.perf_counter() - t0)
+
+ba = mk(True)
+bb = mk(False)
+for i in range(3):
+    ra = run(ba); rb = run(bb)
+    print(f"round {i}: spec={ra:.4f} plain={rb:.4f} iters/s  ratio={ra/rb:.3f}", flush=True)

@@ -1,0 +1,42 @@
+"""Per-tree median timing A/B of the speculative ramp at full Higgs scale."""
+import os, sys, time, statistics
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax.numpy as jnp
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import set_verbosity
+
+set_verbosity(-1)
+rows = int(os.environ.get("ROWS", 10_500_000))
+rng = np.random.RandomState(0)
+f = 28
+X = rng.randn(rows, f).astype(np.float32)
+w = rng.randn(f) / np.sqrt(f)
+y = ((X @ w + 0.3*np.sin(2*X[:,0])*X[:,1] + rng.randn(rows)*0.5) > 0).astype(np.float64)
+
+def mk(spec):
+    p = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+         "learning_rate": 0.1, "verbosity": -1,
+         "use_quantized_grad": True, "num_grad_quant_bins": 254,
+         "quant_train_renew_leaf": True, "tpu_speculative_ramp": spec}
+    ds = lgb.Dataset(X, y, params=p)
+    b = lgb.Booster(params=p, train_set=ds)
+    b.update(); b.update()
+    float(jnp.sum(b._gbdt.score))
+    return b
+
+def times(b, k=22):
+    out = []
+    for _ in range(k):
+        t0 = time.perf_counter()
+        b.update()
+        float(jnp.sum(b._gbdt.score))
+        out.append(time.perf_counter() - t0)
+    return out
+
+ba, bb = mk(True), mk(False)
+ta, tb = times(ba), times(bb)
+ma, mb = statistics.median(ta), statistics.median(tb)
+print(f"spec : median {ma*1e3:.0f} ms/tree  min {min(ta)*1e3:.0f}", flush=True)
+print(f"plain: median {mb*1e3:.0f} ms/tree  min {min(tb)*1e3:.0f}", flush=True)
+print(f"speedup median {mb/ma:.3f}  min-based {min(tb)/min(ta):.3f}", flush=True)

@@ -1,0 +1,42 @@
+"""Spec-ramp commit hit-rate probe on CPU (debug.print works there).
+
+Mimics Higgs-scale statistics at reduced n with the SAME subsample ratio
+(1/8 at 2M rows): n=512K, spec_subsample=64K.
+"""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["LGBM_TPU_SPEC_DEBUG"] = "1"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from lightgbm_tpu.learner.wave import make_wave_grow_fn
+from lightgbm_tpu.ops.split import SplitParams
+from lightgbm_tpu.binning import BinMapper
+
+n, f, b = 1 << 19, 28, 255
+rng = np.random.RandomState(0)
+Xf = rng.randn(n, f).astype(np.float32)
+w = rng.randn(f) / np.sqrt(f)
+y = ((Xf @ w + 0.3*np.sin(2*Xf[:,0])*Xf[:,1] + rng.randn(n)*0.5) > 0)
+bins = np.empty((f, n), np.uint8)
+for j in range(f):
+    from lightgbm_tpu.binning import find_bin
+    m = find_bin(Xf[:, j].astype(np.float64), max_bin=b)
+    bins[j] = m.value_to_bin(Xf[:, j].astype(np.float64)).astype(np.uint8)
+p0 = y.mean()
+grad = (p0 - y).astype(np.float32)
+hess = np.full(n, p0*(1-p0), np.float32)
+
+sp = SplitParams(min_data_in_leaf=20, any_cat=False)
+grow = make_wave_grow_fn(
+    num_leaves=255, num_features=f, max_bins=b, max_depth=0,
+    split_params=sp, hist_impl="pallas", any_cat=False, jit=True,
+    quantized=True, stochastic=False, spec_ramp=True, spec_tol=0.02,
+    spec_subsample=1 << 16)
+nb = jnp.full((f,), b, jnp.int32)
+t = grow(jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+         jnp.ones((n,), jnp.float32), nb, jnp.zeros((f,), bool),
+         jnp.zeros((f,), bool), jnp.zeros((f,), jnp.int32),
+         jnp.zeros((f,), jnp.float32), (), jnp.ones((f,), bool))
+print("num_leaves:", int(t.num_leaves))

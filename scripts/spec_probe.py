@@ -1,0 +1,25 @@
+"""TPU spec-ramp commit probe: reads prov/commit counts smuggled through
+split_gain[-2:] when LGBM_TPU_SPEC_DEBUG is set (debug-only clobber)."""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["LGBM_TPU_SPEC_DEBUG"] = "1"
+import numpy as np
+import jax.numpy as jnp
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import set_verbosity
+set_verbosity(-1)
+rng = np.random.RandomState(0)
+rows, f = int(os.environ.get("ROWS", 4_000_000)), 28
+X = rng.randn(rows, f).astype(np.float32)
+w = rng.randn(f) / np.sqrt(f)
+y = ((X @ w + 0.3*np.sin(2*X[:,0])*X[:,1] + rng.randn(rows)*0.5) > 0).astype(np.float64)
+p = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+     "learning_rate": 0.1, "verbosity": -1, "use_quantized_grad": True,
+     "num_grad_quant_bins": 254, "quant_train_renew_leaf": True}
+b = lgb.Booster(params=p, train_set=lgb.Dataset(X, y, params=p))
+for i in range(4):
+    b.update()
+    t = b._gbdt.models[-1]
+    sg = np.asarray(t.split_gain[-2:])
+    print(f"tree {i}: prov_leaves={sg[0]:.0f} commits={sg[1]:.0f} of 41",
+          flush=True)

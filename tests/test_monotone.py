@@ -96,3 +96,26 @@ def test_monotone_penalty_reduces_monotone_splits():
         return total
     # high penalty discourages splits on the constrained features
     assert mono_split_count(b9) < mono_split_count(b0)
+
+
+def test_monotone_intermediate_wave():
+    """monotone_constraints_method=intermediate on the wave grower:
+    constraints hold under the region-box propagation, and the looser
+    sibling-output bounds fit at least as well as basic."""
+    X, y = _gen()
+    base = {**PARAMS, "tree_grow_mode": "wave"}
+    bst_b = lgb.train({**base, "monotone_constraints_method": "basic"},
+                      lgb.Dataset(X, y), 60)
+    bst_i = lgb.train({**base, "monotone_constraints_method": "intermediate"},
+                      lgb.Dataset(X, y), 60)
+    assert _is_monotone(bst_i, 0, +1)
+    assert _is_monotone(bst_i, 1, -1)
+    mse_b = np.mean((bst_b.predict(X) - y) ** 2)
+    mse_i = np.mean((bst_i.predict(X) - y) ** 2)
+    # intermediate is less constraining: fit must not be (meaningfully)
+    # worse than basic
+    assert mse_i <= mse_b * 1.02 + 1e-6
+    # 'advanced' downgrades to intermediate with a warning, still monotone
+    bst_a = lgb.train({**base, "monotone_constraints_method": "advanced"},
+                      lgb.Dataset(X, y), 30)
+    assert _is_monotone(bst_a, 0, +1)

@@ -155,3 +155,27 @@ def test_wave_sample_weights_match_partition():
         bst = lgb.train(p, lgb.Dataset(X, y, weight=w), num_boost_round=6)
         pred[mode] = bst.predict(X)
     np.testing.assert_allclose(pred["wave"], pred["partition"], atol=2e-4)
+
+
+def test_wave_forced_splits(tmp_path):
+    """ForceSplits on the wave grower: pre-committed waves apply the BFS
+    prefix (no more fallback to the partitioned grower), then gain-driven
+    growth resumes; numbering matches the partitioned grower's."""
+    import json
+    X, y = _binary(nan_frac=0.0)
+    fs = {"feature": 5, "threshold": 0.0,
+          "left": {"feature": 4, "threshold": 0.5},
+          "right": {"feature": 3, "threshold": -0.2}}
+    path = str(tmp_path / "forced.json")
+    json.dump(fs, open(path, "w"))
+    pw = _params("wave", forcedsplits_filename=path)
+    bst = lgb.train(pw, lgb.Dataset(X, y), 5)
+    for tree in bst._gbdt.models:
+        assert tree.split_feature[0] == 5
+        assert {int(tree.split_feature[1]), int(tree.split_feature[2])} == \
+            {4, 3}
+    # quality parity with the partitioned grower under the same forcing
+    pp = _params("partition", forcedsplits_filename=path)
+    ll_w = _logloss(y, bst.predict(X))
+    ll_p = _logloss(y, lgb.train(pp, lgb.Dataset(X, y), 5).predict(X))
+    assert ll_w < ll_p * 1.05 + 1e-3
