@@ -62,8 +62,12 @@ class Booster:
         """One boosting iteration (reference LGBM_BoosterUpdateOneIter /
         basic.py Booster.update).  ``fobj(preds, train_set) -> (grad, hess)``
         enables custom objectives."""
-        if train_set is not None:
-            raise NotImplementedError("resetting train data is not supported yet")
+        if train_set is not None and train_set is not self._gbdt.train_set:
+            # the reference skips ResetTrainingData for the identical
+            # Dataset (basic.py is_the_same_train_set check) — resetting
+            # rebuilds scores over every tree, which would turn a cheap
+            # no-op into O(trees x N) per update call
+            self.reset_train_data(train_set)
         if fobj is not None:
             preds = np.asarray(self._gbdt.score)
             grad, hess = fobj(preds, self._gbdt.train_set)
@@ -72,6 +76,16 @@ class Booster:
 
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()
+        return self
+
+    def reset_train_data(self, train_set: Dataset) -> "Booster":
+        """Swap the training dataset under the existing model (reference
+        Booster::ResetTrainingData / LGBM_BoosterResetTrainingData):
+        trees are kept, scores rebuild on the new rows, and further
+        ``update()`` calls continue boosting on them."""
+        if not isinstance(train_set, Dataset):
+            raise TypeError("train_set must be a Dataset")
+        self._gbdt.reset_train_data(train_set)
         return self
 
     def refit(self, data, label, decay_rate: float = 0.9,

@@ -57,6 +57,13 @@ class Metric:
         """score: raw (untransformed) ensemble score, (N,) or (N, K)."""
         raise NotImplementedError
 
+    @property
+    def eval_names(self) -> List[str]:
+        """One entry per value ``eval`` returns (the reference's
+        Metric::GetName() vector — multi-position metrics like ndcg/map
+        report one value per eval_at position, c_api.cpp GetEvalCounts)."""
+        return [self.name]
+
     # helpers
     def _avg(self, pointwise: np.ndarray) -> float:
         if self.weight is not None:
@@ -304,6 +311,10 @@ class NDCGMetric(Metric):
     name = "ndcg"
     is_higher_better = True
 
+    @property
+    def eval_names(self):
+        return [f"ndcg@{int(k)}" for k in self.config.eval_at]
+
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
         if self.query_boundaries is None:
@@ -330,6 +341,10 @@ class NDCGMetric(Metric):
 
 class MapMetric(Metric):
     name = "map"
+
+    @property
+    def eval_names(self):
+        return [f"map@{int(k)}" for k in self.config.eval_at]
     is_higher_better = True
 
     def init(self, metadata, num_data):

@@ -1,0 +1,251 @@
+"""Second C-API surface batch (the functions added for full c_api.h
+parity — reference patterns: tests/c_api_test/test_.py CSC round-trip,
+fast single-row init, eval names, leaf get/set, merge, reset)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import lightgbm_tpu.capi as capi
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(11)
+    X = rng.randn(500, 6)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0.2).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def booster(data):
+    X, y = data
+    _, dh = capi.LGBM_DatasetCreateFromMat(
+        X, "objective=binary verbosity=-1", label=y)
+    _, bh = capi.LGBM_BoosterCreate(
+        dh, "objective=binary num_leaves=15 verbosity=-1 metric=binary_logloss,auc")
+    for _ in range(8):
+        capi.LGBM_BoosterUpdateOneIter(bh)
+    return bh
+
+
+def test_csc_dataset_and_predict(data):
+    X, y = data
+    csc = sp.csc_matrix(X)
+    code, dh = capi.LGBM_DatasetCreateFromCSC(
+        csc, "objective=binary verbosity=-1 min_data_in_bin=1", label=y)
+    assert code == 0
+    assert capi.LGBM_DatasetGetNumData(dh)[1] == 500
+    _, bh = capi.LGBM_BoosterCreate(
+        dh, "objective=binary num_leaves=15 verbosity=-1")
+    for _ in range(5):
+        capi.LGBM_BoosterUpdateOneIter(bh)
+    _, p_csc = capi.LGBM_BoosterPredictForCSC(bh, csc)
+    _, p_mat = capi.LGBM_BoosterPredictForMat(bh, X)
+    np.testing.assert_allclose(p_csc, p_mat, rtol=1e-6)
+    capi.LGBM_BoosterFree(bh)
+    capi.LGBM_DatasetFree(dh)
+
+
+def test_eval_names_counts_predict(booster):
+    code, n = capi.LGBM_BoosterGetEvalCounts(booster)
+    assert code == 0 and n == 2
+    code, names = capi.LGBM_BoosterGetEvalNames(booster)
+    assert set(names) == {"binary_logloss", "auc"}
+    code, npred = capi.LGBM_BoosterGetNumPredict(booster, 0)
+    assert (code, npred) == (0, 500)
+    code, preds = capi.LGBM_BoosterGetPredict(booster, 0)
+    assert preds.shape == (500,)
+    assert np.all((preds >= 0) & (preds <= 1))   # transformed probs
+
+
+def test_leaf_get_set(booster, data):
+    X, _ = data
+    code, v = capi.LGBM_BoosterGetLeafValue(booster, 0, 0)
+    assert code == 0
+    before = capi.LGBM_BoosterPredictForMat(booster, X)[1]
+    capi.LGBM_BoosterSetLeafValue(booster, 0, 0, v + 1.0)
+    after = capi.LGBM_BoosterPredictForMat(booster, X)[1]
+    assert not np.allclose(before, after)
+    capi.LGBM_BoosterSetLeafValue(booster, 0, 0, v)   # restore
+    restored = capi.LGBM_BoosterPredictForMat(booster, X)[1]
+    np.testing.assert_allclose(restored, before, rtol=1e-6)
+    assert capi.LGBM_BoosterGetLeafValue(booster, 0, 0)[1] == pytest.approx(v)
+
+
+def test_bounds_linear_calcnum(booster):
+    _, lo = capi.LGBM_BoosterGetLowerBoundValue(booster)
+    _, hi = capi.LGBM_BoosterGetUpperBoundValue(booster)
+    assert lo < hi
+    assert capi.LGBM_BoosterGetLinear(booster)[1] == 0
+    assert capi.LGBM_BoosterCalcNumPredict(booster, 7, 0)[1] == 7
+    assert capi.LGBM_BoosterCalcNumPredict(
+        booster, 7, capi.C_API_PREDICT_LEAF_INDEX)[1] == 7 * 8
+    assert capi.LGBM_BoosterCalcNumPredict(
+        booster, 3, capi.C_API_PREDICT_CONTRIB)[1] == 3 * 7
+
+
+def test_fast_single_row(booster, data):
+    X, _ = data
+    _, fc = capi.LGBM_BoosterPredictForMatSingleRowFastInit(
+        booster, ncol=X.shape[1])
+    _, p = capi.LGBM_BoosterPredictForMatSingleRowFast(fc, X[3])
+    _, ref = capi.LGBM_BoosterPredictForMat(booster, X[3:4])
+    assert p == pytest.approx(np.asarray(ref)[0])
+    capi.LGBM_FastConfigFree(fc)
+
+    _, fc2 = capi.LGBM_BoosterPredictForCSRSingleRowFastInit(
+        booster, num_col=X.shape[1])
+    row = sp.csr_matrix(X[5:6])
+    _, p2 = capi.LGBM_BoosterPredictForCSRSingleRowFast(fc2, row)
+    assert p2 == pytest.approx(np.asarray(
+        capi.LGBM_BoosterPredictForMat(booster, X[5:6])[1])[0])
+    # (indices, values) form
+    nz = np.nonzero(X[5])[0]
+    _, p3 = capi.LGBM_BoosterPredictForCSRSingleRowFast(
+        fc2, (nz, X[5][nz]))
+    assert p3 == pytest.approx(p2)
+    capi.LGBM_FastConfigFree(fc2)
+
+
+def test_predict_mats_and_sparse_contrib(booster, data):
+    X, _ = data
+    _, pm = capi.LGBM_BoosterPredictForMats(booster, [X[0], X[1], X[2]])
+    _, ref = capi.LGBM_BoosterPredictForMat(booster, X[:3])
+    np.testing.assert_allclose(pm, ref, rtol=1e-6)
+
+    csr = sp.csr_matrix(X[:50])
+    _, sparse = capi.LGBM_BoosterPredictSparseOutput(
+        booster, csr, capi.C_API_PREDICT_CONTRIB)
+    dense = capi.LGBM_BoosterPredictForCSR(
+        booster, csr, capi.C_API_PREDICT_CONTRIB)[1]
+    np.testing.assert_allclose(np.asarray(sparse.todense()), dense,
+                               rtol=1e-6, atol=1e-9)
+    assert capi.LGBM_BoosterFreePredictSparse()[0] == 0
+
+
+def test_merge_and_shuffle(data):
+    X, y = data
+    def train(rounds, seed):
+        _, dh = capi.LGBM_DatasetCreateFromMat(
+            X, f"objective=binary verbosity=-1 seed={seed}", label=y)
+        _, bh = capi.LGBM_BoosterCreate(
+            dh, f"objective=binary num_leaves=7 verbosity=-1 seed={seed}")
+        for _ in range(rounds):
+            capi.LGBM_BoosterUpdateOneIter(bh)
+        return bh
+    a, b = train(4, 1), train(3, 2)
+    capi.LGBM_BoosterMerge(a, b)
+    assert capi.LGBM_BoosterNumberOfTotalModel(a)[1] == 7
+    pr = capi.LGBM_BoosterPredictForMat(a, X)[1]
+    assert np.all(np.isfinite(pr))
+    # shuffle changes tree order but not the (additive) predictions
+    capi.LGBM_BoosterShuffleModels(a, 0, -1)
+    np.testing.assert_allclose(capi.LGBM_BoosterPredictForMat(a, X)[1], pr,
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_reset_training_data(data):
+    X, y = data
+    rng = np.random.RandomState(3)
+    X2 = rng.randn(300, 6)
+    y2 = (X2[:, 0] + 0.5 * X2[:, 1] > 0.2).astype(np.float64)
+    _, dh = capi.LGBM_DatasetCreateFromMat(
+        X, "objective=binary verbosity=-1", label=y)
+    _, bh = capi.LGBM_BoosterCreate(
+        dh, "objective=binary num_leaves=7 verbosity=-1 metric=binary_logloss")
+    for _ in range(4):
+        capi.LGBM_BoosterUpdateOneIter(bh)
+    _, dh2 = capi.LGBM_DatasetCreateFromMat(
+        X2, "objective=binary verbosity=-1", label=y2, reference=dh)
+    assert capi.LGBM_BoosterResetTrainingData(bh, dh2)[0] == 0
+    # model kept; training continues on the NEW data
+    assert capi.LGBM_BoosterNumberOfTotalModel(bh)[1] == 4
+    assert capi.LGBM_BoosterGetNumPredict(bh, 0)[1] == 300
+    for _ in range(4):
+        capi.LGBM_BoosterUpdateOneIter(bh)
+    assert capi.LGBM_BoosterNumberOfTotalModel(bh)[1] == 8
+    pr = capi.LGBM_BoosterPredictForMat(bh, X2)[1]
+    ll = -np.mean(y2 * np.log(np.clip(pr, 1e-9, 1)) +
+                  (1 - y2) * np.log(np.clip(1 - pr, 1e-9, 1)))
+    assert ll < 0.6
+
+
+def test_dataset_extras(data, tmp_path):
+    X, y = data
+    # feature names set/get
+    _, dh = capi.LGBM_DatasetCreateFromMat(
+        X, "objective=binary verbosity=-1", label=y)
+    names = [f"f{i}" for i in range(6)]
+    capi.LGBM_DatasetSetFeatureNames(dh, names)
+    assert capi.LGBM_DatasetGetFeatureNames(dh)[1] == names
+    # dump text
+    path = str(tmp_path / "dump.txt")
+    capi.LGBM_DatasetDumpText(dh, path)
+    head = open(path).read().splitlines()
+    assert head[0] == "num_data: 500" and "f3" in head[2]
+    # param checking
+    assert capi.LGBM_DatasetUpdateParamChecking(
+        "max_bin=255 learning_rate=0.1", "max_bin=255 learning_rate=0.2")[0] == 0
+    with pytest.raises(ValueError):
+        capi.LGBM_DatasetUpdateParamChecking("max_bin=255", "max_bin=63")
+
+    # mats create == mat create
+    _, dh2 = capi.LGBM_DatasetCreateFromMats(
+        [X[:200], X[200:]], "objective=binary verbosity=-1", label=y)
+    assert capi.LGBM_DatasetGetNumData(dh2)[1] == 500
+
+    # CSR-func create
+    csr = sp.csr_matrix(X)
+    def get_row(i):
+        lo, hi = csr.indptr[i], csr.indptr[i + 1]
+        return csr.indices[lo:hi], csr.data[lo:hi]
+    _, dh3 = capi.LGBM_DatasetCreateFromCSRFunc(
+        get_row, 500, 6, "objective=binary verbosity=-1 min_data_in_bin=1",
+        label=y)
+    assert capi.LGBM_DatasetGetNumData(dh3)[1] == 500
+
+    # add features from (needs retained raw data)
+    _, a = capi.LGBM_DatasetCreateFromMat(
+        X[:, :3], "verbosity=-1 free_raw_data=false")
+    _, b = capi.LGBM_DatasetCreateFromMat(
+        X[:, 3:], "verbosity=-1 free_raw_data=false")
+    assert capi.LGBM_DatasetAddFeaturesFrom(a, b)[0] == 0
+    ds = capi._get(a)
+    assert ds.data.shape == (500, 6)
+
+
+def test_sampled_column_streaming(data):
+    X, y = data
+    cols = [X[:100, j].copy() for j in range(6)]
+    idx = [np.arange(100)] * 6
+    code, dh = capi.LGBM_DatasetCreateFromSampledColumn(
+        cols, idx, 500, "objective=binary verbosity=-1")
+    assert code == 0
+    for lo in range(0, 500, 125):
+        capi.LGBM_DatasetPushRows(dh, X[lo:lo + 125], lo)
+    capi.LGBM_DatasetSetField(dh, "label", y)
+    _, bh = capi.LGBM_BoosterCreate(
+        dh, "objective=binary num_leaves=7 verbosity=-1")
+    for _ in range(4):
+        capi.LGBM_BoosterUpdateOneIter(bh)
+    pr = capi.LGBM_BoosterPredictForMat(bh, X)[1]
+    assert np.all(np.isfinite(pr))
+
+
+def test_log_callback_and_set_error():
+    lines = []
+    capi.LGBM_RegisterLogCallback(lambda m: lines.append(m))
+    from lightgbm_tpu.utils.log import log_info, set_verbosity
+    set_verbosity(1)
+    log_info("hello-capi")
+    capi.LGBM_RegisterLogCallback(None)
+    assert any("hello-capi" in ln for ln in lines)
+    capi.LGBM_SetLastError("boom")
+    assert capi.LGBM_GetLastError() == "boom"
+
+
+def test_network_with_functions_single():
+    assert capi.LGBM_NetworkInitWithFunctions(1, 0)[0] == 0
+    with pytest.raises(NotImplementedError):
+        capi.LGBM_NetworkInitWithFunctions(2, 0)

@@ -126,3 +126,194 @@ def test_native_abi_train_predict_roundtrip(tmp_path):
     assert lib.LGBM_BoosterFree(bh) == 0
     assert lib.LGBM_BoosterFree(bh2) == 0
     assert lib.LGBM_DatasetFree(dh) == 0
+
+
+_ALL_C_SYMBOLS = [
+    # the complete extern-C surface of reference include/LightGBM/c_api.h
+    "LGBM_BoosterAddValidData", "LGBM_BoosterCalcNumPredict",
+    "LGBM_BoosterCreate", "LGBM_BoosterCreateFromModelfile",
+    "LGBM_BoosterDumpModel", "LGBM_BoosterFeatureImportance",
+    "LGBM_BoosterFree", "LGBM_BoosterFreePredictSparse",
+    "LGBM_BoosterGetCurrentIteration", "LGBM_BoosterGetEval",
+    "LGBM_BoosterGetEvalCounts", "LGBM_BoosterGetEvalNames",
+    "LGBM_BoosterGetFeatureNames", "LGBM_BoosterGetLeafValue",
+    "LGBM_BoosterGetLinear", "LGBM_BoosterGetLowerBoundValue",
+    "LGBM_BoosterGetNumClasses", "LGBM_BoosterGetNumFeature",
+    "LGBM_BoosterGetNumPredict", "LGBM_BoosterGetPredict",
+    "LGBM_BoosterGetUpperBoundValue", "LGBM_BoosterLoadModelFromString",
+    "LGBM_BoosterMerge", "LGBM_BoosterNumModelPerIteration",
+    "LGBM_BoosterNumberOfTotalModel", "LGBM_BoosterPredictForCSC",
+    "LGBM_BoosterPredictForCSR", "LGBM_BoosterPredictForCSRSingleRow",
+    "LGBM_BoosterPredictForCSRSingleRowFast",
+    "LGBM_BoosterPredictForCSRSingleRowFastInit",
+    "LGBM_BoosterPredictForFile", "LGBM_BoosterPredictForMat",
+    "LGBM_BoosterPredictForMatSingleRow",
+    "LGBM_BoosterPredictForMatSingleRowFast",
+    "LGBM_BoosterPredictForMatSingleRowFastInit",
+    "LGBM_BoosterPredictForMats", "LGBM_BoosterPredictSparseOutput",
+    "LGBM_BoosterRefit", "LGBM_BoosterResetParameter",
+    "LGBM_BoosterResetTrainingData", "LGBM_BoosterRollbackOneIter",
+    "LGBM_BoosterSaveModel", "LGBM_BoosterSaveModelToString",
+    "LGBM_BoosterSetLeafValue", "LGBM_BoosterShuffleModels",
+    "LGBM_BoosterUpdateOneIter", "LGBM_BoosterUpdateOneIterCustom",
+    "LGBM_DatasetAddFeaturesFrom", "LGBM_DatasetCreateByReference",
+    "LGBM_DatasetCreateFromCSC", "LGBM_DatasetCreateFromCSR",
+    "LGBM_DatasetCreateFromCSRFunc", "LGBM_DatasetCreateFromFile",
+    "LGBM_DatasetCreateFromMat", "LGBM_DatasetCreateFromMats",
+    "LGBM_DatasetCreateFromSampledColumn", "LGBM_DatasetDumpText",
+    "LGBM_DatasetFree", "LGBM_DatasetGetFeatureNames",
+    "LGBM_DatasetGetField", "LGBM_DatasetGetNumData",
+    "LGBM_DatasetGetNumFeature", "LGBM_DatasetGetSubset",
+    "LGBM_DatasetPushRows", "LGBM_DatasetPushRowsByCSR",
+    "LGBM_DatasetSaveBinary", "LGBM_DatasetSetFeatureNames",
+    "LGBM_DatasetSetField", "LGBM_DatasetUpdateParamChecking",
+    "LGBM_FastConfigFree", "LGBM_NetworkFree", "LGBM_NetworkInit",
+    "LGBM_NetworkInitWithFunctions", "LGBM_RegisterLogCallback",
+    "LGBM_GetLastError",
+]
+
+
+def test_all_c_api_symbols_resolve():
+    """Every c_api.h symbol must dlsym from the shim — a real C/R/Java
+    client never hits an unresolved symbol."""
+    lib = ctypes.CDLL(_SHIM)
+    missing = []
+    for name in _ALL_C_SYMBOLS:
+        try:
+            getattr(lib, name)
+        except AttributeError:
+            missing.append(name)
+    assert not missing, f"unresolved: {missing}"
+
+
+def test_reference_style_csr_fast_and_strings(tmp_path):
+    """Reference tests/c_api_test/test_.py style drive: CSR dataset,
+    training, GetEvalNames (char** convention), SaveModelToString,
+    fast single-row init/predict, leaf get/set, bounds."""
+    import scipy.sparse as sp
+    lib = ctypes.CDLL(_SHIM)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    rng = np.random.RandomState(5)
+    X = np.ascontiguousarray(rng.randn(400, 5))
+    X[X < -1.2] = 0.0
+    y = np.ascontiguousarray(
+        (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32))
+    csr = sp.csr_matrix(X)
+    indptr = np.ascontiguousarray(csr.indptr.astype(np.int32))
+    indices = np.ascontiguousarray(csr.indices.astype(np.int32))
+    data = np.ascontiguousarray(csr.data.astype(np.float64))
+
+    dh = ctypes.c_void_p()
+    rc = lib.LGBM_DatasetCreateFromCSR(
+        indptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(0),
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(csr.nnz),
+        ctypes.c_int64(5),
+        b"objective=binary verbosity=-1 min_data_in_bin=1", None,
+        ctypes.byref(dh))
+    assert rc == 0, lib.LGBM_GetLastError()
+    rc = lib.LGBM_DatasetSetField(
+        dh, b"label", y.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(400), ctypes.c_int(0))
+    assert rc == 0, lib.LGBM_GetLastError()
+
+    bh = ctypes.c_void_p()
+    rc = lib.LGBM_BoosterCreate(
+        dh, b"objective=binary num_leaves=15 verbosity=-1 "
+            b"metric=binary_logloss,auc", ctypes.byref(bh))
+    assert rc == 0, lib.LGBM_GetLastError()
+    fin = ctypes.c_int(0)
+    for _ in range(8):
+        assert lib.LGBM_BoosterUpdateOneIter(bh, ctypes.byref(fin)) == 0
+
+    # GetEvalNames through the (len, buffer_len, char**) convention
+    n_metrics = ctypes.c_int(0)
+    assert lib.LGBM_BoosterGetEvalCounts(bh, ctypes.byref(n_metrics)) == 0
+    assert n_metrics.value == 2
+    bufs = [(ctypes.c_char * 64)() for _ in range(n_metrics.value)]
+    arr = (ctypes.c_char_p * n_metrics.value)(
+        *[ctypes.addressof(b) for b in bufs])
+    out_n = ctypes.c_int(0)
+    out_buf = ctypes.c_size_t(0)
+    rc = lib.LGBM_BoosterGetEvalNames(
+        bh, ctypes.c_int(n_metrics.value), ctypes.byref(out_n),
+        ctypes.c_size_t(64), ctypes.byref(out_buf), arr)
+    assert rc == 0, lib.LGBM_GetLastError()
+    names = {bufs[i].value.decode() for i in range(out_n.value)}
+    assert names == {"binary_logloss", "auc"}
+
+    # eval values land in a double buffer
+    evals = np.zeros(2, np.float64)
+    out_len = ctypes.c_int(0)
+    rc = lib.LGBM_BoosterGetEval(
+        bh, ctypes.c_int(0), ctypes.byref(out_len),
+        evals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0 and out_len.value == 2
+
+    # model string round trip
+    out_sz = ctypes.c_int64(0)
+    rc = lib.LGBM_BoosterSaveModelToString(
+        bh, 0, -1, 0, ctypes.c_int64(0), ctypes.byref(out_sz), None)
+    assert rc == 0 and out_sz.value > 100
+    buf = ctypes.create_string_buffer(out_sz.value)
+    rc = lib.LGBM_BoosterSaveModelToString(
+        bh, 0, -1, 0, ctypes.c_int64(out_sz.value), ctypes.byref(out_sz),
+        buf)
+    assert rc == 0 and b"tree" in buf.value
+    bh2 = ctypes.c_void_p()
+    it2 = ctypes.c_int(0)
+    assert lib.LGBM_BoosterLoadModelFromString(
+        buf.value, ctypes.byref(it2), ctypes.byref(bh2)) == 0
+    assert it2.value == 8
+
+    # dense predict == CSR predict
+    pred = np.zeros(400, np.float64)
+    plen = ctypes.c_int64(0)
+    Xc = np.ascontiguousarray(X, np.float64)
+    assert lib.LGBM_BoosterPredictForMat(
+        bh, Xc.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int32(400), ctypes.c_int32(5), ctypes.c_int(1),
+        ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1), b"",
+        ctypes.byref(plen),
+        pred.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    pred_csr = np.zeros(400, np.float64)
+    assert lib.LGBM_BoosterPredictForCSR(
+        bh, indptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(0),
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(csr.nnz),
+        ctypes.c_int64(5), ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", ctypes.byref(plen),
+        pred_csr.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    np.testing.assert_allclose(pred_csr, pred, rtol=1e-6)
+
+    # fast single-row
+    fc = ctypes.c_void_p()
+    assert lib.LGBM_BoosterPredictForMatSingleRowFastInit(
+        bh, ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1),
+        ctypes.c_int(1), ctypes.c_int32(5), b"", ctypes.byref(fc)) == 0
+    row = np.ascontiguousarray(X[7], np.float64)
+    one = np.zeros(1, np.float64)
+    assert lib.LGBM_BoosterPredictForMatSingleRowFast(
+        fc, row.ctypes.data_as(ctypes.c_void_p), ctypes.byref(plen),
+        one.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    assert one[0] == pytest.approx(pred[7], rel=1e-6)
+    assert lib.LGBM_FastConfigFree(fc) == 0
+
+    # leaf get/set + bounds
+    lv = ctypes.c_double(0.0)
+    assert lib.LGBM_BoosterGetLeafValue(
+        bh, 0, 0, ctypes.byref(lv)) == 0
+    assert lib.LGBM_BoosterSetLeafValue(
+        bh, 0, 0, ctypes.c_double(lv.value)) == 0
+    lo = ctypes.c_double(0.0)
+    hi = ctypes.c_double(0.0)
+    assert lib.LGBM_BoosterGetLowerBoundValue(bh, ctypes.byref(lo)) == 0
+    assert lib.LGBM_BoosterGetUpperBoundValue(bh, ctypes.byref(hi)) == 0
+    assert lo.value < hi.value
+
+    lib.LGBM_BoosterFree(bh)
+    lib.LGBM_BoosterFree(bh2)
+    lib.LGBM_DatasetFree(dh)
