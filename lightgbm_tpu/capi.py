@@ -359,13 +359,8 @@ def LGBM_BoosterGetEval(handle: int, data_idx: int):
     metric config (c_api.cpp CreateObjectiveAndMetrics), so data_idx=0
     works without is_provide_training_metric — lazily instantiate."""
     bst = _get(handle)
-    g = bst._gbdt
-    if data_idx == 0 and not g.train_metrics and g.train_set is not None:
-        from .metric import create_metrics
-        ms = create_metrics(g.config)
-        for m in ms:
-            m.init(g.train_set.metadata, g.num_data)
-        g.train_metrics = ms
+    if data_idx == 0:
+        _eval_metrics(handle)
     res = bst.eval_train() if data_idx == 0 else bst.eval_valid()
     if data_idx > 0:
         names = [n for n, _ in bst._gbdt.valid_sets]
@@ -618,7 +613,15 @@ def LGBM_DatasetCreateFromSampledColumn(sample_data, sample_indices,
 @_api
 def LGBM_DatasetSetFeatureNames(handle: int, feature_names):
     ds = _get(handle)
-    ds.feature_name = [str(n) for n in feature_names]
+    names = [str(n) for n in feature_names]
+    ds.feature_name = names
+    if getattr(ds, "feature_names_", None) is not None:
+        # capi datasets construct at creation: propagate into the frozen
+        # post-construct names so boosters/saved models see them too
+        if len(names) != len(ds.feature_names_):
+            raise ValueError(f"expected {len(ds.feature_names_)} names, "
+                             f"got {len(names)}")
+        ds.feature_names_ = list(names)
     return 0, None
 
 
@@ -688,6 +691,11 @@ def LGBM_DatasetDumpText(handle: int, filename: str):
         names = LGBM_DatasetGetFeatureNames(handle)[1]
         fh.write("feature_names: " + "\t".join(names) + "\n")
         xb = ds.X_binned
+        if ds.efb is not None:
+            # device columns are EFB bundles, not per-feature bins —
+            # label the rows honestly so the dump stays self-consistent
+            fh.write(f"num_device_columns: {xb.shape[1]} "
+                     "(EFB bundle-space bin codes follow)\n")
         for i in range(min(len(xb), ds.num_data())):
             fh.write("\t".join(str(int(v)) for v in xb[i]) + "\n")
     return 0, None
@@ -744,11 +752,17 @@ def LGBM_BoosterShuffleModels(handle: int, start_iter: int, end_iter: int):
 
 
 def _eval_metrics(handle: int):
+    """Training metrics, lazily created + CACHED on the booster (the
+    reference's Booster always builds them from the metric config,
+    c_api.cpp CreateObjectiveAndMetrics)."""
     g = _get(handle)._gbdt
-    if g.train_metrics:
-        return g.train_metrics
-    from .metric import create_metrics
-    return create_metrics(g.config)
+    if not g.train_metrics and g.train_set is not None:
+        from .metric import create_metrics
+        ms = create_metrics(g.config)
+        for m in ms:
+            m.init(g.train_set.metadata, g.num_data)
+        g.train_metrics = ms
+    return g.train_metrics
 
 
 @_api
@@ -830,8 +844,8 @@ def LGBM_BoosterCalcNumPredict(handle: int, num_row: int,
     g = _get(handle)._gbdt
     k = g.num_tree_per_iteration
     total_iter = len(g.models) // max(k, 1)
-    ni = total_iter - start_iteration if num_iteration < 0 else \
-        min(num_iteration, total_iter - start_iteration)
+    ni = max(0, total_iter - start_iteration if num_iteration < 0 else
+             min(num_iteration, total_iter - start_iteration))
     if predict_type == C_API_PREDICT_LEAF_INDEX:
         per_row = ni * k
     elif predict_type == C_API_PREDICT_CONTRIB:

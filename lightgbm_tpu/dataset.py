@@ -132,6 +132,14 @@ class Dataset:
 
         cat_indices = self._resolve_categoricals(feature_names)
 
+        if cfg.linear_tree and sparse:
+            # linear leaves fit on RAW dense feature values
+            # (linear_tree_learner.cpp reads raw columns); the reference
+            # rejects this combination too
+            raise ValueError("linear_tree requires dense input (the "
+                             "per-leaf linear fits read raw feature "
+                             "values); densify or disable linear_tree")
+
         # pre-partitioned multi-process ingest (reference pre_partition +
         # distributed bin finding, dataset_loader.cpp:1040-1130): each
         # process holds only ITS row range; bin-finding samples are
@@ -143,18 +151,11 @@ class Dataset:
                      and self.reference is None)
         self.distributed_rows = dist_rows
         if dist_rows:
-            if sparse:
-                raise NotImplementedError(
-                    "pre_partition with sparse input is not supported yet")
             if self._group_arg is not None:
                 raise ValueError(
                     "pre_partition cannot shard query/group data (queries "
                     "must not straddle partitions); drop pre_partition or "
                     "the group argument")
-            if cfg.linear_tree:
-                raise NotImplementedError(
-                    "linear_tree with pre_partition is not supported yet")
-
         rng = np.random.RandomState(cfg.data_random_seed)
         if dist_rows:
             sample_cnt = min(n, max(1, int(cfg.bin_construct_sample_cnt) //
@@ -164,12 +165,57 @@ class Dataset:
         sample_idx = (np.sort(rng.choice(n, size=sample_cnt, replace=False))
                       if sample_cnt < n else np.arange(n))
         sample_rows_global = None
+        dist_sparse_cols = None
         n_total = n
         if dist_rows:
-            sample_rows_global = _dist.allgather_host(
-                np.asarray(raw[sample_idx], np.float64))
             n_total = int(_dist.allgather_host(
                 np.asarray([n], np.int32)).sum())
+            if sparse:
+                # per-column sampled NONZEROS gathered flat (one
+                # variable-length collective), plus global nnz counts so
+                # every rank derives identical zero fractions — the
+                # sparse analog of the dense sample allgather below; the
+                # raw shard itself never leaves this process
+                vals_list, lens_loc, nnz_loc = [], [], []
+                for j in range(f):
+                    lo, hi = raw.indptr[j], raw.indptr[j + 1]
+                    vals = np.asarray(raw.data[lo:hi], np.float64)
+                    if len(vals) > sample_cnt:
+                        vals = vals[np.sort(rng.choice(len(vals),
+                                                       sample_cnt, False))]
+                    vals_list.append(vals)
+                    lens_loc.append(len(vals))
+                    nnz_loc.append(hi - lo)
+                flat_all = _dist.allgather_host(
+                    np.concatenate(vals_list) if vals_list
+                    else np.zeros(0, np.float64))
+                lens_all = _dist.allgather_host(
+                    np.asarray(lens_loc, np.int32)).reshape(-1, f)
+                nnz_all = _dist.allgather_host(
+                    np.asarray(nnz_loc, np.int32)).reshape(-1, f)
+                nnz_glob = nnz_all.sum(axis=0)
+                rank_off = np.concatenate(
+                    [[0], np.cumsum(lens_all.sum(axis=1))])
+                col_off = np.cumsum(
+                    np.concatenate([np.zeros((len(lens_all), 1), np.int64),
+                                    lens_all], axis=1), axis=1)
+                dist_sparse_cols = []
+                for j in range(f):
+                    parts = [flat_all[rank_off[r] + col_off[r, j]:
+                                      rank_off[r] + col_off[r, j + 1]]
+                             for r in range(len(lens_all))]
+                    vals = np.concatenate(parts) if parts else \
+                        np.zeros(0, np.float64)
+                    zfrac = 1.0 - nnz_glob[j] / max(n_total, 1)
+                    nz = int(round(len(vals) * zfrac /
+                                   max(1e-9, 1 - zfrac))) \
+                        if zfrac < 1.0 else sample_cnt
+                    nz = min(nz, sample_cnt * max(len(lens_all), 1))
+                    dist_sparse_cols.append(
+                        np.concatenate([vals, np.zeros(nz)]))
+            else:
+                sample_rows_global = _dist.allgather_host(
+                    np.asarray(raw[sample_idx], np.float64))
 
         if self.reference is not None:
             ref = self.reference
@@ -194,7 +240,9 @@ class Dataset:
                             list(ent["bin_upper_bound"])
             self.bin_mappers = []
             for j in range(f):
-                if sparse:
+                if dist_sparse_cols is not None:
+                    col_sample = dist_sparse_cols[j]
+                elif sparse:
                     # sparse column: sampled nonzeros + proportional
                     # implied zeros (no densification)
                     lo, hi = raw.indptr[j], raw.indptr[j + 1]
@@ -274,6 +322,9 @@ class Dataset:
         if cfg.linear_tree and not sparse:
             # linear trees fit on RAW feature values (reference
             # linear_tree_learner.cpp raw_index); keep the used columns
+            # (under pre_partition this is the LOCAL row shard — padded
+            # in _finalize_distributed_rows and assembled row-sharded on
+            # the mesh by the GBDT driver)
             self.raw_used = raw[:, used].astype(np.float32)
         else:
             self.raw_used = None
@@ -300,6 +351,8 @@ class Dataset:
         pad = pad_to - n_local
         if pad:
             self.X_binned = np.pad(self.X_binned, ((0, pad), (0, 0)))
+            if self.raw_used is not None:
+                self.raw_used = np.pad(self.raw_used, ((0, pad), (0, 0)))
 
         def padded(a, fill=0.0):
             a = np.asarray(a, np.float64).ravel()

@@ -297,7 +297,18 @@ class GBDT:
             # linear leaves re-fit on raw values each iteration; tree
             # deferral buys nothing here
             self._defer_trees = False
-            self.X_raw_dev = jnp.asarray(train_set.raw_used)
+            if getattr(train_set, "distributed_rows", False):
+                # pre-partitioned: assemble the row-sharded global raw
+                # matrix like X_dev (local shards never replicate)
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as _P2
+                from ..parallel.mesh import get_mesh as _get_mesh2
+                _mesh2 = _get_mesh2(int(cfg.num_devices))
+                self.X_raw_dev = jax.make_array_from_process_local_data(
+                    NamedSharding(_mesh2, _P2(_mesh2.axis_names[0])),
+                    train_set.raw_used)
+            else:
+                self.X_raw_dev = jnp.asarray(train_set.raw_used)
 
         if self.objective is None and cfg.objective != "none":
             self.objective = create_objective(cfg.objective, cfg)
@@ -674,6 +685,14 @@ class GBDT:
                 elif tree.num_leaves > 1:
                     finished = False
             self._prev_iter_leaves = leaves_this_iter or None
+            for x in leaves_this_iter:
+                # start the device->host copy NOW so next iteration's
+                # lagged stump check reads a landed value instead of
+                # paying a blocking ~100 ms round trip per iteration
+                # (small-shape configs spend more time in that RTT than
+                # in their kernels)
+                if hasattr(x, "copy_to_host_async"):
+                    x.copy_to_host_async()
             self.iter_ += 1
             if finished:
                 log_warning("Stopped training because there are no more leaves "
@@ -1216,9 +1235,15 @@ class GBDT:
         if self.objective is None:
             raise ValueError("cannot refit without an objective")
         k = self.num_tree_per_iteration
-        if any(t.is_linear for t in source.models):
-            raise NotImplementedError(
-                "refit of linear-tree models is not supported yet")
+        any_linear = any(t.is_linear for t in source.models)
+        if any_linear and getattr(self, "X_raw_dev", None) is None:
+            # linear leaves predict from raw values; refit needs them on
+            # device even if this booster trains plain trees
+            if self.train_set.raw_used is None:
+                raise ValueError(
+                    "refit of a linear-tree model needs raw feature "
+                    "values; construct the dataset with linear_tree=true")
+            self.X_raw_dev = jnp.asarray(self.train_set.raw_used)
         trees = [self._align_loaded_tree(t) for t in source.models]
         n = self.num_data
         if leaf_preds.shape != (n, len(trees)):
@@ -1248,11 +1273,27 @@ class GBDT:
                     jnp.asarray(sum_g, jnp.float32),
                     jnp.asarray(sum_h, jnp.float32), sp), np.float64)
                 new_out *= tree.shrinkage
-                tree.leaf_value = (decay * tree.leaf_value[:len(new_out)] +
+                old_vals = tree.leaf_value[:len(new_out)].copy()
+                tree.leaf_value = (decay * old_vals +
                                    (1.0 - decay) * new_out)
                 tree.leaf_count = np.bincount(lp, minlength=nl)[:nl].astype(
                     np.int64)
-                delta = tree.leaf_value[lp].astype(np.float32)
+                if tree.is_linear:
+                    # linear leaves keep their fitted coefficients (the
+                    # reference's FitByExistingTree copies the tree and
+                    # refits only the leaf OUTPUT); shifting the constant
+                    # by the output delta re-centers the linear model on
+                    # the new rows consistently with the refit value
+                    shift = tree.leaf_value - old_vals
+                    tree.leaf_const = tree.leaf_const[:len(shift)] + shift
+                    from ..learner.linear import linear_score_delta
+                    lf, fm, co, lconst, lval = \
+                        self._linear_device_arrays(tree)
+                    delta = np.asarray(linear_score_delta(
+                        self.X_raw_dev, jnp.asarray(lp, jnp.int32), lf, fm,
+                        co, lconst, lval, 1.0), np.float32)
+                else:
+                    delta = tree.leaf_value[lp].astype(np.float32)
                 if k == 1:
                     score += delta
                 else:

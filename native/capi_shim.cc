@@ -229,7 +229,10 @@ def dataset_get_field(handle, name):
     else:
         arr = np.ascontiguousarray(v, np.float32)
         dtype = 0
-    _keep[("field", handle, name)] = arr
+    # APPEND (never replace): the reference keeps every pointer handed
+    # out valid until DatasetFree, including older results of repeated
+    # GetField calls for the same field
+    _keep.setdefault(("field", handle, name), []).append(arr)
     return code, len(arr), arr.ctypes.data, dtype
 
 

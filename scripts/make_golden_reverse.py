@@ -1,0 +1,48 @@
+"""Generate REVERSE-interchange golden fixtures: a model trained by THIS
+framework, scored by the REAL reference CLI (built per
+tests/test_reference_parity.py's recipe).
+
+  golden_ours_model.txt      our saved model (binary example data)
+  golden_ours_refpreds.txt   the reference binary's predictions on
+                             examples/binary_classification/binary.test
+
+The committed pair lets tests/test_reference_parity.py assert the
+reverse direction (our format parsed + reproduced by the reference)
+without the binary present.  Regenerate with LGBM_BIN set.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+GOLD = os.path.join(REPO, "tests", "golden")
+BIN = os.environ.get("LGBM_BIN", "/tmp/lgbm_build/lightgbm")
+EX = os.path.join(REPO, "examples", "binary_classification")
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import set_verbosity
+
+set_verbosity(-1)
+train = np.loadtxt(os.path.join(EX, "binary.train"))
+p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+     "min_data_in_leaf": 20, "seed": 7}
+bst = lgb.train(p, lgb.Dataset(train[:, 1:], train[:, 0]),
+                num_boost_round=8)
+model = os.path.join(GOLD, "golden_ours_model.txt")
+bst.save_model(model)
+out = os.path.join(GOLD, "golden_ours_refpreds.txt")
+subprocess.run(
+    [BIN, "task=predict", f"data={os.path.join(EX, 'binary.test')}",
+     f"input_model={model}", f"output_result={out}", "verbosity=-1",
+     "num_threads=1"], check=True, capture_output=True, timeout=300)
+test = np.loadtxt(os.path.join(EX, "binary.test"))
+ours = bst.predict(test[:, 1:])
+theirs = np.loadtxt(out)
+np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-7)
+print(f"wrote {model} and {out}; live parity max diff "
+      f"{np.abs(theirs - ours).max():.2e}")

@@ -89,3 +89,28 @@ def test_linear_host_predict_agrees(pw_linear):
                for t in gbdt.models)
     np.testing.assert_allclose(host, bst.predict(X[:100], raw_score=True),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_linear_refit():
+    """Refit of a linear-tree model: structures + coefficients keep, leaf
+    values/constants re-center on the new data with the decay mix."""
+    rng = np.random.RandomState(9)
+    X = rng.randn(800, 5).astype(np.float64)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.randn(800)
+    p = {"objective": "regression", "num_leaves": 7, "linear_tree": True,
+         "verbosity": -1, "min_data_in_leaf": 10}
+    bst = lgb.train(p, lgb.Dataset(X, y), 8)
+    X2 = rng.randn(600, 5).astype(np.float64)
+    y2 = X2[:, 0] * 2 + X2[:, 1] + 0.5 + 0.1 * rng.randn(600)  # shifted
+    re = bst.refit(X2, y2, decay_rate=0.5)
+    assert re.num_trees() == bst.num_trees()
+    t0, r0 = bst._gbdt.models[0], re._gbdt.models[0]
+    assert r0.is_linear and t0.num_leaves == r0.num_leaves
+    np.testing.assert_array_equal(t0.split_feature, r0.split_feature)
+    # coefficients preserved; constants shifted by the refit delta
+    for a, b in zip(t0.leaf_coeff, r0.leaf_coeff):
+        np.testing.assert_allclose(a, b, rtol=1e-7)
+    pr = re.predict(X2)
+    assert np.all(np.isfinite(pr))
+    # refit toward the shifted data beats the unrefit model there
+    assert np.mean((pr - y2) ** 2) < np.mean((bst.predict(X2) - y2) ** 2)

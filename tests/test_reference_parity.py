@@ -134,23 +134,45 @@ def test_same_config_training_matches_reference_quality():
         (auc, meta["binary_test_auc"])
 
 
-@pytest.mark.skipif(not REF_BIN, reason="LGBM_TPU_REFERENCE_BIN not set")
-def test_our_model_scored_by_reference_binary(tmp_path):
-    """Reverse interchange: the reference CLI loads OUR model file and
-    reproduces OUR predictions."""
-    train = np.loadtxt(os.path.join(EX, "binary.train"))
+def test_committed_reverse_fixture_matches():
+    """Reverse interchange WITHOUT the binary: the committed model was
+    saved by THIS framework and scored by the reference CLI once
+    (scripts/make_golden_reverse.py); loading the committed model here
+    must reproduce the committed reference predictions — both parsers
+    agree on our emitted format."""
+    model = os.path.join(GOLD, "golden_ours_model.txt")
+    refp = os.path.join(GOLD, "golden_ours_refpreds.txt")
     test = np.loadtxt(os.path.join(EX, "binary.test"))
+    bst = lgb.Booster(model_file=model)
+    ours = bst.predict(test[:, 1:])
+    theirs = np.loadtxt(refp)
+    np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-7)
+
+    # when a reference binary is available (env override or the build
+    # recipe's default path), ALSO run the live direction: the CLI loads
+    # a freshly-trained model of ours and reproduces its predictions
+    ref_bin = REF_BIN or ("/tmp/lgbm_build/lightgbm"
+                          if os.path.exists("/tmp/lgbm_build/lightgbm")
+                          else "")
+    if not ref_bin:
+        return
+    import subprocess
+    import tempfile
+    train = np.loadtxt(os.path.join(EX, "binary.train"))
     p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
          "min_data_in_leaf": 20}
-    bst = lgb.train(p, lgb.Dataset(train[:, 1:], train[:, 0]),
-                    num_boost_round=8)
-    ours = bst.predict(test[:, 1:])
-    model = tmp_path / "ours.txt"
-    bst.save_model(str(model))
-    out = tmp_path / "preds.txt"
-    subprocess.run(
-        [REF_BIN, "task=predict", f"data={os.path.join(EX, 'binary.test')}",
-         f"input_model={model}", f"output_result={out}", "verbosity=-1",
-         "num_threads=1"], check=True, capture_output=True, timeout=300)
-    theirs = np.loadtxt(out)
-    np.testing.assert_allclose(theirs, ours, rtol=1e-5, atol=1e-7)
+    live = lgb.train(p, lgb.Dataset(train[:, 1:], train[:, 0]),
+                     num_boost_round=8)
+    with tempfile.TemporaryDirectory() as td:
+        mpath = os.path.join(td, "ours.txt")
+        live.save_model(mpath)
+        opath = os.path.join(td, "preds.txt")
+        subprocess.run(
+            [ref_bin, "task=predict",
+             f"data={os.path.join(EX, 'binary.test')}",
+             f"input_model={mpath}", f"output_result={opath}",
+             "verbosity=-1", "num_threads=1"], check=True,
+            capture_output=True, timeout=300)
+        np.testing.assert_allclose(np.loadtxt(opath),
+                                   live.predict(test[:, 1:]),
+                                   rtol=1e-5, atol=1e-7)
