@@ -27,9 +27,17 @@ AUTOTUNE_MAX_CELLS = 1 << 22
 
 
 def pick_hist_impl(X_binned: np.ndarray, max_bins: int,
-                   candidates=("pallas", "onehot"), reps: int = 3) -> str:
+                   candidates=("pallas", "onehot"), reps: int = 10) -> str:
     """Time one full histogram build per candidate impl on the actual
-    data shapes; return the faster (ties -> first candidate)."""
+    data shapes; return the faster (ties -> first candidate).
+
+    Measurement is amortized over ``reps`` builds with a single host
+    sync: through a remote-tunnel device the sync alone costs ~100 ms,
+    so it must be a CONSTANT bias shared by both candidates, not part of
+    the per-build signal.  The static default (candidates[0] — pallas on
+    TPU) additionally gets a 1.3x hysteresis margin: a wrong flip to the
+    XLA onehot path costs 5-10x per histogram pass at wave-grower
+    shapes, so the probe must beat real noise, not tie with it."""
     import jax
     import jax.numpy as jnp
     n, f = X_binned.shape
@@ -77,6 +85,9 @@ def pick_hist_impl(X_binned: np.ndarray, max_bins: int,
         except Exception:  # noqa: BLE001 — a failing impl simply loses
             times[impl] = float("inf")
     win = min(candidates, key=lambda i: times[i])
+    if win != candidates[0] and \
+            times[win] > times[candidates[0]] / 1.3:
+        win = candidates[0]
     from ..utils.log import log_info
     log_info("histogram autotune at shape "
              f"({n}, {f}, {max_bins}): " +

@@ -601,6 +601,12 @@ def split_params_from_config(config: Config,
         feature_fraction_bynode=float(config.feature_fraction_bynode),
         extra_trees=bool(config.extra_trees),
         any_cat=bool(is_cat is None or np.any(np.asarray(is_cat))))
+    # NOTE: cat_idx (the static cat-column positions that bound the
+    # sorted-subset search) is NOT set here — scans that operate on
+    # per-shard feature BLOCKS (feature-parallel, voting, DP
+    # psum_scatter) index a sliced feature space where global positions
+    # would be wrong.  Full-feature-space learners attach it via
+    # ``sp._replace(cat_idx=...)``.
 
 
 def resolve_monotone_method(config: Config, use_mc: bool,
@@ -693,6 +699,14 @@ class SerialTreeLearner:
             jnp.int32)
         self.num_features = num_features
         self.split_params = split_params_from_config(config, num_bins, is_cat)
+        if np.any(np.asarray(is_cat)):
+            # serial scans + the wave row update run in FULL feature
+            # space: record the static cat-column positions (bounds the
+            # subset search's argsort and enables the embedding-style
+            # membership lookup)
+            self.split_params = self.split_params._replace(
+                cat_idx=tuple(int(j) for j in
+                              np.where(np.asarray(is_cat))[0]))
         pool_f, pool_b = (self._efb_dims if self._efb_dims is not None
                           else (num_features, self.max_bins))
         self.use_hist_pool = hist_pool_fits(config, pool_f, pool_b)

@@ -807,31 +807,60 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     cols_w, rl, tab, interpret=interpret)
                 rl = rl_new.astype(rl.dtype)
             else:
-                ch = jnp.full((n,), -1, jnp.int8)
+                # Vectorized XLA fallback (categorical / EFB / wide-bin
+                # shapes the fused kernel cannot take).  The former W
+                # SEQUENTIAL masked sweeps cost ~0.7-2 ms of fused-loop
+                # launch overhead EACH (~50 ms/wave at small N — the
+                # dominant cost of the whole benchmark-matrix shapes);
+                # one batched (W, N) formulation replaces them: every
+                # row belongs to at most one split leaf, so an argmax
+                # over the match matrix picks its slot and a single
+                # take_along_axis resolves the decision.
+                cols_w = jax.vmap(feature_col)(feat)           # (W, N)
                 if small_bins:
-                    thr_c = thr.astype(jnp.uint8)
+                    thr_c = thr.astype(jnp.uint8)[:, None]
                     nan_c = jnp.where(f_nan_bin < 0, 255,
-                                      f_nan_bin).astype(jnp.uint8)
+                                      f_nan_bin).astype(jnp.uint8)[:, None]
                 else:
-                    thr_c, nan_c = thr, f_nan_bin
-                sel_c = sel_leaves.astype(rl.dtype)
-                new_c = new_ids.astype(rl.dtype)
-                jidx = jnp.arange(W, dtype=jnp.int8)
-                for j in range(W):
-                    col = feature_col(feat[j])
-                    if any_cat:
-                        go_left = jnp.where(
-                            fcat[j], member[j][col],
-                            jnp.where(col == nan_c[j], dleft[j],
-                                      col <= thr_c[j]))
+                    thr_c = thr[:, None]
+                    nan_c = f_nan_bin[:, None]
+                num_go = jnp.where(cols_w == nan_c, dleft[:, None],
+                                   cols_w <= thr_c)            # (W, N)
+                if any_cat:
+                    cat_static = sp.cat_idx
+                    if 0 < len(cat_static) <= 8:
+                        # per-slot bitset lookup as FEW-INDICES x WIDE-ROW
+                        # embedding takes: a (W, N)-indexed gather from the
+                        # (W, B) membership table costs ~45 ms at 145K rows
+                        # on TPU, while N row-takes from the (B, W)
+                        # transposed table cost ~6 ms — loop the STATIC
+                        # cat features and combine by split-feature match
+                        mi8 = member.astype(jnp.int8).T        # (B, W)
+                        acc = jnp.zeros((n, W), jnp.int8)
+                        for cf in cat_static:
+                            colv = feature_col(jnp.asarray(cf, jnp.int32))
+                            look = jnp.take(mi8, colv.astype(jnp.int32),
+                                            axis=0)            # (N, W)
+                            acc = acc + look * (feat == cf).astype(
+                                jnp.int8)[None, :]
+                        cat_go = acc.T > 0
                     else:
-                        go_left = jnp.where(col == nan_c[j], dleft[j],
-                                            col <= thr_c[j])
-                    upd = sel[j] & (rl == sel_c[j])
-                    ch = jnp.where(upd & (go_left == left_smaller[j]),
-                                   jidx[j], ch)
-                    rl = jnp.where(upd & jnp.logical_not(go_left),
-                                   new_c[j], rl)
+                        cat_go = jnp.take_along_axis(
+                            member, cols_w.astype(jnp.int32), axis=1)
+                    go_w = jnp.where(fcat[:, None], cat_go, num_go)
+                else:
+                    go_w = num_go
+                sel_c = sel_leaves.astype(rl.dtype)
+                match = sel[:, None] & (rl[None, :] == sel_c[:, None])
+                has = jnp.any(match, axis=0)                   # (N,)
+                jhit = jnp.argmax(match, axis=0)               # (N,)
+                go = jnp.take_along_axis(go_w, jhit[None, :],
+                                         axis=0)[0]
+                ch = jnp.where(
+                    has & (go == left_smaller[jhit]),
+                    jhit.astype(jnp.int8), jnp.int8(-1))
+                rl = jnp.where(has & jnp.logical_not(go),
+                               new_ids[jhit].astype(rl.dtype), rl)
 
             # ---- one kernel pass: all W smaller-child histograms ----
             hist_small = hist_waves(ch)                    # (W, G, Bb, 3)

@@ -58,6 +58,10 @@ class SplitParams(NamedTuple):
     min_data_per_group: int = 100
     use_cat_subset: bool = False   # any categorical feature needs the
                                    # sorted-subset search (num_bin > onehot)
+    cat_idx: tuple = ()            # STATIC positions of categorical
+                                   # features — the sorted-subset search
+                                   # (argsort per candidate) runs on this
+                                   # slice only, not all F features
     # cost-effective gradient boosting (cost_effective_gradient_boosting
     # .hpp:103 DetlaGain): gain -= tradeoff*(penalty_split*leaf_count +
     # coupled feature penalty when the feature is not yet used)
@@ -298,19 +302,29 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
         # non-onehot branch): categories ordered by sum_grad/(sum_hess +
         # cat_smooth); prefix subsets scanned from BOTH ends, up to
         # max_cat_threshold categories; the LEFT child takes the subset.
+        # The argsort/rank machinery is the single most expensive part of
+        # a categorical scan, so it runs ONLY on the static cat columns
+        # (params.cat_idx) and scatters back — numeric features never pay
+        # for it.
         if params.use_cat_subset:
+            ci = jnp.asarray(params.cat_idx, jnp.int32) \
+                if params.cat_idx else jnp.arange(f, dtype=jnp.int32)
+            nc = len(params.cat_idx) or f
+            hgc, hhc, hcc = hg_m[ci], hh_m[ci], hc_m[ci]
+            real_bin_c = real_bin[ci]
+            rand_bins_c = rand_bins[ci] if use_et else None
             mdpg = float(params.min_data_per_group)
             # candidate categories: count >= cat_smooth (the reference
             # reuses cat_smooth as the per-category min count filter)
-            cat_valid = real_bin & (hc_m >= params.cat_smooth)
+            cat_valid = real_bin_c & (hcc >= params.cat_smooth)
             ratio = jnp.where(cat_valid,
-                              hg_m / (hh_m + params.cat_smooth), BIG)
-            order = jnp.argsort(ratio, axis=1, stable=True)      # (F, B)
-            rank = jnp.zeros((f, b), jnp.int32).at[
-                jnp.arange(f)[:, None], order].set(
+                              hgc / (hhc + params.cat_smooth), BIG)
+            order = jnp.argsort(ratio, axis=1, stable=True)      # (nc, B)
+            rank = jnp.zeros((nc, b), jnp.int32).at[
+                jnp.arange(nc)[:, None], order].set(
                 jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[None, :],
-                                 (f, b)))
-            used = jnp.sum(cat_valid, axis=1).astype(jnp.int32)  # (F,)
+                                 (nc, b)))
+            used = jnp.sum(cat_valid, axis=1).astype(jnp.int32)  # (nc,)
             pos = jnp.arange(b, dtype=jnp.int32)[None, :]        # (1, B)
             pos_used = pos < used[:, None]
 
@@ -328,16 +342,16 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                 cumb = total_used - jnp.where(bidx >= 0, tb, 0.0)
                 return cumf, cumb
 
-            cumf_g, cumb_g = fwd_bwd(hg_m)
-            cumf_h, cumb_h = fwd_bwd(hh_m)
-            cumf_c, cumb_c = fwd_bwd(hc_m)
+            cumf_g, cumb_g = fwd_bwd(hgc)
+            cumf_h, cumb_h = fwd_bwd(hhc)
+            cumf_c, cumb_c = fwd_bwd(hcc)
 
             max_pos = jnp.minimum(jnp.minimum(params.max_cat_threshold,
                                               (used[:, None] + 1) // 2),
                                   used[:, None])                 # (F, 1)
             pos_ok = pos < max_pos
             if use_et:  # one random subset size per node (USE_RAND)
-                pos_ok = pos_ok & (pos == rand_bins[:, None] %
+                pos_ok = pos_ok & (pos == rand_bins_c[:, None] %
                                    jnp.maximum(max_pos, 1))
 
             def subset_gain(lg, lh, lc):
@@ -347,7 +361,7 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                 # last evaluated one; approximated here as crossing a
                 # multiple of min_data_per_group in the prefix count
                 gcross = jnp.floor(lc / mdpg)
-                gprev = jnp.concatenate([jnp.full((f, 1), -1.0),
+                gprev = jnp.concatenate([jnp.full((nc, 1), -1.0),
                                          gcross[:, :-1]], axis=1)
                 ok = (pos_ok & (lc >= min_cnt) & (lh >= min_h) &
                       (rc >= jnp.maximum(min_cnt, mdpg)) &
@@ -385,6 +399,14 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                 (rank >= used[:, None] - 1 - sub_pos[:, None]) &
                 (rank < used[:, None]),
                 rank <= sub_pos[:, None])
+
+            # scatter the nc-sliced results back into F-space
+            sub_gain = jnp.full((f,), NEG_INF, hist.dtype).at[ci].set(
+                sub_gain, mode="drop")
+            sub_left = jnp.zeros((f, 3), hist.dtype).at[ci].set(
+                sub_left, mode="drop")
+            sub_member = jnp.zeros((f, b), jnp.bool_).at[ci].set(
+                sub_member, mode="drop")
 
             use_subset = is_cat & (num_bins > params.max_cat_to_onehot)
             cat_best_gain = jnp.where(use_subset, sub_gain, oh_gain)

@@ -30,6 +30,22 @@ def create_parallel_learner(config, num_features, max_bins, num_bins, is_cat,
     }.get(kind)
     if cls is None:
         raise ValueError(f"Unknown tree_learner: {kind}")
+    import jax
+    from .mesh import get_mesh
+    if get_mesh(int(config.num_devices)).devices.size == 1 and \
+            jax.process_count() == 1:
+        # a parallel learner over a 1-device mesh IS the serial learner
+        # with collective overhead on top — the reference likewise runs
+        # serial when num_machines == 1 (application.cpp).  Fall back so
+        # single-chip runs of parallel configs get the fast wave path.
+        from ..utils.log import log_info
+        from ..learner.serial import SerialTreeLearner
+        log_info(f"tree_learner={kind} on a single-device mesh: using "
+                 "the serial learner (no collectives needed)")
+        return SerialTreeLearner(
+            config, num_features, max_bins, num_bins, is_cat, has_nan,
+            monotone, forced_splits,
+            interaction_groups=interaction_groups, cegb_lazy=cegb_lazy)
     if kind == "data":
         return cls(config, num_features, max_bins, num_bins, is_cat,
                    has_nan, monotone, interaction_groups=interaction_groups,

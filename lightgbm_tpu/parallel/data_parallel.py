@@ -256,6 +256,12 @@ class DataParallelTreeLearner:
         from ..ops.quantize import quant_levels
         self.quantized = bool(config.use_quantized_grad)
         sp = split_params_from_config(config, num_bins, is_cat)
+        if np.any(np.asarray(is_cat)):
+            # the DP-WAVE scan runs replicated in FULL feature space
+            # (unlike the masked psum_scatter blocks) — attach the static
+            # cat positions that bound the subset search's argsort
+            sp = sp._replace(cat_idx=tuple(
+                int(j) for j in np.where(np.asarray(is_cat))[0]))
         self.split_params = sp
         from ..learner.serial import resolve_monotone_method
         mc_inter = resolve_monotone_method(config, sp.use_monotone,

@@ -1,0 +1,34 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax.numpy as jnp
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import set_verbosity
+set_verbosity(-1)
+
+n = int(581_000 * 0.25)
+rng = np.random.RandomState(2)
+Xn = rng.randn(n, 10).astype(np.float32)
+cat = rng.randint(0, 40, (n, 2)).astype(np.float32)
+X = np.concatenate([Xn, cat], axis=1)
+logits = np.stack([Xn @ (rng.randn(10) / 3) +
+                   (cat[:, 0] % 7 == c) * 1.5 for c in range(7)], 1)
+y = np.argmax(logits + 0.5 * rng.randn(n, 7), axis=1).astype(np.float64)
+
+def run(tag, extra, cats=(10, 11)):
+    p = {"objective": "multiclass", "num_class": 7, "max_bin": 255,
+         "learning_rate": 0.1, "verbosity": -1, "boosting": "goss"}
+    p.update(extra)
+    ds = lgb.Dataset(X, y, categorical_feature=list(cats), params=p)
+    b = lgb.Booster(params=p, train_set=ds)
+    b.update(); float(jnp.sum(b._gbdt.score))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        b.update()
+    float(jnp.sum(b._gbdt.score))
+    print(f"{tag}: {(time.perf_counter()-t0)/3*1e3:.0f} ms/iter", flush=True)
+
+run("L=31 cats", {"num_leaves": 31})
+run("L=63 cats", {"num_leaves": 63})
+run("L=63 nocat", {"num_leaves": 63}, cats=())
+run("L=63 cats partition", {"num_leaves": 63, "tree_grow_mode": "partition"})

@@ -1,0 +1,30 @@
+"""Per-update timing of the EXACT benchmark-matrix multiclass config."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax.numpy as jnp
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import set_verbosity
+set_verbosity(-1)
+
+n = int(581_000 * 0.25)
+rng = np.random.RandomState(2)
+Xn = rng.randn(n, 10).astype(np.float32)
+cat = rng.randint(0, 40, (n, 2)).astype(np.float32)
+X = np.concatenate([Xn, cat], axis=1)
+logits = np.stack([Xn @ (rng.randn(10) / 3) +
+                   (cat[:, 0] % 7 == c) * 1.5 for c in range(7)], 1)
+y = np.argmax(logits + 0.5 * rng.randn(n, 7), axis=1).astype(np.float64)
+p = {"objective": "multiclass", "num_class": 7, "num_leaves": 63,
+     "max_bin": 255, "learning_rate": 0.1, "verbosity": -1,
+     "boosting": "goss"}
+ds = lgb.Dataset(X, y, categorical_feature=[10, 11], params=p)
+b = lgb.Booster(params=p, train_set=ds)
+g = b._gbdt
+def sync(): return float(jnp.sum(g.score))
+b.update(); sync()
+for i in range(12):
+    t0 = time.perf_counter()
+    b.update()
+    sync()
+    print(f"iter {i}: {(time.perf_counter()-t0)*1e3:.0f} ms", flush=True)

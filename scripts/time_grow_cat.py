@@ -1,0 +1,30 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax, jax.numpy as jnp
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import set_verbosity
+set_verbosity(-1)
+n = 145250
+rng = np.random.RandomState(2)
+Xn = rng.randn(n, 10).astype(np.float32)
+cat = rng.randint(0, 40, (n, 2)).astype(np.float32)
+X = np.concatenate([Xn, cat], axis=1)
+y = ((Xn[:, 0] + (cat[:, 0] % 3 == 1)) > 0.5).astype(np.float64)
+
+for tag, cats in (("cats", [10, 11]), ("nocat", [])):
+    p = {"objective": "binary", "num_leaves": 63, "max_bin": 255,
+         "verbosity": -1}
+    ds = lgb.Dataset(X, y, categorical_feature=cats, params=p)
+    b = lgb.Booster(params=p, train_set=ds)
+    g = b._gbdt
+    b.update(); float(jnp.sum(g.score))
+    grad, hess = g.objective.get_gradients(g.score)
+    fmask = g._feature_mask()
+    mask = jnp.ones((n,), jnp.float32)
+    out = g.learner.train(g.X_dev, grad, hess, mask, feature_mask=fmask)
+    jax.block_until_ready(out.num_leaves)
+    t0 = time.perf_counter()
+    for _ in range(15):
+        out = g.learner.train(g.X_dev, grad, hess, mask, feature_mask=fmask)
+    float(np.asarray(out.num_leaves))
+    print(f"grow {tag}: {(time.perf_counter()-t0)/15*1e3:.0f} ms", flush=True)

@@ -1,0 +1,74 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+n, W, B = 145408, 25, 256
+rng = np.random.RandomState(0)
+member = jnp.asarray(rng.rand(W, B) < 0.5)
+cols = jnp.asarray(rng.randint(0, 250, (W, n)).astype(np.uint8))
+
+def t(tag, fn, *a):
+    out = fn(*a); float(jnp.sum(out.astype(jnp.float32)))
+    t0 = time.perf_counter()
+    for _ in range(20): out = fn(*a)
+    float(jnp.sum(out.astype(jnp.float32)))
+    print(f"{tag}: {(time.perf_counter()-t0)/20*1e3:.2f} ms", flush=True)
+
+t("bool gather", jax.jit(lambda m, c: jnp.take_along_axis(m, c.astype(jnp.int32), 1)), member, cols)
+t("f32 gather ", jax.jit(lambda m, c: jnp.take_along_axis(m.astype(jnp.float32), c.astype(jnp.int32), 1) > 0.5), member, cols)
+t("i32 gather ", jax.jit(lambda m, c: jnp.take_along_axis(m.astype(jnp.int32), c.astype(jnp.int32), 1) > 0), member, cols)
+
+# matmul one-hot-free: dot member f32 (W,B) with per-bin compare accumulated
+# via 8-bit decomposition: col bit b of value v... instead: byte-table via
+# bitpack: member bits packed to (W, 8) u32 words + extract
+def bitpack(m):
+    w = m.reshape(W, 32, 8)
+    p2 = (2 ** jnp.arange(8, dtype=jnp.uint32))
+    return jnp.sum(w.astype(jnp.uint32) * p2, axis=2)  # (W, 32) bytes
+@jax.jit
+def byte_gather(m, c):
+    bytes_ = bitpack(m).astype(jnp.int32)      # (W, 32)
+    hi = (c >> 3).astype(jnp.int32)            # (W, N) byte index
+    lo = (c & 7).astype(jnp.int32)
+    by = jnp.take_along_axis(bytes_, hi, 1)    # (W, N) gather from 32-wide
+    return ((by >> lo) & 1) > 0
+t("byte gather", byte_gather, member, cols)
+
+# polynomial via segmented compare: 256 compares per slot is the kernel way
+@jax.jit
+def compare_sum(m, c):
+    # (W, N) bool via 2-level: 16 coarse x 16 fine using equality products
+    mf = m.astype(jnp.float32).reshape(W, 16, 16)
+    hi = (c >> 4).astype(jnp.int32); lo = (c & 15).astype(jnp.int32)
+    hi_oh = jax.nn.one_hot(hi, 16, dtype=jnp.float32)   # (W, N, 16)? too big
+    return None
+t2 = None
+
+colv = jnp.asarray(rng.randint(0, 250, n).astype(np.uint8))  # one cat column
+
+@jax.jit
+def embed_gather(m, cv):
+    # (B, W) table, N row-indices -> (N, W): embedding-style take
+    return jnp.take(m.astype(jnp.int8).T, cv.astype(jnp.int32), axis=0)
+t("embed gather (N rows from (B,W))", embed_gather, member, colv)
+
+@jax.jit
+def onehot_dot(m, cv):
+    oh = jax.nn.one_hot(cv.astype(jnp.int32), B, dtype=jnp.bfloat16)
+    return jax.lax.dot_general(oh, m.astype(jnp.bfloat16).T,
+                               (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+t("onehot dot (N,B)@(B,W)", onehot_dot, member, colv)
+
+@jax.jit
+def flat_take(m, c):
+    flat_idx = (jnp.arange(W, dtype=jnp.int32)[:, None] * B +
+                c.astype(jnp.int32))
+    return jnp.take(m.astype(jnp.int8).ravel(), flat_idx, axis=0)
+t("flat take (W,N) idx from (W*B,)", flat_take, member, cols)
+
+@jax.jit
+def flat_take_T(m, c):
+    flat_idx = (c.T.astype(jnp.int32) * 1 +
+                jnp.arange(W, dtype=jnp.int32)[None, :] * B)  # (N, W)
+    return jnp.take(m.astype(jnp.int8).ravel(), flat_idx, axis=0)
+t("flat take (N,W) idx", flat_take_T, member, cols)

@@ -1,0 +1,52 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+n, F, W, B, L = 145408, 12, 25, 256, 63
+rng = np.random.RandomState(0)
+X_T = jnp.asarray(rng.randint(0, 250, (F, n)).astype(np.uint8))
+feat = jnp.asarray(rng.randint(0, F, W))
+member = jnp.asarray(rng.rand(W, B) < 0.5)
+rl = jnp.asarray(rng.randint(0, 40, n).astype(np.uint8))
+sel_leaves = jnp.asarray(rng.choice(40, W, False))
+thr = jnp.asarray(rng.randint(0, 250, W))
+dleft = jnp.zeros((W,), bool)
+sel = jnp.ones((W,), bool)
+new_ids = jnp.asarray(40 + np.arange(W))
+ls = jnp.asarray(rng.rand(W) < 0.5)
+
+def t(tag, fn, *a):
+    def syn(o):
+        o = o[0] if isinstance(o, tuple) else o
+        return float(jnp.sum(o.astype(jnp.float32)))
+    syn(fn(*a))
+    t0 = time.perf_counter()
+    for _ in range(20): out = fn(*a)
+    syn(out)
+    print(f"{tag}: {(time.perf_counter()-t0)/20*1e3:.2f} ms", flush=True)
+
+t("take rows", jax.jit(lambda f: jnp.take(X_T, f, axis=0)), feat)
+
+@jax.jit
+def full(feat, member, rl):
+    cols_w = jnp.take(X_T, feat, axis=0)
+    thr_c = thr.astype(jnp.uint8)[:, None]
+    nan_c = jnp.full((W, 1), 255, jnp.uint8)
+    num_go = jnp.where(cols_w == nan_c, dleft[:, None], cols_w <= thr_c)
+    cat_go = jnp.take_along_axis(member, cols_w.astype(jnp.int32), axis=1)
+    fcat = jnp.zeros((W,), bool).at[:2].set(True)
+    go_w = jnp.where(fcat[:, None], cat_go, num_go)
+    sel_c = sel_leaves.astype(rl.dtype)
+    match = sel[:, None] & (rl[None, :] == sel_c[:, None])
+    has = jnp.any(match, axis=0)
+    jhit = jnp.argmax(match, axis=0)
+    go = jnp.take_along_axis(go_w, jhit[None, :], axis=0)[0]
+    ch = jnp.where(has & (go == ls[jhit]), jhit.astype(jnp.int8), jnp.int8(-1))
+    rl2 = jnp.where(has & ~go, new_ids[jhit].astype(rl.dtype), rl)
+    return rl2, ch
+t("full rowupd", full, feat, member, rl)
+
+@jax.jit
+def memb(member, cols_w):
+    return jnp.take_along_axis(member, cols_w.astype(jnp.int32), axis=1)
+cols_w = jnp.take(X_T, feat, axis=0)
+t("membership gather", memb, member, cols_w)

@@ -1,0 +1,32 @@
+"""Scan timing with categorical configurations (the matrix's shape)."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from lightgbm_tpu.ops.split import SplitParams
+from lightgbm_tpu.learner.serial import local_best_candidate
+
+C, F, B = 50, 12, 256
+rng = np.random.RandomState(0)
+hists = jnp.asarray(rng.rand(C, F, B, 3).astype(np.float32))
+sums = jnp.asarray(hists.sum(axis=(1, 2)) / F)
+nb = jnp.full((F,), B, jnp.int32)
+ic = jnp.zeros((F,), bool).at[10].set(True).at[11].set(True)
+hn = jnp.zeros((F,), bool)
+fm = jnp.ones((F,), bool)
+
+def run(tag, sp):
+    def one(h, s):
+        return local_best_candidate(h, s, nb, ic, hn, fm, sp)
+    fn = jax.jit(jax.vmap(one))
+    out = fn(hists, sums); jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(30):
+        out = fn(hists, sums)
+    float(np.asarray(out[0]).sum())
+    print(f"{tag}: {(time.perf_counter()-t0)/30*1e3:.2f} ms", flush=True)
+
+run("nocat          ", SplitParams(any_cat=False))
+run("cat onehot-only", SplitParams(any_cat=True))
+run("cat subset all-F", SplitParams(any_cat=True, use_cat_subset=True))
+run("cat subset idx  ", SplitParams(any_cat=True, use_cat_subset=True,
+                                    cat_idx=(10, 11)))
