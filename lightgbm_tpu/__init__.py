@@ -11,6 +11,22 @@ jax collectives, and the reference's public Python surface::
     bst.predict(X)
 """
 
+# Honor JAX_PLATFORMS even when a preloaded PJRT plugin (sitecustomize)
+# registered an accelerator backend eagerly: jax.config wins over the
+# registered plugin as long as no client exists yet.  Without this, ANY
+# import-and-train with JAX_PLATFORMS=cpu silently initializes — or
+# hangs on — the accelerator (same guard as tests/conftest.py).
+import os as _os
+
+if "cpu" in _os.environ.get("JAX_PLATFORMS", ""):
+    import jax as _jax
+
+    try:
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except RuntimeError:
+        pass  # a backend already initialized; too late to switch
+
+
 from . import distributed
 from .basic import Booster
 from .callback import (EarlyStopException, early_stopping, log_evaluation,

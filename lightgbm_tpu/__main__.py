@@ -3,7 +3,7 @@ src/main.cpp:11)."""
 
 import sys
 
-from .cli import main
+from .cli import main  # the package __init__ honors JAX_PLATFORMS
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -218,7 +218,7 @@ _PARAMS: List[ParamSpec] = [
     # which splits to precompute.  Applies on the serial Pallas wave path
     # for numeric-only datasets with num_leaves >= 3*wave_size.
     _p("tpu_speculative_ramp", bool, True),
-    _p("tpu_spec_tolerance", float, 0.1, check=">=0.0"),
+    _p("tpu_spec_tolerance", float, 0.3, check=">=0.0"),
     _p("num_devices", int, 0),               # 0 = all visible devices
     # --- gradient quantization (config.h use_quantized_grad block;
     # gradient_discretizer.cpp) — int8 histogram training on the MXU

@@ -67,7 +67,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                       renew_leaf: bool = False, stochastic: bool = True,
                       interaction_groups: tuple = (),
                       cegb_lazy: tuple = (), spec_ramp: bool = False,
-                      spec_tol: float = 0.1,
+                      spec_tol: float = 0.3,
                       spec_subsample: int = 1 << 19,
                       forced_splits: tuple = (),
                       mc_inter: bool = False):
@@ -816,7 +816,6 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 # row belongs to at most one split leaf, so an argmax
                 # over the match matrix picks its slot and a single
                 # take_along_axis resolves the decision.
-                cols_w = jax.vmap(feature_col)(feat)           # (W, N)
                 if small_bins:
                     thr_c = thr.astype(jnp.uint8)[:, None]
                     nan_c = jnp.where(f_nan_bin < 0, 255,
@@ -824,43 +823,70 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 else:
                     thr_c = thr[:, None]
                     nan_c = f_nan_bin[:, None]
-                num_go = jnp.where(cols_w == nan_c, dleft[:, None],
-                                   cols_w <= thr_c)            # (W, N)
-                if any_cat:
-                    cat_static = sp.cat_idx
-                    if 0 < len(cat_static) <= 8:
-                        # per-slot bitset lookup as FEW-INDICES x WIDE-ROW
-                        # embedding takes: a (W, N)-indexed gather from the
-                        # (W, B) membership table costs ~45 ms at 145K rows
-                        # on TPU, while N row-takes from the (B, W)
-                        # transposed table cost ~6 ms — loop the STATIC
-                        # cat features and combine by split-feature match
-                        mi8 = member.astype(jnp.int8).T        # (B, W)
-                        acc = jnp.zeros((n, W), jnp.int8)
+                sel_c = sel_leaves.astype(rl.dtype)
+                mi8 = member.astype(jnp.int8).T                # (B, W)
+                cat_static = sp.cat_idx if any_cat else ()
+
+                def _upd_block(Xb, rlb):
+                    """One row block of the batched update — (W, m)
+                    intermediates stay bounded for very large N."""
+                    m = Xb.shape[1]
+
+                    def fcol(ff):
+                        g = f_bundle[ff] if use_efb else ff
+                        v = jax.lax.dynamic_slice(Xb, (g, 0), (1, m))[0]
+                        if small_bins:
+                            return v
+                        return bundle_decode(v.astype(jnp.int32), ff)
+
+                    cols_w = jax.vmap(fcol)(feat)              # (W, m)
+                    num_go = jnp.where(cols_w == nan_c, dleft[:, None],
+                                       cols_w <= thr_c)
+                    if not any_cat:
+                        go_w = num_go
+                    elif 0 < len(cat_static) <= 8:
+                        # per-slot bitset lookup as FEW-INDICES x
+                        # WIDE-ROW embedding takes: a (W, N)-indexed
+                        # gather from the (W, B) membership table costs
+                        # ~45 ms at 145K rows on TPU for every dtype,
+                        # while N row-takes from the transposed (B, W)
+                        # table cost ~6 ms — loop the STATIC cat
+                        # features, combine by split-feature match
+                        acc = jnp.zeros((m, W), jnp.int8)
                         for cf in cat_static:
-                            colv = feature_col(jnp.asarray(cf, jnp.int32))
+                            colv = fcol(jnp.asarray(cf, jnp.int32))
                             look = jnp.take(mi8, colv.astype(jnp.int32),
-                                            axis=0)            # (N, W)
+                                            axis=0)            # (m, W)
                             acc = acc + look * (feat == cf).astype(
                                 jnp.int8)[None, :]
-                        cat_go = acc.T > 0
+                        go_w = jnp.where(fcat[:, None], acc.T > 0, num_go)
                     else:
-                        cat_go = jnp.take_along_axis(
-                            member, cols_w.astype(jnp.int32), axis=1)
-                    go_w = jnp.where(fcat[:, None], cat_go, num_go)
+                        go_w = jnp.where(
+                            fcat[:, None],
+                            jnp.take_along_axis(
+                                member, cols_w.astype(jnp.int32), axis=1),
+                            num_go)
+                    match = sel[:, None] & (rlb[None, :] == sel_c[:, None])
+                    has = jnp.any(match, axis=0)               # (m,)
+                    jhit = jnp.argmax(match, axis=0)
+                    go = jnp.take_along_axis(go_w, jhit[None, :],
+                                             axis=0)[0]
+                    chb = jnp.where(
+                        has & (go == left_smaller[jhit]),
+                        jhit.astype(jnp.int8), jnp.int8(-1))
+                    rlb = jnp.where(has & jnp.logical_not(go),
+                                    new_ids[jhit].astype(rlb.dtype), rlb)
+                    return rlb, chb
+
+                blk = max(4096, ((1 << 26) // max(W, 1)) // 4096 * 4096)
+                if n <= blk:
+                    rl, ch = _upd_block(X_T, rl)
                 else:
-                    go_w = num_go
-                sel_c = sel_leaves.astype(rl.dtype)
-                match = sel[:, None] & (rl[None, :] == sel_c[:, None])
-                has = jnp.any(match, axis=0)                   # (N,)
-                jhit = jnp.argmax(match, axis=0)               # (N,)
-                go = jnp.take_along_axis(go_w, jhit[None, :],
-                                         axis=0)[0]
-                ch = jnp.where(
-                    has & (go == left_smaller[jhit]),
-                    jhit.astype(jnp.int8), jnp.int8(-1))
-                rl = jnp.where(has & jnp.logical_not(go),
-                               new_ids[jhit].astype(rl.dtype), rl)
+                    parts = [_upd_block(X_T[:, lo:lo + blk],
+                                        rl[lo:lo + blk])
+                             for lo in range(0, n, blk)]
+                    rl = jnp.concatenate([p_[0] for p_ in parts])
+                    ch = jnp.concatenate([p_[1] for p_ in parts])
 
             # ---- one kernel pass: all W smaller-child histograms ----
             hist_small = hist_waves(ch)                    # (W, G, Bb, 3)

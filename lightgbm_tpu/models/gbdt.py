@@ -455,7 +455,8 @@ class GBDT:
             has_nan, monotone,
             interaction_groups=self._parse_interaction_constraints(),
             cegb_lazy=self._inner_cegb_lazy(),
-            forced_splits=self._parse_forced_splits())
+            forced_splits=self._parse_forced_splits(),
+            feature_contri=self._inner_contri())
 
     def _walk(self, bins, *tree_args):
         """Binned tree walk; routes through the bundle-space decode

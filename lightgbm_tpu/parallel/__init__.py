@@ -19,7 +19,8 @@ from .mesh import get_mesh
 
 def create_parallel_learner(config, num_features, max_bins, num_bins, is_cat,
                             has_nan, monotone=None, interaction_groups=(),
-                            cegb_lazy=(), forced_splits=()):
+                            cegb_lazy=(), forced_splits=(),
+                            feature_contri=()):
     """Factory (reference tree_learner.h:104 TreeLearner::CreateTreeLearner
     dispatching on tree_learner type)."""
     kind = config.tree_learner
@@ -45,7 +46,8 @@ def create_parallel_learner(config, num_features, max_bins, num_bins, is_cat,
         return SerialTreeLearner(
             config, num_features, max_bins, num_bins, is_cat, has_nan,
             monotone, forced_splits,
-            interaction_groups=interaction_groups, cegb_lazy=cegb_lazy)
+            interaction_groups=interaction_groups, cegb_lazy=cegb_lazy,
+            feature_contri=feature_contri)
     if kind == "data":
         return cls(config, num_features, max_bins, num_bins, is_cat,
                    has_nan, monotone, interaction_groups=interaction_groups,

@@ -21,8 +21,10 @@ for spec in (True, False):
     p = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
          "learning_rate": 0.1, "verbosity": -1, "use_quantized_grad": True,
          "num_grad_quant_bins": 254, "quant_train_renew_leaf": True,
-         "tpu_speculative_ramp": spec}
-    bst = lgb.train(p, lgb.Dataset(Xtr, ytr, params=p), 30)
+         "tpu_speculative_ramp": spec,
+         "tpu_spec_tolerance": float(os.environ.get("TOL", 0.1))}
+    bst = lgb.train(p, lgb.Dataset(Xtr, ytr, params=p),
+                    int(os.environ.get("TREES", 30)))
     s = bst.predict(Xte, raw_score=True)
     pr = 1/(1+np.exp(-s))
     ll = -np.mean(yte*np.log(np.clip(pr,1e-9,1)) + (1-yte)*np.log(np.clip(1-pr,1e-9,1)))

@@ -33,16 +33,6 @@ def byte_gather(m, c):
     return ((by >> lo) & 1) > 0
 t("byte gather", byte_gather, member, cols)
 
-# polynomial via segmented compare: 256 compares per slot is the kernel way
-@jax.jit
-def compare_sum(m, c):
-    # (W, N) bool via 2-level: 16 coarse x 16 fine using equality products
-    mf = m.astype(jnp.float32).reshape(W, 16, 16)
-    hi = (c >> 4).astype(jnp.int32); lo = (c & 15).astype(jnp.int32)
-    hi_oh = jax.nn.one_hot(hi, 16, dtype=jnp.float32)   # (W, N, 16)? too big
-    return None
-t2 = None
-
 colv = jnp.asarray(rng.randint(0, 250, n).astype(np.uint8))  # one cat column
 
 @jax.jit
