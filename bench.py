@@ -94,10 +94,15 @@ def main() -> None:
     leaves = int(os.environ.get("BENCH_LEAVES", 255))
     bins = int(os.environ.get("BENCH_BINS", 255))
 
-    import jax
     import jax.numpy as jnp
     import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.backend import default_backend
     from lightgbm_tpu.utils.log import set_verbosity
+
+    # resolve the backend FIRST: when the TPU plugin raises UNAVAILABLE
+    # this pins the platform to CPU (with a warning) instead of letting
+    # the first jitted op crash the whole benchmark run
+    backend = default_backend()
 
     set_verbosity(-1)
     rng = np.random.RandomState(0)
@@ -149,7 +154,7 @@ def main() -> None:
         "metric": f"boosting_iters_per_sec (binary, {rows}x{f}, "
                   f"{leaves} leaves, {bins} bins"
                   f"{', quantized-grad int8' if quant else ''}, "
-                  f"{jax.default_backend()})",
+                  f"{backend})",
         "value": round(iters_per_sec, 4),
         "unit": "iters/s",
         "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC, 4),

@@ -1,0 +1,79 @@
+"""Serving latency benchmark: p50/p99 per shape bucket on a warm
+CompiledPredictor, one bench.py-schema JSON line per bucket.
+
+Measures the steady-state request path (pad -> jitted bucket program ->
+host copy) that the /predict endpoint pays per micro-batch, after
+ahead-of-time warmup — so the numbers are recompile-free by construction
+(asserted via the stats counter).
+
+    python benchmarks/serve_latency.py           # all ladder buckets
+    LAT_REQUESTS=200 python benchmarks/serve_latency.py
+
+Env knobs: LAT_TREES (50), LAT_LEAVES (63), LAT_FEATURES (28),
+LAT_REQUESTS (100 timed requests per bucket), LAT_ROWS (20000 training
+rows).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    trees = int(os.environ.get("LAT_TREES", 50))
+    leaves = int(os.environ.get("LAT_LEAVES", 63))
+    feats = int(os.environ.get("LAT_FEATURES", 28))
+    reqs = int(os.environ.get("LAT_REQUESTS", 100))
+    rows = int(os.environ.get("LAT_ROWS", 20000))
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve import SHAPE_BUCKETS
+    from lightgbm_tpu.serve.stats import percentile as _pct
+    from lightgbm_tpu.utils.backend import default_backend
+    from lightgbm_tpu.utils.log import set_verbosity
+
+    backend = default_backend()  # CPU fallback when the plugin is broken
+    set_verbosity(-1)
+    rng = np.random.RandomState(0)
+    X = rng.randn(rows, feats).astype(np.float32)
+    w = rng.randn(feats) / np.sqrt(feats)
+    y = ((X @ w + 0.5 * rng.randn(rows)) > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": leaves,
+              "learning_rate": 0.1, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y, params=params), trees)
+    pred = bst.to_predictor(warmup=True)
+    recompiles0 = pred.stats.snapshot()["recompiles"]
+
+    for bucket in SHAPE_BUCKETS:
+        Xq = rng.randn(bucket, feats).astype(np.float32)
+        pred.predict(Xq)  # one unmeasured run per bucket (cache touch)
+        lat = []
+        for _ in range(reqs):
+            t0 = time.perf_counter()
+            pred.predict(Xq)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        lat.sort()
+        print(json.dumps({
+            "metric": f"serve_latency_p50_ms (bucket {bucket}, {trees} "
+                      f"trees, {leaves} leaves, {backend})",
+            "value": round(_pct(lat, 50.0), 4),
+            "unit": "ms",
+            "p99_ms": round(_pct(lat, 99.0), 4),
+            "rows_per_sec": round(bucket / (_pct(lat, 50.0) / 1e3), 1),
+        }), flush=True)
+
+    recompiled = pred.stats.snapshot()["recompiles"] - recompiles0
+    print(json.dumps({
+        "metric": "serve_recompiles_after_warmup",
+        "value": recompiled,
+        "unit": "count",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,7 @@
 """``python -m lightgbm_tpu`` — the CLI entry point (reference
-src/main.cpp:11)."""
+src/main.cpp:11).  Tasks: train / predict / refit / convert_model via
+``key=value`` args, plus the serving verb
+``python -m lightgbm_tpu serve model.txt [port=8080 ...]``."""
 
 import sys
 

@@ -180,6 +180,20 @@ class Booster:
                                   pred_early_stop_freq=pred_early_stop_freq,
                                   pred_early_stop_margin=pred_early_stop_margin)
 
+    def to_predictor(self, num_iteration: Optional[int] = None,
+                     warmup: bool = False, **kwargs):
+        """Serving handle for this model: a
+        :class:`~lightgbm_tpu.serve.CompiledPredictor` holding the
+        ensemble device-resident with jit-compiled prediction per shape
+        bucket (``warmup=True`` compiles every bucket ahead of the first
+        request).  See ``lightgbm_tpu.serve`` for the registry /
+        micro-batching / HTTP layers above it."""
+        from .serve import CompiledPredictor
+        pred = CompiledPredictor(self, num_iteration=num_iteration, **kwargs)
+        if warmup:
+            pred.warmup()
+        return pred
+
     # -- model IO ------------------------------------------------------------
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0,

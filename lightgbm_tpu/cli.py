@@ -197,6 +197,10 @@ def model_to_if_else(gbdt) -> str:
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "serve":
+        # serving verb: python -m lightgbm_tpu serve model.txt [key=value]
+        from .serve.server import main as serve_main
+        return serve_main(argv[1:])
     params = parse_cli_args(argv)
     cfg = Config(params)
     task = cfg.task
@@ -208,6 +212,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_refit(params, cfg)
     elif task == "convert_model":
         run_convert_model(params, cfg)
+    elif task == "serve":
+        # config-file form: task=serve input_model=model.txt [port=...]
+        from .serve.server import main as serve_main
+        extra = [f"{k}={v}" for k, v in params.items()
+                 if k not in ("task", "config", "config_file", "input_model")]
+        if not cfg.input_model:
+            log_fatal("task=serve needs input_model=<model file>")
+        return serve_main([cfg.input_model] + extra)
     else:
         log_fatal(f"unknown task: {task}")
     return 0

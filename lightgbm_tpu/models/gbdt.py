@@ -29,7 +29,7 @@ from ..objective import ObjectiveFunction, create_objective
 from ..utils.log import log_info, log_warning
 from ..utils.random import host_rng
 from ..utils.timer import FunctionTimer
-from .tree import Tree, TreeBatch, predict_raw
+from .tree import Tree, TreeBatch, pad_rows, predict_raw
 from ..ops.split import SplitParams, leaf_output as _leaf_output_fn
 
 EPSILON = 1e-12
@@ -191,8 +191,9 @@ class GBDT:
         has_nan = np.array([m.missing_type == MissingType.NAN for m in mappers],
                            bool)
         learner_cfg = cfg
+        from ..utils.backend import default_backend as _safe_backend
         if (cfg.tpu_histogram_impl == "auto" and
-                jax.default_backend() == "tpu" and
+                _safe_backend() == "tpu" and
                 train_set.X_binned.size <= (1 << 22) and
                 self.max_bins <= 256 and
                 cfg.tree_learner in ("serial", "")):
@@ -1015,9 +1016,15 @@ class GBDT:
             t0 = start_iteration * k
             t1 = batch.num_trees if num_iteration is None else min(
                 batch.num_trees, (start_iteration + num_iteration) * k)
-            Xd = jnp.asarray(Xi)
+            # rows pad up the shape-bucket ladder so repeated odd-sized
+            # predict calls reuse a few compiled programs instead of
+            # tracing per novel row count (padding rows are sliced away
+            # and cannot perturb real rows: every walk reduces per row)
+            n_rows = Xi.shape[0]
+            Xd = jnp.asarray(pad_rows(Xi))
             if k == 1:
-                raw = np.asarray(predict_raw(batch, Xd, t0, t1 - t0))[:, None]
+                raw = np.asarray(
+                    predict_raw(batch, Xd, t0, t1 - t0))[:n_rows, None]
             else:
                 # class c's trees are at indices i*k + c
                 cols = []
@@ -1032,8 +1039,9 @@ class GBDT:
                             if sel else None
                         if cache is not None:
                             cache[ck] = sub
-                    cols.append(np.asarray(predict_raw(sub, Xd)) if sub is not None
-                                else np.zeros(X.shape[0], np.float32))
+                    cols.append(np.asarray(predict_raw(sub, Xd))[:n_rows]
+                                if sub is not None
+                                else np.zeros(n_rows, np.float32))
                 raw = np.stack(cols, axis=1)
         if raw_score or self.objective is None:
             return raw[:, 0] if k == 1 else raw
@@ -1070,10 +1078,14 @@ class GBDT:
                 batch.leaf_value, batch.num_leaves)
         per_class = tuple(tuple(a[t0 + c:t1:k] for a in base)
                           for c in range(k))
-        out = predict_raw_early_stop(per_class, jnp.asarray(Xi),
-                                     float(margin), freq=max(1, int(freq)),
-                                     mode=mode)
-        return np.asarray(out)
+        Xp = pad_rows(np.asarray(Xi))
+        # padding rows start pre-stopped: they must not keep the tree
+        # loop alive after every real row has hit its margin
+        stopped0 = jnp.asarray(np.arange(Xp.shape[0]) >= Xi.shape[0])
+        out = predict_raw_early_stop(per_class, jnp.asarray(Xp),
+                                     float(margin), stopped0,
+                                     freq=max(1, int(freq)), mode=mode)
+        return np.asarray(out)[:Xi.shape[0]]
 
     def _predict_leaf(self, Xi, start_iteration, num_iteration):
         from .tree import _walk_raw
@@ -1081,7 +1093,7 @@ class GBDT:
         t0 = start_iteration * k
         t1 = len(self.models) if num_iteration is None else min(
             len(self.models), (start_iteration + num_iteration) * k)
-        Xd = jnp.asarray(Xi)
+        Xd = jnp.asarray(pad_rows(np.asarray(Xi)))
         leaves = []
         for t in range(t0, t1):
             tree = self.models[t]
@@ -1090,7 +1102,8 @@ class GBDT:
             idx_tree.leaf_value = np.arange(tree.max_leaves, dtype=np.float64)
             idx_tree.is_linear = False  # leaf INDEX lookup, not outputs
             tb = TreeBatch([idx_tree])
-            leaves.append(np.asarray(predict_raw(tb, Xd)).astype(np.int32))
+            leaves.append(np.asarray(predict_raw(tb, Xd))
+                          [:Xi.shape[0]].astype(np.int32))
         return np.stack(leaves, axis=1) if leaves else np.zeros(
             (Xi.shape[0], 0), np.int32)
 

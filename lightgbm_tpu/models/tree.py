@@ -26,12 +26,44 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["Tree", "TreeBatch", "predict_binned", "predict_raw"]
+__all__ = ["Tree", "TreeBatch", "predict_binned", "predict_raw",
+           "SHAPE_BUCKETS", "bucket_rows", "pad_rows",
+           "ensemble_serve_fields", "predict_raw_ensemble"]
 
 CAT_MASK = 1
 DEFAULT_LEFT_MASK = 2
 MISSING_ZERO = 1 << 2
 MISSING_NAN = 2 << 2
+
+# Row-count ladder for compiled prediction: requests pad up to the next
+# bucket so arbitrary batch sizes hit a handful of compiled programs
+# instead of one XLA trace per novel shape.  Beyond the ladder, sizes
+# round up to the next MULTIPLE of the top bucket — waste stays under
+# one bucket (vs up to 2x for power-of-two rounding) while the distinct
+# compiled-shape count stays bounded.
+SHAPE_BUCKETS = (1, 8, 64, 512, 4096)
+
+
+def bucket_rows(n: int, ladder=SHAPE_BUCKETS) -> int:
+    """Smallest ladder bucket holding ``n`` rows (multiple of the top
+    bucket above the ladder's end)."""
+    if n <= 0:
+        return ladder[0]
+    for b in ladder:
+        if n <= b:
+            return b
+    top = ladder[-1]
+    return (n + top - 1) // top * top
+
+
+def pad_rows(X: np.ndarray, ladder=SHAPE_BUCKETS) -> np.ndarray:
+    """Zero-pad ``X`` (N, F) up to its row bucket.  Padding rows cannot
+    perturb real rows: every prediction path reduces per row."""
+    nb = bucket_rows(X.shape[0], ladder)
+    if nb == X.shape[0]:
+        return X
+    return np.concatenate(
+        [X, np.zeros((nb - X.shape[0], X.shape[1]), X.dtype)], axis=0)
 
 
 @dataclasses.dataclass
@@ -330,7 +362,8 @@ class TreeBatch:
 
 
 @functools.partial(jax.jit, static_argnames=("freq", "mode"))
-def predict_raw_early_stop(fields, X, margin, *, freq: int, mode: str):
+def predict_raw_early_stop(fields, X, margin, stopped0, *, freq: int,
+                           mode: str):
     """Raw prediction with per-row margin-based early exit across trees
     (reference src/boosting/prediction_early_stop.cpp:54 binary — stop when
     2|raw| > margin — and :25 multiclass — stop when top-2 margin exceeds
@@ -340,6 +373,9 @@ def predict_raw_early_stop(fields, X, margin, *, freq: int, mode: str):
 
     fields: per-class tuple trees-first arrays as in predict_raw; for
     multiclass a list of per-class field tuples sharing the walk.
+    stopped0: (N,) bool initial stop mask — shape-bucket padding rows
+    ride in pre-stopped so they can never hold the tree loop open past
+    the point where every real row has exited.
     """
     per_class = fields
     k = len(per_class)
@@ -371,7 +407,7 @@ def predict_raw_early_stop(fields, X, margin, *, freq: int, mode: str):
 
     _, out, _ = jax.lax.while_loop(
         cond, body, (jnp.asarray(0, jnp.int32), jnp.zeros((n, k), jnp.float32),
-                     jnp.zeros((n,), jnp.bool_)))
+                     stopped0))
     return out
 
 
@@ -718,6 +754,85 @@ def _predict_dense_scan(X, fields, lin_fields=None, has_linear=False):
     return out
 
 
+@functools.partial(jax.jit, static_argnames=("has_linear",))
+def _predict_seq_scan(X, fields, lin_fields=None, has_linear=False):
+    """Jitted tree-scan over the sequential raw walk (categorical
+    ensembles) — the seq counterpart of :func:`_predict_dense_scan`, so
+    the categorical inference path also compiles once per shape."""
+    if not has_linear:
+        def body(carry, tf):
+            return carry + _walk_raw(X, *tf)[0], None
+        out, _ = jax.lax.scan(body, jnp.zeros((X.shape[0],), jnp.float32),
+                              fields)
+        return out
+
+    def body_lin(carry, tf):
+        tree_fields, lf = tf
+        val, leaf = _walk_raw(X, *tree_fields)
+        return carry + _linear_leaf_eval(X, val, leaf, lf), None
+
+    out, _ = jax.lax.scan(body_lin, jnp.zeros((X.shape[0],), jnp.float32),
+                          (fields, lin_fields))
+    return out
+
+
+def ensemble_serve_fields(batch: TreeBatch, start: int = 0,
+                          end: Optional[int] = None):
+    """Pure-array view of one ensemble for :func:`predict_raw_ensemble`:
+    ``(kind, fields, lin_fields)`` where ``kind`` is a static dispatch tag
+    and the arrays are plain device-residable jnp arrays.  Because the
+    jitted entry takes the arrays as ARGUMENTS, XLA's compile cache keys
+    on shapes/dtypes only — two models with the same shape signature
+    (tree count, leaves, features) share every compiled program."""
+    t1 = batch.num_trees if end is None else min(end, batch.num_trees)
+    t0 = min(start, t1)
+    if batch.max_leaves <= 1:
+        return "const", (batch.leaf_value[t0:t1],), None
+    lin = None
+    if batch.has_linear:
+        lin = tuple(a[t0:t1] for a in
+                    (batch.leaf_const, batch.leaf_coef, batch.leaf_feat,
+                     batch.leaf_fmask, batch.linear_flag))
+    if not batch.has_cat:
+        fields = tuple(a[t0:t1] for a in
+                       (batch.split_feature, batch.threshold,
+                        batch.decision_type, batch.path_dir,
+                        batch.plen_right, batch.plen_total,
+                        batch.leaf_value))
+        return ("dense_lin" if lin is not None else "dense"), fields, lin
+    fields = tuple(a[t0:t1] for a in
+                   (batch.split_feature, batch.threshold, batch.cat_words,
+                    batch.decision_type, batch.left_child,
+                    batch.right_child, batch.leaf_value, batch.num_leaves))
+    return ("seq_lin" if lin is not None else "seq"), fields, lin
+
+
+@functools.partial(jax.jit, static_argnames=("kinds",))
+def predict_raw_ensemble(X, per_class, kinds):
+    """Pure jitted ensemble prediction entry for the serving layer:
+    ``per_class`` is a tuple over model classes of ``(fields,
+    lin_fields)`` from :func:`ensemble_serve_fields`, ``kinds`` the
+    matching static tags.  Returns (N, k) raw scores.  Module-level and
+    argument-driven so every model with the same shape signature reuses
+    one compiled program per row bucket."""
+    cols = []
+    for (fields, lin), kind in zip(per_class, kinds):
+        if kind == "const":
+            cols.append(jnp.broadcast_to(
+                jnp.sum(fields[0]).astype(jnp.float32), (X.shape[0],)))
+        elif kind == "dense":
+            cols.append(_predict_dense_scan(X, fields))
+        elif kind == "dense_lin":
+            cols.append(_predict_dense_scan(X, fields, lin, has_linear=True))
+        elif kind == "seq":
+            cols.append(_predict_seq_scan(X, fields))
+        elif kind == "seq_lin":
+            cols.append(_predict_seq_scan(X, fields, lin, has_linear=True))
+        else:
+            raise ValueError(f"unknown ensemble kind: {kind}")
+    return jnp.stack(cols, axis=1)
+
+
 def predict_raw(batch: TreeBatch, X: jnp.ndarray,
                 start_iteration: int = 0,
                 num_iteration: Optional[int] = None) -> jnp.ndarray:
@@ -749,26 +864,10 @@ def predict_raw(batch: TreeBatch, X: jnp.ndarray,
     fields = (batch.split_feature, batch.threshold, batch.cat_words,
               batch.decision_type, batch.left_child,
               batch.right_child, batch.leaf_value, batch.num_leaves)
-    walk = lambda x, tf: _walk_raw(x, *tf)
     sliced = tuple(a[start_iteration:t_end] for a in fields)
-
     if not batch.has_linear:
-        def body(carry, tree_fields):
-            return carry + walk(X, tree_fields)[0], None
-
-        out, _ = jax.lax.scan(body, jnp.zeros((X.shape[0],), jnp.float32),
-                              sliced)
-        return out
-
+        return _predict_seq_scan(X, sliced)
     lin_fields = tuple(a[start_iteration:t_end] for a in
                        (batch.leaf_const, batch.leaf_coef, batch.leaf_feat,
                         batch.leaf_fmask, batch.linear_flag))
-
-    def body_lin(carry, tf):
-        tree_fields, lf = tf
-        val, leaf = walk(X, tree_fields)
-        return carry + _linear_leaf_eval(X, val, leaf, lf), None
-
-    out, _ = jax.lax.scan(body_lin, jnp.zeros((X.shape[0],), jnp.float32),
-                          (sliced, lin_fields))
-    return out
+    return _predict_seq_scan(X, sliced, lin_fields, has_linear=True)

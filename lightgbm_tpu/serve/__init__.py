@@ -1,0 +1,26 @@
+"""lightgbm_tpu.serve — low-latency inference subsystem.
+
+Holds trained models warm on device and answers request traffic without
+per-request Python dispatch costs or fresh XLA traces:
+
+- :class:`CompiledPredictor` — device-resident ensemble arrays +
+  jit-compiled prediction per shape bucket (``SHAPE_BUCKETS`` ladder),
+  with ahead-of-time ``warmup()``;
+- :class:`MicroBatcher` — coalesces concurrent small requests into one
+  bucketed device call under a max-wait/max-rows policy;
+- :class:`ModelRegistry` — named models, shared compile caches across
+  versions, atomic hot-swap for rollouts;
+- :class:`PredictionServer` — dependency-free ``http.server`` JSON
+  endpoint (``/predict``, ``/models``, ``/healthz``, ``/stats``),
+  exposed as the ``python -m lightgbm_tpu serve`` CLI verb;
+- :class:`ModelStats` — per-model serving counters behind ``/stats``.
+"""
+
+from .batcher import MicroBatcher
+from .predictor import SHAPE_BUCKETS, CompiledPredictor
+from .registry import ModelRegistry
+from .server import PredictionServer
+from .stats import ModelStats
+
+__all__ = ["CompiledPredictor", "MicroBatcher", "ModelRegistry",
+           "PredictionServer", "ModelStats", "SHAPE_BUCKETS"]

@@ -1,0 +1,138 @@
+"""Micro-batching queue: coalesce concurrent small requests into one
+bucketed device call.
+
+A single worker thread drains the queue under a max-wait/max-rows
+policy: the first waiting request opens a window of ``max_wait_ms``;
+every request arriving inside it joins the batch until ``max_batch_rows``
+is reached.  One concatenated predict runs, and each waiter gets its row
+slice back through a Future — so N concurrent single-row requests cost
+one device dispatch on the next bucket up instead of N dispatches.
+
+Requests are grouped by (raw_score, feature-count) inside a window: a
+malformed request can only fail its own group, never poison co-batched
+traffic with a different shape.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = ["MicroBatcher"]
+
+_CLOSE = object()
+
+
+class MicroBatcher:
+    """Thread-safe request coalescer in front of a predict function.
+
+    ``predict_fn(X, raw_score) -> np.ndarray`` must be row-aligned:
+    output row i corresponds to input row i (true for every predictor
+    path).  ``submit`` returns a Future; ``predict`` blocks on it.
+    """
+
+    def __init__(self, predict_fn: Callable[[np.ndarray, bool], np.ndarray],
+                 max_batch_rows: int = 4096,
+                 max_wait_ms: float = 2.0) -> None:
+        self._predict_fn = predict_fn
+        self._max_rows = int(max_batch_rows)
+        self._max_wait = max(0.0, float(max_wait_ms)) / 1e3
+        self._q: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._state_lock = threading.Lock()  # serializes submit vs close
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="lgb-tpu-microbatcher")
+        self._thread.start()
+
+    # -- client side --------------------------------------------------------
+    def submit(self, X: np.ndarray, raw_score: bool = False) -> Future:
+        X = np.asarray(X, np.float32)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        fut: Future = Future()
+        # the closed-check and the put are one atomic step, so no item
+        # can land behind the _CLOSE sentinel and hang its waiter
+        with self._state_lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._q.put((X, bool(raw_score), fut))
+        return fut
+
+    def predict(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
+        return self.submit(X, raw_score).result()
+
+    def close(self, timeout: Optional[float] = 5.0) -> None:
+        with self._state_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(_CLOSE)
+        self._thread.join(timeout)
+        # fail anything the worker left behind rather than hanging waiters
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _CLOSE and not item[2].done():
+                item[2].set_exception(RuntimeError("batcher closed"))
+
+    # -- worker side --------------------------------------------------------
+    def _loop(self) -> None:
+        import time
+        while True:
+            first = self._q.get()
+            if first is _CLOSE:
+                return
+            batch = [first]
+            rows = first[0].shape[0]
+            deadline = time.monotonic() + self._max_wait
+            stop = False
+            while rows < self._max_rows:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    if len(batch) == 1:
+                        # uncontended request: dispatch immediately — the
+                        # wait window only opens once a second request is
+                        # already queued, so sequential traffic pays no
+                        # max_wait latency tax
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = self._q.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                if nxt is _CLOSE:
+                    stop = True
+                    break
+                batch.append(nxt)
+                rows += nxt[0].shape[0]
+            self._run(batch)
+            if stop:
+                return
+
+    def _run(self, batch) -> None:
+        groups: dict = {}
+        for item in batch:
+            groups.setdefault((item[1], item[0].shape[1]), []).append(item)
+        for (raw, _cols), group in groups.items():
+            try:
+                X = (group[0][0] if len(group) == 1 else
+                     np.concatenate([g[0] for g in group], axis=0))
+                out = self._predict_fn(X, raw)
+                ofs = 0
+                for g in group:
+                    n = g[0].shape[0]
+                    g[2].set_result(out[ofs:ofs + n])
+                    ofs += n
+            except Exception as exc:  # propagate to every waiter in group
+                for g in group:
+                    if not g[2].done():
+                        g[2].set_exception(exc)

@@ -1,0 +1,166 @@
+"""CompiledPredictor: a trained model held warm for low-latency inference.
+
+Wraps a ``GBDT``/``Booster``/model file as device-resident ensemble
+arrays and drives the pure jitted entry
+:func:`lightgbm_tpu.models.tree.predict_raw_ensemble`.  Request rows pad
+up a fixed shape-bucket ladder (``SHAPE_BUCKETS``) so arbitrary batch
+sizes hit a handful of compiled programs; ``warmup()`` compiles every
+bucket ahead of the first request.
+
+Compile-cache sharing: the jitted entry takes the model arrays as
+ARGUMENTS, so XLA keys its cache on shapes/dtypes only — every model
+with the same shape signature (tree count, max leaves, feature count,
+walk kind) reuses one compiled program per bucket.  The process-wide
+``_COMPILE_KEYS`` set mirrors that cache to drive the ``/stats``
+recompile counter: a (signature, bucket) pair counts as a recompile the
+first time any predictor in the process dispatches it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..models.tree import (SHAPE_BUCKETS, bucket_rows, ensemble_serve_fields,
+                           pad_rows, predict_raw_ensemble)
+from .stats import ModelStats
+
+__all__ = ["CompiledPredictor", "SHAPE_BUCKETS"]
+
+# (shape-signature, bucket) pairs that have already been dispatched — the
+# process-wide mirror of XLA's jit cache for predict_raw_ensemble
+_COMPILE_KEYS: set = set()
+_COMPILE_LOCK = threading.Lock()
+
+
+def _note_dispatch(key) -> bool:
+    """True when ``key`` is new to the process (an XLA trace happens)."""
+    with _COMPILE_LOCK:
+        if key in _COMPILE_KEYS:
+            return False
+        _COMPILE_KEYS.add(key)
+        return True
+
+
+def _resolve_gbdt(source):
+    """Accept a Booster, a GBDT, a model file path, or a model string."""
+    from ..basic import Booster
+    from ..models.gbdt import GBDT
+    if isinstance(source, Booster):
+        return source._gbdt
+    if isinstance(source, GBDT):
+        return source
+    if isinstance(source, str):
+        if "\n" in source:  # model TEXT always spans lines
+            return Booster(model_str=source)._gbdt
+        if not os.path.exists(source):
+            raise FileNotFoundError(f"no such model file: {source}")
+        return Booster(model_file=source)._gbdt
+    raise TypeError(f"cannot build a predictor from {type(source).__name__}")
+
+
+class CompiledPredictor:
+    """Shape-bucketed compiled inference handle for one model version.
+
+    Immutable once built (hot-swap replaces the whole object), so reads
+    need no lock: concurrent ``predict`` calls share the device arrays
+    and the jit cache.
+    """
+
+    def __init__(self, source, num_iteration: Optional[int] = None,
+                 buckets: Tuple[int, ...] = SHAPE_BUCKETS,
+                 stats: Optional[ModelStats] = None) -> None:
+        gbdt = _resolve_gbdt(source)
+        self.buckets = tuple(sorted(buckets))
+        self.stats = stats if stats is not None else ModelStats()
+        self.objective = gbdt.objective
+        self.num_class = k = gbdt.num_tree_per_iteration
+        self.num_features = gbdt.feature_mapping()[1]
+        models = gbdt.models
+        self.num_trees = len(models) if num_iteration is None else min(
+            len(models), num_iteration * k)
+        # RF / average_output models predict the MEAN of tree outputs;
+        # the divisor is the FULL model count even under num_iteration
+        # truncation (RF.predict divides by len(models)//k regardless)
+        self._avg_div = (max(1, len(models) // k)
+                         if getattr(gbdt, "name", "gbdt") == "rf" else 1)
+        ts = gbdt.train_set
+        self._used = (np.asarray(ts.used_feature_map)
+                      if ts is not None else None)
+        from ..models.tree import TreeBatch
+        per_class = []
+        kinds = []
+        for c in range(k):
+            sel = [models[t] for t in range(self.num_trees) if t % k == c]
+            if not sel:
+                raise ValueError("predictor needs at least one tree per class")
+            kind, fields, lin = ensemble_serve_fields(TreeBatch(sel))
+            kinds.append(kind)
+            per_class.append((fields, lin))
+        # one device_put pins every array; requests then ship only rows
+        self._per_class = jax.device_put(tuple(per_class))
+        self._kinds = tuple(kinds)
+        # shape signature: kinds + every model array's (shape, dtype) —
+        # exactly what XLA's cache keys on besides the row bucket
+        leaves = jax.tree_util.tree_leaves(self._per_class)
+        self._sig = (self._kinds,
+                     tuple((a.shape, str(a.dtype)) for a in leaves))
+
+    # -- core ---------------------------------------------------------------
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        """Bucketed raw-score prediction: (N,) for single-class models,
+        (N, k) for multiclass.  Bitwise identical to ``Booster.predict``
+        (both pad up the same ladder and run the same walk kernels)."""
+        X = np.asarray(X, np.float32)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        n = X.shape[0]
+        if X.shape[1] != self.num_features:
+            raise ValueError(
+                f"request has {X.shape[1]} features; model expects "
+                f"{self.num_features}")
+        Xi = X[:, self._used] if self._used is not None else X
+        nb = bucket_rows(n, self.buckets)
+        Xp = pad_rows(Xi, self.buckets)
+        new = _note_dispatch((self._sig, nb))
+        t0 = time.perf_counter()
+        out = np.asarray(predict_raw_ensemble(Xp, self._per_class,
+                                              self._kinds))[:n]
+        self.stats.record_batch(n, nb, (time.perf_counter() - t0) * 1e3,
+                                recompiled=new)
+        if self._avg_div != 1:
+            out = out / self._avg_div
+        return out[:, 0] if self.num_class == 1 else out
+
+    def predict(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
+        """Prediction with the model objective's output transform (same
+        contract as ``Booster.predict`` without the special modes)."""
+        import jax.numpy as jnp
+        raw = self.predict_raw(X)
+        if raw_score or self.objective is None:
+            return raw
+        return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+
+    # -- warmup -------------------------------------------------------------
+    def warmup(self, buckets: Optional[Tuple[int, ...]] = None) -> int:
+        """Ahead-of-time compile every shape bucket (zeros ride the same
+        kernels).  Returns the number of buckets traced for the first
+        time process-wide."""
+        before = self.stats.snapshot()["recompiles"]
+        for b in (buckets if buckets is not None else self.buckets):
+            self.predict_raw(np.zeros((b, self.num_features), np.float32))
+        return self.stats.snapshot()["recompiles"] - before
+
+    def info(self) -> dict:
+        return {
+            "num_trees": self.num_trees,
+            "num_class": self.num_class,
+            "num_features": self.num_features,
+            "kinds": list(self._kinds),
+            "buckets": list(self.buckets),
+        }

@@ -1,0 +1,104 @@
+"""Model registry: named warm predictors with atomic hot-swap.
+
+A registry maps names to :class:`CompiledPredictor` instances.  Loading
+builds (and optionally warms) the new predictor entirely OUTSIDE the
+lock, then swaps the reference in one locked assignment — readers either
+get the old version or the new one, never a half-built model, and
+traffic is served without interruption during a rollout.
+
+Stats survive a swap: the new predictor inherits the old entry's
+``ModelStats``, so ``/stats`` counters (including recompiles — usually 0
+on a same-shape rollout thanks to the shared compile cache) track the
+NAME, not the version.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from .predictor import CompiledPredictor
+from .stats import ModelStats
+from ..utils.log import log_info
+
+__all__ = ["ModelRegistry"]
+
+
+class ModelRegistry:
+    """Thread-safe named model store with atomic hot-swap and eviction."""
+
+    def __init__(self, max_models: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self._models: Dict[str, CompiledPredictor] = {}
+        # stats live keyed by NAME, independent of predictor versions, so
+        # counters survive hot-swaps and racing first-time loads of the
+        # same name share one instance
+        self._stats: Dict[str, ModelStats] = {}
+        self._versions: Dict[str, int] = {}
+        self._max_models = max_models
+
+    def load(self, name: str, source, warmup: bool = True,
+             **predictor_kwargs) -> CompiledPredictor:
+        """Load or hot-swap ``name``.  The predictor is built and warmed
+        before the swap, so in-flight traffic never waits on a compile;
+        the swap itself is one dict assignment under the lock."""
+        with self._lock:
+            stats = self._stats.setdefault(name, ModelStats())
+        pred = CompiledPredictor(source, stats=stats, **predictor_kwargs)
+        if warmup:
+            pred.warmup()
+        with self._lock:
+            swapped = name in self._models
+            self._models[name] = pred
+            self._versions[name] = self._versions.get(name, 0) + 1
+            if self._max_models is not None and \
+                    len(self._models) > self._max_models:
+                # evict the oldest OTHER entry (insertion order)
+                for victim in list(self._models):
+                    if victim != name:
+                        del self._models[victim]
+                        self._stats.pop(victim, None)
+                        break
+        log_info(f"serve: {'hot-swapped' if swapped else 'loaded'} model "
+                 f"'{name}' (v{self._versions[name]}, "
+                 f"{pred.num_trees} trees)")
+        return pred
+
+    def get(self, name: Optional[str] = None) -> CompiledPredictor:
+        """Predictor by name; with ``name=None`` the single loaded model
+        (the common one-model deployment needs no name in requests)."""
+        with self._lock:
+            if name is None:
+                if len(self._models) == 1:
+                    return next(iter(self._models.values()))
+                raise KeyError(
+                    f"registry holds {len(self._models)} models; requests "
+                    "must name one" if self._models else "no models loaded")
+            if name not in self._models:
+                raise KeyError(f"unknown model '{name}'")
+            return self._models[name]
+
+    def evict(self, name: str) -> bool:
+        with self._lock:
+            if name not in self._models:
+                return False
+            del self._models[name]
+            self._stats.pop(name, None)
+            log_info(f"serve: evicted model '{name}'")
+            return True
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._models)
+
+    def info(self) -> Dict[str, dict]:
+        with self._lock:
+            items = list(self._models.items())
+            versions = dict(self._versions)
+        return {name: {**pred.info(), "version": versions.get(name, 1)}
+                for name, pred in items}
+
+    def stats(self) -> Dict[str, dict]:
+        with self._lock:
+            items = list(self._models.items())
+        return {name: pred.stats.snapshot() for name, pred in items}

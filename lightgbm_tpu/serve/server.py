@@ -1,0 +1,250 @@
+"""Dependency-free JSON inference endpoint over ``http.server``.
+
+Endpoints:
+  GET  /healthz  -> {"status": "ok", "models": [...]}
+  GET  /models   -> per-model info (trees, classes, buckets, version)
+  GET  /stats    -> per-model counters (requests/rows/batches/recompiles/
+                    bucket histogram/p50/p99 latency)
+  POST /predict  -> {"rows": [[...], ...]} or {"row": [...]}, optional
+                    "model" (required only with >1 loaded), "raw_score";
+                    returns {"model", "num_rows", "predictions"}
+  POST /models   -> {"name": ..., "file": ...} loads or atomically
+                    hot-swaps a model from a model_text file
+
+Each HTTP request runs on its own thread (ThreadingHTTPServer); /predict
+routes through a per-model :class:`MicroBatcher`, so concurrent small
+requests coalesce into one bucketed device call.  Started by the CLI
+verb ``python -m lightgbm_tpu serve model.txt [key=value ...]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .batcher import MicroBatcher
+from .registry import ModelRegistry
+from ..utils.log import log_debug, log_info
+
+__all__ = ["PredictionServer", "main"]
+
+
+class PredictionServer:
+    """Registry + HTTP front end + per-model micro-batchers."""
+
+    def __init__(self, registry: ModelRegistry, host: str = "127.0.0.1",
+                 port: int = 8080, max_batch_rows: int = 4096,
+                 max_wait_ms: float = 2.0, batching: bool = True) -> None:
+        self.registry = registry
+        self._batching = batching
+        self._batch_opts = (max_batch_rows, max_wait_ms)
+        self._batchers: Dict[str, MicroBatcher] = {}
+        self._batchers_lock = threading.Lock()
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    def _predict(self, name: Optional[str], X: np.ndarray,
+                 raw_score: bool) -> np.ndarray:
+        pred = self.registry.get(name)  # resolves None -> the single model
+        pred.stats.record_request(X.shape[0])
+        if not self._batching:
+            return pred.predict(X, raw_score=raw_score)
+        key = name if name is not None else "\0default"
+        with self._batchers_lock:
+            batcher = self._batchers.get(key)
+            if batcher is None:
+                # the closure re-resolves the registry per batch, so a
+                # hot-swap redirects batched traffic without a restart
+                batcher = MicroBatcher(
+                    lambda Xb, raw, _n=name: self.registry.get(_n).predict(
+                        Xb, raw_score=raw),
+                    max_batch_rows=self._batch_opts[0],
+                    max_wait_ms=self._batch_opts[1])
+                self._batchers[key] = batcher
+        return batcher.predict(X, raw_score=raw_score)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "PredictionServer":
+        """Serve on a background thread (tests / embedding)."""
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="lgb-tpu-serve")
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        with self._batchers_lock:
+            batchers, self._batchers = dict(self._batchers), {}
+        for b in batchers.values():
+            b.close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+
+def _make_handler(server: PredictionServer):
+    class ServeHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route access logs to debug
+            log_debug("serve: " + fmt % args)
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_json(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            if length <= 0:
+                return {}
+            return json.loads(self.rfile.read(length).decode())
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"status": "ok",
+                                  "models": server.registry.names()})
+            elif self.path == "/models":
+                self._reply(200, server.registry.info())
+            elif self.path == "/stats":
+                self._reply(200, server.registry.stats())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            try:
+                req = self._read_json()
+            except (ValueError, UnicodeDecodeError) as exc:
+                self._reply(400, {"error": f"bad JSON body: {exc}"})
+                return
+            if self.path == "/predict":
+                self._predict(req)
+            elif self.path == "/models":
+                self._load_model(req)
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def _predict(self, req: dict) -> None:
+            name = req.get("model")
+            rows = req.get("rows")
+            if rows is None and "row" in req:
+                rows = [req["row"]]
+            if not isinstance(rows, list) or not rows:
+                self._reply(400, {"error": "body needs 'rows' (list of "
+                                           "feature lists) or 'row'"})
+                return
+            try:
+                X = np.asarray(rows, np.float32)
+                if X.ndim != 2:
+                    raise ValueError(f"rows must be 2-D, got shape {X.shape}")
+                out = server._predict(name, X, bool(req.get("raw_score")))
+            except KeyError as exc:
+                self._reply(404, {"error": str(exc.args[0])})
+                return
+            except Exception as exc:
+                try:
+                    server.registry.get(name).stats.record_error()
+                except KeyError:
+                    pass
+                self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+                return
+            self._reply(200, {"model": name, "num_rows": int(X.shape[0]),
+                              "predictions": np.asarray(out).tolist()})
+
+        def _load_model(self, req: dict) -> None:
+            name, path = req.get("name"), req.get("file")
+            if not name or not path:
+                self._reply(400, {"error": "body needs 'name' and 'file'"})
+                return
+            try:
+                pred = server.registry.load(str(name), str(path))
+            except Exception as exc:
+                self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+                return
+            self._reply(200, {"model": name, **pred.info()})
+
+    return ServeHandler
+
+
+def _parse_bool(v, default: bool) -> bool:
+    """Accept the repo's config bool spellings (true/false/1/0)."""
+    if v is None:
+        return default
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes", "on"):
+        return True
+    if s in ("false", "0", "no", "off"):
+        return False
+    raise ValueError(f"expected a boolean (true/false/1/0), got {v!r}")
+
+
+def main(argv: List[str]) -> int:
+    """``python -m lightgbm_tpu serve <model.txt> [key=value ...]``.
+
+    Keys: host (127.0.0.1), port (8080), name (single model's registry
+    name), warmup (1), batching (1), max_batch (4096), max_wait_ms (2.0),
+    num_iteration (-1: all).  Multiple model files register under their
+    basenames.
+    """
+    from ..utils.backend import default_backend
+    from ..utils.log import log_fatal
+    # resolve the backend before any model touches the device: a broken
+    # accelerator plugin downgrades the server to CPU instead of killing
+    # it during warmup
+    default_backend()
+    files = [a for a in argv if "=" not in a]
+    kv = dict(a.split("=", 1) for a in argv if "=" in a)
+    if kv.get("model"):
+        files.append(kv["model"])
+    if not files:
+        log_fatal("serve needs at least one model file: "
+                  "python -m lightgbm_tpu serve model.txt [port=8080 ...]")
+    registry = ModelRegistry()
+    n_iter = int(kv.get("num_iteration", -1))
+    seen = set()
+    for path in files:
+        name = (kv["name"] if len(files) == 1 and kv.get("name") else
+                os.path.splitext(os.path.basename(path))[0])
+        if name in seen:
+            log_fatal(f"two model files share the registry name '{name}' "
+                      f"(names come from basenames); rename one file or "
+                      f"serve them from separate processes")
+        seen.add(name)
+        registry.load(name, path,
+                      warmup=_parse_bool(kv.get("warmup"), True),
+                      num_iteration=None if n_iter < 0 else n_iter)
+    srv = PredictionServer(
+        registry, host=kv.get("host", "127.0.0.1"),
+        port=int(kv.get("port", 8080)),
+        max_batch_rows=int(kv.get("max_batch", 4096)),
+        max_wait_ms=float(kv.get("max_wait_ms", 2.0)),
+        batching=_parse_bool(kv.get("batching"), True))
+    log_info(f"serve: listening on http://{srv.host}:{srv.port} "
+             f"(models: {', '.join(registry.names())})")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        log_info("serve: shutting down")
+        srv.shutdown()
+    return 0

@@ -1,0 +1,83 @@
+"""Per-model serving counters surfaced on the ``/stats`` endpoint.
+
+Thread-safe by a single lock per model: the counters are bumped on every
+device call (micro-batches, not client requests, are the expensive unit)
+and snapshots are cheap dict copies.  Latency percentiles come from a
+bounded ring of recent batch latencies — a serving dashboard wants the
+current tail, not the all-time one.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+__all__ = ["ModelStats", "percentile"]
+
+
+def percentile(sorted_vals: List[float], p: float) -> float:
+    """Nearest-rank percentile over pre-sorted values (shared by /stats
+    and the latency benchmark so the two never diverge)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class ModelStats:
+    """Counters for one served model (requests, rows, batches, recompiles,
+    per-bucket histogram, p50/p99 latency over a sliding window)."""
+
+    WINDOW = 4096  # batch latencies kept for percentile estimates
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.requests = 0      # client-level calls (HTTP or registry)
+        self.rows = 0          # data rows predicted (pre-padding)
+        self.batches = 0       # device calls (post micro-batching)
+        self.recompiles = 0    # XLA traces triggered by novel shapes
+        self.errors = 0
+        self.bucket_hist: Dict[int, int] = {}
+        self._lat_ms: List[float] = []
+        self._lat_pos = 0
+
+    def record_request(self, n_rows: int = 1) -> None:
+        with self._lock:
+            self.requests += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def record_batch(self, n_rows: int, bucket: int, latency_ms: float,
+                     recompiled: bool) -> None:
+        with self._lock:
+            self.batches += 1
+            self.rows += int(n_rows)
+            self.bucket_hist[bucket] = self.bucket_hist.get(bucket, 0) + 1
+            if recompiled:
+                self.recompiles += 1
+            if len(self._lat_ms) < self.WINDOW:
+                self._lat_ms.append(latency_ms)
+            else:
+                self._lat_ms[self._lat_pos] = latency_ms
+                self._lat_pos = (self._lat_pos + 1) % self.WINDOW
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            return {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "recompiles": self.recompiles,
+                "errors": self.errors,
+                "bucket_histogram": {str(k): v for k, v in
+                                     sorted(self.bucket_hist.items())},
+                "latency_ms": {
+                    "p50": round(percentile(lat, 50.0), 4),
+                    "p99": round(percentile(lat, 99.0), 4),
+                    "window": len(lat),
+                },
+            }

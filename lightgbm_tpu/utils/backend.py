@@ -1,0 +1,41 @@
+"""Accelerator-backend probing that degrades to CPU instead of crashing.
+
+The container registers the TPU PJRT plugin eagerly; when the device is
+absent or the tunnel is down, the first ``jax.default_backend()`` call
+raises ``RuntimeError: Unable to initialize backend ... UNAVAILABLE``.
+Anything that merely ASKS which backend is active (bench harnesses, the
+histogram autotune gate) must not die on that probe — it should fall back
+to CPU and keep going.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .log import log_warning
+
+_resolved: str | None = None
+
+
+def default_backend() -> str:
+    """``jax.default_backend()`` with CPU fallback.
+
+    On the first probe failure the platform is pinned to CPU (legal while
+    no client exists — the failed init leaves none) and the warning names
+    the broken plugin.  The result is cached: the backend cannot change
+    within a process once a client is live.
+    """
+    global _resolved
+    if _resolved is not None:
+        return _resolved
+    try:
+        _resolved = jax.default_backend()
+    except RuntimeError as exc:
+        log_warning(f"accelerator backend unavailable ({exc}); "
+                    "falling back to CPU")
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # a client appeared concurrently; use whatever it is
+        _resolved = jax.default_backend()
+    return _resolved

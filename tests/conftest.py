@@ -15,7 +15,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax has no jax_num_cpu_devices; the XLA_FLAGS knob is the
+    # portable spelling and is read at first backend creation (setting
+    # BOTH on newer jax is rejected, so only set it on the fallback)
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8").strip()
 # Persistent compile cache: ~190 tests trigger hundreds of XLA:CPU
 # compilations in one process; caching them on disk cuts repeat-run time
 # drastically and reduces exposure to rare in-process compiler crashes

@@ -1,0 +1,344 @@
+"""Serving-layer tests (lightgbm_tpu.serve): bucketed predictor parity
+with Booster.predict across bucket boundaries, micro-batcher correctness
+under concurrent submitters, registry hot-swap atomicity, and end-to-end
+HTTP smoke tests over localhost (slow-marked)."""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve import (SHAPE_BUCKETS, CompiledPredictor,
+                                MicroBatcher, ModelRegistry,
+                                PredictionServer)
+
+SMALL = {"num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1}
+
+
+@pytest.fixture(scope="module")
+def booster(binary_data):
+    X, y = binary_data
+    p = {**SMALL, "objective": "binary"}
+    return lgb.train(p, lgb.Dataset(X, y, params=p), 15)
+
+
+@pytest.fixture(scope="module")
+def predictor(booster):
+    return booster.to_predictor(warmup=True)
+
+
+# -- shape buckets ----------------------------------------------------------
+def test_bucket_ladder():
+    from lightgbm_tpu.models.tree import bucket_rows
+    assert [bucket_rows(n) for n in (0, 1, 2, 8, 9, 64, 65, 512, 513,
+                                     4096, 4097, 10000)] == \
+        [1, 1, 8, 8, 64, 64, 512, 512, 4096, 4096, 8192, 12288]
+
+
+@pytest.mark.parametrize("n", [1, 7, 8, 9, 511, 513])
+def test_bucket_parity(n, booster, predictor):
+    """Bucketed predictor output is bitwise identical to Booster.predict
+    across bucket boundaries."""
+    rng = np.random.RandomState(n)
+    Xs = rng.randn(n, 6)
+    assert np.array_equal(predictor.predict(Xs), booster.predict(Xs))
+    assert np.array_equal(predictor.predict(Xs, raw_score=True),
+                          booster.predict(Xs, raw_score=True))
+
+
+def test_zero_recompiles_after_warmup(predictor):
+    r0 = predictor.stats.snapshot()["recompiles"]
+    assert r0 >= 0
+    rng = np.random.RandomState(0)
+    for n in (1, 2, 3, 5, 9, 63, 65, 511, 513, 4096):
+        predictor.predict(rng.randn(n, predictor.num_features))
+    assert predictor.stats.snapshot()["recompiles"] == r0
+
+
+def test_predictor_nan_and_single_row(booster, predictor):
+    rng = np.random.RandomState(1)
+    Xs = rng.randn(5, 6)
+    Xs[2, 1] = np.nan
+    assert np.array_equal(predictor.predict(Xs), booster.predict(Xs))
+    # 1-D row is accepted as one request row
+    assert np.array_equal(predictor.predict(Xs[0]),
+                          booster.predict(Xs[0].reshape(1, -1)))
+
+
+def test_multiclass_predictor_parity(multiclass_data):
+    X, y = multiclass_data
+    p = {**SMALL, "objective": "multiclass", "num_class": 3}
+    bst = lgb.train(p, lgb.Dataset(X, y, params=p), 8)
+    pred = bst.to_predictor(warmup=True)
+    rng = np.random.RandomState(3)
+    for n in (1, 9, 130):
+        Xs = rng.randn(n, 6)
+        out = pred.predict(Xs)
+        assert out.shape == (n, 3)
+        assert np.array_equal(out, bst.predict(Xs))
+
+
+def test_categorical_predictor_parity():
+    """Categorical models take the sequential walk kind — parity must
+    hold there too."""
+    rng = np.random.RandomState(5)
+    n = 600
+    Xc = rng.randn(n, 6)
+    Xc[:, 3] = rng.randint(0, 12, n)
+    # the label hangs mostly on the CATEGORY so the trees must split on it
+    y = ((Xc[:, 3] % 3 == 0) * 2.0 + 0.3 * Xc[:, 0] +
+         0.3 * rng.randn(n) > 1.0).astype(np.float64)
+    p = {**SMALL, "objective": "binary"}
+    ds = lgb.Dataset(Xc, y, categorical_feature=[3], params=p)
+    bst = lgb.train(p, ds, 10)
+    pred = bst.to_predictor()
+    assert "seq" in pred.info()["kinds"]
+    Xq = rng.randn(9, 6)
+    Xq[:, 3] = rng.randint(0, 14, 9)  # incl. unseen category 12/13
+    assert np.array_equal(pred.predict(Xq), bst.predict(Xq))
+
+
+def test_linear_tree_predictor_parity(regression_data):
+    X, y = regression_data
+    p = {**SMALL, "objective": "regression", "linear_tree": True}
+    bst = lgb.train(p, lgb.Dataset(X, y, params=p), 8)
+    pred = bst.to_predictor()
+    assert pred.info()["kinds"] == ["dense_lin"]
+    rng = np.random.RandomState(6)
+    Xq = rng.randn(9, 6)
+    Xq[3, 0] = np.nan  # linear leaves fall back to plain output on NaN
+    assert np.array_equal(pred.predict(Xq), bst.predict(Xq))
+
+
+def test_rf_predictor_parity(binary_data):
+    """RF models predict the MEAN of tree outputs; the predictor must
+    apply the same averaging."""
+    X, y = binary_data
+    p = {**SMALL, "objective": "binary", "boosting": "rf",
+         "bagging_freq": 1, "bagging_fraction": 0.8}
+    bst = lgb.train(p, lgb.Dataset(X, y, params=p), 6)
+    pred = bst.to_predictor()
+    rng = np.random.RandomState(8)
+    Xq = rng.randn(9, 6)
+    assert np.array_equal(pred.predict(Xq), bst.predict(Xq))
+
+
+def test_stats_counters(booster):
+    pred = booster.to_predictor()
+    pred.predict(np.zeros((3, 6), np.float32))
+    pred.predict(np.zeros((70, 6), np.float32))
+    s = pred.stats.snapshot()
+    assert s["batches"] == 2 and s["rows"] == 73
+    assert s["bucket_histogram"] == {"8": 1, "512": 1}
+    assert s["latency_ms"]["p50"] > 0
+
+
+# -- micro-batcher ----------------------------------------------------------
+def test_batcher_concurrent_submitters(booster, predictor):
+    rng = np.random.RandomState(7)
+    inputs = [rng.randn(1 + (i * 13) % 40, 6) for i in range(24)]
+    refs = [booster.predict(Xs) for Xs in inputs]
+    mb = MicroBatcher(lambda X, raw: predictor.predict(X, raw_score=raw),
+                      max_wait_ms=5.0)
+    try:
+        outs = [None] * len(inputs)
+
+        def worker(lo, hi):
+            for i in range(lo, hi):
+                outs[i] = mb.predict(inputs[i])
+
+        threads = [threading.Thread(target=worker, args=(i * 3, i * 3 + 3))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for out, ref in zip(outs, refs):
+            assert np.array_equal(out, ref)
+    finally:
+        mb.close()
+
+
+def test_batcher_bad_request_does_not_poison_batch(predictor):
+    mb = MicroBatcher(lambda X, raw: predictor.predict(X, raw_score=raw),
+                      max_wait_ms=20.0)
+    try:
+        good = mb.submit(np.zeros((2, 6), np.float32))
+        bad = mb.submit(np.zeros((2, 9), np.float32))  # wrong width
+        assert good.result(timeout=30).shape == (2,)
+        with pytest.raises(Exception):
+            bad.result(timeout=30)
+    finally:
+        mb.close()
+
+
+# -- registry ---------------------------------------------------------------
+def test_registry_basics(booster):
+    reg = ModelRegistry()
+    with pytest.raises(KeyError):
+        reg.get()
+    reg.load("a", booster, warmup=False)
+    assert reg.get() is reg.get("a")  # single model needs no name
+    reg.load("b", booster, warmup=False)
+    with pytest.raises(KeyError):
+        reg.get()  # ambiguous now
+    info = reg.info()
+    assert set(info) == {"a", "b"} and info["a"]["version"] == 1
+    assert reg.evict("a") and not reg.evict("a")
+    assert reg.names() == ["b"]
+
+
+def test_registry_hot_swap_atomic(binary_data):
+    """Readers racing a rollout must see exactly one version's output,
+    never a mix."""
+    X, y = binary_data
+    p = {**SMALL, "objective": "binary"}
+    b1 = lgb.train(p, lgb.Dataset(X, y, params=p), 5)
+    b2 = lgb.train(p, lgb.Dataset(X, y, params=p), 9)
+    rng = np.random.RandomState(11)
+    Xq = rng.randn(9, 6)
+    ref1, ref2 = b1.predict(Xq), b2.predict(Xq)
+    assert not np.array_equal(ref1, ref2)
+    reg = ModelRegistry()
+    reg.load("m", b1, warmup=False)
+    bad = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            out = reg.get("m").predict(Xq)
+            if not (np.array_equal(out, ref1) or np.array_equal(out, ref2)):
+                bad.append(out)
+
+    readers = [threading.Thread(target=reader) for _ in range(4)]
+    for t in readers:
+        t.start()
+    for i in range(6):
+        reg.load("m", b2 if i % 2 == 0 else b1, warmup=False)
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not bad, "hot-swap produced mixed-version outputs"
+    assert reg.info()["m"]["version"] == 7
+
+
+def test_registry_swap_keeps_stats(booster):
+    reg = ModelRegistry()
+    reg.load("m", booster, warmup=False)
+    reg.get("m").predict(np.zeros((2, 6), np.float32))
+    before = reg.stats()["m"]["batches"]
+    reg.load("m", booster, warmup=False)  # hot-swap, stats carry over
+    assert reg.stats()["m"]["batches"] == before
+
+
+# -- end-to-end HTTP --------------------------------------------------------
+def _post(conn, path, payload):
+    conn.request("POST", path, json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    return resp.status, json.loads(resp.read())
+
+
+def _get(conn, path):
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    return resp.status, json.loads(resp.read())
+
+
+@pytest.mark.slow
+def test_serve_e2e_http(tmp_path, binary_data, booster):
+    """The acceptance flow: a warm server answers 1000 sequential
+    single-row /predict requests with ZERO recompiles after warmup,
+    verified through the /stats counter; plus /healthz, /models listing,
+    and an over-HTTP hot-swap."""
+    import http.client
+    X, y = binary_data
+    model_file = str(tmp_path / "model.txt")
+    booster.save_model(model_file)
+    reg = ModelRegistry()
+    reg.load("model", model_file, warmup=True)
+    srv = PredictionServer(reg, port=0, max_wait_ms=0.5).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+        status, health = _get(conn, "/healthz")
+        assert status == 200 and health["models"] == ["model"]
+        status, models = _get(conn, "/models")
+        assert status == 200 and models["model"]["num_trees"] == 15
+        recompiles0 = _get(conn, "/stats")[1]["model"]["recompiles"]
+
+        row = X[0].tolist()
+        ref = float(booster.predict(X[:1])[0])
+        for _ in range(1000):
+            status, body = _post(conn, "/predict", {"rows": [row]})
+            assert status == 200
+            assert body["predictions"][0] == pytest.approx(ref, abs=0.0)
+        status, stats = _get(conn, "/stats")
+        assert stats["model"]["recompiles"] == recompiles0, \
+            "single-row traffic recompiled after warmup"
+        assert stats["model"]["requests"] >= 1000
+        assert stats["model"]["bucket_histogram"].get("1", 0) >= 1000
+
+        # error paths
+        assert _post(conn, "/predict", {})[0] == 400
+        assert _post(conn, "/predict", {"rows": [row],
+                                        "model": "nope"})[0] == 404
+        assert _get(conn, "/bogus")[0] == 404
+
+        # hot-swap over HTTP: predictions switch to the new version
+        p = {**SMALL, "objective": "binary"}
+        b2 = lgb.train(p, lgb.Dataset(X, y, params=p), 7)
+        model2 = str(tmp_path / "model2.txt")
+        b2.save_model(model2)
+        status, info = _post(conn, "/models", {"name": "model",
+                                               "file": model2})
+        assert status == 200 and info["num_trees"] == 7
+        _, body = _post(conn, "/predict", {"row": row})
+        assert body["predictions"][0] == pytest.approx(
+            float(b2.predict(X[:1])[0]), abs=0.0)
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.slow
+def test_serve_cli_subprocess(tmp_path, booster, binary_data):
+    """`python -m lightgbm_tpu serve model.txt` boots, answers /predict,
+    and dies cleanly."""
+    import http.client
+    import re
+    import subprocess
+    import time
+    X, _ = binary_data
+    model_file = str(tmp_path / "model.txt")
+    booster.save_model(model_file)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+           "PYTHONUNBUFFERED": "1"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu", "serve", model_file,
+         "port=0", "warmup=0"],
+        cwd=repo, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        port = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line and proc.poll() is not None:
+                break
+            m = re.search(r"listening on http://[^:]+:(\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port, "server never reported its port"
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        status, body = _post(conn, "/predict", {"row": X[0].tolist()})
+        assert status == 200
+        assert body["predictions"][0] == pytest.approx(
+            float(booster.predict(X[:1])[0]), abs=1e-12)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
