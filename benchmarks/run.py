@@ -16,10 +16,17 @@ Configs:
   ranking    LambdaRank, MSLR-like query structure, feature-parallel
   multiclass Covertype-like 7-class + categoricals, GOSS
   sparse     Criteo-like wide one-hot sparse, EFB + voting-parallel
+
+``--json out.json`` additionally writes one machine-trackable record for
+the whole run (schema ``bench-matrix-v1``: git sha, backend, SCALE, and
+the per-config name/config/iters_per_sec rows), so the perf trajectory
+lands in BENCH_*.json-style artifacts instead of being hand-copied into
+PERF.md.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -29,8 +36,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SCALE = float(os.environ.get("SCALE", 1.0))
 
+# rows accumulated for the --json record (one per benched config)
+_RECORDS = []
 
-def _emit(name, trees, dt, extra="", baseline=None):
+
+def _git_sha():
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=10).stdout.strip() or None
+    except Exception:
+        return None
+
+
+def _emit(name, trees, dt, extra="", baseline=None, config=None):
     """One bench.py-schema JSON line.  ``baseline`` is the reference
     iters/s for THIS config when published (docs/Experiments.rst); the
     non-Higgs configs have no comparable published number and omit
@@ -44,6 +64,14 @@ def _emit(name, trees, dt, extra="", baseline=None):
     if baseline:
         rec["vs_baseline"] = round(ips / baseline, 4)
     print(json.dumps(rec), flush=True)
+    _RECORDS.append({
+        "name": name,
+        "iters_per_sec": round(ips, 4),
+        "trees": trees,
+        "seconds": round(dt, 3),
+        **({"vs_baseline": round(ips / baseline, 4)} if baseline else {}),
+        **({"config": config} if config else {}),
+    })
 
 
 HIGGS_CPU_BASELINE = 500.0 / 130.094   # == bench.py BASELINE_ITERS_PER_SEC
@@ -79,7 +107,8 @@ def bench_higgs(tree_learner="serial"):
     _emit("higgs" if tree_learner == "serial" else "higgs_dp", trees, dt,
           f", {n}x28, tl={tree_learner}",
           # the published number is for the FULL 10.5M config only
-          baseline=HIGGS_CPU_BASELINE if SCALE == 1.0 else None)
+          baseline=HIGGS_CPU_BASELINE if SCALE == 1.0 else None,
+          config={**p, "rows": n, "features": 28})
 
 
 def bench_ranking():
@@ -103,7 +132,8 @@ def bench_ranking():
     trees = int(os.environ.get("TREES", 25))
     ds = lgb.Dataset(X, y, group=group, params=p)
     _, dt = _train(p, ds, trees)
-    _emit("ranking_lambdarank", trees, dt, f", {nq} queries, tl=feature")
+    _emit("ranking_lambdarank", trees, dt, f", {nq} queries, tl=feature",
+          config={**p, "queries": nq, "rows": n, "features": 64})
 
 
 def bench_multiclass():
@@ -122,7 +152,8 @@ def bench_multiclass():
     trees = int(os.environ.get("TREES", 10))
     ds = lgb.Dataset(X, y, categorical_feature=[10, 11], params=p)
     _, dt = _train(p, ds, trees, warmup=int(1.0 / p["learning_rate"]) + 2)
-    _emit("multiclass_goss", trees, dt, f", {n}x12 7-class")
+    _emit("multiclass_goss", trees, dt, f", {n}x12 7-class",
+          config={**p, "rows": n, "features": 12})
 
 
 def bench_sparse():
@@ -144,7 +175,8 @@ def bench_sparse():
     trees = int(os.environ.get("TREES", 10))
     ds = lgb.Dataset(X, y, params=p)
     _, dt = _train(p, ds, trees)
-    _emit("sparse_voting_efb", trees, dt, f", {n}x{f} 98.75%-sparse")
+    _emit("sparse_voting_efb", trees, dt, f", {n}x{f} 98.75%-sparse",
+          config={**p, "rows": n, "features": f})
 
 
 ALL = {
@@ -159,9 +191,30 @@ ALL = {
 def main():
     from lightgbm_tpu.utils.log import set_verbosity
     set_verbosity(-1)
-    which = sys.argv[1:] or list(ALL)
+    argv = list(sys.argv[1:])
+    json_path = None
+    if "--json" in argv:
+        i = argv.index("--json")
+        if i + 1 >= len(argv):
+            sys.exit("usage: run.py [configs...] --json OUT.json")
+        json_path = argv[i + 1]
+        del argv[i:i + 2]
+    which = argv or list(ALL)
     for name in which:
         ALL[name]()
+    if json_path:
+        from lightgbm_tpu.utils.backend import default_backend
+        record = {
+            "schema": "bench-matrix-v1",
+            "git_sha": _git_sha(),
+            "backend": default_backend(),
+            "scale": SCALE,
+            "rows": _RECORDS,
+        }
+        with open(json_path, "w") as f:
+            json.dump(record, f, indent=2)
+        print(json.dumps({"written": json_path,
+                          "configs": len(_RECORDS)}), flush=True)
 
 
 if __name__ == "__main__":

@@ -219,6 +219,15 @@ _PARAMS: List[ParamSpec] = [
     # for numeric-only datasets with num_leaves >= 3*wave_size.
     _p("tpu_speculative_ramp", bool, True),
     _p("tpu_spec_tolerance", float, 0.3, check=">=0.0"),
+    # exact device-side endgame (learner/wave.py + learner/endgame.py):
+    # once the remaining leaf budget drops below 2*wave_size, ONE batched
+    # kernel pass precomputes the frontier candidates' smaller-child
+    # histograms (larger siblings via subtraction) and the remaining
+    # splits are selected by the TRUE sequential best-first order in an
+    # on-device while loop over the cached histogram bank — no more
+    # full-data passes per taper wave.  Replaces the wave-halving taper
+    # on numeric non-EFB shapes; reproduces the exact leaf-wise order.
+    _p("tpu_exact_endgame", bool, True),
     _p("num_devices", int, 0),               # 0 = all visible devices
     # --- gradient quantization (config.h use_quantized_grad block;
     # gradient_discretizer.cpp) — int8 histogram training on the MXU

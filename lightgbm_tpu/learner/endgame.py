@@ -1,0 +1,80 @@
+"""Shared sequential split-commit selector.
+
+The TRUE leaf-wise order (serial_tree_learner.cpp:158-209) commits one
+split at a time: pick the global best candidate, write the node records,
+patch the parent's child pointer at the committed node, renumber leaves
+(left child keeps the split leaf's id, right child takes the next fresh
+id — Tree::Split).  Three growers share this selector:
+
+* the partitioned grower's per-split ``fori_loop`` body
+  (learner/partitioned.py) — the exact sequential reference path;
+* the wave grower's **exact device-side endgame** (learner/wave.py): once
+  the remaining budget drops below ``2*wave_size``, one batched kernel
+  pass precomputes the frontier candidates' smaller-child histograms and
+  the remaining splits are committed in the exact sequential order by a
+  ``lax.while_loop`` over the cached bank — zero further full-data
+  passes in the common case;
+* degenerately, every ``wave_size=1`` wave.
+
+Leaves are encoded in child slots as ``-(leaf+1)``; at any moment exactly
+one node slot holds a given leaf's code (its parent's — earlier holders
+were patched when the leaf was created), so a full-array compare-and-set
+replaces the reference's parent-index bookkeeping.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["patch_child_pointers", "write_split_records"]
+
+
+def patch_child_pointers(left_child, right_child, leaf, node, active=None):
+    """Point the split leaf's parent slot at the newly committed node.
+
+    ``active`` (scalar bool) masks the patch for fori-loop growers whose
+    iteration may be a no-op; the wave endgame always commits.
+    """
+    enc = -(leaf + 1)
+    hit_l = left_child == enc
+    hit_r = right_child == enc
+    if active is not None:
+        hit_l = hit_l & active
+        hit_r = hit_r & active
+    return (jnp.where(hit_l, node, left_child),
+            jnp.where(hit_r, node, right_child))
+
+
+def write_split_records(out, *, node, leaf, new_id, feat, thr, f_nan_bin,
+                        dt_bits, gain, internal_value, internal_weight,
+                        internal_count, left_child, right_child,
+                        member=None, active=None):
+    """Write one committed split's node records into the state dict.
+
+    ``out`` must hold the standard node arrays (split_feature,
+    threshold_bin, nan_bin, decision_type, split_gain, internal_value/
+    weight/count, and cat_member when ``member`` is given).
+    ``left_child``/``right_child`` arrive pre-patched
+    (:func:`patch_child_pointers`); the node's own slots are written here,
+    encoding the children as leaves ``-(leaf+1)`` / ``-(new_id+1)``.
+    ``active=False`` turns every write into a dropped no-op.
+    """
+    idx = node if active is None else jnp.where(
+        active, node, out["split_feature"].shape[0])
+
+    def w(name, val):
+        out[name] = out[name].at[idx].set(val, mode="drop")
+
+    w("split_feature", feat)
+    w("threshold_bin", thr)
+    w("nan_bin", f_nan_bin)
+    if member is not None:
+        w("cat_member", member)
+    w("decision_type", dt_bits)
+    w("split_gain", gain)
+    w("internal_value", internal_value)
+    w("internal_weight", internal_weight)
+    w("internal_count", internal_count)
+    out["left_child"] = left_child.at[idx].set(-(leaf + 1), mode="drop")
+    out["right_child"] = right_child.at[idx].set(-(new_id + 1), mode="drop")
+    return out

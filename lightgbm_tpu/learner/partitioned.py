@@ -48,6 +48,7 @@ from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
 from ..ops.histogram import build_histogram
 from ..ops.split import (BIG, NEG_INF, _leaf_gain, leaf_output,
                          leaf_output_smoothed)
+from .endgame import patch_child_pointers, write_split_records
 from .serial import CommStrategy, GrownTree
 
 __all__ = ["make_partitioned_grow_fn", "PART_ROW_BLOCK"]
@@ -387,7 +388,6 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             "leaf_seg": jnp.zeros((L,), jnp.int32).at[0].set(n),
             "leaf_sum": jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum),
             "leaf_depth": jnp.zeros((L,), jnp.int32),
-            "leaf_parent": jnp.full((L,), -1, jnp.int32),
             "cand_gain": jnp.full((L,), NEG_INF, jnp.float32).at[0].set(cand[0]),
             "cand_feat": jnp.zeros((L,), jnp.int32).at[0].set(cand[1]),
             "cand_bin": jnp.zeros((L,), jnp.int32).at[0].set(cand[2]),
@@ -545,15 +545,13 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                        jnp.where(dleft_rec, DEFAULT_LEFT_MASK, 0) |
                        jnp.where(fnan & jnp.logical_not(fcat), MISSING_NAN, 0)
                        ).astype(jnp.int32)
-            parent_node = s["leaf_parent"][best_leaf]
-            enc_best = -(best_leaf + 1)
-            node_idx = jnp.arange(L - 1, dtype=jnp.int32)
-            patch_l = (node_idx == parent_node) & \
-                (s["left_child"] == enc_best) & do
-            patch_r = (node_idx == parent_node) & \
-                (s["right_child"] == enc_best) & do
-            left_child = jnp.where(patch_l, node, s["left_child"])
-            right_child = jnp.where(patch_r, node, s["right_child"])
+            # sequential selector bookkeeping shared with the wave
+            # grower's exact endgame (learner/endgame.py): the split
+            # leaf's unique -(leaf+1) child-slot code is patched to the
+            # committed node — no parent-index tracking needed
+            left_child, right_child = patch_child_pointers(
+                s["left_child"], s["right_child"], best_leaf, node,
+                active=do)
 
             def upd(arr, idx, val):
                 return arr.at[idx].set(jnp.where(do, val, arr[idx]))
@@ -576,8 +574,6 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                                   new_id, rsum)
             out["leaf_depth"] = upd(upd(s["leaf_depth"], best_leaf,
                                         child_depth), new_id, child_depth)
-            out["leaf_parent"] = upd(upd(s["leaf_parent"], best_leaf, node),
-                                     new_id, node)
             out["cand_gain"] = upd(upd(s["cand_gain"], best_leaf, gl_),
                                    new_id, gr_)
             out["cand_feat"] = upd(upd(s["cand_feat"], best_leaf, cl[1]),
@@ -592,18 +588,13 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                                    new_id, cr[5])
             out["cand_member"] = upd(upd(s["cand_member"], best_leaf, cl[6]),
                                      new_id, cr[6])
-            out["split_feature"] = upd(s["split_feature"], node, feat)
-            out["threshold_bin"] = upd(s["threshold_bin"], node, thr)
-            out["nan_bin"] = upd(s["nan_bin"], node, f_nan_bin)
-            out["cat_member"] = upd(s["cat_member"], node, member)
-            out["decision_type"] = upd(s["decision_type"], node, dt_bits)
-            out["left_child"] = upd(left_child, node, enc_best)
-            out["right_child"] = upd(right_child, node, -(new_id + 1))
-            out["split_gain"] = upd(s["split_gain"], node, bgain)
-            out["internal_value"] = upd(s["internal_value"], node,
-                                        leaf_output(psum_[0], psum_[1], sp))
-            out["internal_weight"] = upd(s["internal_weight"], node, psum_[1])
-            out["internal_count"] = upd(s["internal_count"], node, psum_[2])
+            write_split_records(
+                out, node=node, leaf=best_leaf, new_id=new_id, feat=feat,
+                thr=thr, f_nan_bin=f_nan_bin, dt_bits=dt_bits, gain=bgain,
+                internal_value=leaf_output(psum_[0], psum_[1], sp),
+                internal_weight=psum_[1], internal_count=psum_[2],
+                left_child=left_child, right_child=right_child,
+                member=member, active=do)
             if use_mc:
                 out["leaf_mn"] = upd(upd(s["leaf_mn"], best_leaf, mn_l),
                                      new_id, mn_r)
@@ -656,6 +647,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
             internal_weight=s["internal_weight"],
             internal_count=s["internal_count"], leaf_value=s["leaf_value"],
             leaf_weight=s["leaf_weight"], leaf_count=s["leaf_count"],
-            num_leaves=s["num_leaves"], row_leaf=row_leaf)
+            num_leaves=s["num_leaves"], row_leaf=row_leaf,
+            hist_passes=jnp.asarray(0, jnp.int32))
 
     return jax.jit(grow) if jit else grow

@@ -69,6 +69,11 @@ class GrownTree(NamedTuple):
     leaf_count: jnp.ndarray        # (L,) float32
     num_leaves: jnp.ndarray        # () int32 — actual leaves grown
     row_leaf: jnp.ndarray          # (N,) int32 — final leaf of every row
+    hist_passes: jnp.ndarray       # () int32 — full-data histogram passes
+    #                                spent growing this tree (wave grower;
+    #                                0 = untracked: the partitioned/masked
+    #                                growers' per-split builds scale with
+    #                                the split leaf's size, not with N)
 
 
 def local_best_candidate(hist, leaf_sum, num_bins, is_cat, has_nan,
@@ -526,7 +531,8 @@ def make_grow_fn(*, num_leaves: int, max_bins: int, max_depth: int,
             internal_weight=s["internal_weight"],
             internal_count=s["internal_count"], leaf_value=s["leaf_value"],
             leaf_weight=s["leaf_weight"], leaf_count=s["leaf_count"],
-            num_leaves=s["num_leaves"], row_leaf=s["row_leaf"])
+            num_leaves=s["num_leaves"], row_leaf=s["row_leaf"],
+            hist_passes=jnp.asarray(0, jnp.int32))
 
     return jax.jit(grow) if jit else grow
 
@@ -772,13 +778,14 @@ class SerialTreeLearner:
                 if self.quantized else (False,)
             spec_ramp = bool(config.tpu_speculative_ramp)
             spec_tol = float(config.tpu_spec_tolerance)
+            endg = bool(config.tpu_exact_endgame)
             mc_inter = resolve_monotone_method(
                 config, self.split_params.use_monotone, wave=True)
             key = ("wave", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
                    impl, any_cat, wave_size, self._efb_dims, feature_contri,
                    qtuple, interaction_groups, cegb_lazy, spec_ramp,
-                   spec_tol, forced_splits, mc_inter)
+                   spec_tol, forced_splits, mc_inter, endg)
             if key not in _GROW_FN_CACHE:
                 from .wave import make_wave_grow_fn
                 _cache_put(key, make_wave_grow_fn(
@@ -794,7 +801,7 @@ class SerialTreeLearner:
                     interaction_groups=interaction_groups,
                     cegb_lazy=cegb_lazy, spec_ramp=spec_ramp,
                     spec_tol=spec_tol, forced_splits=forced_splits,
-                    mc_inter=mc_inter))
+                    mc_inter=mc_inter, exact_endgame=endg))
             self._grow = _cache_hit(key)
         elif self.partitioned:
             key = ("part", int(config.num_leaves), num_features,
@@ -887,10 +894,13 @@ class SerialTreeLearner:
                 # the used-feature bitmap persists across trees (the
                 # reference's feature_used_in_data_ lives for the whole
                 # training run)
+                from .wave import LAZY_PACK, lazy_bitmap_init
+                bitpack = n_pad % LAZY_PACK == 0  # pallas pads to 4096
+                width = n_pad // LAZY_PACK if bitpack else n_pad
                 if self._lazy_used is None or \
-                        self._lazy_used.shape[1] != n_pad:
-                    self._lazy_used = jnp.zeros(
-                        (self.num_features, n_pad), jnp.bool_)
+                        self._lazy_used.shape[1] != width:
+                    self._lazy_used = lazy_bitmap_init(
+                        self.num_features, n_pad, bitpack)
                 kw["lazy_used"] = self._lazy_used
             out = self._grow(self._XpT, grad, hess, sample_mask,
                              self.num_bins, self.is_cat, self.has_nan,

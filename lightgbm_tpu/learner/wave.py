@@ -26,9 +26,18 @@ Semantics vs the exact sequential leaf-wise order: identical while fewer
 than ``num_leaves`` leaves exist and all wave candidates have positive
 gain, EXCEPT that a wave commits its top-k splits before the children of
 those splits can compete for the budget.  With ``wave_size=1`` the grower
-reproduces the sequential order exactly (tests cross-check this); at
-wave_size=16 the tree can differ near budget exhaustion — quality parity
-is asserted by tests on held-out loss.
+reproduces the sequential order exactly (tests cross-check this).  Near
+budget exhaustion (remaining budget < 2*wave_size) the **exact
+device-side endgame** (``tpu_exact_endgame``, learner/endgame.py) takes
+over on numeric non-EFB shapes: one batched kernel pass precomputes the
+frontier candidates' smaller-child histograms and the remaining splits
+are committed in the TRUE sequential best-first order by an on-device
+while loop over the cached bank — typically zero further full-data
+passes where the former wave-halving taper spent 3-4, and exact where
+the taper was approximate.  Configurations outside the endgame gate keep
+the taper; quality parity is asserted by tests on held-out loss.  The
+``hist_passes`` field of the returned GrownTree counts full-data
+histogram passes (root/mega + one per wave + one per endgame pass).
 
 Forced splits (serial_tree_learner.cpp:450 ForceSplits) are applied as
 pre-committed waves before gain-driven growth.  EFB, monotone
@@ -45,13 +54,45 @@ import jax
 import jax.numpy as jnp
 
 from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
-from ..ops.histogram import build_histogram_leaves
+from ..ops.histogram import build_histogram_leaves, histogram_subtract
 from ..ops.quantize import dequant_scales, quantize_wch
 from ..ops.split import (BIG, NEG_INF, _leaf_gain, leaf_output,
                          leaf_output_smoothed)
+from .endgame import patch_child_pointers, write_split_records
 from .serial import CommStrategy, GrownTree, local_best_candidate
 
-__all__ = ["make_wave_grow_fn", "WAVE_SIZE", "Q_WAVE_SIZE"]
+__all__ = ["make_wave_grow_fn", "WAVE_SIZE", "Q_WAVE_SIZE",
+           "lazy_bitmap_init", "LAZY_PACK"]
+
+# Lazy-CEGB persistent bitmap layout: one bit per (feature, row), packed
+# LSB-first into uint8 bytes — 8x less HBM than the former bool layout
+# for wide lazy-penalized datasets.  The bool layout remains available
+# behind ``lazy_bitpack=False`` (tests cross-check equality).
+LAZY_PACK = 8
+
+
+def lazy_bitmap_init(num_features: int, n_pad: int, bitpack: bool = True):
+    """Fresh persistent 'feature computed for row' bitmap (the reference's
+    feature_used_in_data_ bitset; allocated once per training run)."""
+    if bitpack:
+        return jnp.zeros((num_features, n_pad // LAZY_PACK), jnp.uint8)
+    return jnp.zeros((num_features, n_pad), jnp.bool_)
+
+
+def _pack_bits(m: jnp.ndarray) -> jnp.ndarray:
+    """(N,) bool -> (N//8,) uint8, LSB-first."""
+    b = m.reshape(-1, LAZY_PACK).astype(jnp.uint8)
+    out = b[:, 0]
+    for k in range(1, LAZY_PACK):
+        out = out | (b[:, k] << k)
+    return out
+
+
+def _unpack_bits(p: jnp.ndarray) -> jnp.ndarray:
+    """(..., N8) uint8 -> (..., N8*8) bool, LSB-first."""
+    sh = jnp.arange(LAZY_PACK, dtype=jnp.uint8)
+    bits = (p[..., None] >> sh) & jnp.uint8(1)
+    return bits.reshape(*p.shape[:-1], -1).astype(jnp.bool_)
 
 from ..ops.histogram_pallas import LEAF_CHANNELS as WAVE_SIZE  # 25/pass
 from ..ops.histogram_pallas import Q_LEAF_CHANNELS as Q_WAVE_SIZE  # 42/pass
@@ -70,7 +111,9 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                       spec_tol: float = 0.3,
                       spec_subsample: int = 1 << 19,
                       forced_splits: tuple = (),
-                      mc_inter: bool = False):
+                      mc_inter: bool = False,
+                      exact_endgame: bool = True,
+                      lazy_bitpack: bool = True):
     """Build the wave single-tree grower.
 
     Returned signature matches the partitioned grower:
@@ -131,16 +174,40 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
     # exact best split.  Exactness: committed gains/sums/hists all come
     # from the full-data channel sums — the subsample only chooses which
     # histograms to precompute; a bad guess costs a skipped commit, never
-    # a wrong number.  Gated to the serial Pallas numeric path (the shapes
-    # the flagship benchmark runs); every other configuration keeps the
-    # plain ramp.
+    # a wrong number.  Gated to the Pallas numeric path (the shapes the
+    # flagship benchmark runs) — SERIAL or row-sharded DATA-PARALLEL: a
+    # WaveDPStrategy advertises ``spec_ok`` and the provisional subsample
+    # waves psum their histograms over ICI exactly like committed waves
+    # (one collective per provisional pass), so every shard grows the
+    # same provisional tree and verifies it against the full sharded
+    # data.  Every other configuration keeps the plain ramp.
+    spec_dp_ok = strategy is None or getattr(strategy, "spec_ok", False)
+    spec_shards = int(getattr(strategy, "nshards", 1) or 1)
     use_spec = (spec_ramp and hist_impl == "pallas" and not any_cat and
                 not use_efb and max_bins <= 255 and not use_mc and
                 not use_sm and not use_ic and not use_bynode and
                 not use_et and not use_lazy and not sp.use_cegb and
-                strategy is None and max_depth <= 0 and
+                spec_dp_ok and max_depth <= 0 and
                 not feature_contri and W >= 2 and L >= 3 * W and
                 not forced_splits)
+    # Narrow-dtype fast path (shared by the row updates and the endgame):
+    # bin codes stay uint8 (255 reserved as the no-NaN sentinel) and leaf
+    # ids uint8 when the tree fits — 4x less HBM traffic than int32.
+    small_bins = (not use_efb) and max_bins <= 255
+    # Exact device-side endgame eligibility (all static).  Once the
+    # remaining budget drops below 2W the halving taper is replaced by
+    # ONE batched kernel pass over the frontier candidates' smaller
+    # children plus a true sequential best-first selection over the
+    # cached histogram bank (learner/endgame.py docnotes).  Gated off the
+    # per-wave-stateful features (monotone bounds, interaction paths,
+    # per-node RNG streams, lazy-CEGB bitmap upkeep) and categorical/EFB
+    # shapes; works on the serial AND row-sharded DP paths (the batched
+    # pass rides the same one-psum-per-pass reduction as committed
+    # waves), quantized or exact, any hist impl.
+    use_endgame = (exact_endgame and not any_cat and not use_efb and
+                   small_bins and not use_mc and not use_ic and
+                   not use_bynode and not use_et and not use_lazy and
+                   L > 2)
     # Forced splits (serial_tree_learner.cpp:450 ForceSplits): the
     # BFS-ordered (leaf, inner feature, threshold bin) triples are applied
     # as PRE-COMMITTED waves before gain-driven growth — statically
@@ -224,6 +291,12 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
         gm = (grad * bag_mask).astype(jnp.float32)
         hm = (hess * bag_mask).astype(jnp.float32)
         cnt_mask = (bag_mask > 0).astype(jnp.float32)
+        if use_lazy:
+            # packed vs bool layout of the persistent `used` bitmap: follow
+            # whatever the learner threads in (its dtype is static at trace
+            # time); fresh bitmaps pack only when the row count allows it
+            lp = (lazy_used.dtype == jnp.uint8) if lazy_used is not None \
+                else (lazy_bitpack and n % LAZY_PACK == 0)
         if pallas:
             if not quantized:
                 w8 = pack_weights8(grad, hess, bag_mask)
@@ -252,6 +325,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             def dq(h):
                 """int32 channel sums -> f32 (sum_grad, sum_hess, count)."""
                 return h.astype(jnp.float32) * qscales
+
+        _dqh = dq if quantized else (lambda h: h)
 
         def hist_waves(ch, k=W):
             """(k, G, Bb, 3) histograms of the wave's leaf channels,
@@ -283,13 +358,6 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     bins_rows, gm, hm, cnt_mask, ch,
                     num_channels=W, num_bins=Bb, impl=hist_impl)
             return strat.reduce_hist(h[:k])
-
-        # Narrow-dtype fast path for the per-wave row updates: W streaming
-        # passes over N rows dominate after the kernel, so keep the
-        # comparisons in uint8 (bin codes never exceed 254 here, freeing
-        # 255 as the "no NaN bin" sentinel) and the leaf ids in uint8
-        # when the tree fits — 4x less HBM traffic than the int32 form.
-        small_bins = (not use_efb) and max_bins <= 255
 
         def feature_col(feat):
             """FEATURE-space bin codes (N,) of one feature (decoded from
@@ -376,12 +444,21 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             """Speculative-ramp initial state: provisional subtree from a
             row subsample, verified and committed against one full-data
             W-channel histogram pass (see make_wave_grow_fn docnotes).
-            Replaces the root pass + the first ~log2(W) ramp waves."""
+            Replaces the root pass + the first ~log2(W) ramp waves.
+
+            Data-parallel: each shard strides its LOCAL rows (the global
+            subsample budget divides by ``spec_shards``) and every
+            provisional pass psums its (W, G, Bb, 3) histogram batch over
+            the mesh — exactly one extra collective per provisional pass,
+            the same payload shape as a committed wave's — so all shards
+            grow one identical provisional tree; the verification pass
+            and commit tests then run on psum'd full-data sums."""
             import math as _m
             Kc, K1 = W, W - 1
             # -- statically-strided row subsample (weights carry bagging/
             # GOSS masks, so out-of-bag rows contribute nothing) --
-            stride = max(1, n // max(int(spec_subsample), 4096))
+            stride = max(1, n // max(int(spec_subsample) // spec_shards,
+                                     4096))
             n_ss = max((n // stride) // 4096 * 4096, 4096)
             X_ss = X_T[:, ::stride][:, :n_ss]
             w_src = wch0 if quantized else w8
@@ -420,6 +497,10 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     h_ss = build_histogram_pallas_leaves(
                         X_ss, w_ss, rl_ss.astype(jnp.int8), num_bins=Bb,
                         interpret=interpret)[:Kc]
+                # DP: the one collective of this provisional pass — every
+                # shard sees the same pooled subsample histograms and
+                # grows the same provisional tree (serial: identity)
+                h_ss = strat.reduce_hist(h_ss)
                 hfs = dqh(h_ss)                              # (Kc, G, Bb, 3)
                 sums_pl = hfs[:, 0].sum(axis=1)              # (Kc, 3)
                 lvp = leaf_output(sums_pl[:, 0], sums_pl[:, 1], sp)
@@ -609,6 +690,10 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 "leaf_count": jnp.where(live, lsum0[:, 2], 0.0),
                 "num_leaves": nl_run,
                 "done": jnp.asarray(False),
+                # full-data histogram passes so far: the one verification
+                # mega-pass (the ~log2(W) provisional passes run at
+                # subsample scale and are not counted)
+                "hist_passes": jnp.asarray(1, jnp.int32),
             }
 
         if use_spec:
@@ -647,9 +732,10 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 # degrades gracefully (it only biases split selection).
                 base = strat.cegb_full if strat.cegb_full is not None else 0.0
                 used0 = lazy_used if lazy_used is not None \
-                    else jnp.zeros((F, n), jnp.bool_)
+                    else lazy_bitmap_init(F, n, lp)
                 used_root = strat.reduce_sum(jax.lax.dot_general(
-                    used0.astype(jnp.bfloat16),
+                    (_unpack_bits(used0) if lp
+                     else used0).astype(jnp.bfloat16),
                     (bag_mask > 0).astype(jnp.bfloat16)[None, :],
                     (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32)[:, 0])       # (F,)
@@ -692,6 +778,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 "leaf_count": jnp.zeros((L,), jnp.float32).at[0].set(root_sum[2]),
                 "num_leaves": jnp.asarray(1, jnp.int32),
                 "done": jnp.asarray(False),
+                "hist_passes": jnp.asarray(1, jnp.int32),  # the root pass
             }
             if use_mc:
                 state["leaf_mn"] = jnp.full((L,), -BIG, jnp.float32)
@@ -712,10 +799,11 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 # across trees like the reference's feature_used_in_data_
                 # bitset (it is allocated once per training run and never
                 # cleared); the learner threads it through every grow call.
-                # Kept as bool (1 byte per cell) — bit-packing would cut HBM
-                # 8x for very wide lazy-penalized datasets.
+                # Packed to uint8 bitfields (lazy_bitmap_init) — 8x less
+                # HBM than the former bool layout; lazy_bitpack=False
+                # keeps the bool path (tests cross-check equality).
                 state["used"] = lazy_used if lazy_used is not None \
-                    else jnp.zeros((F, n), jnp.bool_)
+                    else lazy_bitmap_init(F, n, lp)
 
         jarange = jnp.arange(W, dtype=jnp.int32)
 
@@ -1039,7 +1127,9 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     # only in-bag rows: the reference marks via the
                     # bagged DataPartition's GetIndexOnLeaf
                     m = sel[j] & (rl_old == slz[j]) & in_bag
-                    used_b = used_b.at[feat[j]].set(used_b[feat[j]] | m)
+                    used_b = used_b.at[feat[j]].set(
+                        used_b[feat[j]] | (_pack_bits(m) if lp
+                                           else m))
                 # 2) per-(feature, child) unused counts: grouped matvecs
                 # against the bitmap (0/1 bf16 products, f32 accumulation
                 # — exact to 2^24 counted rows per shard)
@@ -1050,7 +1140,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 if pad_c:
                     cid2 = jnp.concatenate(
                         [cid2, jnp.full((pad_c,), -2, cid2.dtype)])
-                used_f = used_b.astype(jnp.bfloat16)
+                used_f = (_unpack_bits(used_b) if lp
+                          else used_b).astype(jnp.bfloat16)
                 # out-of-bag rows are invisible to the counts (sums2
                 # totals are bagged counts too)
                 rl32 = jnp.where(in_bag, rl.astype(jnp.int32), -9)
@@ -1157,14 +1248,233 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
 
             out["num_leaves"] = nl0 + total_new
             out["done"] = total_new == 0
+            out["hist_passes"] = s["hist_passes"] + 1
             return out
 
+        if use_endgame:
+            # ---- exact device-side endgame --------------------------
+            # The main loop stops once the remaining budget drops below
+            # 2W (instead of tapering the wave); the endgame below then
+            # commits the rest in the TRUE sequential best-first order.
+            # One batched kernel pass precomputes the smaller child of
+            # each of the top-W frontier candidates (channel j = slot j's
+            # smaller side, via the TRIAL form of the row-update kernel —
+            # nothing committed); the selection while-loop then takes the
+            # global top-1, writes its node records, derives BOTH
+            # children's histograms from the cached bank by subtraction,
+            # rescans the two children so they compete, and repeats.
+            # Children born in the endgame have no precomputed bank entry
+            # for their own candidates' children — when such a leaf
+            # becomes the global best, the outer loop flushes the
+            # committed row updates and runs ONE more batched pass over
+            # the then-current frontier.  Every outer pass commits at
+            # least one split (the global best always holds slot 0 of a
+            # fresh pass), so the loop terminates; in the flattening-gain
+            # endgame typical of deep trees one pass serves the whole
+            # remaining budget, vs the taper's 3-4 full passes.
+            EG = 2 * W   # pending-commit capacity (budget < 2W at entry)
+
+            def _pend0():
+                z = jnp.zeros((EG,), jnp.int32)
+                return {"feat": z, "thr": z, "nan": z - 1, "dleft": z,
+                        "leaf": z, "newid": z, "act": z}
+
+            def _apply_pending(rl, pend, pcnt):
+                """Flush committed endgame splits into row_leaf, in
+                commit order (a row rerouted by an earlier entry can be
+                caught by a later one — parents precede children)."""
+                def flush(rl):
+                    if pallas:
+                        for c in range(EG // W):
+                            sl = slice(c * W, (c + 1) * W)
+                            cols = jnp.take(X_T, pend["feat"][sl], axis=0)
+                            tab = jnp.stack([
+                                pend["thr"][sl], pend["nan"][sl],
+                                pend["dleft"][sl],
+                                jnp.zeros((W,), jnp.int32),
+                                pend["leaf"][sl], pend["newid"][sl],
+                                pend["act"][sl],
+                                jnp.zeros((W,), jnp.int32)])
+                            rl2, _ = wave_row_update_pallas(
+                                cols, rl, tab, interpret=interpret)
+                            rl = rl2.astype(rl_dtype)
+                        return rl
+
+                    def one(k, rl_):
+                        colv = feature_col(pend["feat"][k]).astype(
+                            jnp.int32)
+                        go = jnp.where(colv == pend["nan"][k],
+                                       pend["dleft"][k] > 0,
+                                       colv <= pend["thr"][k])
+                        move = ((pend["act"][k] > 0) &
+                                (rl_ == pend["leaf"][k].astype(rl_.dtype))
+                                & jnp.logical_not(go))
+                        return jnp.where(
+                            move, pend["newid"][k].astype(rl_.dtype), rl_)
+                    return jax.lax.fori_loop(0, EG, one, rl)
+                return jax.lax.cond(pcnt > 0, flush, lambda r: r, rl)
+
+            def _trial_channels(rl, sel, sel_leaves, feat, thr, fnanb,
+                                dleft, small):
+                """(N,) int8 candidate slot whose SMALLER side each row
+                would take (-1 = none) — the splits stay uncommitted."""
+                if pallas:
+                    from ..ops.histogram_pallas import (
+                        wave_trial_channels_pallas)
+                    cols = jnp.take(X_T, feat, axis=0)
+                    return wave_trial_channels_pallas(
+                        cols, rl, sel_leaves, thr, fnanb, dleft, small,
+                        sel, interpret=interpret)
+                cols = jax.vmap(feature_col)(feat).astype(jnp.int32)
+                go = jnp.where(cols == fnanb[:, None], dleft[:, None],
+                               cols <= thr[:, None])
+                match = sel[:, None] & \
+                    (rl[None, :] == sel_leaves.astype(rl.dtype)[:, None])
+                has = jnp.any(match, axis=0)
+                jhit = jnp.argmax(match, axis=0)
+                go_hit = jnp.take_along_axis(go, jhit[None, :], axis=0)[0]
+                return jnp.where(has & (go_hit == small[jhit]),
+                                 jhit.astype(jnp.int8), jnp.int8(-1))
+
+            def _commit_cond(c):
+                s, slot, pend, pcnt = c
+                b = jnp.argmax(s["cand_gain"])
+                return ((s["num_leaves"] < L) & (s["cand_gain"][b] > 0) &
+                        (slot[b] >= 0))
+
+            def _make_commit(bank):
+                def _commit(c):
+                    s, slot, pend, pcnt = c
+                    b = jnp.argmax(s["cand_gain"]).astype(jnp.int32)
+                    gain = s["cand_gain"][b]
+                    feat = s["cand_feat"][b]
+                    thr = s["cand_bin"][b]
+                    dleft = s["cand_dleft"][b]
+                    lsum = s["cand_lsum"][b]
+                    rsum = s["cand_rsum"][b]
+                    psum_ = s["leaf_sum"][b]
+                    nl0 = s["num_leaves"]
+                    new_id = nl0
+                    node = nl0 - 1
+                    fnan = hn_full[feat]
+                    f_nan_bin = jnp.where(fnan, nb_full[feat] - 1, -1)
+                    left_smaller = lsum[2] <= rsum[2]
+                    hist_small = bank[slot[b]]
+                    hist_big = histogram_subtract(s["hists"][b], hist_small)
+                    hist_l = jnp.where(left_smaller, hist_small, hist_big)
+                    hist_r = jnp.where(left_smaller, hist_big, hist_small)
+                    # both children's candidates in one vmapped scan
+                    child_depth = s["leaf_depth"][b] + 1
+                    parent_lv = s["leaf_value"][b]
+                    out_l = _child_out(lsum[0], lsum[1], lsum[2], parent_lv)
+                    out_r = _child_out(rsum[0], rsum[1], rsum[2], parent_lv)
+                    hists2 = jnp.stack([hist_l, hist_r])
+                    sums2 = jnp.stack([lsum, rsum])
+                    lv2 = jnp.stack([out_l, out_r])
+                    d2 = jnp.full((2,), child_depth, jnp.int32)
+                    cnds = many_candidates(
+                        jax.vmap(expand_hist)(_dqh(hists2), sums2), sums2,
+                        jnp.zeros((2, 2), jnp.float32), d2, lv2,
+                        jnp.broadcast_to(feature_mask, (2, F)))
+                    depth_ok = jnp.logical_or(max_depth <= 0,
+                                              child_depth < max_depth)
+                    cg2 = jnp.where(depth_ok, cnds[0], NEG_INF)
+                    out = dict(s)
+                    idx2 = jnp.stack([b, new_id])
+
+                    def sc2(arr, val2):
+                        return arr.at[idx2].set(val2)
+
+                    out["hists"] = s["hists"].at[b].set(hist_l) \
+                                             .at[new_id].set(hist_r)
+                    out["leaf_sum"] = sc2(s["leaf_sum"], sums2)
+                    out["leaf_depth"] = sc2(s["leaf_depth"], d2)
+                    out["cand_gain"] = sc2(s["cand_gain"], cg2)
+                    out["cand_feat"] = sc2(s["cand_feat"], cnds[1])
+                    out["cand_bin"] = sc2(s["cand_bin"], cnds[2])
+                    out["cand_dleft"] = sc2(s["cand_dleft"], cnds[3])
+                    out["cand_lsum"] = sc2(s["cand_lsum"], cnds[4])
+                    out["cand_rsum"] = sc2(s["cand_rsum"], cnds[5])
+                    out["cand_member"] = sc2(s["cand_member"], cnds[6])
+                    out["leaf_value"] = sc2(s["leaf_value"], lv2)
+                    out["leaf_weight"] = sc2(s["leaf_weight"], sums2[:, 1])
+                    out["leaf_count"] = sc2(s["leaf_count"], sums2[:, 2])
+                    # node records via the shared sequential selector
+                    dt_bits = (jnp.where(dleft, DEFAULT_LEFT_MASK, 0) |
+                               jnp.where(fnan, MISSING_NAN, 0)
+                               ).astype(jnp.int32)
+                    lc, rc = patch_child_pointers(
+                        s["left_child"], s["right_child"], b, node)
+                    write_split_records(
+                        out, node=node, leaf=b, new_id=new_id, feat=feat,
+                        thr=thr, f_nan_bin=f_nan_bin, dt_bits=dt_bits,
+                        gain=gain,
+                        internal_value=leaf_output(psum_[0], psum_[1], sp),
+                        internal_weight=psum_[1], internal_count=psum_[2],
+                        left_child=lc, right_child=rc)
+                    out["num_leaves"] = nl0 + 1
+                    slot2 = slot.at[b].set(-1).at[new_id].set(-1)
+                    pend2 = dict(pend)
+                    for k_, v_ in (("feat", feat), ("thr", thr),
+                                   ("nan", f_nan_bin),
+                                   ("dleft", dleft.astype(jnp.int32)),
+                                   ("leaf", b), ("newid", new_id),
+                                   ("act", jnp.asarray(1, jnp.int32))):
+                        pend2[k_] = pend2[k_].at[pcnt].set(v_)
+                    return (out, slot2, pend2, pcnt + 1)
+                return _commit
+
+            def _eg_cond(c):
+                s, pend, pcnt = c
+                return (s["num_leaves"] < L) & \
+                    (jnp.max(s["cand_gain"]) > 0)
+
+            def _eg_body(c):
+                s, pend, pcnt = c
+                rl = _apply_pending(s["row_leaf"], pend, pcnt)
+                s = dict(s)
+                s["row_leaf"] = rl
+                pend = _pend0()
+                pcnt = jnp.asarray(0, jnp.int32)
+                vals, sel_leaves = jax.lax.top_k(s["cand_gain"], W)
+                sel = vals > 0
+                feat = s["cand_feat"][sel_leaves]
+                thr = s["cand_bin"][sel_leaves]
+                dleft = s["cand_dleft"][sel_leaves]
+                lsum = s["cand_lsum"][sel_leaves]
+                rsum = s["cand_rsum"][sel_leaves]
+                fnanb = jnp.where(hn_full[feat], nb_full[feat] - 1, -1)
+                small = lsum[:, 2] <= rsum[:, 2]
+                ch = _trial_channels(rl, sel, sel_leaves, feat, thr,
+                                     fnanb, dleft, small)
+                bank = hist_waves(ch)       # (W, G, Bb, 3); DP: one psum
+                slot = jnp.full((L,), -1, jnp.int32).at[
+                    jnp.where(sel, sel_leaves, L)].set(
+                        jnp.arange(W, dtype=jnp.int32), mode="drop")
+                s, slot, pend, pcnt = jax.lax.while_loop(
+                    _commit_cond, _make_commit(bank),
+                    (s, slot, pend, pcnt))
+                s = dict(s)
+                s["hist_passes"] = s["hist_passes"] + 1
+                return (s, pend, pcnt)
+
         def cond(s):
-            return jnp.logical_not(s["done"]) & (s["num_leaves"] < L)
+            go = jnp.logical_not(s["done"]) & (s["num_leaves"] < L)
+            if use_endgame:
+                # hand off to the endgame instead of tapering the wave
+                go = go & (s["num_leaves"] + 2 * W <= L)
+            return go
 
         for fw in forced_waves:   # pre-committed ForceSplits prefix
             state = body(state, forced=fw)
         s = jax.lax.while_loop(cond, body, state)
+        if use_endgame:
+            s, pend, pcnt = jax.lax.while_loop(
+                _eg_cond, _eg_body,
+                (s, _pend0(), jnp.asarray(0, jnp.int32)))
+            s = dict(s)
+            s["row_leaf"] = _apply_pending(s["row_leaf"], pend, pcnt)
+            s["done"] = jnp.asarray(True)
 
         if quantized and renew_leaf:
             # Exact leaf-value renewal (the reference's
@@ -1217,7 +1527,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             internal_count=s["internal_count"], leaf_value=s["leaf_value"],
             leaf_weight=s["leaf_weight"], leaf_count=s["leaf_count"],
             num_leaves=s["num_leaves"],
-            row_leaf=s["row_leaf"].astype(jnp.int32))
+            row_leaf=s["row_leaf"].astype(jnp.int32),
+            hist_passes=s["hist_passes"])
         if use_lazy:
             return tree_out, s["used"]
         return tree_out

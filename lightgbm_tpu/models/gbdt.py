@@ -676,6 +676,10 @@ class GBDT:
                         jax.random.PRNGKey(cfg.seed), it)
                 grown = self.learner.train(self.X_dev, g, h, mask,
                                            feature_mask=fmask, **extra)
+                # full-data histogram passes of the last grown tree (wave
+                # grower; 0 = untracked) — a device scalar, pulled lazily
+                # by bench/diagnostic readers only
+                self.last_hist_passes = grown.hist_passes
                 tree = self._record_tree(grown, cid)
                 if tree is not None and self._cegb_coupled is not None:
                     sf = tree.split_feature[:tree.num_leaves - 1]

@@ -42,6 +42,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["build_histogram_pallas", "build_histogram_pallas_leaves",
            "build_histogram_pallas_leaves_q8", "pack_weights8",
+           "wave_trial_channels_pallas",
            "DEFAULT_ROW_BLOCK", "pad_rows", "LEAF_CHANNELS",
            "Q_LEAF_CHANNELS"]
 
@@ -580,3 +581,30 @@ def wave_row_update_pallas(cols_w: jnp.ndarray, rl: jnp.ndarray,
         interpret=interpret,
     )(cols3, rl2, tab)
     return rl_new.reshape(n), ch.reshape(n)
+
+
+def wave_trial_channels_pallas(cols_w: jnp.ndarray, rl: jnp.ndarray,
+                               sel_leaves: jnp.ndarray, thr: jnp.ndarray,
+                               nan_bin: jnp.ndarray, default_left: jnp.ndarray,
+                               left_smaller: jnp.ndarray, active: jnp.ndarray,
+                               *, row_block: int = DEFAULT_ROW_BLOCK,
+                               interpret: bool = False) -> jnp.ndarray:
+    """TRIAL leaf-channel assignment for W *candidate* splits.
+
+    Same fused kernel as :func:`wave_row_update_pallas`, but the splits are
+    NOT committed: each candidate's ``new_right_id`` is set to its own
+    split leaf, so ``rl`` is provably unchanged and only the smaller-child
+    channel vector comes back.  The wave grower's exact endgame uses this
+    to precompute the frontier candidates' smaller-child histograms in one
+    batched pass before the sequential best-first selection commits any of
+    them (learner/wave.py).
+
+    Returns ``ch`` int8 (N,): the candidate slot whose smaller side the
+    row would take, or -1.
+    """
+    tab = jnp.stack([thr, nan_bin, default_left.astype(jnp.int32),
+                     left_smaller.astype(jnp.int32), sel_leaves, sel_leaves,
+                     active.astype(jnp.int32), jnp.zeros_like(thr)])
+    _, ch = wave_row_update_pallas(cols_w, rl, tab, row_block=row_block,
+                                   interpret=interpret)
+    return ch

@@ -1,9 +1,9 @@
 """Vectorized split finding over histogram bins.
 
 TPU-native replacement for FeatureHistogram's sequential threshold scan
-(reference: src/treelearner/feature_histogram.hpp:858
+(reference: src/treelearner/feature_histogram.hpp
 ``FindBestThresholdSequentially`` — a per-bin loop in two directions — and
-:278 ``FindBestThresholdCategoricalInner``).  On TPU the scan becomes
+``FindBestThresholdCategoricalInner``).  On TPU the scan becomes
 bidirectional ``cumsum`` over the bin axis, all features at once; the
 missing-direction double scan becomes two masked gain tensors; the argmax
 replaces the reference's SplitInfo comparison ladder.
@@ -52,7 +52,8 @@ class SplitParams(NamedTuple):
     path_smooth: float = 0.0
     use_monotone: bool = False     # any monotone_constraints nonzero
     monotone_penalty: float = 0.0
-    # categorical split search (feature_histogram.hpp:278)
+    # categorical split search (feature_histogram.hpp
+    # FindBestThresholdCategoricalInner)
     max_cat_to_onehot: int = 4
     max_cat_threshold: int = 32
     min_data_per_group: int = 100
@@ -63,7 +64,8 @@ class SplitParams(NamedTuple):
                                    # (argsort per candidate) runs on this
                                    # slice only, not all F features
     # cost-effective gradient boosting (cost_effective_gradient_boosting
-    # .hpp:103 DetlaGain): gain -= tradeoff*(penalty_split*leaf_count +
+    # .hpp DeltaGain — upstream spells the method ``DetlaGain``):
+    # gain -= tradeoff*(penalty_split*leaf_count +
     # coupled feature penalty when the feature is not yet used)
     use_cegb: bool = False
     cegb_tradeoff: float = 1.0
@@ -275,7 +277,8 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
 
     if params.any_cat:
         # ---- categorical one-vs-rest: category bin b goes left, rest right
-        # (feature_histogram.hpp:278 one-hot branch; cat_l2 regularizes)
+        # (feature_histogram.hpp FindBestThresholdCategoricalInner
+        # one-hot branch; cat_l2 regularizes)
         cat_l2 = l2 + params.cat_l2
         crg, crh, crc = tot_g - hg_m, tot_h - hh_m, tot_c - hc_m
         if use_out:  # clamp/smooth outputs (no direction check for cats)
@@ -298,7 +301,7 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
         oh_left = jnp.stack([at_bin(hg_m, oh_bin), at_bin(hh_m, oh_bin),
                              at_bin(hc_m, oh_bin)], axis=-1)
 
-        # ---- categorical sorted-subset search (feature_histogram.hpp:278
+        # ---- categorical sorted-subset search (feature_histogram.hpp
         # non-onehot branch): categories ordered by sum_grad/(sum_hess +
         # cat_smooth); prefix subsets scanned from BOTH ends, up to
         # max_cat_threshold categories; the LEFT child takes the subset.
@@ -446,7 +449,7 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     gain = jnp.where(is_cat, cat_best_gain, num_gain)
     if params.use_cegb:
         # constant per-feature penalty commutes with the per-bin argmax, so
-        # it is applied to each feature's best (DetlaGain subtracted from
+        # it is applied to each feature's best (DeltaGain subtracted from
         # SplitInfo.gain in ComputeBestSplitForFeature)
         delta = (params.cegb_tradeoff * params.cegb_penalty_split *
                  parent_sum[2] +

@@ -33,7 +33,7 @@ from ..config import Config
 from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
                               make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
-from .mesh import get_mesh
+from .mesh import get_mesh, shard_map_compat
 
 __all__ = ["DataParallelTreeLearner", "DataParallelStrategy"]
 
@@ -103,12 +103,20 @@ class DataParallelStrategy(CommStrategy):
 
 class WaveDPStrategy(CommStrategy):
     """Row-sharded strategy for the wave grower: ONE histogram psum per
-    wave (up to 25/42 splits' smaller children), scans replicated."""
+    wave (up to 25/42 splits' smaller children), scans replicated.
+
+    ``spec_ok``/``nshards`` unlock the speculative ramp on this path:
+    each shard strides its local rows for the provisional subsample
+    (global budget / nshards each) and the provisional passes psum their
+    histogram batches like committed waves — one extra collective per
+    provisional pass, nothing else (learner/wave.py _spec_state)."""
 
     rows_sharded = True
+    spec_ok = True
 
-    def __init__(self, axis_name: str):
+    def __init__(self, axis_name: str, nshards: int = 1):
         self.axis_name = axis_name
+        self.nshards = int(nshards)
         self.monotone_full = None
 
     def reduce_sum(self, v):
@@ -158,10 +166,29 @@ class DataParallelTreeLearner:
         # same gates as SerialTreeLearner's wave_ok: the wave state carries
         # the full (L, G, B, 3) histogram pool — fall back to the masked
         # sequential grower when it would blow the HBM budget
-        self.wave = (int(config.num_leaves) > 2 and
-                     hist_pool_fits(config, num_features, self.max_bins) and
-                     (mode == "wave" or
-                      (mode == "auto" and impl_wave == "pallas")))
+        wave_able = (int(config.num_leaves) > 2 and
+                     hist_pool_fits(config, num_features, self.max_bins))
+        self.wave = wave_able and (mode == "wave" or
+                                   (mode == "auto" and
+                                    impl_wave == "pallas"))
+        if not self.wave and not hasattr(jax, "shard_map"):
+            # jax<0.5 only ships jax.experimental.shard_map, whose legacy
+            # SPMD partitioner hits a hard CHECK (hlo_sharding_util merge
+            # of manual/tuple shardings) on the MASKED grower's program
+            # and aborts the process.  The wave grower compiles fine there
+            # — route through it when it can serve the config, otherwise
+            # fail cleanly instead of crashing the interpreter.
+            if wave_able and mode != "partition":
+                from ..utils.log import log_warning
+                log_warning("this jax version cannot compile the masked "
+                            "data-parallel grower (legacy SPMD "
+                            "partitioner); using the DP-wave grower")
+                self.wave = True
+            else:
+                raise RuntimeError(
+                    "tree_learner=data with the masked grower requires "
+                    "jax.shard_map (jax>=0.5); upgrade jax or use "
+                    "tree_grow_mode=wave")
         if self.wave:
             self._init_wave(config, num_features, num_bins, is_cat, has_nan,
                             monotone, impl_wave)
@@ -224,7 +251,7 @@ class DataParallelTreeLearner:
         def grow(X, g, h, m, nb, ic, hn, mono, fm):
             return grow_t(X, None, g, h, m, nb, ic, hn, mono, fm)
         tree_specs = self._tree_specs(self.axis)
-        self._grow = jax.jit(jax.shard_map(
+        self._grow = jax.jit(shard_map_compat(
             grow, mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis), P(self.axis), P(self.axis),
                       P(), P(), P(), P(), P()),
@@ -239,7 +266,7 @@ class DataParallelTreeLearner:
             right_child=P(), split_gain=P(), internal_value=P(),
             internal_weight=P(), internal_count=P(), leaf_value=P(),
             leaf_weight=P(), leaf_count=P(), num_leaves=P(),
-            row_leaf=P(axis))
+            row_leaf=P(axis), hist_passes=P())
 
     def _init_wave(self, config, num_features, num_bins, is_cat, has_nan,
                    monotone, impl):
@@ -269,7 +296,7 @@ class DataParallelTreeLearner:
         self._use_node_key = sp.feature_fraction_bynode < 1.0 or \
             sp.extra_trees
         gq_max, hq_max = quant_levels(int(config.num_grad_quant_bins))
-        strategy = WaveDPStrategy(self.axis)
+        strategy = WaveDPStrategy(self.axis, nshards=self.ndev)
         grow_w = make_wave_grow_fn(
             num_leaves=int(config.num_leaves), num_features=num_features,
             max_bins=self.max_bins, max_depth=int(config.max_depth),
@@ -282,7 +309,10 @@ class DataParallelTreeLearner:
             stochastic=bool(config.stochastic_rounding),
             interaction_groups=self.interaction_groups,
             cegb_lazy=self.cegb_lazy, forced_splits=self.forced_splits,
-            mc_inter=mc_inter)
+            mc_inter=mc_inter,
+            spec_ramp=bool(config.tpu_speculative_ramp),
+            spec_tol=float(config.tpu_spec_tolerance),
+            exact_endgame=bool(config.tpu_exact_endgame))
 
         # cegb penalties, the quantization/bynode keys and the persistent
         # lazy-CEGB bitmap ride extra operands; arity is static config
@@ -306,7 +336,7 @@ class DataParallelTreeLearner:
 
         tree_specs = self._tree_specs(self.axis)
         out_specs = (tree_specs, P(None, self.axis)) if nl else tree_specs
-        self._grow = jax.jit(jax.shard_map(
+        self._grow = jax.jit(shard_map_compat(
             grow, mesh=self.mesh,
             in_specs=(P(None, self.axis), P(self.axis), P(self.axis),
                       P(self.axis), P(), P(), P(), P(), P(), P()) +
@@ -331,7 +361,9 @@ class DataParallelTreeLearner:
                 from ..ops.histogram_pallas import DEFAULT_ROW_BLOCK
                 quantum = self.ndev * DEFAULT_ROW_BLOCK
             else:
-                quantum = self.ndev
+                # x8 so each shard's rows (and the packed lazy-CEGB
+                # bitmap's byte columns) stay 8-divisible
+                quantum = self.ndev * 8
             pad = (-n) % quantum
             if self._x_src is not X_dev:
                 Xp = jnp.pad(X_dev, ((0, pad), (0, 0))) if pad else X_dev
@@ -355,11 +387,12 @@ class DataParallelTreeLearner:
                     node_key = jnp.zeros((2, 2), jnp.uint32)
                 keys.append(node_key)
             if self.cegb_lazy:
+                from ..learner.wave import LAZY_PACK, lazy_bitmap_init
                 n_pad_all = self._XpT.shape[1]
                 if self._lazy_used is None or \
-                        self._lazy_used.shape[1] != n_pad_all:
-                    self._lazy_used = jnp.zeros(
-                        (self.num_features, n_pad_all), jnp.bool_)
+                        self._lazy_used.shape[1] != n_pad_all // LAZY_PACK:
+                    self._lazy_used = lazy_bitmap_init(
+                        self.num_features, n_pad_all)
                 keys.append(self._lazy_used)
             out = self._grow(self._XpT, grad, hess, sample_mask,
                              self.num_bins, self.is_cat, self.has_nan,

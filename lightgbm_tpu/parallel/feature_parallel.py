@@ -30,7 +30,7 @@ from ..config import Config
 from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
                               make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
-from .mesh import get_mesh
+from .mesh import get_mesh, shard_map_compat
 
 __all__ = ["FeatureParallelTreeLearner", "FeatureParallelStrategy"]
 
@@ -107,6 +107,13 @@ class FeatureParallelTreeLearner:
                  num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
                  monotone: Optional[np.ndarray] = None):
         self.config = config
+        if not hasattr(jax, "shard_map"):
+            # jax<0.5's legacy SPMD partitioner aborts the process (hard
+            # CHECK in hlo_sharding_util) compiling this learner's
+            # shard_map program; fail cleanly instead
+            raise RuntimeError(
+                "tree_learner=feature requires jax.shard_map (jax>=0.5); "
+                "upgrade jax, or use tree_learner=data (wave grower)")
         if config.use_quantized_grad:
             from ..utils.log import log_warning
             log_warning("use_quantized_grad is only applied by the wave "
@@ -156,12 +163,13 @@ class FeatureParallelTreeLearner:
             cat_member=P(), decision_type=P(), left_child=P(), right_child=P(),
             split_gain=P(), internal_value=P(), internal_weight=P(),
             internal_count=P(), leaf_value=P(), leaf_weight=P(),
-            leaf_count=P(), num_leaves=P(), row_leaf=P())
+            leaf_count=P(), num_leaves=P(), row_leaf=P(),
+            hist_passes=P())
         # X is feature-sharded; rows + every descriptor replicated.  The
         # descriptor args reaching the grower must be FULL arrays (global
         # feature indexing), so they ride in replicated and the strategy
         # slices per shard.
-        self._grow = jax.jit(jax.shard_map(
+        self._grow = jax.jit(shard_map_compat(
             grow, mesh=self.mesh,
             in_specs=(P(None, self.axis), P(), P(), P(), P(), P(), P(), P(),
                       P()),

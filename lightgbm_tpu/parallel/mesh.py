@@ -10,7 +10,23 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["get_mesh", "shard_rows", "replicate"]
+__all__ = ["get_mesh", "shard_rows", "replicate", "shard_map_compat"]
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes it at the top level with ``check_vma``; older
+    releases only have ``jax.experimental.shard_map.shard_map`` with the
+    flag spelled ``check_rep``.  Every parallel learner builds its grower
+    through this shim so the mesh path works on both.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
 
 
 def get_mesh(num_devices: int = 0, axis_name: str = "workers") -> Mesh:

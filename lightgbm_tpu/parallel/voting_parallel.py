@@ -29,7 +29,7 @@ from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
                               make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
 from ..ops.split import NEG_INF, best_split_per_feature
-from .mesh import get_mesh
+from .mesh import get_mesh, shard_map_compat
 
 __all__ = ["VotingParallelTreeLearner", "VotingStrategy"]
 
@@ -152,8 +152,9 @@ class VotingParallelTreeLearner:
             cat_member=P(), decision_type=P(), left_child=P(), right_child=P(),
             split_gain=P(), internal_value=P(), internal_weight=P(),
             internal_count=P(), leaf_value=P(), leaf_weight=P(),
-            leaf_count=P(), num_leaves=P(), row_leaf=P(self.axis))
-        self._grow = jax.jit(jax.shard_map(
+            leaf_count=P(), num_leaves=P(), row_leaf=P(self.axis),
+            hist_passes=P())
+        self._grow = jax.jit(shard_map_compat(
             grow, mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis), P(self.axis), P(self.axis),
                       P(), P(), P(), P(), P()),

@@ -1,0 +1,185 @@
+"""Exact device-side endgame tests (learner/wave.py + learner/endgame.py).
+
+Once the remaining leaf budget drops below 2*wave_size, the wave grower
+precomputes the frontier candidates' smaller-child histograms in ONE
+batched pass and commits the remaining splits in the TRUE sequential
+best-first order on-device.  Therefore:
+  (a) with wave_size=1 (already sequential), endgame on/off must agree
+      bit-for-bit;
+  (b) when the WHOLE tree fits in the endgame (num_leaves - 1 < 2W), the
+      grown tree must be IDENTICAL to the wave_size=1 sequential tree —
+      the selector reproduces the exact leaf-wise order;
+  (c) the endgame must spend no more full-data histogram passes than the
+      halving taper it replaces (hist_passes counter);
+  (d) held-out quality must be at least taper-par.
+Growers run the real Pallas kernels in interpret mode on CPU; the XLA
+fallback path is cross-checked against the Pallas path.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.learner.wave import make_wave_grow_fn
+from lightgbm_tpu.ops.histogram_pallas import pad_rows
+from lightgbm_tpu.ops.split import SplitParams
+
+F, B = 6, 64
+
+
+def _mk_data(n_raw=6000, seed=0):
+    rng = np.random.RandomState(seed)
+    n = pad_rows(n_raw)
+    bins = rng.randint(0, B - 1, (F, n)).astype(np.uint8)
+    logit = (bins[0].astype(np.float32) / B - 0.5) * 3 + \
+        ((bins[1] > 40).astype(np.float32) - 0.5) * 2 + \
+        (bins[2].astype(np.float32) / B) * (bins[3] > 20)
+    y = (logit + rng.randn(n) * 0.7 > 0).astype(np.float32)
+    grad = (0.5 - y).astype(np.float32)
+    hess = np.full(n, 0.25, np.float32)
+    mask = np.ones(n, np.float32)
+    mask[n_raw:] = 0.0
+    return (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(mask), y, n)
+
+
+def _grow(leaves, wave, endgame, impl="pallas", quantized=False):
+    sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=0.0,
+                     any_cat=False)
+    return make_wave_grow_fn(
+        num_leaves=leaves, num_features=F, max_bins=B, max_depth=0,
+        split_params=sp, hist_impl=impl, any_cat=False, interpret=True,
+        jit=False, wave_size=wave, quantized=quantized, stochastic=False,
+        spec_ramp=False, exact_endgame=endgame)
+
+
+def _call(grow, bins, grad, hess, mask):
+    nb = jnp.full((F,), B, jnp.int32)
+    return grow(bins, grad, hess, mask, nb,
+                jnp.zeros((F,), bool), jnp.zeros((F,), bool),
+                jnp.zeros((F,), jnp.int32), jnp.zeros((F,), jnp.float32),
+                (), jnp.ones((F,), bool))
+
+
+def _assert_same_tree(a, b, atol=0.0):
+    assert int(a.num_leaves) == int(b.num_leaves)
+    for name in ("split_feature", "threshold_bin", "nan_bin",
+                 "decision_type", "left_child", "right_child"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, name)),
+                                      np.asarray(getattr(b, name)), err_msg=name)
+    np.testing.assert_array_equal(np.asarray(a.row_leaf),
+                                  np.asarray(b.row_leaf))
+    np.testing.assert_allclose(np.asarray(a.leaf_value),
+                               np.asarray(b.leaf_value), rtol=0, atol=atol)
+
+
+def test_wave1_endgame_bitwise_matches_sequential():
+    """wave_size=1 is the exact sequential grower; flipping the endgame on
+    must not change a single bit of the golden-fixture tree."""
+    bins, grad, hess, mask, y, n = _mk_data()
+    t_off = _call(_grow(13, 1, False), bins, grad, hess, mask)
+    t_on = _call(_grow(13, 1, True), bins, grad, hess, mask)
+    _assert_same_tree(t_off, t_on)
+    np.testing.assert_array_equal(np.asarray(t_off.split_gain),
+                                  np.asarray(t_on.split_gain))
+
+
+def test_full_endgame_reproduces_sequential_order():
+    """num_leaves-1 < 2W puts every split in the endgame: the tree must be
+    bitwise identical to the wave_size=1 sequential tree."""
+    bins, grad, hess, mask, y, n = _mk_data(seed=2)
+    t_seq = _call(_grow(13, 1, False), bins, grad, hess, mask)
+    t_eg = _call(_grow(13, 8, True), bins, grad, hess, mask)
+    _assert_same_tree(t_seq, t_eg)
+
+
+def test_endgame_quantized_matches_sequential():
+    bins, grad, hess, mask, y, n = _mk_data(seed=3)
+    t_seq = _call(_grow(13, 1, False, quantized=True), bins, grad, hess,
+                  mask)
+    t_eg = _call(_grow(13, 8, True, quantized=True), bins, grad, hess,
+                 mask)
+    _assert_same_tree(t_seq, t_eg)
+
+
+def test_endgame_xla_path_matches_pallas():
+    """The onehot (non-Pallas) trial-channel / row-update fallback must
+    produce the same tree as the fused kernels."""
+    bins, grad, hess, mask, y, n = _mk_data(seed=4)
+    t_pl = _call(_grow(13, 8, True, impl="pallas"), bins, grad, hess, mask)
+    t_oh = _call(_grow(13, 8, True, impl="onehot"), bins, grad, hess, mask)
+    _assert_same_tree(t_pl, t_oh, atol=1e-6)
+
+
+def test_endgame_saves_passes_vs_taper():
+    """hist_passes: the endgame must not spend more full-data passes than
+    the taper, and must report the counter at all."""
+    bins, grad, hess, mask, y, n = _mk_data(seed=5)
+    t_taper = _call(_grow(13, 4, False), bins, grad, hess, mask)
+    t_eg = _call(_grow(13, 4, True), bins, grad, hess, mask)
+    p_taper, p_eg = int(t_taper.hist_passes), int(t_eg.hist_passes)
+    assert p_taper >= 3                      # root + waves + taper
+    assert p_eg <= p_taper
+    assert int(t_eg.num_leaves) == int(t_taper.num_leaves) == 13
+
+
+def test_endgame_heldout_quality_vs_taper():
+    """The endgame reproduces the exact order where the taper
+    approximates it — held-out loss must be at least taper-par."""
+    bins, grad, hess, mask, y, n = _mk_data(n_raw=8000, seed=6)
+    ho_bins, ho_grad, ho_hess, ho_mask, ho_y, _ = _mk_data(n_raw=8000,
+                                                           seed=7)
+
+    def heldout_loss(tree):
+        # route the held-out rows through the grown tree's binned splits
+        sf = np.asarray(tree.split_feature)
+        thr = np.asarray(tree.threshold_bin)
+        lc = np.asarray(tree.left_child)
+        rc = np.asarray(tree.right_child)
+        lv = np.asarray(tree.leaf_value)
+        Xb = np.asarray(ho_bins)
+        m = np.asarray(ho_mask) > 0
+        preds = np.zeros(Xb.shape[1])
+        for i in range(Xb.shape[1]):
+            node = 0
+            while True:
+                f_, t_ = sf[node], thr[node]
+                nxt = lc[node] if Xb[f_, i] <= t_ else rc[node]
+                if nxt < 0:
+                    preds[i] = lv[-(nxt + 1)]
+                    break
+                node = nxt
+        p = 1.0 / (1.0 + np.exp(-4.0 * preds))
+        p = np.clip(p, 1e-6, 1 - 1e-6)
+        return -np.mean(ho_y[m] * np.log(p[m]) +
+                        (1 - ho_y[m]) * np.log(1 - p[m]))
+
+    t_taper = _call(_grow(13, 4, False), bins, grad, hess, mask)
+    t_eg = _call(_grow(13, 4, True), bins, grad, hess, mask)
+    ll_taper = heldout_loss(t_taper)
+    ll_eg = heldout_loss(t_eg)
+    assert ll_eg < ll_taper * 1.02 + 1e-3
+
+
+def test_cegb_lazy_bitpack_matches_bool():
+    """Satellite: the packed uint8 lazy-CEGB bitmap must reproduce the
+    bool path bit-for-bit (same trees, same persistent bitmap)."""
+    bins, grad, hess, mask, y, n = _mk_data(seed=8)
+    sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=0.0,
+                     any_cat=False)
+
+    def grow_lazy(bitpack):
+        return make_wave_grow_fn(
+            num_leaves=9, num_features=F, max_bins=B, max_depth=0,
+            split_params=sp, hist_impl="pallas", any_cat=False,
+            interpret=True, jit=False, wave_size=4,
+            cegb_lazy=(0.01,) * F, exact_endgame=False,
+            lazy_bitpack=bitpack)
+
+    t_p, used_p = _call(grow_lazy(True), bins, grad, hess, mask)
+    t_b, used_b = _call(grow_lazy(False), bins, grad, hess, mask)
+    _assert_same_tree(t_p, t_b)
+    assert used_p.dtype == jnp.uint8 and used_b.dtype == jnp.bool_
+    assert used_p.shape == (F, n // 8)
+    from lightgbm_tpu.learner.wave import _unpack_bits
+    np.testing.assert_array_equal(np.asarray(_unpack_bits(used_p)),
+                                  np.asarray(used_b))

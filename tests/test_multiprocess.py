@@ -24,8 +24,13 @@ _WORKER = textwrap.dedent("""
     rank = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
     tl = sys.argv[4]
     sys.path.insert(0, {repo!r})
+    import os
     import jax
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:  # older jax: XLA_FLAGS is the portable spelling
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=2").strip()
     import lightgbm_tpu as lgb
     lgb.distributed.init(coordinator_address="127.0.0.1:" + port,
                          num_processes=2, process_id=rank)
@@ -99,8 +104,13 @@ _WORKER_PREPART = textwrap.dedent("""
     import sys
     rank = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
     sys.path.insert(0, {repo!r})
+    import os
     import jax
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:  # older jax: XLA_FLAGS is the portable spelling
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=2").strip()
     import lightgbm_tpu as lgb
     lgb.distributed.init(coordinator_address="127.0.0.1:" + port,
                          num_processes=2, process_id=rank)
@@ -158,8 +168,13 @@ _WORKER_PREPART_EXT = textwrap.dedent("""
     rank = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
     mode = sys.argv[4]
     sys.path.insert(0, {repo!r})
+    import os
     import jax
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:  # older jax: XLA_FLAGS is the portable spelling
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=2").strip()
     import lightgbm_tpu as lgb
     lgb.distributed.init(coordinator_address="127.0.0.1:" + port,
                          num_processes=2, process_id=rank)

@@ -110,3 +110,101 @@ def test_spec_strided_subsample_valid_tree():
     p = np.clip(pred[m], 1e-6, 1 - 1e-6)
     ll = -np.mean(y[m] * np.log(p) + (1 - y[m]) * np.log(1 - p))
     assert ll < base
+
+
+# ---------------------------------------------------------------------------
+# Data-parallel speculative ramp (WaveDPStrategy.spec_ok): every shard
+# strides its LOCAL rows and the provisional passes psum their histogram
+# batches, so all shards grow one identical provisional tree verified
+# against the full sharded data.  With stride 1 on both sides the serial
+# and DP spec paths see identical pooled histograms, so the trees must
+# match exactly (quantized: bit-for-bit — integer channel sums psum
+# exactly).
+# ---------------------------------------------------------------------------
+
+
+def _mk_grow_dp(strategy, spec, wave=4, leaves=13, quantized=True):
+    sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=0.0,
+                     any_cat=False)
+    return make_wave_grow_fn(
+        num_leaves=leaves, num_features=6, max_bins=64, max_depth=0,
+        split_params=sp, hist_impl="pallas", any_cat=False, interpret=True,
+        jit=False, wave_size=wave, quantized=quantized, stochastic=False,
+        spec_ramp=spec, spec_tol=0.02, strategy=strategy)
+
+
+def _wrap_dp(grow, mesh, ax):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from lightgbm_tpu.parallel.data_parallel import DataParallelTreeLearner
+    from lightgbm_tpu.parallel.mesh import shard_map_compat
+    return jax.jit(shard_map_compat(
+        lambda X_T, g, h, m, nb, ic, hn, mono, cp, fm: grow(
+            X_T, g, h, m, nb, ic, hn, mono, cp, (), fm),
+        mesh=mesh,
+        in_specs=(P(None, ax), P(ax), P(ax), P(ax), P(), P(), P(), P(),
+                  P(), P()),
+        out_specs=DataParallelTreeLearner._tree_specs(ax)))
+
+
+def test_spec_dp_matches_serial_on_mesh():
+    """8-way row-sharded spec ramp == serial spec ramp, bit-for-bit on
+    the quantized path (stride 1 both sides -> identical pooled
+    histograms -> identical provisional trees and commits)."""
+    from lightgbm_tpu.parallel.data_parallel import WaveDPStrategy
+    from lightgbm_tpu.parallel.mesh import get_mesh
+    mesh = get_mesh(8)
+    ax = mesh.axis_names[0]
+    bins, grad, hess, mask, y, n = _mk_data(n_raw=8 * 4096 - 100)
+    assert n == 8 * 4096
+    t_serial = _call(_mk_grow_dp(None, True), bins, grad, hess, mask)
+    dp = _wrap_dp(_mk_grow_dp(WaveDPStrategy(ax, nshards=8), True),
+                  mesh, ax)
+    nb = jnp.full((6,), 64, jnp.int32)
+    t_dp = dp(bins, grad, hess, mask, nb,
+              jnp.zeros((6,), bool), jnp.zeros((6,), bool),
+              jnp.zeros((6,), jnp.int32), jnp.zeros((6,), jnp.float32),
+              jnp.ones((6,), bool))
+    assert int(t_dp.num_leaves) == int(t_serial.num_leaves)
+    for name in ("split_feature", "threshold_bin", "left_child",
+                 "right_child", "decision_type"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t_dp, name)),
+            np.asarray(getattr(t_serial, name)), err_msg=name)
+    np.testing.assert_array_equal(np.asarray(t_dp.row_leaf),
+                                  np.asarray(t_serial.row_leaf))
+    np.testing.assert_allclose(np.asarray(t_dp.leaf_value),
+                               np.asarray(t_serial.leaf_value),
+                               rtol=0, atol=1e-6)
+    assert int(t_dp.hist_passes) == int(t_serial.hist_passes)
+
+
+def test_spec_dp_one_psum_per_provisional_pass():
+    """The DP spec ramp's only extra collectives are ONE histogram psum
+    per provisional subsample pass (ceil(log2(W)) of them) — counted on
+    the traced program: spec-on minus spec-off psum count == provisional
+    passes + the verification mega-pass - the root pass it replaces."""
+    import math
+    import jax
+    from lightgbm_tpu.parallel.data_parallel import WaveDPStrategy
+    from lightgbm_tpu.parallel.mesh import get_mesh
+    mesh = get_mesh(8)
+    ax = mesh.axis_names[0]
+    bins, grad, hess, mask, y, n = _mk_data(n_raw=8 * 4096 - 100)
+    nb = jnp.full((6,), 64, jnp.int32)
+    args = (bins, grad, hess, mask, nb,
+            jnp.zeros((6,), bool), jnp.zeros((6,), bool),
+            jnp.zeros((6,), jnp.int32), jnp.zeros((6,), jnp.float32),
+            jnp.ones((6,), bool))
+
+    def count_psums(spec):
+        g = _wrap_dp(_mk_grow_dp(WaveDPStrategy(ax, nshards=8), spec),
+                     mesh, ax)
+        txt = str(jax.make_jaxpr(lambda *a: g(*a))(*args))
+        return txt.count("psum")
+
+    w = 4
+    extra = count_psums(True) - count_psums(False)
+    # spec-on adds ceil(log2(W)) provisional psums + 1 mega-pass psum and
+    # drops the root-pass psum
+    assert extra == math.ceil(math.log2(w)), extra
