@@ -193,15 +193,31 @@ def main():
     set_verbosity(-1)
     argv = list(sys.argv[1:])
     json_path = None
+    telemetry_path = None
     if "--json" in argv:
         i = argv.index("--json")
         if i + 1 >= len(argv):
-            sys.exit("usage: run.py [configs...] --json OUT.json")
+            sys.exit("usage: run.py [configs...] --json OUT.json "
+                     "[--telemetry OUT.json]")
         json_path = argv[i + 1]
+        del argv[i:i + 2]
+    if "--telemetry" in argv:
+        i = argv.index("--telemetry")
+        if i + 1 >= len(argv):
+            sys.exit("usage: run.py [configs...] --json OUT.json "
+                     "[--telemetry OUT.json]")
+        telemetry_path = argv[i + 1]
         del argv[i:i + 2]
     which = argv or list(ALL)
     for name in which:
         ALL[name]()
+    if telemetry_path:
+        # metrics registry + last benched config's TrainRecord (per-phase
+        # seconds, hist passes, collective tallies) — the CI artifact
+        from lightgbm_tpu.telemetry import write_snapshot
+        write_snapshot(telemetry_path)
+        print(json.dumps({"written": telemetry_path,
+                          "kind": "telemetry-snapshot-v1"}), flush=True)
     if json_path:
         from lightgbm_tpu.utils.backend import default_backend
         record = {

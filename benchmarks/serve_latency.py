@@ -33,7 +33,7 @@ def main() -> None:
 
     import lightgbm_tpu as lgb
     from lightgbm_tpu.serve import SHAPE_BUCKETS
-    from lightgbm_tpu.serve.stats import percentile as _pct
+    from lightgbm_tpu.telemetry.metrics import percentile as _pct
     from lightgbm_tpu.utils.backend import default_backend
     from lightgbm_tpu.utils.log import set_verbosity
 

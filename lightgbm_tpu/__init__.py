@@ -27,7 +27,7 @@ if "cpu" in _os.environ.get("JAX_PLATFORMS", ""):
         pass  # a backend already initialized; too late to switch
 
 
-from . import distributed
+from . import distributed, telemetry
 from .basic import Booster
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        print_evaluation, record_evaluation, reset_parameter)
@@ -52,6 +52,7 @@ __all__ = ["Dataset", "Booster", "Config", "train", "cv", "CVBooster",
            "early_stopping", "print_evaluation", "log_evaluation",
            "record_evaluation", "reset_parameter", "EarlyStopException",
            "register_log_callback", "set_verbosity", "distributed",
+           "telemetry",
            "plot_importance", "plot_metric", "plot_tree",
            "plot_split_value_histogram", "create_tree_digraph"]
 if _SKLEARN_OK:

@@ -106,6 +106,17 @@ class Booster:
         return new_booster
 
     @property
+    def train_record(self):
+        """Telemetry record of this booster's training run
+        (:class:`~lightgbm_tpu.telemetry.TrainRecord`): per-tree
+        histogram passes, per-phase wall time, trace-time collective
+        tallies, XLA compile events, device-memory watermark.  Call
+        ``.snapshot()`` for a JSON-ready dict; the same record is
+        exported by the serve ``/metrics`` endpoint as the process's
+        last training run."""
+        return self._gbdt.train_record
+
+    @property
     def current_iteration(self) -> int:
         return self._gbdt.current_iteration
 

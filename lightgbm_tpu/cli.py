@@ -195,12 +195,79 @@ def model_to_if_else(gbdt) -> str:
     return "\n".join(lines) + "\n"
 
 
+def run_profile(argv: List[str]) -> int:
+    """``python -m lightgbm_tpu profile [config=train.conf] [key=value ...]``
+
+    Wraps a train or predict run (``config.task``, default train) in a
+    ``jax.profiler.trace`` capture plus a telemetry dump: enables the
+    span tracer and timetag timer, runs the task, then writes
+
+      * ``<profile_dir>/``            — jax profiler capture
+        (TensorBoard / xprof readable), unless ``jax_trace=0``
+      * ``<profile_dir>/host_spans.json`` — host span chrome trace
+      * ``<profile_dir>/telemetry.json``  — metrics registry + the run's
+        TrainRecord (per-phase seconds, hist passes, collective tallies,
+        compile events, memory watermark)
+
+    Keys consumed here: ``profile_dir`` (default ``lgbm_tpu_profile``),
+    ``telemetry_out``, ``host_trace_out``, ``jax_trace`` (1).
+    """
+    import contextlib
+    import os
+    params = parse_cli_args(argv)
+    prof_dir = str(params.pop("profile_dir", "lgbm_tpu_profile"))
+    jax_trace = str(params.pop("jax_trace", "1")).strip().lower() \
+        not in ("0", "false", "no", "off")
+    telemetry_out = str(params.pop("telemetry_out", "") or
+                        os.path.join(prof_dir, "telemetry.json"))
+    host_out = str(params.pop("host_trace_out", "") or
+                   os.path.join(prof_dir, "host_spans.json"))
+    os.makedirs(prof_dir, exist_ok=True)
+    from .telemetry import enable as telemetry_enable
+    from .telemetry import global_tracer, write_snapshot
+    from .utils.timer import global_timer
+    telemetry_enable()
+    global_tracer.enable()
+    global_tracer.clear()
+    global_timer.enable()
+    cfg = Config(params)
+    task = cfg.task or "train"
+    if task not in ("train", "predict", "refit"):
+        log_fatal(f"profile wraps task=train/predict/refit only, got "
+                  f"task={task}")
+    capture = contextlib.nullcontext()
+    if jax_trace:
+        try:
+            import jax.profiler
+            capture = jax.profiler.trace(prof_dir)
+        except Exception as exc:
+            jax_trace = False  # the closing log must not claim a capture
+            log_warning(f"jax.profiler.trace unavailable ({exc}); "
+                        f"profiling without a device capture")
+    with capture:
+        if task == "train":
+            run_train(params, cfg)
+        elif task == "predict":
+            run_predict(params, cfg)
+        else:
+            run_refit(params, cfg)
+    n_spans = global_tracer.export_chrome_trace(host_out)
+    write_snapshot(telemetry_out)
+    log_info(f"profile: telemetry in {telemetry_out}, {n_spans} host "
+             f"spans in {host_out}" +
+             (f", device capture in {prof_dir}" if jax_trace else ""))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "serve":
         # serving verb: python -m lightgbm_tpu serve model.txt [key=value]
         from .serve.server import main as serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == "profile":
+        # profiling verb: python -m lightgbm_tpu profile config=train.conf
+        return run_profile(argv[1:])
     params = parse_cli_args(argv)
     cfg = Config(params)
     task = cfg.task

@@ -26,6 +26,7 @@ from ..dataset import Dataset
 from ..learner.serial import GrownTree, SerialTreeLearner
 from ..metric import Metric, create_metrics
 from ..objective import ObjectiveFunction, create_objective
+from ..telemetry.train_record import TrainRecord, set_last_train_record
 from ..utils.log import log_info, log_warning
 from ..utils.random import host_rng
 from ..utils.timer import FunctionTimer
@@ -167,6 +168,10 @@ class GBDT:
         self.iter_ = 0
         self.init_scores: Optional[np.ndarray] = None
         self.best_iteration = -1
+        # loaded (train-set-less) models keep an inert record so the
+        # eval/snapshot surfaces never need a None check; _init_train
+        # replaces it with the published per-run record
+        self.train_record = TrainRecord(meta={"boosting": self.name})
         if train_set is not None:
             self._init_train(train_set)
 
@@ -345,6 +350,21 @@ class GBDT:
             self.train_metrics = create_metrics(cfg)
             for m in self.train_metrics:
                 m.init(md, self.num_data)
+
+        # telemetry: one TrainRecord per training run (per-tree histogram
+        # passes, per-phase wall time, trace-time collective tallies,
+        # compile events, device-memory watermark).  Purely observational
+        # — reads values the loop already computes — and published as the
+        # process's freshest record so /metrics can export it.
+        self.train_record = TrainRecord(meta={
+            "boosting": self.name,
+            "objective": str(cfg.objective),
+            "tree_learner": str(cfg.tree_learner) or "serial",
+            "num_leaves": int(cfg.num_leaves),
+            "num_data": int(self.num_data),
+            "num_features": int(self.num_features),
+        })
+        set_last_train_record(self.train_record)
 
     def _inner_monotone(self) -> Optional[np.ndarray]:
         """Map config.monotone_constraints (original column indexing, may be
@@ -598,12 +618,14 @@ class GBDT:
                        hess: Optional[jnp.ndarray] = None) -> bool:
         cfg = self.config
         k = self.num_tree_per_iteration
+        rec = self.train_record
         with FunctionTimer("GBDT::train_one_iter"):
             if grad is None or hess is None:
                 if self.objective is None:
                     raise ValueError("no objective: pass gradients explicitly "
                                      "(custom objective path, boosting.h:85)")
-                grad, hess = self.objective.get_gradients(self.score)
+                with rec.phase("gradients"):
+                    grad, hess = self.objective.get_gradients(self.score)
             else:
                 def _coerce(a):
                     a = jnp.asarray(a, jnp.float32)
@@ -674,13 +696,17 @@ class GBDT:
                     # (gradient_discretizer.cpp seeds from config seed)
                     extra["quant_key"] = jax.random.fold_in(
                         jax.random.PRNGKey(cfg.seed), it)
-                grown = self.learner.train(self.X_dev, g, h, mask,
-                                           feature_mask=fmask, **extra)
+                with rec.phase("grow"):
+                    grown = self.learner.train(self.X_dev, g, h, mask,
+                                               feature_mask=fmask, **extra)
                 # full-data histogram passes of the last grown tree (wave
                 # grower; 0 = untracked) — a device scalar, pulled lazily
                 # by bench/diagnostic readers only
                 self.last_hist_passes = grown.hist_passes
-                tree = self._record_tree(grown, cid)
+                rec.add_tree(self.iter_, cid, grown.hist_passes,
+                             grown.num_leaves)
+                with rec.phase("record"):
+                    tree = self._record_tree(grown, cid)
                 if tree is not None and self._cegb_coupled is not None:
                     sf = tree.split_feature[:tree.num_leaves - 1]
                     self._cegb_used[sf[sf >= 0]] = True
@@ -700,6 +726,10 @@ class GBDT:
                 if hasattr(x, "copy_to_host_async"):
                     x.copy_to_host_async()
             self.iter_ += 1
+            if self.iter_ % 16 == 1:
+                # periodic device-memory watermark sample (cheap local
+                # PJRT query; None on backends without memory_stats)
+                rec.note_memory()
             if finished:
                 log_warning("Stopped training because there are no more leaves "
                             "that meet the split requirements")
@@ -918,19 +948,23 @@ class GBDT:
         out = []
         if not self.train_metrics:
             return out
-        score = np.asarray(self.score)
-        for m in self.train_metrics:
-            for name, val, hib in m.eval(score):
-                out.append(("training", name, val, hib))
+        with self.train_record.phase("eval"):
+            score = np.asarray(self.score)
+            for m in self.train_metrics:
+                for name, val, hib in m.eval(score):
+                    out.append(("training", name, val, hib))
         return out
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
         out = []
-        for vi, (vname, _) in enumerate(self.valid_sets):
-            score = np.asarray(self.valid_scores[vi])
-            for m in self.valid_metrics[vi]:
-                for name, val, hib in m.eval(score):
-                    out.append((vname, name, val, hib))
+        if not self.valid_sets:
+            return out
+        with self.train_record.phase("eval"):
+            for vi, (vname, _) in enumerate(self.valid_sets):
+                score = np.asarray(self.valid_scores[vi])
+                for m in self.valid_metrics[vi]:
+                    for name, val, hib in m.eval(score):
+                        out.append((vname, name, val, hib))
         return out
 
     # -- prediction ----------------------------------------------------------

@@ -33,6 +33,7 @@ from ..config import Config
 from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
                               make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
+from ..telemetry.train_record import note_collective
 from .mesh import get_mesh, shard_map_compat
 
 __all__ = ["DataParallelTreeLearner", "DataParallelStrategy"]
@@ -51,6 +52,7 @@ class DataParallelStrategy(CommStrategy):
         self.f_local = f_local
 
     def reduce_sum(self, v):
+        note_collective("data_parallel/masked/leaf_sum", "psum", v)
         return jax.lax.psum(v, self.axis_name)
 
     # reduce_hist stays identity: the pool keeps shard-LOCAL histograms;
@@ -63,6 +65,8 @@ class DataParallelStrategy(CommStrategy):
         r = jax.lax.axis_index(self.axis_name)
         start = r * fb
         # each device reduces + owns one contiguous feature block
+        note_collective("data_parallel/masked/hist_reduce_scatter",
+                        "psum_scatter", hist_local)
         blk = jax.lax.psum_scatter(hist_local, self.axis_name,
                                    scatter_dimension=0, tiled=True)
         sl = lambda a: jax.lax.dynamic_slice(a, (start,), (fb,))
@@ -74,13 +78,16 @@ class DataParallelStrategy(CommStrategy):
             depth, parent_out=parent_out)
         # allreduce-max of the per-block winners with deterministic
         # tie-break on the global feature index (SplitInfo ladder)
+        note_collective("data_parallel/masked/best_gain", "pmax", g)
         gmax = jax.lax.pmax(g, self.axis_name)
         f_glob = start.astype(jnp.int32) + f_loc
         cand = jnp.where(g >= gmax, f_glob, BIG_FEAT)
+        note_collective("data_parallel/masked/best_feature", "pmin", cand)
         f_win = jax.lax.pmin(cand, self.axis_name)
         is_win = (f_glob == f_win) & (g >= gmax)
 
         def bcast(v):
+            note_collective("data_parallel/masked/winner_bcast", "psum", v)
             return jax.lax.psum(
                 jnp.where(is_win, v, jnp.zeros_like(v)), self.axis_name)
 
@@ -120,11 +127,13 @@ class WaveDPStrategy(CommStrategy):
         self.monotone_full = None
 
     def reduce_sum(self, v):
+        note_collective("data_parallel/wave/scalar_sum", "psum", v)
         return jax.lax.psum(v, self.axis_name)
 
     def reduce_max(self, v):
         """Global quantization scales: every shard must see the same max
         (gradient_discretizer scales are global in the reference too)."""
+        note_collective("data_parallel/wave/quant_scale", "pmax", v)
         return jax.lax.pmax(v, self.axis_name)
 
     def shard_key(self, key):
@@ -132,6 +141,11 @@ class WaveDPStrategy(CommStrategy):
         return jax.random.fold_in(key, jax.lax.axis_index(self.axis_name))
 
     def reduce_hist(self, hist):
+        # THE data-parallel collective: one histogram-batch psum per wave
+        # / provisional pass (PERF.md's one-psum-per-pass contract,
+        # asserted on the traced program in tests/test_specramp.py — this
+        # tally counts the same sites at trace time)
+        note_collective("data_parallel/wave/hist_psum", "psum", hist)
         return jax.lax.psum(hist, self.axis_name)
 
 

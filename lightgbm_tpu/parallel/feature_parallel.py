@@ -30,6 +30,7 @@ from ..config import Config
 from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
                               make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
+from ..telemetry.train_record import note_collective
 from .mesh import get_mesh, shard_map_compat
 
 __all__ = ["FeatureParallelTreeLearner", "FeatureParallelStrategy"]
@@ -64,13 +65,16 @@ class FeatureParallelStrategy(CommStrategy):
             hist_local, leaf_sum, nb, ic, hn, fm, params, mono, bound, depth, parent_out=parent_out)
         # global best with deterministic tie-break on the feature index
         # (reference SyncUpGlobalBestSplit allreduce-max)
+        note_collective("feature_parallel/best_gain", "pmax", g)
         gmax = jax.lax.pmax(g, self.axis_name)
         f_glob = start.astype(jnp.int32) + f_loc
         cand = jnp.where(g >= gmax, f_glob, BIG_FEAT)
+        note_collective("feature_parallel/best_feature", "pmin", cand)
         f_win = jax.lax.pmin(cand, self.axis_name)
         is_win = (f_glob == f_win) & (g >= gmax)
 
         def bcast(v):
+            note_collective("feature_parallel/winner_bcast", "psum", v)
             return jax.lax.psum(
                 jnp.where(is_win, v, jnp.zeros_like(v)), self.axis_name)
 
@@ -97,6 +101,7 @@ class FeatureParallelStrategy(CommStrategy):
         lidx = feat_global % self.f_local
         col = jnp.take(X_local, lidx, axis=1).astype(jnp.int32)
         col = jnp.where(r == owner, col, 0)
+        note_collective("feature_parallel/column_bcast", "psum", col)
         return jax.lax.psum(col, self.axis_name)
 
 

@@ -29,6 +29,7 @@ from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
                               make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
 from ..ops.split import NEG_INF, best_split_per_feature
+from ..telemetry.train_record import note_collective
 from .mesh import get_mesh, shard_map_compat
 
 __all__ = ["VotingParallelTreeLearner", "VotingStrategy"]
@@ -46,6 +47,7 @@ class VotingStrategy(CommStrategy):
         self.local_params = local_params  # 1/num_machines-scaled constraints
 
     def reduce_sum(self, v):
+        note_collective("voting_parallel/leaf_sum", "psum", v)
         return jax.lax.psum(v, self.axis_name)
 
     # reduce_hist stays identity: the pool keeps shard-LOCAL histograms and
@@ -64,6 +66,8 @@ class VotingStrategy(CommStrategy):
         gain = jnp.where(feature_mask, fs.gain, NEG_INF)
         # 2. local top-k vote -> allgather (LightSplitInfo allgather :322)
         _, top_ids = jax.lax.top_k(gain, k)
+        note_collective("voting_parallel/vote_allgather", "all_gather",
+                        top_ids)
         all_ids = jax.lax.all_gather(top_ids, self.axis_name)  # (ndev, k)
         # 3. global voting: feature vote counts, top-2k selected
         #    (GlobalVoting :151); ties break toward lower feature index via
@@ -75,7 +79,10 @@ class VotingStrategy(CommStrategy):
                                                            self.num_features))
         # 4. aggregate only the selected features' histograms (the 2k*B psum
         #    replacing the F*B reduce-scatter)
-        hist_sel = jax.lax.psum(hist_local[selected], self.axis_name)
+        sel_local = hist_local[selected]
+        note_collective("voting_parallel/voted_hist_psum", "psum",
+                        sel_local)
+        hist_sel = jax.lax.psum(sel_local, self.axis_name)
         nb = self.num_bins_full[selected]
         ic = self.is_cat_full[selected]
         hn = self.has_nan_full[selected]

@@ -9,7 +9,12 @@ traffic is served without interruption during a rollout.
 Stats survive a swap: the new predictor inherits the old entry's
 ``ModelStats``, so ``/stats`` counters (including recompiles — usually 0
 on a same-shape rollout thanks to the shared compile cache) track the
-NAME, not the version.
+NAME, not the version.  Since the series live in the process-wide
+telemetry registry (labeled ``model=<name>``), they are monotone across
+ModelRegistry instances too — Prometheus counter semantics: a new
+registry serving a previously-served name continues the name's series
+rather than resetting it (scrapers take rates; pass a private
+``metrics_registry`` for isolated counters).
 """
 
 from __future__ import annotations
@@ -19,6 +24,7 @@ from typing import Dict, List, Optional
 
 from .predictor import CompiledPredictor
 from .stats import ModelStats
+from ..telemetry.metrics import default_registry
 from ..utils.log import log_info
 
 __all__ = ["ModelRegistry"]
@@ -27,7 +33,8 @@ __all__ = ["ModelRegistry"]
 class ModelRegistry:
     """Thread-safe named model store with atomic hot-swap and eviction."""
 
-    def __init__(self, max_models: Optional[int] = None) -> None:
+    def __init__(self, max_models: Optional[int] = None,
+                 metrics_registry=None) -> None:
         self._lock = threading.Lock()
         self._models: Dict[str, CompiledPredictor] = {}
         # stats live keyed by NAME, independent of predictor versions, so
@@ -36,6 +43,10 @@ class ModelRegistry:
         self._stats: Dict[str, ModelStats] = {}
         self._versions: Dict[str, int] = {}
         self._max_models = max_models
+        # registry-managed models report into the process-wide metrics
+        # registry (labeled model=<name>) so /metrics covers them
+        self._metrics = (metrics_registry if metrics_registry is not None
+                         else default_registry())
 
     def load(self, name: str, source, warmup: bool = True,
              **predictor_kwargs) -> CompiledPredictor:
@@ -43,7 +54,10 @@ class ModelRegistry:
         before the swap, so in-flight traffic never waits on a compile;
         the swap itself is one dict assignment under the lock."""
         with self._lock:
-            stats = self._stats.setdefault(name, ModelStats())
+            stats = self._stats.get(name)
+            if stats is None:
+                stats = self._stats[name] = ModelStats(
+                    model=name, registry=self._metrics)
         pred = CompiledPredictor(source, stats=stats, **predictor_kwargs)
         if warmup:
             pred.warmup()

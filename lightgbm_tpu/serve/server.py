@@ -5,6 +5,9 @@ Endpoints:
   GET  /models   -> per-model info (trees, classes, buckets, version)
   GET  /stats    -> per-model counters (requests/rows/batches/recompiles/
                     bucket histogram/p50/p99 latency)
+  GET  /metrics  -> Prometheus text format: the process-wide telemetry
+                    registry (serving counters, time tags) plus the last
+                    training run's TrainRecord
   POST /predict  -> {"rows": [[...], ...]} or {"row": [...]}, optional
                     "model" (required only with >1 loaded), "raw_score";
                     returns {"model", "num_rows", "predictions"}
@@ -129,6 +132,18 @@ def _make_handler(server: PredictionServer):
                 self._reply(200, server.registry.info())
             elif self.path == "/stats":
                 self._reply(200, server.registry.stats())
+            elif self.path == "/metrics":
+                # Prometheus text: serving counters (registry-managed
+                # models label themselves into the default metrics
+                # registry) + the last training run's TrainRecord
+                from ..telemetry.export import (PROMETHEUS_CONTENT_TYPE,
+                                                render_prometheus)
+                body = render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 

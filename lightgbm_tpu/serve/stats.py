@@ -1,28 +1,28 @@
-"""Per-model serving counters surfaced on the ``/stats`` endpoint.
+"""Per-model serving counters surfaced on ``/stats`` and ``/metrics``.
 
-Thread-safe by a single lock per model: the counters are bumped on every
-device call (micro-batches, not client requests, are the expensive unit)
-and snapshots are cheap dict copies.  Latency percentiles come from a
+Rebased onto :mod:`lightgbm_tpu.telemetry.metrics`: every counter and the
+latency ring are labeled series (``model=<name>``) in a
+:class:`MetricsRegistry`, so the Prometheus exporter reads the same
+numbers the JSON ``/stats`` endpoint reports.  Registry-managed models
+(the HTTP server path) share the process-wide default registry; an
+anonymous ``ModelStats()`` (e.g. ``Booster.to_predictor()``) gets a
+private registry so unrelated predictors never alias each other's
+series.
+
+The counters are bumped on every device call (micro-batches, not client
+requests, are the expensive unit); latency percentiles come from a
 bounded ring of recent batch latencies — a serving dashboard wants the
-current tail, not the all-time one.
+current tail, not the all-time one.  ``percentile`` is re-exported from
+telemetry.metrics (the single shared implementation).
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Dict, List
+from typing import Dict, Optional
+
+from ..telemetry.metrics import (MetricsRegistry, percentile)
 
 __all__ = ["ModelStats", "percentile"]
-
-
-def percentile(sorted_vals: List[float], p: float) -> float:
-    """Nearest-rank percentile over pre-sorted values (shared by /stats
-    and the latency benchmark so the two never diverge)."""
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1,
-              max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
-    return sorted_vals[idx]
 
 
 class ModelStats:
@@ -31,53 +31,70 @@ class ModelStats:
 
     WINDOW = 4096  # batch latencies kept for percentile estimates
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self.requests = 0      # client-level calls (HTTP or registry)
-        self.rows = 0          # data rows predicted (pre-padding)
-        self.batches = 0       # device calls (post micro-batching)
-        self.recompiles = 0    # XLA traces triggered by novel shapes
-        self.errors = 0
-        self.bucket_hist: Dict[int, int] = {}
-        self._lat_ms: List[float] = []
-        self._lat_pos = 0
+    def __init__(self, model: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None) -> None:
+        self.model = model if model is not None else "default"
+        self._reg = registry if registry is not None else MetricsRegistry()
+        self._requests = self._reg.counter(
+            "serve_requests_total", "client-level predict calls",
+            labels=("model",))
+        self._rows = self._reg.counter(
+            "serve_rows_total", "data rows predicted (pre-padding)",
+            labels=("model",))
+        self._batches = self._reg.counter(
+            "serve_batches_total", "device calls (post micro-batching)",
+            labels=("model",))
+        self._recompiles = self._reg.counter(
+            "serve_recompiles_total", "XLA traces triggered by novel shapes",
+            labels=("model",))
+        self._errors = self._reg.counter(
+            "serve_errors_total", "failed predict calls", labels=("model",))
+        self._bucket = self._reg.counter(
+            "serve_batches_by_bucket_total", "device calls per shape bucket",
+            labels=("model", "bucket"))
+        self._latency = self._reg.histogram(
+            "serve_batch_latency_ms", "device-call latency",
+            labels=("model",), window=self.WINDOW)
+        # touch this model's series so a fresh model scrapes as 0 rather
+        # than being absent until its first request
+        for c in (self._requests, self._rows, self._batches,
+                  self._recompiles, self._errors):
+            c.inc(0, model=self.model)
 
     def record_request(self, n_rows: int = 1) -> None:
-        with self._lock:
-            self.requests += 1
+        self._requests.inc(1, model=self.model)
 
     def record_error(self) -> None:
-        with self._lock:
-            self.errors += 1
+        self._errors.inc(1, model=self.model)
 
     def record_batch(self, n_rows: int, bucket: int, latency_ms: float,
                      recompiled: bool) -> None:
-        with self._lock:
-            self.batches += 1
-            self.rows += int(n_rows)
-            self.bucket_hist[bucket] = self.bucket_hist.get(bucket, 0) + 1
-            if recompiled:
-                self.recompiles += 1
-            if len(self._lat_ms) < self.WINDOW:
-                self._lat_ms.append(latency_ms)
-            else:
-                self._lat_ms[self._lat_pos] = latency_ms
-                self._lat_pos = (self._lat_pos + 1) % self.WINDOW
+        m = self.model
+        self._batches.inc(1, model=m)
+        self._rows.inc(int(n_rows), model=m)
+        self._bucket.inc(1, model=m, bucket=str(int(bucket)))
+        if recompiled:
+            self._recompiles.inc(1, model=m)
+        self._latency.observe(latency_ms, model=m)
 
     def snapshot(self) -> Dict:
-        with self._lock:
-            lat = sorted(self._lat_ms)
-            return {
-                "requests": self.requests,
-                "rows": self.rows,
-                "batches": self.batches,
-                "recompiles": self.recompiles,
-                "errors": self.errors,
-                "bucket_histogram": {str(k): v for k, v in
-                                     sorted(self.bucket_hist.items())},
-                "latency_ms": {
-                    "p50": round(percentile(lat, 50.0), 4),
-                    "p99": round(percentile(lat, 99.0), 4),
-                    "window": len(lat),
-                },
-            }
+        m = self.model
+        bucket_hist = {}
+        for lbl, val in self._bucket.series():
+            if lbl.get("model") == m and val:
+                bucket_hist[int(lbl["bucket"])] = int(val)
+        lat = self._latency.values_of(model=m)
+        return {
+            "requests": int(self._requests.value(model=m)),
+            "rows": int(self._rows.value(model=m)),
+            "batches": int(self._batches.value(model=m)),
+            "recompiles": int(self._recompiles.value(model=m)),
+            "errors": int(self._errors.value(model=m)),
+            "bucket_histogram": {str(k): v for k, v in
+                                 sorted(bucket_hist.items())},
+            "latency_ms": {
+                "p50": round(percentile(lat, 50.0), 4),
+                "p99": round(percentile(lat, 99.0), 4),
+                "window": len(lat),
+            },
+        }

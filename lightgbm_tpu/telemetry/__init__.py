@@ -1,0 +1,46 @@
+"""lightgbm_tpu.telemetry — unified observability for training and serving.
+
+Four pieces (see the module docstrings for depth):
+
+  * :mod:`.metrics` — the process-wide :class:`MetricsRegistry` of
+    counters / gauges / windowed histograms with labeled series;
+    ``serve/stats.ModelStats`` and ``utils/timer.global_timer`` report
+    into it.
+  * :mod:`.trace` — hierarchical ``span("tree/wave/psum")`` host spans
+    paired with ``jax.profiler.TraceAnnotation``, chrome-trace export;
+    near-zero overhead when disabled.
+  * :mod:`.train_record` — the per-run :class:`TrainRecord` (histogram
+    passes per tree, trace-time collective counts/bytes, XLA compile
+    events, device-memory watermark, per-phase wall time), accumulated
+    by ``models/gbdt.py`` and surfaced as ``Booster.train_record``.
+  * :mod:`.export` — Prometheus text / JSON renderers; the serve HTTP
+    server mounts ``GET /metrics``; ``python -m lightgbm_tpu profile``
+    wraps a run in a ``jax.profiler.trace`` capture plus a dump.
+
+Master switch: ``enabled()`` / ``enable()`` / ``disable()`` (env
+``LGBM_TPU_TELEMETRY=0`` to opt out).  Telemetry-on and telemetry-off
+training produce bit-identical models — accumulation only observes.
+"""
+
+from ._config import enable, disable, enabled
+from .metrics import (Counter, Gauge, MetricsRegistry, SlidingWindow,
+                      WindowedHistogram, default_registry, percentile)
+from .trace import Tracer, global_tracer, span
+from .train_record import (TrainRecord, collectives_reset,
+                           collectives_snapshot, device_memory_peak,
+                           last_train_record, note_collective,
+                           set_last_train_record)
+from .export import (PROMETHEUS_CONTENT_TYPE, render_json,
+                     render_prometheus, write_snapshot)
+
+__all__ = [
+    "enable", "disable", "enabled",
+    "Counter", "Gauge", "MetricsRegistry", "SlidingWindow",
+    "WindowedHistogram", "default_registry", "percentile",
+    "Tracer", "global_tracer", "span",
+    "TrainRecord", "collectives_reset", "collectives_snapshot",
+    "device_memory_peak", "last_train_record", "note_collective",
+    "set_last_train_record",
+    "PROMETHEUS_CONTENT_TYPE", "render_json", "render_prometheus",
+    "write_snapshot",
+]

@@ -1,0 +1,344 @@
+"""Per-training-run instrumentation: the ``TrainRecord``.
+
+The communication-efficient parallel-GBDT literature (Meng et al. 2016;
+Mitchell & Frank 2017) argues entirely through per-phase time and
+per-pass communication volume; this repo used to reconstruct those
+numbers by hand in PERF.md.  A ``TrainRecord`` accumulates them as the
+boosting loop runs:
+
+  * per-tree full-data histogram passes (``GrownTree.hist_passes``, the
+    counter already asserted by tests/test_endgame.py) and leaf counts —
+    kept as device scalars and pulled in batched, lazy fetches so the
+    async dispatch pipeline never stalls;
+  * collective count and psum'd bytes, tallied at the
+    ``parallel/*.py`` collective call sites.  Those sites execute at
+    TRACE time (the growers are jit/shard_map programs), so the tally
+    is per *traced program* — the same quantity
+    tests/test_specramp.py asserts by counting ``psum`` ops in the
+    jaxpr — and a run that triggers no retrace adds nothing;
+  * XLA compile/retrace events via a ``jax.monitoring`` listener;
+  * device-memory watermark via ``device.memory_stats()`` where the
+    backend provides it (TPU does; CPU returns None);
+  * per-phase wall time (gradients / grow / record / eval).
+
+Accumulation is gated by ``telemetry.enabled()`` and purely
+observational: it reads values training already computed, so
+telemetry-on and telemetry-off training produce bit-identical models
+(asserted in tests/test_telemetry.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import _config
+from .trace import span
+
+__all__ = ["TrainRecord", "note_collective", "collectives_snapshot",
+           "collectives_reset", "last_train_record",
+           "set_last_train_record", "device_memory_peak"]
+
+
+# ---------------------------------------------------------------------------
+# Collective tally — incremented at TRACE time by the parallel strategies
+# ---------------------------------------------------------------------------
+
+_coll_lock = threading.Lock()
+# site -> {"op": str, "count": int, "bytes": int}
+_collectives: Dict[str, Dict[str, Any]] = {}
+
+
+def note_collective(site: str, op: str, value) -> None:
+    """Record one collective call site being traced.
+
+    ``value`` is the operand (concrete array or tracer — both expose
+    shape/dtype).  Called from inside jit/shard_map tracing, so this
+    runs once per traced program, never per executed step; runtime cost
+    of the compiled program is zero."""
+    if not _config.enabled():
+        return
+    try:
+        nbytes = 1
+        for d in value.shape:
+            nbytes *= int(d)
+        nbytes *= value.dtype.itemsize
+    except Exception:
+        nbytes = 0
+    with _coll_lock:
+        rec = _collectives.get(site)
+        if rec is None:
+            rec = _collectives[site] = {"op": op, "count": 0, "bytes": 0}
+        rec["count"] += 1
+        rec["bytes"] += int(nbytes)
+
+
+def collectives_snapshot() -> Dict[str, Dict[str, Any]]:
+    with _coll_lock:
+        return {k: dict(v) for k, v in _collectives.items()}
+
+
+def collectives_reset() -> None:
+    with _coll_lock:
+        _collectives.clear()
+
+
+# ---------------------------------------------------------------------------
+# XLA compile / retrace events via jax.monitoring
+# ---------------------------------------------------------------------------
+
+_mon_lock = threading.Lock()
+_mon_counts: Dict[str, int] = {}
+_mon_secs: Dict[str, float] = {}
+_mon_registered = False
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if not _config.enabled():
+        return
+    with _mon_lock:
+        _mon_counts[event] = _mon_counts.get(event, 0) + 1
+
+
+def _on_event_duration(event: str, duration: float, **kwargs) -> None:
+    if not _config.enabled():
+        return
+    with _mon_lock:
+        _mon_counts[event] = _mon_counts.get(event, 0) + 1
+        _mon_secs[event] = _mon_secs.get(event, 0.0) + float(duration)
+
+
+def _ensure_monitoring() -> None:
+    """Register the jax.monitoring listeners once per process (listeners
+    cannot be unregistered individually, so the callbacks themselves
+    check the telemetry switch)."""
+    global _mon_registered
+    if _mon_registered:
+        return
+    with _mon_lock:
+        if _mon_registered:
+            return
+        try:
+            import jax.monitoring
+            jax.monitoring.register_event_listener(_on_event)
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_event_duration)
+        except Exception:
+            pass  # older jax without monitoring: compile events stay empty
+        _mon_registered = True
+
+
+def _monitoring_snapshot():
+    with _mon_lock:
+        return dict(_mon_counts), dict(_mon_secs)
+
+
+_COMPILE_MARKERS = ("compil", "trace", "jit")
+
+
+def _compile_events(counts: Dict[str, int]) -> Dict[str, int]:
+    return {k: v for k, v in counts.items()
+            if any(m in k.lower() for m in _COMPILE_MARKERS)}
+
+
+# ---------------------------------------------------------------------------
+# Device memory watermark
+# ---------------------------------------------------------------------------
+
+def device_memory_peak() -> Optional[int]:
+    """Max over devices of the backend's peak/in-use byte counter, or
+    None when the backend exposes no memory_stats (XLA:CPU)."""
+    try:
+        import jax
+        peak = None
+        for d in jax.devices():
+            stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+            if not stats:
+                continue
+            v = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+            if v is not None:
+                peak = max(int(v), peak or 0)
+        return peak
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# TrainRecord
+# ---------------------------------------------------------------------------
+
+class _Phase:
+    __slots__ = ("_rec", "_name", "_span", "_t0")
+
+    def __init__(self, rec: "TrainRecord", name: str) -> None:
+        self._rec = rec
+        self._name = name
+
+    def __enter__(self):
+        self._span = span("train/" + self._name)
+        self._span.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        self._span.__exit__(*exc)
+        with self._rec._lock:
+            ph = self._rec._phase_s
+            ph[self._name] = ph.get(self._name, 0.0) + dt
+            cn = self._rec._phase_n
+            cn[self._name] = cn.get(self._name, 0) + 1
+        return False
+
+
+class _NoopPhase:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_PHASE = _NoopPhase()
+
+_FLUSH_EVERY = 256  # pending device scalars pulled per batched fetch
+
+
+class TrainRecord:
+    """Accumulates one training run's observability record.
+
+    Created by ``GBDT._init_train`` and surfaced as
+    ``Booster.train_record`` (a dict snapshot); the freshest record is
+    also published process-wide for the ``/metrics`` exporter."""
+
+    def __init__(self, meta: Optional[Dict[str, Any]] = None) -> None:
+        self._lock = threading.Lock()
+        self.meta = dict(meta or {})
+        self._t_created = time.perf_counter()
+        self._phase_s: Dict[str, float] = {}
+        self._phase_n: Dict[str, int] = {}
+        # per-tree device scalars pending a batched host pull
+        self._pending: List[tuple] = []   # (iteration, class_id, hp, nl)
+        self._trees: List[Dict[str, int]] = []
+        self._mem_peak: Optional[int] = None
+        self._coll_base = collectives_snapshot()
+        _ensure_monitoring()
+        self._mon_base, self._mon_secs_base = _monitoring_snapshot()
+
+    # -- accumulation (boosting loop) ------------------------------------
+    def phase(self, name: str):
+        """``with record.phase("grow"):`` — adds wall time to the named
+        phase and opens a ``train/<name>`` telemetry span."""
+        if not _config.enabled():
+            return _NOOP_PHASE
+        return _Phase(self, name)
+
+    def add_tree(self, iteration: int, class_id: int, hist_passes,
+                 num_leaves) -> None:
+        """Record one grown tree.  ``hist_passes``/``num_leaves`` may be
+        device scalars; they are NOT synced here — batches are pulled
+        lazily so the async dispatch pipeline keeps flowing."""
+        if not _config.enabled():
+            return
+        with self._lock:
+            self._pending.append((int(iteration), int(class_id),
+                                  hist_passes, num_leaves))
+            flush = len(self._pending) >= _FLUSH_EVERY
+        if flush:
+            self._flush()
+
+    def note_memory(self) -> None:
+        if not _config.enabled():
+            return
+        peak = device_memory_peak()
+        if peak is not None:
+            with self._lock:
+                self._mem_peak = max(peak, self._mem_peak or 0)
+
+    def _flush(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return
+        try:
+            import jax
+            vals = jax.device_get([(p[2], p[3]) for p in pending])
+        except Exception:
+            vals = [(p[2], p[3]) for p in pending]
+        rows = [{"iteration": it, "class_id": cid,
+                 "hist_passes": int(hp), "num_leaves": int(nl)}
+                for (it, cid, _, _), (hp, nl) in zip(pending, vals)]
+        with self._lock:
+            self._trees.extend(rows)
+
+    # -- snapshot --------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready record; pulls any pending device scalars (one
+        batched fetch) and diffs the process-wide compile/collective
+        tallies against this record's baseline."""
+        self._flush()
+        self.note_memory()  # final watermark: periodic samples miss the tail
+        with self._lock:
+            trees = list(self._trees)
+            phase_s = dict(self._phase_s)
+            phase_n = dict(self._phase_n)
+            mem_peak = self._mem_peak
+            elapsed = time.perf_counter() - self._t_created
+        trees.sort(key=lambda r: (r["iteration"], r["class_id"]))
+        coll_now = collectives_snapshot()
+        coll = {}
+        for site, rec in coll_now.items():
+            base = self._coll_base.get(site, {"count": 0, "bytes": 0})
+            dc = rec["count"] - base["count"]
+            db = rec["bytes"] - base["bytes"]
+            if dc > 0:
+                coll[site] = {"op": rec["op"], "count": dc, "bytes": db}
+        mon_counts, mon_secs = _monitoring_snapshot()
+        events = {}
+        for k, v in _compile_events(mon_counts).items():
+            d = v - self._mon_base.get(k, 0)
+            if d > 0:
+                events[k] = d
+        secs = {}
+        for k, v in mon_secs.items():
+            d = v - self._mon_secs_base.get(k, 0.0)
+            if d > 1e-9 and any(m in k.lower() for m in _COMPILE_MARKERS):
+                secs[k] = round(d, 6)
+        hp = [r["hist_passes"] for r in trees]
+        return {
+            "schema": "train-record-v1",
+            "meta": dict(self.meta),
+            "num_trees": len(trees),
+            "trees": trees,
+            "hist_passes_total": sum(hp),
+            "hist_passes_last": hp[-1] if hp else 0,
+            "phase_seconds": {k: round(v, 6) for k, v in phase_s.items()},
+            "phase_calls": phase_n,
+            "collectives_traced": coll,
+            "compile_events": events,
+            "compile_seconds": secs,
+            "device_memory_peak_bytes": mem_peak,
+            "elapsed_seconds": round(elapsed, 6),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Process-wide "last training run" handle (the /metrics exporter reads it)
+# ---------------------------------------------------------------------------
+
+_last_lock = threading.Lock()
+_last_record: Optional[TrainRecord] = None
+
+
+def set_last_train_record(rec: Optional[TrainRecord]) -> None:
+    global _last_record
+    with _last_lock:
+        _last_record = rec
+
+
+def last_train_record() -> Optional[TrainRecord]:
+    with _last_lock:
+        return _last_record

@@ -9,8 +9,6 @@ import sys
 import threading
 from typing import Callable, Optional
 
-_state = threading.local()
-
 LEVEL_FATAL = -1
 LEVEL_WARNING = 0
 LEVEL_INFO = 1
@@ -18,6 +16,10 @@ LEVEL_DEBUG = 2
 
 _verbosity = LEVEL_INFO
 _callback: Optional[Callable[[str], None]] = None
+# serializes sink swaps against emission so a message never lands on a
+# half-replaced callback and concurrent writers can't interleave lines;
+# reentrant so a callback may itself log or swap the sink
+_emit_lock = threading.RLock()
 
 
 def set_verbosity(level: int) -> None:
@@ -25,17 +27,23 @@ def set_verbosity(level: int) -> None:
     _verbosity = level
 
 
+def get_verbosity() -> int:
+    return _verbosity
+
+
 def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:
     """Reference c_api.h:54 LGBM_RegisterLogCallback."""
     global _callback
-    _callback = cb
+    with _emit_lock:
+        _callback = cb
 
 
 def _emit(msg: str) -> None:
-    if _callback is not None:
-        _callback(msg + "\n")
-    else:
-        sys.stdout.write(msg + "\n")
+    with _emit_lock:
+        if _callback is not None:
+            _callback(msg + "\n")
+        else:
+            sys.stdout.write(msg + "\n")
 
 
 def log_debug(msg: str) -> None:

@@ -2,7 +2,14 @@
 ``Common::Timer`` + common.h:995 RAII ``FunctionTimer``; compiled in with
 USE_TIMETAG).  Here always available, enabled via env LGBM_TPU_TIMETAG=1 or
 ``global_timer.enable()``; pairs with ``jax.profiler`` named scopes for
-device-side traces."""
+device-side traces.
+
+Rebased onto the telemetry registry: every ``stop`` also lands in the
+process-wide :class:`~lightgbm_tpu.telemetry.MetricsRegistry` as
+``timetag_seconds_total{tag=...}`` / ``timetag_calls_total{tag=...}``,
+so the ``/metrics`` endpoint and the exit report can never disagree.
+``telemetry.span`` drives this timer when it is enabled, which makes
+``LGBM_TPU_TIMETAG=1`` the zero-code compat shim for span timings."""
 
 from __future__ import annotations
 
@@ -19,6 +26,8 @@ class Timer:
         self._count: Dict[str, int] = collections.defaultdict(int)
         self._start: Dict[str, float] = {}
         self.enabled = os.environ.get("LGBM_TPU_TIMETAG", "0") == "1"
+        self._reg_secs = None
+        self._reg_calls = None
 
     def enable(self) -> None:
         self.enabled = True
@@ -28,9 +37,34 @@ class Timer:
             self._start[name] = time.perf_counter()
 
     def stop(self, name: str) -> None:
-        if self.enabled and name in self._start:
-            self._acc[name] += time.perf_counter() - self._start.pop(name)
-            self._count[name] += 1
+        if not self.enabled:
+            return
+        if name not in self._start:
+            # a stop with no matching start is a probe bug; surface it
+            # loudly under debug verbosity instead of passing silently
+            from .log import LEVEL_DEBUG, get_verbosity
+            if get_verbosity() >= LEVEL_DEBUG:
+                raise RuntimeError(
+                    f"Timer.stop({name!r}) without a matching start()")
+            return
+        dt = time.perf_counter() - self._start.pop(name)
+        self._acc[name] += dt
+        self._count[name] += 1
+        self._publish(name, dt)
+
+    def _publish(self, name: str, dt: float) -> None:
+        if self._reg_secs is None:
+            # deferred import: telemetry.trace imports this module
+            from ..telemetry.metrics import default_registry
+            reg = default_registry()
+            self._reg_secs = reg.counter(
+                "timetag_seconds_total",
+                "accumulated wall time per timetag", labels=("tag",))
+            self._reg_calls = reg.counter(
+                "timetag_calls_total",
+                "start/stop pairs per timetag", labels=("tag",))
+        self._reg_secs.inc(dt, tag=name)
+        self._reg_calls.inc(1, tag=name)
 
     def report(self) -> str:
         lines = [f"{name} = {secs:.6f}s (n={self._count[name]})"
@@ -39,7 +73,13 @@ class Timer:
 
     def print_at_exit(self) -> None:
         if self.enabled and self._acc:
-            print("[LightGBM-TPU] time tags:\n" + self.report())
+            # routed through the log sink so a registered callback
+            # captures it, but NOT verbosity-filtered: the user enabled
+            # the timetag explicitly (the reference prints timetags
+            # unconditionally under USE_TIMETAG), and training configs
+            # routinely set verbosity=-1
+            from .log import _emit
+            _emit("[LightGBM-TPU] [Info] time tags:\n" + self.report())
 
 
 global_timer = Timer()

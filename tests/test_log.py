@@ -1,0 +1,119 @@
+"""utils/log tests: callback redirect (the LGBM_RegisterLogCallback
+analog), verbosity filtering, and thread-safety of the module-level
+sink/verbosity state."""
+
+import threading
+
+import pytest
+
+from lightgbm_tpu.utils import log
+
+
+@pytest.fixture(autouse=True)
+def _restore_log_state():
+    old_v = log.get_verbosity()
+    yield
+    log.set_verbosity(old_v)
+    log.register_log_callback(None)
+
+
+def test_callback_redirect(capsys):
+    lines = []
+    log.register_log_callback(lines.append)
+    log.set_verbosity(log.LEVEL_INFO)
+    log.log_info("hello")
+    assert lines == ["[LightGBM-TPU] [Info] hello\n"]
+    assert capsys.readouterr().out == ""  # redirected, not printed
+    # unregistering restores stdout emission
+    log.register_log_callback(None)
+    log.log_info("back on stdout")
+    assert "back on stdout" in capsys.readouterr().out
+    assert len(lines) == 1
+
+
+def test_callback_sees_all_levels(capsys):
+    lines = []
+    log.register_log_callback(lines.append)
+    log.set_verbosity(log.LEVEL_DEBUG)
+    log.log_debug("d")
+    log.log_info("i")
+    log.log_warning("w")
+    assert [l.split("] ")[1].rstrip("\n") for l in lines] == \
+        ["[Debug", "[Info", "[Warning"]
+    assert capsys.readouterr().out == ""
+
+
+def test_reentrant_callback_does_not_deadlock(capsys):
+    """A callback may itself log or swap the sink (the one-shot
+    self-unregistering pattern) — the emit lock must be reentrant."""
+    seen = []
+
+    def one_shot(msg):
+        seen.append(msg)
+        log.register_log_callback(None)   # self-unregister under emit
+        log.log_info("from inside callback")  # re-entrant emit
+
+    log.set_verbosity(log.LEVEL_INFO)
+    log.register_log_callback(one_shot)
+    log.log_info("first")
+    assert seen == ["[LightGBM-TPU] [Info] first\n"]
+    out = capsys.readouterr().out
+    assert "from inside callback" in out  # landed on stdout post-swap
+
+
+def test_verbosity_filtering(capsys):
+    log.set_verbosity(log.LEVEL_WARNING)
+    log.log_info("hidden info")
+    log.log_debug("hidden debug")
+    log.log_warning("shown warning")
+    out = capsys.readouterr().out
+    assert "hidden" not in out and "shown warning" in out
+    # below warning: everything but fatal is silent
+    log.set_verbosity(log.LEVEL_FATAL)
+    log.log_warning("suppressed")
+    assert capsys.readouterr().out == ""
+    with pytest.raises(log.LightGBMError, match="boom"):
+        log.log_fatal("boom")
+    # debug verbosity opens the debug channel
+    log.set_verbosity(log.LEVEL_DEBUG)
+    log.log_debug("now visible")
+    assert "[Debug] now visible" in capsys.readouterr().out
+
+
+def test_thread_safety_of_module_state():
+    """Concurrent emitters + concurrent sink/verbosity pokes: every
+    message must arrive exactly once, as one intact line, on the
+    callback that was registered."""
+    lines = []
+    log.register_log_callback(lines.append)
+    log.set_verbosity(log.LEVEL_INFO)
+    n_threads, n_msgs = 8, 200
+    barrier = threading.Barrier(n_threads)
+    errors = []
+
+    def worker(t):
+        try:
+            barrier.wait()
+            for i in range(n_msgs):
+                log.log_info(f"t{t}-m{i}")
+                if i % 50 == 25:
+                    # racing state pokes must not drop or tear messages
+                    log.set_verbosity(log.LEVEL_INFO)
+        except Exception as exc:  # pragma: no cover - failure reporting
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(lines) == n_threads * n_msgs
+    # intact lines: exactly one prefix and one newline each
+    assert all(l.count("[LightGBM-TPU]") == 1 and l.endswith("\n")
+               for l in lines)
+    # nothing lost per thread
+    for t in range(n_threads):
+        got = [l for l in lines if f"t{t}-m" in l]
+        assert len(got) == n_msgs

@@ -17,6 +17,8 @@ import textwrap
 import numpy as np
 import pytest
 
+from conftest import FP_SKIP
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _WORKER = textwrap.dedent("""
@@ -63,7 +65,8 @@ def _free_port():
     return port
 
 
-@pytest.mark.parametrize("tree_learner", ["data", "feature", "voting"])
+@pytest.mark.parametrize("tree_learner", [
+    "data", pytest.param("feature", marks=FP_SKIP), "voting"])
 def test_two_process_training_matches_serial(tmp_path, tree_learner):
     script = str(tmp_path / "worker.py")
     with open(script, "w") as fh:
