@@ -344,7 +344,8 @@ class Dataset:
         shards)."""
         from . import distributed as _dist
         import jax
-        rb = 4096 if jax.default_backend() == "tpu" else 1
+        from .utils.backend import default_backend
+        rb = 4096 if default_backend() == "tpu" else 1
         quantum = max(1, jax.local_device_count()) * rb
         lens = _dist.allgather_host(np.asarray([n_local], np.int64)).ravel()
         pad_to = int(-(-int(lens.max()) // quantum) * quantum)

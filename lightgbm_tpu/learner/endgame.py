@@ -13,7 +13,10 @@ id — Tree::Split).  Three growers share this selector:
   pass precomputes the frontier candidates' smaller-child histograms and
   the remaining splits are committed in the exact sequential order by a
   ``lax.while_loop`` over the cached bank — zero further full-data
-  passes in the common case;
+  passes in the common case.  Under the DP reduce-scatter merge
+  (``tpu_dp_hist_scatter``) the cached bank and every per-commit
+  2-child rescan operate on this shard's feature slice, with one winner
+  exchange per commit recombining the block-local bests;
 * degenerately, every ``wave_size=1`` wave.
 
 Leaves are encoded in child slots as ``-(leaf+1)``; at any moment exactly

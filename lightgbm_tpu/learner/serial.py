@@ -548,9 +548,10 @@ def resolve_hist_impl(config: Config, parallel: bool = False,
     path has no feature-major layout.  The WAVE grower keeps the Pallas
     leaf-batched kernel in both serial and shard_map form (``wave=True``;
     it owns the (F, N) layout natively)."""
+    from ..utils.backend import default_backend
     impl = config.tpu_histogram_impl
     if impl == "auto":
-        if jax.default_backend() == "tpu":
+        if default_backend() == "tpu":
             impl = "onehot" if (parallel and not wave) else "pallas"
         else:
             impl = "segment"

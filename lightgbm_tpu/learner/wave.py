@@ -123,12 +123,21 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
     of the Pallas row block when hist_impl == 'pallas'.
 
     ``strategy`` hooks the data-parallel mesh in: under shard_map with
-    row-sharded X_T/grad/hess, ``strategy.reduce_hist`` psums each wave's
-    (W, G, Bb, 3) histogram batch and ``reduce_sum`` the root totals —
-    ONE collective per wave instead of the per-split reduce-scatter of
-    the sequential DP learner (data_parallel_tree_learner.cpp:155-173's
-    pattern amortized over up to 25 splits).  Candidate scans then run
-    replicated on every shard with no further communication.
+    row-sharded X_T/grad/hess, each wave's (W, G, Bb, 3) histogram batch
+    is merged with ONE collective (instead of the per-split
+    reduce-scatter of the sequential DP learner,
+    data_parallel_tree_learner.cpp:155-173's pattern amortized over up
+    to 25 splits), in one of two modes:
+
+    * ``strategy.reduce_hist`` (psum) — every shard holds the full
+      merged batch and the candidate scans run replicated with no
+      further communication;
+    * ``strategy.hist_scatter`` — ``reduce_hist_scatter`` psum_scatters
+      the batch over a padded feature-block axis: each shard keeps only
+      its G/k block, scans that slice (per-feature operands sliced to
+      match), and an O(W*k) winner exchange (``exchange_collectives``)
+      recombines the block-local bests into the global per-leaf winners
+      — 1/k the wire residency and scan FLOPs, identical results.
     """
     L = num_leaves
     F = num_features
@@ -233,6 +242,33 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             nl_sim += 1
         if cur:
             forced_waves.append(cur)
+    # Feature-sliced reduce-scatter histogram merge (all static): under a
+    # row-sharded WaveDPStrategy with ``hist_scatter``, each wave's
+    # (W, G, Bb, 3) batch is psum_scatter'd over a padded feature-block
+    # axis — every shard materializes only its G/k slice of the merged
+    # histogram, runs the candidate scan on that slice, and an O(W*k)
+    # winner exchange (pmax gain / pmin global feature / psum'd payload)
+    # picks the global best split per frontier leaf.  This is the
+    # reference DP learner's ReduceScatter refinement
+    # (data_parallel_tree_learner.cpp:155-173, network.h:164) amortized
+    # over the wave's channels: 1/k the ICI residency of the full-batch
+    # psum and 1/k the scan FLOPs, with bit-identical results (the
+    # scattered block equals the same slice of the psum'd batch).  Gated
+    # off categorical shapes (the sorted-subset search's static cat_idx
+    # positions index full feature space), EFB (bundle->feature expansion
+    # needs the whole bundle axis), forced splits (child sums are read
+    # from the parent's pooled histogram at an arbitrary global feature)
+    # and lazy CEGB (its per-(feature, child) unused counts would add a
+    # full-F psum per wave) — those configs keep the full-batch psum.
+    k_sc = int(getattr(strategy, "nshards", 1) or 1)
+    use_scatter = (bool(getattr(strategy, "hist_scatter", False)) and
+                   k_sc > 1 and not any_cat and not use_efb and
+                   not use_lazy and not forced_waves)
+    if use_scatter:
+        FP_SC = -(-G // k_sc) * k_sc   # feature axis padded to k blocks
+        FB_SC = FP_SC // k_sc          # features owned per shard
+        F_PAD_SC = FP_SC - G
+    G_loc = FB_SC if use_scatter else G   # this shard's histogram width
     if use_bynode:
         import math as _math
         kcnt = max(1, int(_math.ceil(F * sp.feature_fraction_bynode)))
@@ -282,6 +318,59 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             strat.contri_full = jnp.asarray(feature_contri, jnp.float32)
         nb_full, ic_full, hn_full = num_bins, is_cat, has_nan
 
+        if use_scatter:
+            # this shard's feature block [f_start, f_start + FB_SC): the
+            # scan sees sliced per-feature descriptors; winner feature
+            # indices are remapped to global space in the exchange
+            f_start = (jax.lax.axis_index(strat.axis_name) *
+                       FB_SC).astype(jnp.int32)
+
+            def _slf(a, fill):
+                """(F,) per-feature array -> this shard's (FB_SC,) block
+                (padded features get inert ``fill`` values)."""
+                if F_PAD_SC:
+                    a = jnp.concatenate(
+                        [a, jnp.full((F_PAD_SC,), fill, a.dtype)])
+                return jax.lax.dynamic_slice_in_dim(a, f_start, FB_SC, 0)
+
+            def _slf2(a, fill):
+                """(..., F) batch -> (..., FB_SC) block slice."""
+                if F_PAD_SC:
+                    a = jnp.concatenate(
+                        [a, jnp.full(a.shape[:-1] + (F_PAD_SC,), fill,
+                                     a.dtype)], axis=-1)
+                return jax.lax.dynamic_slice_in_dim(a, f_start, FB_SC,
+                                                    a.ndim - 1)
+
+            nb_sc = _slf(nb_full, 1)      # 1-bin pads: never splittable
+            ic_sc = _slf(ic_full, False)
+            hn_sc = _slf(hn_full, False)
+            mono_sc = _slf(monotone, 0)
+            xmax_sc, xmin_sc, xsum_sc = strat.exchange_collectives()
+
+            def _exchange(cands):
+                """Combine per-shard block-local best candidates into the
+                global per-leaf winners: pmax of the gain, pmin of the
+                global feature index among gain-achieving blocks (the
+                same lowest-feature tie-break a full-space argmax
+                applies), then one psum of the winner's packed payload
+                (bin, default_left, left/right sums) — O(k) floats per
+                leaf, the SplitInfo allreduce-max analog.  ``member``
+                stays block-local: categorical shapes never take the
+                scatter path, so it is identically all-False."""
+                g, f_loc, b, dl, ls, rs, member = cands
+                gmax = xmax_sc(g)
+                f_glob = f_start + f_loc
+                cf = jnp.where(g >= gmax, f_glob, jnp.int32(2 ** 30))
+                f_win = xmin_sc(cf)
+                is_win = (f_glob == f_win) & (g >= gmax)
+                pack = jnp.concatenate([
+                    b.astype(jnp.float32)[:, None],
+                    dl.astype(jnp.float32)[:, None], ls, rs], axis=-1)
+                pk = xsum_sc(jnp.where(is_win[:, None], pack, 0.0))
+                return (gmax, f_win, pk[:, 0].astype(jnp.int32),
+                        pk[:, 1] > 0, pk[:, 2:5], pk[:, 5:8], member)
+
         from ..efb import make_bundle_decode, make_expand_hist
         expand_hist = make_expand_hist(efb_arrays if use_efb else (),
                                        F, G, Bb)
@@ -328,12 +417,41 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
 
         _dqh = dq if quantized else (lambda h: h)
 
-        def hist_waves(ch, k=W):
-            """(k, G, Bb, 3) histograms of the wave's leaf channels,
-            reduced across row shards (serial: identity).  ``k`` trims the
-            cross-shard reduction to the channels actually used (the root
-            pass needs only channel 0).  Quantized mode returns exact
-            int32 channel sums (dequantize with ``dq``)."""
+        def _reduce_waves(h, k, with_totals=False):
+            """Merge a freshly built (c, G, Bb, 3) histogram batch across
+            row shards, trimmed to the first ``k`` channels.  Scatter
+            mode pads the feature axis to the block quantum and
+            reduce-scatters it, so this shard keeps only its fully
+            reduced (k, FB_SC, Bb, 3) block.  ``with_totals``
+            additionally returns the (k, 3) per-channel leaf totals:
+            under scatter they come from a tiny psum of the LOCAL
+            pre-merge batch's feature-0 bin sums (each shard's slice
+            holds a different feature, whose f32 bin sums agree only up
+            to rounding — and pure-pad shards hold no real feature at
+            all); otherwise from the merged batch.  Quantized batches
+            stay int32 end to end and dequantize AFTER the exact integer
+            sum, so totals are identical across shards and across merge
+            modes."""
+            hk = h[:k]
+            if use_scatter:
+                hp = jnp.pad(hk, ((0, 0), (0, F_PAD_SC), (0, 0), (0, 0))) \
+                    if F_PAD_SC else hk
+                hmg = strat.reduce_hist_scatter(hp)
+                if not with_totals:
+                    return hmg
+                return hmg, _dqh(strat.reduce_sum(hk[:, 0].sum(axis=1)))
+            hmg = strat.reduce_hist(hk)
+            if not with_totals:
+                return hmg
+            return hmg, _dqh(hmg[:, 0].sum(axis=1))
+
+        def hist_waves(ch, k=W, with_totals=False):
+            """(k, G_loc, Bb, 3) histograms of the wave's leaf channels,
+            reduced across row shards (serial: identity; DP scatter mode:
+            this shard's feature block of the merged batch).  ``k`` trims
+            the cross-shard reduction to the channels actually used (the
+            root pass needs only channel 0).  Quantized mode returns
+            exact int32 channel sums (dequantize with ``dq``)."""
             if quantized:
                 if pallas:
                     h = build_histogram_pallas_leaves_q8(
@@ -349,15 +467,14 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                         wch0[2].astype(jnp.float32), ch,
                         num_channels=W, num_bins=Bb, impl=hist_impl)
                     h = jnp.round(h).astype(jnp.int32)
-                return strat.reduce_hist(h[:k])
-            if pallas:
+            elif pallas:
                 h = build_histogram_pallas_leaves(X_T, w8, ch, num_bins=Bb,
                                                   interpret=interpret)
             else:
                 h = build_histogram_leaves(
                     bins_rows, gm, hm, cnt_mask, ch,
                     num_channels=W, num_bins=Bb, impl=hist_impl)
-            return strat.reduce_hist(h[:k])
+            return _reduce_waves(h, k, with_totals)
 
         def feature_col(feat):
             """FEATURE-space bin codes (N,) of one feature (decoded from
@@ -374,42 +491,63 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             ``fms`` is the per-child feature mask (k, F); ``rbs`` the
             per-child ExtraTrees random threshold bins (k, F) or None;
             ``cegb2`` an optional per-child (k, F) CEGB penalty vector
-            (lazy costs) overriding the shared one."""
+            (lazy costs) overriding the shared one.
+
+            Scatter mode: ``hists`` arrive as this shard's feature block
+            (k, FB_SC, Bb, 3); every per-feature operand is sliced to the
+            same block, the scan runs on 1/k of the features, and the
+            winner exchange combines the block-local bests into globally
+            consistent candidates (global feature indices)."""
             cegb = getattr(strat, "cegb_full", None)
             contri = getattr(strat, "contri_full", None)
+            if use_scatter:
+                nb_s, ic_s, hn_s, mono_s = nb_sc, ic_sc, hn_sc, mono_sc
+                fms = _slf2(fms, False)
+                if rbs is not None:
+                    rbs = _slf2(rbs, 0)
+                if cegb2 is not None:
+                    cegb2 = _slf2(cegb2, 0.0)
+                if cegb is not None:
+                    cegb = _slf(cegb, 0.0)
+                if contri is not None:
+                    contri = _slf(contri, 1.0)
+            else:
+                nb_s, ic_s, hn_s, mono_s = nb_full, ic_full, hn_full, \
+                    monotone
             if cegb2 is not None:
                 if rbs is None:
                     def one(h, s, bd, d, po, fm, cg):
                         return local_best_candidate(
-                            h, s, nb_full, ic_full, hn_full, fm, sp,
-                            monotone, bd if use_mc else None, d, cg,
+                            h, s, nb_s, ic_s, hn_s, fm, sp,
+                            mono_s, bd if use_mc else None, d, cg,
                             contri, po)
-                    return jax.vmap(one)(hists, sums, bounds, depths,
-                                         pouts, fms, cegb2)
-
-                def one(h, s, bd, d, po, fm, cg, rb):
-                    return local_best_candidate(
-                        h, s, nb_full, ic_full, hn_full, fm, sp,
-                        monotone, bd if use_mc else None, d, cg, contri,
-                        po, rb)
-                return jax.vmap(one)(hists, sums, bounds, depths, pouts,
-                                     fms, cegb2, rbs)
-            if rbs is None:
+                    out = jax.vmap(one)(hists, sums, bounds, depths,
+                                        pouts, fms, cegb2)
+                else:
+                    def one(h, s, bd, d, po, fm, cg, rb):
+                        return local_best_candidate(
+                            h, s, nb_s, ic_s, hn_s, fm, sp,
+                            mono_s, bd if use_mc else None, d, cg, contri,
+                            po, rb)
+                    out = jax.vmap(one)(hists, sums, bounds, depths,
+                                        pouts, fms, cegb2, rbs)
+            elif rbs is None:
                 def one(h, s, bd, d, po, fm):
                     return local_best_candidate(
-                        h, s, nb_full, ic_full, hn_full, fm, sp,
-                        monotone, bd if use_mc else None, d, cegb, contri,
+                        h, s, nb_s, ic_s, hn_s, fm, sp,
+                        mono_s, bd if use_mc else None, d, cegb, contri,
                         po)
-                return jax.vmap(one)(hists, sums, bounds, depths, pouts,
-                                     fms)
-
-            def one(h, s, bd, d, po, fm, rb):
-                return local_best_candidate(
-                    h, s, nb_full, ic_full, hn_full, fm, sp,
-                    monotone, bd if use_mc else None, d, cegb, contri,
-                    po, rb)
-            return jax.vmap(one)(hists, sums, bounds, depths, pouts, fms,
-                                 rbs)
+                out = jax.vmap(one)(hists, sums, bounds, depths, pouts,
+                                    fms)
+            else:
+                def one(h, s, bd, d, po, fm, rb):
+                    return local_best_candidate(
+                        h, s, nb_s, ic_s, hn_s, fm, sp,
+                        mono_s, bd if use_mc else None, d, cegb, contri,
+                        po, rb)
+                out = jax.vmap(one)(hists, sums, bounds, depths, pouts,
+                                    fms, rbs)
+            return _exchange(out) if use_scatter else out
 
         # per-node RNG streams (bynode sampling / ExtraTrees thresholds),
         # identical on every DP shard (replicated key, identical node ids)
@@ -497,12 +635,15 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     h_ss = build_histogram_pallas_leaves(
                         X_ss, w_ss, rl_ss.astype(jnp.int8), num_bins=Bb,
                         interpret=interpret)[:Kc]
-                # DP: the one collective of this provisional pass — every
-                # shard sees the same pooled subsample histograms and
-                # grows the same provisional tree (serial: identity)
-                h_ss = strat.reduce_hist(h_ss)
-                hfs = dqh(h_ss)                              # (Kc, G, Bb, 3)
-                sums_pl = hfs[:, 0].sum(axis=1)              # (Kc, 3)
+                # DP: the one histogram collective of this provisional
+                # pass — the provisional batches ride the same merge mode
+                # as committed waves (psum, or the feature-sliced
+                # reduce-scatter), so every shard grows the same
+                # provisional tree (serial: identity).  Leaf totals come
+                # from _reduce_waves so they are shard-consistent under
+                # scatter.
+                h_ss, sums_pl = _reduce_waves(h_ss, Kc, with_totals=True)
+                hfs = dqh(h_ss)                            # (Kc, G*, Bb, 3)
                 lvp = leaf_output(sums_pl[:, 0], sums_pl[:, 1], sp)
                 cnds = many_candidates(
                     jax.vmap(expand_hist)(hfs, sums_pl), sums_pl,
@@ -562,9 +703,9 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 rl_full = rlf.astype(jnp.uint8)
 
             # -- ONE full-data pass: exact per-prov-leaf channel sums --
-            h_ch = hist_waves(rl_full.astype(jnp.int8), k=Kc)
+            h_ch, leaf_tot = hist_waves(rl_full.astype(jnp.int8), k=Kc,
+                                        with_totals=True)     # (Kc, 3)
             hf_ch = dqh(h_ch)
-            leaf_tot = hf_ch[:, 0].sum(axis=1)               # (Kc, 3)
 
             # -- exact node aggregates + commit tests --
             lt3 = Lm.astype(jnp.float32) @ leaf_tot          # (K1, 3)
@@ -651,7 +792,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             # -- pools + frontier candidates --
             rl0 = jnp.take(s_map, rl_full.astype(jnp.int32))
             hists0 = jnp.zeros(
-                (L, G, Bb, 3), h_ch.dtype).at[s_map].add(h_ch[:Kc])
+                (L, G_loc, Bb, 3), h_ch.dtype).at[s_map].add(h_ch[:Kc])
             lsum0 = jnp.zeros((L, 3), jnp.float32).at[s_map].add(leaf_tot)
             ldep0 = jnp.zeros((L,), jnp.int32).at[s_map].set(depth_pl)
             live = jnp.arange(L, dtype=jnp.int32) < nl_run
@@ -700,13 +841,19 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             state = _spec_state()
         else:
             # ---- root ----
-            root_hist = hist_waves(jnp.zeros((n,), jnp.int8), k=1)[0]
             if quantized:
-                # derive the root totals from the quantized histogram itself
-                # (any bundle's bins sum to the total) so candidate left+right
-                # sums stay consistent with the totals downstream
-                root_sum = dq(root_hist)[0].sum(axis=0)
+                # derive the root totals from the quantized histogram
+                # itself (any bundle's bins sum to the total, and the
+                # integer sum is exact BEFORE dequantization — identical
+                # for every feature, shard and merge mode) so candidate
+                # left+right sums stay consistent with the totals
+                # downstream
+                rh, rtot = hist_waves(jnp.zeros((n,), jnp.int8), k=1,
+                                      with_totals=True)
+                root_hist = rh[0]
+                root_sum = rtot[0]
             else:
+                root_hist = hist_waves(jnp.zeros((n,), jnp.int8), k=1)[0]
                 root_sum = strat.reduce_sum(jnp.stack([
                     jnp.sum(gm), jnp.sum(hm), jnp.sum(cnt_mask)]))
             root_hist_f = dq(root_hist) if quantized else root_hist
@@ -741,10 +888,22 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     preferred_element_type=jnp.float32)[:, 0])       # (F,)
                 strat.cegb_full = base + lazy_pen * jnp.maximum(
                     root_sum[2] - used_root, 0.0)
-            cand = strat.leaf_candidates(expand_hist(root_hist_f, root_sum),
-                                         root_sum, fm_root, sp,
-                                         root_bound, jnp.asarray(0, jnp.int32),
-                                         root_out, rb_root)
+            if use_scatter:
+                # the root scan rides the sliced many_candidates path (a
+                # 1-channel batch) so it too scans only this shard's
+                # block and exchanges the winner
+                c1 = many_candidates(
+                    expand_hist(root_hist_f, root_sum)[None],
+                    root_sum[None], root_bound[None],
+                    jnp.zeros((1,), jnp.int32), root_out[None],
+                    fm_root[None],
+                    rb_root[None] if rb_root is not None else None)
+                cand = tuple(a[0] for a in c1)
+            else:
+                cand = strat.leaf_candidates(
+                    expand_hist(root_hist_f, root_sum), root_sum, fm_root,
+                    sp, root_bound, jnp.asarray(0, jnp.int32), root_out,
+                    rb_root)
 
             state = {
                 "row_leaf": jnp.zeros((n,), rl_dtype),
@@ -759,7 +918,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 "cand_member": jnp.zeros((L, max_bins), jnp.bool_).at[0].set(
                     cand[6]),
                 "hists": jnp.zeros(
-                    (L, G, Bb, 3),
+                    (L, G_loc, Bb, 3),
                     jnp.int32 if quantized else jnp.float32).at[0].set(
                         root_hist),
                 "split_feature": jnp.full((L - 1,), -1, jnp.int32),

@@ -89,8 +89,11 @@ def _hist_segment_chunk(bins_chunk: jnp.ndarray, w_chunk: jnp.ndarray,
 
 
 def _auto_impl() -> str:
-    backend = jax.default_backend()
-    return "onehot" if backend == "tpu" else "segment"
+    # route through the probing wrapper: a broken TPU plugin raises
+    # RuntimeError from the raw jax.default_backend() before any CPU
+    # fallback can engage (utils/backend.py)
+    from ..utils.backend import default_backend
+    return "onehot" if default_backend() == "tpu" else "segment"
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "impl", "rows_per_chunk"))

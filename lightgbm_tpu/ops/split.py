@@ -172,6 +172,17 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
         output bounds (2,), and the leaf's depth (for monotone_penalty).
     Returns:
       FeatureSplits with per-feature best candidates.
+
+    Feature sub-range scans: F here may be any contiguous SLICE of the
+    dataset's feature space — every per-feature operand (hist, num_bins,
+    is_cat, has_nan, monotone, cegb_penalty, gain_scale, rand_bins) is
+    indexed positionally, so shard-sliced scans (feature-parallel,
+    voting, the DP reduce-scatter wave path) pass their block and remap
+    the returned LOCAL indices to global feature space themselves.  The
+    one exception is ``params.cat_idx``: those STATIC categorical
+    positions index full feature space, so slice-scanned callers must
+    leave it empty (the sorted-subset search then falls back to scanning
+    all F slice columns) or avoid the sliced path for categorical shapes.
     """
     f, b, _ = hist.shape
     l1, l2 = params.lambda_l1, params.lambda_l2
@@ -459,10 +470,18 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
         # per-feature gain penalty (feature_contri; feature_histogram.hpp:94
         # ``output->gain *= meta_->penalty``)
         gain = jnp.where(gain > NEG_INF / 2, gain * gain_scale, gain)
-    cat_member = cat_member & is_cat_b & (gain > NEG_INF / 2)[:, None]
-    # cat threshold_bin kept as the first member bin (display/compat; the
-    # partition decision uses the membership vector)
-    cat_thr = jnp.argmax(cat_member, axis=1).astype(jnp.int32)
+    if params.any_cat:
+        cat_member = cat_member & is_cat_b & (gain > NEG_INF / 2)[:, None]
+        # cat threshold_bin kept as the first member bin (display/compat;
+        # the partition decision uses the membership vector)
+        cat_thr = jnp.argmax(cat_member, axis=1).astype(jnp.int32)
+    else:
+        # cat_member is the all-False constant here; running the argmax
+        # anyway hands XLA a constant-foldable variadic (pred, iota)
+        # reduce that costs >2s of compile time per vmapped scan on
+        # multichip programs (MULTICHIP_r05's %reduce.227 stall) — skip
+        # the reduce instead of folding it
+        cat_thr = jnp.zeros((f,), jnp.int32)
     thr = jnp.where(is_cat, cat_thr, num_thr)
     left_sum = jnp.where(is_cat_b, cat_left_sum, left_num)
     right_sum = parent_sum[None, :] - left_sum

@@ -34,7 +34,7 @@ from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
                               make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
 from ..telemetry.train_record import note_collective
-from .mesh import get_mesh, shard_map_compat
+from .mesh import get_mesh, psum_scatter_compat, shard_map_compat
 
 __all__ = ["DataParallelTreeLearner", "DataParallelStrategy"]
 
@@ -67,8 +67,8 @@ class DataParallelStrategy(CommStrategy):
         # each device reduces + owns one contiguous feature block
         note_collective("data_parallel/masked/hist_reduce_scatter",
                         "psum_scatter", hist_local)
-        blk = jax.lax.psum_scatter(hist_local, self.axis_name,
-                                   scatter_dimension=0, tiled=True)
+        blk = psum_scatter_compat(hist_local, self.axis_name,
+                                  scatter_dimension=0, tiled=True)
         sl = lambda a: jax.lax.dynamic_slice(a, (start,), (fb,))
         mono = sl(self.monotone_full) if self.monotone_full is not None \
             else None
@@ -109,21 +109,36 @@ class DataParallelStrategy(CommStrategy):
 
 
 class WaveDPStrategy(CommStrategy):
-    """Row-sharded strategy for the wave grower: ONE histogram psum per
-    wave (up to 25/42 splits' smaller children), scans replicated.
+    """Row-sharded strategy for the wave grower: ONE histogram collective
+    per wave (up to 25/42 splits' smaller children).
+
+    Two merge modes for that collective:
+
+    * ``hist_scatter=False`` — full-batch ``psum``: every shard holds the
+      whole merged histogram and the candidate scans run replicated.
+    * ``hist_scatter=True`` — feature-sliced ``psum_scatter`` (the
+      reference DP learner's ReduceScatter refinement,
+      data_parallel_tree_learner.cpp:155-173, amortized over the wave's
+      channels): each shard materializes only its F/k feature block of
+      the merged batch, scans that slice, and the per-leaf winners are
+      combined by the wave grower's O(W*k) winner exchange
+      (learner/wave.py).  1/k the wire residency and 1/k the scan FLOPs
+      per pass; results identical to the psum mode.
 
     ``spec_ok``/``nshards`` unlock the speculative ramp on this path:
     each shard strides its local rows for the provisional subsample
-    (global budget / nshards each) and the provisional passes psum their
-    histogram batches like committed waves — one extra collective per
-    provisional pass, nothing else (learner/wave.py _spec_state)."""
+    (global budget / nshards each) and the provisional passes reduce
+    their histogram batches like committed waves — one extra collective
+    per provisional pass, nothing else (learner/wave.py _spec_state)."""
 
     rows_sharded = True
     spec_ok = True
 
-    def __init__(self, axis_name: str, nshards: int = 1):
+    def __init__(self, axis_name: str, nshards: int = 1,
+                 hist_scatter: bool = False):
         self.axis_name = axis_name
         self.nshards = int(nshards)
+        self.hist_scatter = bool(hist_scatter)
         self.monotone_full = None
 
     def reduce_sum(self, v):
@@ -147,6 +162,39 @@ class WaveDPStrategy(CommStrategy):
         # tally counts the same sites at trace time)
         note_collective("data_parallel/wave/hist_psum", "psum", hist)
         return jax.lax.psum(hist, self.axis_name)
+
+    def reduce_hist_scatter(self, hist):
+        """Feature-sliced merge: reduce-scatter the (k, Fp, B, 3) batch
+        over the padded feature axis so this shard receives only its
+        Fp/nshards block, fully reduced.  The telemetry note records the
+        scattered OUTPUT (the per-device received payload — 1/k of the
+        psum mode's full-batch residency)."""
+        out = psum_scatter_compat(hist, self.axis_name,
+                                  scatter_dimension=1, tiled=True,
+                                  axis_size=self.nshards)
+        note_collective("data_parallel/wave/hist_reduce_scatter",
+                        "psum_scatter", out)
+        return out
+
+    def exchange_collectives(self):
+        """(pmax, pmin, psum) hooks of the wave grower's winner exchange,
+        telemetry-tagged — the SplitInfo allreduce-max analog
+        (data_parallel_tree_learner.cpp:244), O(W*k) bytes per scan."""
+        ax = self.axis_name
+
+        def xmax(v):
+            note_collective("data_parallel/wave/winner_exchange", "pmax", v)
+            return jax.lax.pmax(v, ax)
+
+        def xmin(v):
+            note_collective("data_parallel/wave/winner_exchange", "pmin", v)
+            return jax.lax.pmin(v, ax)
+
+        def xsum(v):
+            note_collective("data_parallel/wave/winner_exchange", "psum", v)
+            return jax.lax.psum(v, ax)
+
+        return xmax, xmin, xsum
 
 
 class DataParallelTreeLearner:
@@ -310,7 +358,9 @@ class DataParallelTreeLearner:
         self._use_node_key = sp.feature_fraction_bynode < 1.0 or \
             sp.extra_trees
         gq_max, hq_max = quant_levels(int(config.num_grad_quant_bins))
-        strategy = WaveDPStrategy(self.axis, nshards=self.ndev)
+        strategy = WaveDPStrategy(
+            self.axis, nshards=self.ndev,
+            hist_scatter=bool(config.tpu_dp_hist_scatter))
         grow_w = make_wave_grow_fn(
             num_leaves=int(config.num_leaves), num_features=num_features,
             max_bins=self.max_bins, max_depth=int(config.max_depth),

@@ -10,12 +10,18 @@ boosting loop runs:
     counter already asserted by tests/test_endgame.py) and leaf counts —
     kept as device scalars and pulled in batched, lazy fetches so the
     async dispatch pipeline never stalls;
-  * collective count and psum'd bytes, tallied at the
+  * collective count and reduced bytes, tallied at the
     ``parallel/*.py`` collective call sites.  Those sites execute at
     TRACE time (the growers are jit/shard_map programs), so the tally
     is per *traced program* — the same quantity
     tests/test_specramp.py asserts by counting ``psum`` ops in the
-    jaxpr — and a run that triggers no retrace adds nothing;
+    jaxpr — and a run that triggers no retrace adds nothing.  The DP
+    wave path's merge mode is visible here: the full-batch psum tallies
+    at ``data_parallel/wave/hist_psum``, the feature-sliced
+    reduce-scatter records its 1/k received payload at
+    ``data_parallel/wave/hist_reduce_scatter`` plus the tiny per-scan
+    ``data_parallel/wave/winner_exchange`` (tests/test_wave_scatter.py
+    asserts the >=4x per-pass byte drop at k=8);
   * XLA compile/retrace events via a ``jax.monitoring`` listener;
   * device-memory watermark via ``device.memory_stats()`` where the
     backend provides it (TPU does; CPU returns None);

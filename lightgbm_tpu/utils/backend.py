@@ -37,5 +37,12 @@ def default_backend() -> str:
             jax.config.update("jax_platforms", "cpu")
         except RuntimeError:
             pass  # a client appeared concurrently; use whatever it is
-        _resolved = jax.default_backend()
+        try:
+            _resolved = jax.default_backend()
+        except RuntimeError:
+            # even the pinned-CPU retry failed (a half-initialized plugin
+            # client won the race).  Callers only branch on "tpu" vs
+            # not-"tpu" — report cpu so backend SNIFFING never crashes;
+            # actual device work will surface the real error.
+            _resolved = "cpu"
     return _resolved

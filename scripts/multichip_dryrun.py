@@ -1,0 +1,115 @@
+"""Multichip dryrun + collective-bytes snapshot for CI.
+
+Runs the driver's ``dryrun_multichip`` (every parallel learner compiled
+and executed on an N-virtual-CPU-device mesh, DP == serial parity
+asserted) and then traces the DP wave grower in BOTH histogram-merge
+modes to record the scatter-vs-allreduce byte budget from the telemetry
+collective tally — so the ratio the round-8 optimisation claims
+(PERF.md) is tracked per push as a CI artifact.
+
+Usage: python scripts/multichip_dryrun.py [--devices 8] [--out multichip.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def collective_bytes_snapshot(n_devices: int) -> dict:
+    """Trace the DP wave grower with scatter on/off and diff the
+    telemetry collective tallies (trace-time, no execution needed)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from lightgbm_tpu.learner.wave import make_wave_grow_fn
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.parallel.data_parallel import (
+        DataParallelTreeLearner, WaveDPStrategy)
+    from lightgbm_tpu.parallel.mesh import get_mesh, shard_map_compat
+    from lightgbm_tpu.telemetry.train_record import (collectives_reset,
+                                                     collectives_snapshot)
+
+    f, b, n = 8, 64, n_devices * 4096
+    rng = np.random.RandomState(0)
+    args = (jnp.asarray(rng.randint(0, b - 1, (f, n)).astype(np.uint8)),
+            jnp.asarray(rng.randn(n).astype(np.float32)),
+            jnp.ones((n,), jnp.float32), jnp.ones((n,), jnp.float32),
+            jnp.full((f,), b, jnp.int32), jnp.zeros((f,), bool),
+            jnp.zeros((f,), bool), jnp.zeros((f,), jnp.int32),
+            jnp.zeros((f,), jnp.float32), jnp.ones((f,), bool))
+    mesh = get_mesh(n_devices)
+    ax = mesh.axis_names[0]
+    sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=0.0,
+                     any_cat=False)
+    out = {}
+    for mode, scatter in (("scatter", True), ("allreduce", False)):
+        grow = make_wave_grow_fn(
+            num_leaves=15, num_features=f, max_bins=b, max_depth=0,
+            split_params=sp, hist_impl="pallas", any_cat=False,
+            interpret=True, jit=False, wave_size=4, stochastic=False,
+            quantized=True,
+            strategy=WaveDPStrategy(ax, nshards=n_devices,
+                                    hist_scatter=scatter))
+        wrapped = shard_map_compat(
+            lambda X_T, g, h, m, nb, ic, hn, mono, cp, fm: grow(
+                X_T, g, h, m, nb, ic, hn, mono, cp, (), fm),
+            mesh=mesh,
+            in_specs=(P(None, ax), P(ax), P(ax), P(ax), P(), P(), P(),
+                      P(), P(), P()),
+            out_specs=DataParallelTreeLearner._tree_specs(ax))
+        collectives_reset()
+        jax.make_jaxpr(lambda *a: wrapped(*a))(*args)
+        out[mode] = collectives_snapshot()
+    collectives_reset()
+
+    def per_pass(snap, site):
+        rec = snap.get(site)
+        return rec["bytes"] / rec["count"] if rec else None
+
+    sc = per_pass(out["scatter"], "data_parallel/wave/hist_reduce_scatter")
+    ar = per_pass(out["allreduce"], "data_parallel/wave/hist_psum")
+    out["hist_bytes_per_pass"] = {"scatter": sc, "allreduce": ar}
+    out["hist_bytes_ratio_allreduce_over_scatter"] = (
+        round(ar / sc, 3) if sc and ar else None)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--out", default="multichip.json")
+    ns = ap.parse_args()
+
+    rec = {"schema": "multichip-dryrun-v1", "n_devices": ns.devices,
+           "ok": False}
+    t0 = time.perf_counter()
+    try:
+        import __graft_entry__
+        __graft_entry__.dryrun_multichip(ns.devices)
+        rec["ok"] = True
+    except Exception:  # noqa: BLE001 — the artifact must always be written
+        rec["error"] = traceback.format_exc(limit=20)
+    rec["dryrun_seconds"] = round(time.perf_counter() - t0, 2)
+    try:
+        rec["collectives"] = collective_bytes_snapshot(ns.devices)
+    except Exception:  # noqa: BLE001
+        rec["collectives_error"] = traceback.format_exc(limit=20)
+    with open(ns.out, "w") as fh:
+        json.dump(rec, fh, indent=2, default=str)
+    print(json.dumps({k: rec[k] for k in ("ok", "dryrun_seconds")} |
+                     {"ratio": rec.get("collectives", {}).get(
+                         "hist_bytes_ratio_allreduce_over_scatter")}))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
