@@ -27,13 +27,16 @@ if "cpu" in _os.environ.get("JAX_PLATFORMS", ""):
         pass  # a backend already initialized; too late to switch
 
 
-from . import distributed, telemetry
+from . import distributed, resilience, telemetry
 from .basic import Booster
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        print_evaluation, record_evaluation, reset_parameter)
 from .config import Config
 from .dataset import Dataset
 from .engine import CVBooster, cv, train
+from .models.model_text import ModelCorruptError
+from .resilience import (Checkpoint, CheckpointError, TrainingPreempted,
+                         load_checkpoint)
 from .utils.log import register_log_callback, set_verbosity
 
 try:
@@ -52,7 +55,8 @@ __all__ = ["Dataset", "Booster", "Config", "train", "cv", "CVBooster",
            "early_stopping", "print_evaluation", "log_evaluation",
            "record_evaluation", "reset_parameter", "EarlyStopException",
            "register_log_callback", "set_verbosity", "distributed",
-           "telemetry",
+           "telemetry", "resilience", "Checkpoint", "CheckpointError",
+           "TrainingPreempted", "load_checkpoint", "ModelCorruptError",
            "plot_importance", "plot_metric", "plot_tree",
            "plot_split_value_histogram", "create_tree_digraph"]
 if _SKLEARN_OK:

@@ -44,17 +44,27 @@ class Booster:
             train_set.construct(self.config)
             self._gbdt = create_boosting(self.config, train_set)
         elif model_file is not None:
-            with open(model_file) as fh:
-                self._load_from_string(fh.read())
+            # binary-mode read: a corrupt file with stray invalid utf-8
+            # must surface as ModelCorruptError, not UnicodeDecodeError
+            with open(model_file, "rb") as fh:
+                raw = fh.read()
+            try:
+                text = raw.decode("utf-8")
+            except UnicodeDecodeError as exc:
+                from .models.model_text import ModelCorruptError
+                raise ModelCorruptError(str(model_file), exc.start,
+                                        "not utf-8 text") from exc
+            self._load_from_string(text, source=str(model_file))
         elif model_str is not None:
             self._load_from_string(model_str)
         else:
             raise ValueError("Booster needs train_set, model_file or model_str")
 
-    def _load_from_string(self, model_str: str) -> None:
+    def _load_from_string(self, model_str: str,
+                          source: str = "<model string>") -> None:
         from .models.model_text import string_to_model
         self.config = Config(self.params)
-        self._gbdt = string_to_model(model_str, self.config)
+        self._gbdt = string_to_model(model_str, self.config, source=source)
 
     # -- training ------------------------------------------------------------
     def update(self, train_set: Optional[Dataset] = None,
@@ -215,9 +225,12 @@ class Booster:
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,
                    importance_type: str = "split") -> "Booster":
-        with open(filename, "w") as fh:
-            fh.write(self.model_to_string(num_iteration, start_iteration,
-                                          importance_type))
+        # temp + fsync + atomic rename: mid-train snapshots (and any other
+        # save racing a crash) can never leave a truncated model file
+        from .io_utils import atomic_write_text
+        atomic_write_text(filename,
+                          self.model_to_string(num_iteration, start_iteration,
+                                               importance_type))
         return self
 
     def dump_model(self, num_iteration: Optional[int] = None,

@@ -57,6 +57,9 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callabl
             eval_result[data_name].setdefault(eval_name, [])
             eval_result[data_name][eval_name].append(value)
     _callback.order = 20
+    # resume support: train(resume_from=...) refills this dict with the
+    # checkpointed eval history so the user's record survives preemption
+    _callback.eval_result = eval_result
     return _callback
 
 
@@ -153,6 +156,43 @@ class _EarlyStopper:
             body = "\t".join(_fmt_eval(x) for x in tracker.snapshot)
             log_info(f"{head}\n[{tracker.best_iter + 1}]\t{body}")
         raise EarlyStopException(tracker.best_iter, tracker.snapshot)
+
+    # -- checkpoint support --------------------------------------------------
+    def state_dict(self) -> dict:
+        """JSON-ready best-so-far bookkeeping, captured mid-train by the
+        resilience checkpoint so a resumed run keeps counting patience
+        from the same best iteration instead of restarting it."""
+        return {
+            "rounds": self.rounds,
+            "first_metric_only": self.first_metric_only,
+            "first_metric_name": self.first_metric_name,
+            "trackers": None if self.trackers is None else [
+                {"higher_better": t.higher_better,
+                 "best_score": t.best_score,
+                 "best_iter": t.best_iter,
+                 "snapshot": None if t.snapshot is None else
+                 [list(row) for row in t.snapshot]}
+                for t in self.trackers],
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.first_metric_name = state.get("first_metric_name", "")
+        trackers = state.get("trackers")
+        if trackers is None:
+            self.trackers = None
+            return
+        self.trackers = []
+        self.active = True
+        for t in trackers:
+            tr = _MetricTracker(higher_better=bool(t["higher_better"]),
+                                best_score=t["best_score"],
+                                best_iter=int(t["best_iter"]))
+            tr.snapshot = None if t["snapshot"] is None else \
+                [(r[0], r[1], float(r[2]), bool(r[3]))
+                 for r in t["snapshot"]]
+            self.trackers.append(tr)
+        if not self.trackers:
+            self.trackers = None
 
     def __call__(self, env: CallbackEnv) -> None:
         if self.trackers is None and self.active:

@@ -24,14 +24,27 @@ from .config import Config, parse_config_file
 from .dataset import Dataset
 from .engine import train as train_api
 from .io_utils import load_sidecar
+from .resilience.checkpoint import TrainingPreempted
 from .utils.log import log_fatal, log_info, log_warning
 
 
 def parse_cli_args(argv: List[str]) -> Dict[str, Any]:
     """``key=value`` arguments + optional config file, command line wins
-    (reference application.cpp:52 LoadParameters)."""
+    (reference application.cpp:52 LoadParameters).  ``--resume`` (bare)
+    is sugar for ``resume=latest``; ``--key=value`` strips the dashes."""
     cli: Dict[str, Any] = {}
     for arg in argv:
+        if arg.startswith("--"):
+            arg = arg[2:]
+            if "=" not in arg:
+                if arg.strip() == "resume":
+                    cli["resume"] = "latest"
+                else:
+                    # unknown bare flags must not silently become
+                    # key=true params (they would land in Config.extra
+                    # and leak into the saved model text)
+                    log_warning(f"unknown CLI flag ignored: --{arg.strip()}")
+                continue
         if "=" not in arg:
             log_warning(f"unknown CLI argument ignored: {arg}")
             continue
@@ -73,10 +86,18 @@ def run_train(params: Dict[str, Any], cfg: Config) -> None:
                 valid_sets.append(_load_dataset(path, params,
                                                 reference=train_set))
                 valid_names.append(f"valid_{i}" if i else "valid_1")
-    booster = train_api(params, train_set,
-                        num_boost_round=int(cfg.num_iterations),
-                        valid_sets=valid_sets or None,
-                        valid_names=valid_names or None)
+    try:
+        booster = train_api(params, train_set,
+                            num_boost_round=int(cfg.num_iterations),
+                            valid_sets=valid_sets or None,
+                            valid_names=valid_names or None)
+    except TrainingPreempted as exc:
+        # graceful drain done, final checkpoint flushed; exit with the
+        # conventional 128+signum so orchestrators see the signal death
+        # and re-schedule — the rescheduled run resumes with --resume
+        log_warning(f"{exc}; restart with --resume (or resume=latest) "
+                    f"to continue this run")
+        raise SystemExit(128 + int(exc.signum))
     booster.save_model(cfg.output_model)
     log_info(f"Finished training; model saved to {cfg.output_model}")
 

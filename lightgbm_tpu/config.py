@@ -123,6 +123,15 @@ _PARAMS: List[ParamSpec] = [
     _p("output_model", str, "LightGBM_model.txt", ("model_output", "model_out")),
     _p("saved_feature_importance_type", int, 0),
     _p("snapshot_freq", int, -1, ("save_period",)),
+    # fault tolerance (lightgbm_tpu/resilience/): full-state checkpoint
+    # bundles next to the reference's model-text snapshots.  checkpoint_dir
+    # defaults to "<output_model>.ckpt" when snapshot_freq > 0; setting it
+    # explicitly enables checkpointing even without snapshot_freq (then
+    # every iteration).  resume: "" (off), "latest"/"auto" (newest bundle
+    # in checkpoint_dir; cold-start friendly), or a bundle/directory path.
+    _p("checkpoint_dir", str, "", ("checkpoint_directory",)),
+    _p("checkpoint_keep", int, 3, ("checkpoint_ring",), check=">0"),
+    _p("resume", str, "", ("resume_from",)),
     _p("max_bin", int, 255, check="1<v<=65535"),
     _p("min_data_in_bin", int, 3, check=">0"),
     _p("bin_construct_sample_cnt", int, 200000, ("subsample_for_bin",), check=">0"),

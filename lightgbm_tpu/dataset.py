@@ -550,6 +550,45 @@ class Dataset:
         # (bundle columns), but the feature surface stays per-feature
         return int(len(self.used_feature_map))
 
+    def fingerprint(self) -> Dict[str, Any]:
+        """Identity of the BINNED training matrix for checkpoint/resume
+        validation: a resume against different rows or different binning
+        cannot be bit-identical, so the bundle records (shape, a sha256
+        over every used mapper's bin edges / category maps, a crc32 over
+        the binned codes) and restore fails loudly on mismatch.
+
+        Cached: the crc over X_binned is the only non-trivial cost and
+        the binned matrix is immutable once constructed.  Under
+        pre-partitioned multi-process ingest this fingerprints the LOCAL
+        shard — resume must keep the same process count and sharding.
+        """
+        self._check_constructed()
+        fp = self._device_cache.get("_fingerprint")
+        if fp is not None:
+            return fp
+        import hashlib
+        import zlib
+        h = hashlib.sha256()
+        for j in self.used_feature_map:
+            m = self.bin_mappers[j]
+            h.update(f"{int(j)}:{m.num_bin}:{int(m.is_categorical)}:"
+                     f"{m.missing_type.value}".encode())
+            if m.bin_upper_bound is not None:
+                h.update(np.ascontiguousarray(
+                    m.bin_upper_bound, np.float64).tobytes())
+            if m.cat_to_bin:
+                h.update(repr(sorted(m.cat_to_bin.items())).encode())
+        crc = zlib.crc32(np.ascontiguousarray(self.X_binned).tobytes())
+        fp = {
+            "num_data": int(self.num_data()),
+            "binned_shape": [int(v) for v in self.X_binned.shape],
+            "num_features": int(self.num_feature()),
+            "binning_sha256": h.hexdigest(),
+            "data_crc32": int(crc),
+        }
+        self._device_cache["_fingerprint"] = fp
+        return fp
+
     @property
     def feature_names(self) -> List[str]:
         self._check_constructed()

@@ -1,6 +1,15 @@
 """Training entry points: train() and cv()
 (reference: python-package/lightgbm/engine.py ``train``:15, ``cv``:391,
-``CVBooster``:277)."""
+``CVBooster``:277).
+
+Fault tolerance: with ``snapshot_freq > 0`` (or ``checkpoint_dir`` set)
+the loop periodically flushes a full-state checkpoint bundle through
+:mod:`lightgbm_tpu.resilience.checkpoint` — atomic on disk, bounded
+ring, ``LATEST`` pointer — and ``train(..., resume_from=...)`` (or
+``resume=latest`` in params / ``--resume`` on the CLI) continues a
+preempted run bit-identically.  While checkpointing is active a
+SIGTERM/SIGINT (TPU preemption notice) drains the in-flight iteration,
+flushes one final bundle and raises :class:`TrainingPreempted`."""
 
 from __future__ import annotations
 
@@ -15,9 +24,123 @@ from .callback import (CallbackEnv, EarlyStopException, early_stopping,
                        print_evaluation)
 from .config import Config
 from .dataset import Dataset
+from .resilience.checkpoint import (CKPT_SOFT_KEYS, CKPT_STRUCTURAL_KEYS,
+                                    Checkpoint, CheckpointError,
+                                    CheckpointManager, PreemptionGuard,
+                                    TrainingPreempted, load_checkpoint,
+                                    resolve_checkpoint)
+from .resilience.faults import faults
+from .telemetry.metrics import default_registry
 from .utils.log import log_info, log_warning
+from .utils.random import rng_checkpoint_state
 
 __all__ = ["train", "cv", "CVBooster"]
+
+# params whose drift across a resume either breaks the continuation
+# (validate_config fails on the structural ones) or breaks bit-identity
+# (warned); recorded into every checkpoint bundle.  Single-sourced from
+# checkpoint.py so the recorded set and the checked set cannot drift.
+_CKPT_PARAM_KEYS = CKPT_STRUCTURAL_KEYS + CKPT_SOFT_KEYS
+
+
+def _resolve_resume(cfg, ckpt_dir: str):
+    """Map config's ``resume`` param to a checkpoint path.  The ``latest``
+    spelling is cold-start friendly: an empty/absent checkpoint dir means
+    "first run of this job", not an error."""
+    want = str(cfg.resume).strip()
+    if not want:
+        return None
+    if want.lower() in ("latest", "auto", "true", "1"):
+        if not ckpt_dir:
+            raise ValueError("resume=latest needs snapshot_freq>0 or "
+                             "checkpoint_dir to locate checkpoints")
+        path = resolve_checkpoint(ckpt_dir)
+        if path is None:
+            log_info(f"resume=latest: no checkpoint in {ckpt_dir} yet; "
+                     "starting fresh")
+        return path
+    return want
+
+
+def _capture(booster: Booster, train_set: Dataset, cfg,
+             callbacks_after: List[Callable],
+             history: Dict[str, Dict[str, List[float]]]) -> Checkpoint:
+    """Bundle the full boosting state at the current iteration boundary
+    (called AFTER the iteration's eval callbacks ran, so eval history and
+    early-stop bookkeeping land in the same bundle as the model)."""
+    g = booster._gbdt
+    arrays = g.capture_checkpoint_arrays()
+    return Checkpoint(
+        iteration=int(g.iter_),
+        model_text=booster.model_to_string(),
+        score=arrays["score"],
+        valid_names=arrays["valid_names"],
+        valid_scores=arrays["valid_scores"],
+        eval_history=copy.deepcopy(history),
+        early_stop=[cb.state_dict() for cb in callbacks_after
+                    if hasattr(cb, "state_dict")],
+        rng_state=rng_checkpoint_state(cfg),
+        fingerprint=train_set.fingerprint(),
+        params={k: getattr(cfg, k) for k in _CKPT_PARAM_KEYS},
+        cegb_used=arrays["cegb_used"],
+        prev_iter_leaves=arrays["prev_iter_leaves"],
+    )
+
+
+def _restore(ckpt: Checkpoint, booster: Booster, train_set: Dataset,
+             cfg, callbacks_after: List[Callable]) -> int:
+    """Continue from a bundle: validate, restore the boosting state and
+    the callback-side bookkeeping, return the first iteration to run."""
+    ckpt.validate_config(cfg)
+    ckpt.validate_dataset(train_set)
+    g = booster._gbdt
+    names_now = [name for name, _ in g.valid_sets]
+    if list(ckpt.valid_names) != names_now:
+        raise CheckpointError(
+            f"checkpoint tracked valid sets {list(ckpt.valid_names)} but "
+            f"this run registered {names_now}; resume with the same "
+            f"valid_sets/valid_names to continue the eval streams")
+    g.restore_boosting_state(ckpt.model_text, ckpt.iteration, ckpt.score,
+                             ckpt.valid_scores, ckpt.cegb_used,
+                             ckpt.prev_iter_leaves)
+    stoppers = [cb for cb in callbacks_after if hasattr(cb, "load_state_dict")]
+    if ckpt.early_stop and stoppers and \
+            len(stoppers) != len(ckpt.early_stop):
+        # a positional zip would silently mispair the saved patience
+        # bookkeeping and fork the stopping decision
+        raise CheckpointError(
+            f"checkpoint carries {len(ckpt.early_stop)} early-stopping "
+            f"states but this run registered {len(stoppers)} early-stopping "
+            f"callbacks; resume with the same callbacks to keep the "
+            f"continuation bit-identical")
+    for cb, state in zip(stoppers, ckpt.early_stop):
+        # any knob that steers the stop decision must match the saved run,
+        # or the continuation silently forks from the uninterrupted one
+        for key, label in (("rounds", "stopping_rounds"),
+                           ("first_metric_only", "first_metric_only")):
+            saved = state.get(key)
+            now = getattr(cb, key, None)
+            if saved is not None and now is not None and saved != now:
+                raise CheckpointError(
+                    f"checkpoint early-stopping {label} is {saved} but "
+                    f"this run registered {label}={now}; resume with the "
+                    f"same early-stopping configuration to keep the "
+                    f"continuation bit-identical")
+        cb.load_state_dict(state)
+    if ckpt.early_stop and not stoppers and any(
+            s.get("trackers") for s in ckpt.early_stop):
+        log_warning("checkpoint carries early-stopping state but this run "
+                    "has no early-stopping callback; patience restarts")
+    for cb in callbacks_after:
+        er = getattr(cb, "eval_result", None)
+        if isinstance(er, dict):
+            er.clear()
+            er.update(copy.deepcopy(ckpt.eval_history))
+    default_registry().counter(
+        "resume_total", "training runs continued from a checkpoint").inc()
+    log_info(f"resuming training from iteration {ckpt.iteration} "
+             f"({len(g.models)} trees restored)")
+    return int(ckpt.iteration)
 
 
 def train(params: Dict[str, Any], train_set: Dataset,
@@ -29,8 +152,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
           init_model: Optional[Union[str, Booster]] = None,
           keep_training_booster: bool = False,
           callbacks: Optional[List[Callable]] = None,
+          resume_from: Optional[Union[str, Checkpoint]] = None,
           **kwargs) -> Booster:
-    """Train a boosted model (reference engine.py:15)."""
+    """Train a boosted model (reference engine.py:15).
+
+    ``resume_from`` continues a checkpointed run (a bundle path, a
+    checkpoint directory, or a loaded :class:`Checkpoint`); with the
+    same data, params and seeds the result is bit-identical to a run
+    that never stopped."""
     params = dict(params or {})
     params.update(kwargs)
     cfg = Config(params)
@@ -86,31 +215,92 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
-    for it in range(num_boost_round):
-        for cb in callbacks_before:
-            cb(CallbackEnv(booster, params, it, 0, num_boost_round, None))
-        if booster.update(fobj=fobj):
-            # no leaf met the split requirements — stop like the reference
-            # CLI train loop (gbdt.cpp:264-283)
-            break
-        if cfg2.snapshot_freq > 0 and (it + 1) % cfg2.snapshot_freq == 0:
-            # periodic checkpoints (reference gbdt.cpp:277-281 Train +
-            # config snapshot_freq/save_period)
-            booster.save_model(f"{cfg2.output_model}.snapshot_iter_{it + 1}")
+    # -- fault tolerance setup (resilience/checkpoint.py) --------------------
+    ckpt_dir = str(cfg2.checkpoint_dir or "")
+    snap_freq = int(cfg2.snapshot_freq)
+    if not ckpt_dir and snap_freq > 0:
+        ckpt_dir = f"{cfg2.output_model}.ckpt"
+    if ckpt_dir and snap_freq <= 0:
+        # explicit checkpoint_dir without a cadence: ~100 bundles per run.
+        # A bundle serializes the whole model so far, so flushing every
+        # iteration of a long run would make checkpoint cost quadratic.
+        snap_freq = max(1, num_boost_round // 100)
+    manager = CheckpointManager(ckpt_dir, keep=int(cfg2.checkpoint_keep)) \
+        if ckpt_dir else None
 
-        evaluation_result_list = []
-        if booster._gbdt.train_metrics or booster._gbdt.valid_sets or feval:
-            evaluation_result_list = booster.eval_train(feval) + \
-                booster.eval_valid(feval)
-        try:
-            for cb in callbacks_after:
-                cb(CallbackEnv(booster, params, it, 0, num_boost_round,
-                               evaluation_result_list))
-        except EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            for ds_name, eval_name, score, _ in e.best_score:
-                booster.best_score.setdefault(ds_name, {})[eval_name] = score
-            break
+    if resume_from is None and cfg2.resume:
+        resume_from = _resolve_resume(cfg2, ckpt_dir)
+    ckpt: Optional[Checkpoint] = None
+    if isinstance(resume_from, Checkpoint):
+        ckpt = resume_from
+    elif resume_from:
+        ckpt = load_checkpoint(str(resume_from))
+    start_iter = 0
+    # the engine's own eval-history record: checkpoints carry it even
+    # when the user never registered a record_evaluation callback
+    run_history: Dict[str, Dict[str, List[float]]] = {}
+    if ckpt is not None:
+        if init_model is not None:
+            log_warning("both init_model and resume_from given: the "
+                        "checkpoint's model replaces the init_model trees")
+        start_iter = _restore(ckpt, booster, train_set, cfg2,
+                              callbacks_after)
+        run_history = copy.deepcopy(ckpt.eval_history)
+
+    def _flush(final: bool = False) -> Optional[str]:
+        if manager is None:
+            return None
+        path = manager.save(_capture(booster, train_set, cfg2,
+                                     callbacks_after, run_history))
+        if final:
+            log_info(f"final checkpoint flushed to {path}")
+        return path
+
+    # The guard turns a SIGTERM/SIGINT (TPU preemption notice) into a
+    # drain-and-flush exit; installed only while checkpointing is active
+    # so a plain Ctrl-C on an uncheckpointed run stays KeyboardInterrupt.
+    with PreemptionGuard(enabled=manager is not None) as guard:
+        for it in range(start_iter, num_boost_round):
+            faults.check_train_iter(it)  # chaos layer (resilience/faults.py)
+            for cb in callbacks_before:
+                cb(CallbackEnv(booster, params, it, 0, num_boost_round, None))
+            if booster.update(fobj=fobj):
+                # no leaf met the split requirements — stop like the reference
+                # CLI train loop (gbdt.cpp:264-283)
+                break
+            if cfg2.snapshot_freq > 0 and \
+                    (it + 1) % cfg2.snapshot_freq == 0:
+                # reference-compatible model-text snapshot (gbdt.cpp:277-281
+                # Train + snapshot_freq/save_period), atomically written
+                booster.save_model(
+                    f"{cfg2.output_model}.snapshot_iter_{it + 1}")
+
+            evaluation_result_list = []
+            if booster._gbdt.train_metrics or booster._gbdt.valid_sets or feval:
+                evaluation_result_list = booster.eval_train(feval) + \
+                    booster.eval_valid(feval)
+            if manager is not None:
+                for data_name, eval_name, value, _ in evaluation_result_list:
+                    run_history.setdefault(
+                        data_name, {}).setdefault(eval_name, []).append(value)
+            try:
+                for cb in callbacks_after:
+                    cb(CallbackEnv(booster, params, it, 0, num_boost_round,
+                                   evaluation_result_list))
+            except EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                for ds_name, eval_name, score, _ in e.best_score:
+                    booster.best_score.setdefault(ds_name, {})[eval_name] = score
+                _flush()
+                break
+            # the full-state bundle flushes AFTER the iteration's eval
+            # callbacks so eval history / early-stop bookkeeping restore
+            # to the exact same boundary
+            if manager is not None and (it + 1) % snap_freq == 0:
+                _flush()
+            if guard.fired is not None:
+                raise TrainingPreempted(guard.fired, booster=booster,
+                                        checkpoint=_flush(final=True))
     return booster
 
 

@@ -1,13 +1,66 @@
-"""Text data parsers: CSV / TSV / LibSVM with format auto-detection
-(reference: src/io/parser.cpp:235 ``Parser::CreateParser`` + parser.hpp
+"""Text data parsers (CSV / TSV / LibSVM with format auto-detection,
+reference: src/io/parser.cpp:235 ``Parser::CreateParser`` + parser.hpp
 CSVParser/TSVParser/LibSVMParser; label column handling per config
-label_column)."""
+label_column) and crash-safe file writing shared by model saves and the
+resilience checkpoint subsystem."""
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+import itertools
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+# concurrent writers to the SAME target must not share a temp file, or one
+# open('wb') truncates the other mid-write and the rename publishes the
+# interleaved bytes this module exists to prevent
+_tmp_seq = itertools.count()
+
+
+def atomic_write_bytes(path: str, data: Optional[bytes] = None,
+                       writer: Optional[Callable] = None) -> None:
+    """Write a file so a crash at ANY point leaves either the old content
+    or the new — never a truncated hybrid: write to a same-directory temp
+    file, flush + fsync it, ``os.replace`` onto the target (atomic on
+    POSIX), then fsync the directory so the rename itself is durable.
+
+    Pass raw ``data`` bytes, or a ``writer(fh)`` callback for producers
+    that stream into a file object (``np.savez``)."""
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = os.path.join(
+        d, f".{os.path.basename(path)}.tmp.{os.getpid()}"
+           f".{threading.get_ident()}.{next(_tmp_seq)}")
+    try:
+        with open(tmp, "wb") as fh:
+            if writer is not None:
+                writer(fh)
+            else:
+                fh.write(data or b"")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # some filesystems refuse directory fsync; rename landed
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Crash-safe text-file write (see :func:`atomic_write_bytes`)."""
+    atomic_write_bytes(path, text.encode("utf-8"))
 
 
 def _detect_format(line: str) -> str:

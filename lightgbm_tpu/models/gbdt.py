@@ -1356,6 +1356,73 @@ class GBDT:
         self._pending_bias[:] = 0.0
         self.score = jnp.asarray(score)
 
+    # -- checkpoint/resume (resilience/checkpoint.py rides these) ------------
+    def capture_checkpoint_arrays(self) -> Dict[str, Any]:
+        """The mutable boosting state beyond the model text, pulled to
+        host with EXACT bits: the f32 train/valid scores (rebuilding
+        them from trees re-rounds in a different order and can drift
+        the last ulp, forking the remaining trajectory), the CEGB
+        used-feature set, and the lagged stump-stop bookkeeping."""
+        prev = getattr(self, "_prev_iter_leaves", None)
+        return {
+            "score": np.asarray(self.score),
+            "valid_names": [name for name, _ in self.valid_sets],
+            "valid_scores": [np.asarray(s) for s in self.valid_scores],
+            "cegb_used": (None if self._cegb_coupled is None
+                          else np.asarray(self._cegb_used)),
+            "prev_iter_leaves": (None if prev is None else
+                                 [int(x) for x in jax.device_get(prev)]),
+        }
+
+    def restore_boosting_state(self, model_text: str, iteration: int,
+                               score: np.ndarray,
+                               valid_scores: List[np.ndarray],
+                               cegb_used: Optional[np.ndarray] = None,
+                               prev_iter_leaves: Optional[List[int]] = None
+                               ) -> None:
+        """Continue boosting from a checkpoint: trees reload from model
+        text (%.17g round-trips every double) and re-key onto this
+        dataset's binning; scores restore from the saved f32 bits
+        instead of a tree-walk rebuild.  With the same data, params and
+        seeds the continuation is bit-identical to a run that never
+        stopped."""
+        if self.name in ("dart", "rf"):
+            raise ValueError(
+                f"checkpoint/resume is not supported for boosting="
+                f"{self.name}: its per-tree weight/averaging caches "
+                f"(DART drop weights, RF running tree sums) are not part "
+                f"of the model text")
+        from .model_text import string_to_model
+        loaded = string_to_model(model_text, self.config)
+        k = self.num_tree_per_iteration
+        ok = getattr(loaded, "num_tree_per_iteration", 1)
+        if ok != k:
+            raise ValueError(f"checkpoint model has {ok} trees/iteration, "
+                             f"this training configuration needs {k}")
+        self._pending = []
+        self._models_list = [self._align_loaded_tree(t)
+                             for t in loaded.models]
+        self.iter_ = int(iteration)
+        # tree 0 already carries any boost-from-average bias
+        self._pending_bias[:] = 0.0
+        score = np.asarray(score, np.float32)
+        want = (self.num_data,) if k == 1 else (self.num_data, k)
+        if score.shape != want:
+            raise ValueError(f"checkpoint score shape {score.shape} does "
+                             f"not match this dataset ({want})")
+        self.score = jnp.asarray(score)
+        if len(valid_scores) != len(self.valid_scores):
+            raise ValueError(
+                f"checkpoint carries {len(valid_scores)} validation score "
+                f"sets, this run registered {len(self.valid_scores)} "
+                f"valid sets")
+        self.valid_scores = [jnp.asarray(np.asarray(vs, np.float32))
+                             for vs in valid_scores]
+        if cegb_used is not None and self._cegb_coupled is not None:
+            self._cegb_used[:] = np.asarray(cegb_used, bool)
+        self._prev_iter_leaves = (None if prev_iter_leaves is None else
+                                  [int(x) for x in prev_iter_leaves])
+
     # -- model management ----------------------------------------------------
     def rollback_one_iter(self) -> None:
         """Reference gbdt.cpp:454 RollbackOneIter."""

@@ -26,6 +26,27 @@ from .tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN, MISSING_ZERO, Tree
 MODEL_VERSION = "v3"
 
 
+class ModelCorruptError(ValueError):
+    """A model text file/string is truncated or not a model at all.
+
+    Typed so callers (serving registry reloads, checkpoint restore, CLI)
+    can distinguish "this file is damaged" from ordinary ValueErrors;
+    names the source and the byte offset where parsing failed — which
+    for a crash-truncated file is its (short) length."""
+
+    def __init__(self, source: str, offset: int, detail: str) -> None:
+        super().__init__(f"{source}: corrupt or truncated model text at "
+                         f"byte {offset}: {detail}")
+        self.source = source
+        self.offset = int(offset)
+
+
+def _offset_of(lines: List[str], idx: int) -> int:
+    """Byte offset of ``lines[idx]`` in the original utf-8 text (lines
+    were split on '\\n', so each earlier line contributes len + 1)."""
+    return sum(len(ln.encode("utf-8")) + 1 for ln in lines[:min(idx, len(lines))])
+
+
 def _fmt(x: float) -> str:
     # %.17g round-trips doubles exactly (reference Common::DoubleToStr);
     # positional formatting would truncate tiny magnitudes to "0"
@@ -171,6 +192,12 @@ def model_to_string(gbdt, start_iteration: int = 0,
         tail.write(f"{name}={int(val)}\n")
     tail.write("\nparameters:\n")
     for key, value in sorted(gbdt.config.to_dict().items()):
+        if key in ("resume", "checkpoint_dir", "checkpoint_keep"):
+            # transient run directives, not training config: a preempted-
+            # and-resumed run must produce byte-identical model text to
+            # the run that never stopped, and a shipped model must not
+            # embed machine-local checkpoint paths
+            continue
         if isinstance(value, list):
             value = ",".join(str(v) for v in value)
         tail.write(f"[{key}: {value}]\n")
@@ -192,12 +219,21 @@ def _parse_kv_block(lines: List[str], idx: int) -> Dict[str, str]:
     return out
 
 
-def string_to_model(model_str: str, config):
+def string_to_model(model_str: str, config, source: str = "<model string>"):
     """Parse a reference-format model file into a GBDT holding Tree objects
-    (reference gbdt_model_text.cpp:583 LoadModelFromString)."""
+    (reference gbdt_model_text.cpp:583 LoadModelFromString).
+
+    Raises :class:`ModelCorruptError` (naming ``source`` and the byte
+    offset) on garbage input or a crash-truncated file instead of an
+    arbitrary downstream parse exception."""
     from .gbdt import GBDT
     from .boosting import RF
     lines = model_str.split("\n")
+    first = next((ln.strip() for ln in lines if ln.strip()), "")
+    if first != "tree":
+        raise ModelCorruptError(
+            source, 0, "does not start with the 'tree' model header "
+            f"(first content line: {first[:40]!r})")
     header: Dict[str, str] = {}
     i = 0
     average_output = False
@@ -246,18 +282,40 @@ def string_to_model(model_str: str, config):
             gbdt.objective = None
 
     # trees
+    expected = None
+    if header.get("tree_sizes", "").strip():
+        expected = len(header["tree_sizes"].split())
     trees: List[Tree] = []
+    saw_end = False
     while i < len(lines):
         line = lines[i].strip()
         if line.startswith("Tree="):
             block = _parse_kv_block(lines, i)
-            trees.append(_tree_from_block(block))
+            try:
+                trees.append(_tree_from_block(block))
+            except (KeyError, ValueError, IndexError) as exc:
+                raise ModelCorruptError(
+                    source, _offset_of(lines, i),
+                    f"tree {len(trees)} is unparseable "
+                    f"({type(exc).__name__}: {exc})") from exc
             while i < len(lines) and lines[i].strip():
                 i += 1
         elif line.startswith("end of trees"):
+            saw_end = True
             break
         else:
             i += 1
+    if expected is not None and len(trees) != expected:
+        raise ModelCorruptError(
+            source, _offset_of(lines, i),
+            f"header declares {expected} trees (tree_sizes) but only "
+            f"{len(trees)} parsed before the text ended — the file was "
+            f"cut off mid-write")
+    if not saw_end and expected is None:
+        raise ModelCorruptError(
+            source, _offset_of(lines, i),
+            "neither a tree_sizes header nor an 'end of trees' marker — "
+            "not a complete model text")
     gbdt.models = trees
     gbdt.iter_ = len(trees) // max(k, 1)
     return gbdt
@@ -271,6 +329,11 @@ def _tree_from_block(block: Dict[str, str]) -> Tree:
         if key not in block or not block[key].strip():
             return np.full(size, default, dtype)
         vals = block[key].split()
+        if len(vals) != size:
+            # a crash-truncated file ends mid-line; the default-fill path
+            # above must never paper over a short field
+            raise ValueError(f"field '{key}' has {len(vals)} values, "
+                             f"expected {size}")
         out = np.asarray([float(v) for v in vals], np.float64)
         return out.astype(dtype)
 
@@ -293,6 +356,11 @@ def _tree_from_block(block: Dict[str, str]) -> Tree:
                     leaf_count=np.zeros(1, np.int64),
                     shrinkage=float(block.get("shrinkage", 1.0)))
 
+    for req in ("split_feature", "threshold", "left_child", "right_child",
+                "leaf_value"):
+        if not block.get(req, "").strip():
+            raise ValueError(f"split node block is missing required "
+                             f"field '{req}'")
     decision_type = arr("decision_type", np.uint8, n_int)
     threshold = arr("threshold", np.float64, n_int)
     num_cat = int(block.get("num_cat", 0))

@@ -1,0 +1,35 @@
+"""lightgbm_tpu.resilience — fault tolerance for training and serving.
+
+Three pieces:
+
+  * :mod:`.checkpoint` — crash-safe checkpoint/resume of the FULL
+    boosting state: model text, iteration, exact f32 train/valid score
+    bits, early-stopping bookkeeping, eval history, RNG seed state and
+    a dataset fingerprint validated on restore.  Snapshots are written
+    atomically (temp + fsync + rename), kept in a bounded ring with a
+    ``LATEST`` pointer.  ``train(..., resume_from=...)`` continues
+    bit-identically to an uninterrupted run.
+  * :mod:`.faults` — chaos injection points (crash/kill at iteration k,
+    simulated device loss) driven by ``LGBM_TPU_FAULTS`` or
+    :func:`faults.configure`; the recovery test suite uses them to
+    PROVE resume rather than assume it.
+  * :mod:`.admission` — serving admission control: typed errors for a
+    bounded request queue (503 + Retry-After load shedding), per-request
+    deadlines (504), and batcher shutdown (``ServerClosed``), with the
+    shed/deadline counters in the telemetry registry.
+"""
+
+from .admission import (DeadlineExceeded, QueueFullError, ServerClosed,
+                        deadline_counter, shed_counter)
+from .checkpoint import (Checkpoint, CheckpointError, CheckpointManager,
+                         TrainingPreempted, load_checkpoint,
+                         resolve_checkpoint)
+from .faults import InjectedFault, faults
+
+__all__ = [
+    "Checkpoint", "CheckpointError", "CheckpointManager",
+    "TrainingPreempted", "load_checkpoint", "resolve_checkpoint",
+    "InjectedFault", "faults",
+    "DeadlineExceeded", "QueueFullError", "ServerClosed",
+    "deadline_counter", "shed_counter",
+]

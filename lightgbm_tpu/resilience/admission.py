@@ -1,0 +1,67 @@
+"""Serving admission control: typed errors + telemetry for load
+shedding, per-request deadlines and shutdown draining.
+
+The micro-batcher (``serve/batcher.py``) enforces the policy; the HTTP
+layer (``serve/server.py``) maps the errors to wire semantics:
+
+  :class:`QueueFullError`     -> 503 + ``Retry-After`` (load shed: the
+                                 bounded queue is over its row budget;
+                                 admitting more would only grow latency
+                                 for everyone already queued)
+  :class:`DeadlineExceeded`   -> 504 (the request's deadline passed
+                                 before a device slot freed up; the
+                                 handler thread returns instead of
+                                 hanging on the future)
+  :class:`ServerClosed`       -> request failed because the batcher was
+                                 shut down; queued work is drained and
+                                 failed promptly, never left blocking
+                                 its caller until a client timeout
+
+Counters (process-wide registry, labeled ``model=<name>``):
+``requests_shed_total`` and ``deadline_exceeded_total`` — both exported
+through ``GET /metrics`` and consulted by the degraded-mode ``/healthz``.
+"""
+
+from __future__ import annotations
+
+from ..telemetry.metrics import Counter, default_registry
+
+__all__ = ["QueueFullError", "DeadlineExceeded", "ServerClosed",
+           "shed_counter", "deadline_counter"]
+
+
+class QueueFullError(RuntimeError):
+    """Request rejected by admission control; ``retry_after`` is the
+    suggested client backoff in seconds (drives ``Retry-After``)."""
+
+    def __init__(self, backlog_rows: int, limit_rows: int,
+                 retry_after: float) -> None:
+        super().__init__(
+            f"request queue is full ({backlog_rows} rows queued, limit "
+            f"{limit_rows}); retry in {retry_after:.2f}s")
+        self.backlog_rows = int(backlog_rows)
+        self.limit_rows = int(limit_rows)
+        self.retry_after = float(retry_after)
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline expired before its batch ran (or before
+    its result was collected)."""
+
+
+class ServerClosed(RuntimeError):
+    """The batcher/server shut down while the request was queued."""
+
+
+def shed_counter() -> Counter:
+    return default_registry().counter(
+        "requests_shed_total",
+        "requests rejected by admission control (503 load shed)",
+        labels=("model",))
+
+
+def deadline_counter() -> Counter:
+    return default_registry().counter(
+        "deadline_exceeded_total",
+        "requests failed by per-request deadline (504)",
+        labels=("model",))

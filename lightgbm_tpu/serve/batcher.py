@@ -1,5 +1,5 @@
 """Micro-batching queue: coalesce concurrent small requests into one
-bucketed device call.
+bucketed device call, with admission control in front of it.
 
 A single worker thread drains the queue under a max-wait/max-rows
 policy: the first waiting request opens a window of ``max_wait_ms``;
@@ -11,16 +11,36 @@ one device dispatch on the next bucket up instead of N dispatches.
 Requests are grouped by (raw_score, feature-count) inside a window: a
 malformed request can only fail its own group, never poison co-batched
 traffic with a different shape.
+
+Admission control (resilience/admission.py semantics):
+
+  * ``max_queue_rows`` bounds the backlog; a submit that would exceed it
+    is rejected with :class:`QueueFullError` carrying a ``retry_after``
+    estimated from the EWMA batch latency — admitting more work than the
+    device drains only grows everyone's latency, so shed at the door.
+  * a per-request ``deadline`` (monotonic seconds) expires queued work:
+    the worker fails expired requests with :class:`DeadlineExceeded`
+    instead of spending device time on an answer nobody is waiting for,
+    and ``predict`` stops blocking at the deadline either way.
+  * ``close()`` drains the queue and fails every pending future with
+    :class:`ServerClosed` — a shutdown never leaves a caller blocked
+    until its own client timeout.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from concurrent.futures import Future
+import time
+from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Callable, Optional
 
 import numpy as np
+
+from ..resilience.admission import (DeadlineExceeded, QueueFullError,
+                                    ServerClosed, deadline_counter,
+                                    shed_counter)
 
 __all__ = ["MicroBatcher"]
 
@@ -33,37 +53,90 @@ class MicroBatcher:
     ``predict_fn(X, raw_score) -> np.ndarray`` must be row-aligned:
     output row i corresponds to input row i (true for every predictor
     path).  ``submit`` returns a Future; ``predict`` blocks on it.
+    ``name`` labels the shed/deadline telemetry counters.
     """
 
     def __init__(self, predict_fn: Callable[[np.ndarray, bool], np.ndarray],
                  max_batch_rows: int = 4096,
-                 max_wait_ms: float = 2.0) -> None:
+                 max_wait_ms: float = 2.0,
+                 max_queue_rows: int = 0,
+                 name: str = "default") -> None:
         self._predict_fn = predict_fn
         self._max_rows = int(max_batch_rows)
         self._max_wait = max(0.0, float(max_wait_ms)) / 1e3
+        self._max_queue_rows = max(0, int(max_queue_rows))  # 0 = unbounded
+        self.name = str(name)
         self._q: "queue.Queue" = queue.Queue()
         self._closed = False
+        self._backlog_rows = 0  # rows admitted but not yet dispatched
+        self._ewma_batch_s = 0.05  # device-call latency estimate
         self._state_lock = threading.Lock()  # serializes submit vs close
+        self._shed = shed_counter()
+        self._deadline = deadline_counter()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="lgb-tpu-microbatcher")
         self._thread.start()
 
     # -- client side --------------------------------------------------------
-    def submit(self, X: np.ndarray, raw_score: bool = False) -> Future:
+    @property
+    def backlog_rows(self) -> int:
+        return self._backlog_rows
+
+    def submit(self, X: np.ndarray, raw_score: bool = False,
+               deadline: Optional[float] = None) -> Future:
+        """Queue one request.  ``deadline`` is an absolute
+        ``time.monotonic()`` instant after which the request is failed
+        with :class:`DeadlineExceeded` rather than dispatched."""
         X = np.asarray(X, np.float32)
         if X.ndim == 1:
             X = X.reshape(1, -1)
         fut: Future = Future()
-        # the closed-check and the put are one atomic step, so no item
-        # can land behind the _CLOSE sentinel and hang its waiter
+        rows = int(X.shape[0])
+        # the closed/limit checks and the put are one atomic step, so no
+        # item can land behind the _CLOSE sentinel or sneak past the
+        # queue bound under concurrent submitters
         with self._state_lock:
             if self._closed:
-                raise RuntimeError("batcher is closed")
-            self._q.put((X, bool(raw_score), fut))
+                raise ServerClosed("batcher is closed")
+            if self._max_queue_rows and \
+                    self._backlog_rows + rows > self._max_queue_rows:
+                retry = self._retry_after_locked()
+                self._shed.inc(1, model=self.name)
+                raise QueueFullError(self._backlog_rows,
+                                     self._max_queue_rows, retry)
+            self._backlog_rows += rows
+            self._q.put((X, bool(raw_score), fut, deadline))
         return fut
 
-    def predict(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
-        return self.submit(X, raw_score).result()
+    def _retry_after_locked(self) -> float:
+        """Backoff hint: how long the current backlog takes to drain at
+        the EWMA device-call latency (>= one batch window)."""
+        batches = max(1.0, self._backlog_rows / max(1, self._max_rows))
+        return max(0.05, batches * self._ewma_batch_s + self._max_wait)
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                timeout_s: Optional[float] = None) -> np.ndarray:
+        """Blocking submit; with ``timeout_s`` the call raises
+        :class:`DeadlineExceeded` at the deadline instead of hanging the
+        calling (handler) thread on a future that is still queued."""
+        deadline = None if timeout_s is None else \
+            time.monotonic() + float(timeout_s)
+        fut = self.submit(X, raw_score, deadline=deadline)
+        if timeout_s is None:
+            return fut.result()
+        try:
+            return fut.result(timeout=max(0.0, deadline - time.monotonic()))
+        except FutureTimeout:
+            exc = DeadlineExceeded(
+                f"request did not complete within {timeout_s:.3f}s")
+            try:
+                # mark the future failed so the worker neither batches
+                # nor double-counts this request when it dequeues it
+                fut.set_exception(exc)
+            except InvalidStateError:
+                return fut.result()  # completed in the race window
+            self._deadline.inc(1, model=self.name)
+            raise exc from None
 
     def close(self, timeout: Optional[float] = 5.0) -> None:
         with self._state_lock:
@@ -72,22 +145,41 @@ class MicroBatcher:
             self._closed = True
             self._q.put(_CLOSE)
         self._thread.join(timeout)
-        # fail anything the worker left behind rather than hanging waiters
+        # drain: fail anything the worker left behind rather than leaving
+        # its caller blocked until a client-side timeout
         while True:
             try:
                 item = self._q.get_nowait()
             except queue.Empty:
                 break
-            if item is not _CLOSE and not item[2].done():
-                item[2].set_exception(RuntimeError("batcher closed"))
+            if item is not _CLOSE:
+                try:
+                    item[2].set_exception(ServerClosed(
+                        "batcher closed while the request was queued"))
+                except InvalidStateError:
+                    pass  # its waiter expired it in the race window
 
     # -- worker side --------------------------------------------------------
+    def _take(self, item) -> bool:
+        """Account one dequeued request; expire it instead of batching it
+        when its deadline already passed."""
+        with self._state_lock:
+            self._backlog_rows -= int(item[0].shape[0])
+        if item[3] is not None and time.monotonic() > item[3]:
+            if not item[2].done():
+                self._deadline.inc(1, model=self.name)
+                item[2].set_exception(DeadlineExceeded(
+                    "request expired while queued"))
+            return False
+        return True
+
     def _loop(self) -> None:
-        import time
         while True:
             first = self._q.get()
             if first is _CLOSE:
                 return
+            if not self._take(first):
+                continue
             batch = [first]
             rows = first[0].shape[0]
             deadline = time.monotonic() + self._max_wait
@@ -112,8 +204,9 @@ class MicroBatcher:
                 if nxt is _CLOSE:
                     stop = True
                     break
-                batch.append(nxt)
-                rows += nxt[0].shape[0]
+                if self._take(nxt):
+                    batch.append(nxt)
+                    rows += nxt[0].shape[0]
             self._run(batch)
             if stop:
                 return
@@ -123,6 +216,7 @@ class MicroBatcher:
         for item in batch:
             groups.setdefault((item[1], item[0].shape[1]), []).append(item)
         for (raw, _cols), group in groups.items():
+            t0 = time.monotonic()
             try:
                 X = (group[0][0] if len(group) == 1 else
                      np.concatenate([g[0] for g in group], axis=0))
@@ -130,9 +224,18 @@ class MicroBatcher:
                 ofs = 0
                 for g in group:
                     n = g[0].shape[0]
-                    g[2].set_result(out[ofs:ofs + n])
+                    try:
+                        g[2].set_result(out[ofs:ofs + n])
+                    except InvalidStateError:
+                        pass  # its waiter expired it in the race window
                     ofs += n
+                # retry-after estimates ride this (reads are unlocked —
+                # a slightly stale float is fine)
+                self._ewma_batch_s = 0.8 * self._ewma_batch_s + \
+                    0.2 * (time.monotonic() - t0)
             except Exception as exc:  # propagate to every waiter in group
                 for g in group:
-                    if not g[2].done():
+                    try:
                         g[2].set_exception(exc)
+                    except InvalidStateError:
+                        pass  # its waiter expired it in the race window

@@ -1,7 +1,10 @@
 """Dependency-free JSON inference endpoint over ``http.server``.
 
 Endpoints:
-  GET  /healthz  -> {"status": "ok", "models": [...]}
+  GET  /healthz  -> {"status": "ok"|"degraded", "models": [...]} —
+                    degraded (with "reasons") while serving on the CPU
+                    fallback backend or while admission control shed
+                    requests in the last minute; still 200
   GET  /models   -> per-model info (trees, classes, buckets, version)
   GET  /stats    -> per-model counters (requests/rows/batches/recompiles/
                     bucket histogram/p50/p99 latency)
@@ -25,6 +28,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
@@ -32,22 +36,42 @@ import numpy as np
 
 from .batcher import MicroBatcher
 from .registry import ModelRegistry
+from ..resilience.admission import (DeadlineExceeded, QueueFullError,
+                                    ServerClosed)
 from ..utils.log import log_debug, log_info
 
 __all__ = ["PredictionServer", "main"]
 
+# /healthz reports "degraded" while sheds happened inside this window —
+# the tier is up but actively refusing some traffic
+SHED_DEGRADED_WINDOW_S = 60.0
+
 
 class PredictionServer:
-    """Registry + HTTP front end + per-model micro-batchers."""
+    """Registry + HTTP front end + per-model micro-batchers.
+
+    Admission control: ``max_queue_rows`` bounds each model's batcher
+    backlog (an over-limit submit is shed with 503 + ``Retry-After``);
+    ``deadline_ms`` (server default, per-request override in the JSON
+    body) fails slow requests with 504 instead of hanging the handler
+    thread.  Both ride the micro-batcher queue and are inert with
+    ``batching=False`` (the direct-dispatch debug path has no queue to
+    bound or expire).  ``/healthz`` reports ``degraded`` while traffic
+    is served on the CPU fallback backend or sheds happened recently."""
 
     def __init__(self, registry: ModelRegistry, host: str = "127.0.0.1",
                  port: int = 8080, max_batch_rows: int = 4096,
-                 max_wait_ms: float = 2.0, batching: bool = True) -> None:
+                 max_wait_ms: float = 2.0, batching: bool = True,
+                 max_queue_rows: int = 0,
+                 deadline_ms: float = 0.0) -> None:
         self.registry = registry
         self._batching = batching
         self._batch_opts = (max_batch_rows, max_wait_ms)
+        self._max_queue_rows = int(max_queue_rows)
+        self._deadline_ms = float(deadline_ms)  # 0 = no default deadline
         self._batchers: Dict[str, MicroBatcher] = {}
         self._batchers_lock = threading.Lock()
+        self._last_shed_t = 0.0
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
@@ -62,9 +86,14 @@ class PredictionServer:
         return self._httpd.server_address[0]
 
     def _predict(self, name: Optional[str], X: np.ndarray,
-                 raw_score: bool) -> np.ndarray:
+                 raw_score: bool,
+                 deadline_ms: Optional[float] = None) -> np.ndarray:
         pred = self.registry.get(name)  # resolves None -> the single model
         pred.stats.record_request(X.shape[0])
+        if deadline_ms is None:
+            deadline_ms = self._deadline_ms
+        timeout_s = float(deadline_ms) / 1e3 if deadline_ms and \
+            deadline_ms > 0 else None
         if not self._batching:
             return pred.predict(X, raw_score=raw_score)
         key = name if name is not None else "\0default"
@@ -77,9 +106,31 @@ class PredictionServer:
                     lambda Xb, raw, _n=name: self.registry.get(_n).predict(
                         Xb, raw_score=raw),
                     max_batch_rows=self._batch_opts[0],
-                    max_wait_ms=self._batch_opts[1])
+                    max_wait_ms=self._batch_opts[1],
+                    max_queue_rows=self._max_queue_rows,
+                    name=name if name is not None else "default")
                 self._batchers[key] = batcher
-        return batcher.predict(X, raw_score=raw_score)
+        return batcher.predict(X, raw_score=raw_score, timeout_s=timeout_s)
+
+    def health(self) -> dict:
+        """``/healthz`` payload: ``ok``, or ``degraded`` with reasons
+        while traffic runs on the CPU fallback backend or admission
+        control shed requests in the last minute — still 200 (the tier
+        answers), but a reason for an operator to look."""
+        from ..utils.backend import fallback_reason
+        reasons = []
+        fb = fallback_reason()
+        if fb:
+            reasons.append(f"cpu_fallback: {fb}")
+        if self._last_shed_t and \
+                time.monotonic() - self._last_shed_t < SHED_DEGRADED_WINDOW_S:
+            reasons.append("shedding: request queue hit its limit in the "
+                           f"last {int(SHED_DEGRADED_WINDOW_S)}s")
+        out = {"status": "degraded" if reasons else "ok",
+               "models": self.registry.names()}
+        if reasons:
+            out["reasons"] = reasons
+        return out
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "PredictionServer":
@@ -110,11 +161,14 @@ def _make_handler(server: PredictionServer):
         def log_message(self, fmt, *args):  # route access logs to debug
             log_debug("serve: " + fmt % args)
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict,
+                   extra_headers: Optional[Dict[str, str]] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -126,8 +180,7 @@ def _make_handler(server: PredictionServer):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._reply(200, {"status": "ok",
-                                  "models": server.registry.names()})
+                self._reply(200, server.health())
             elif self.path == "/models":
                 self._reply(200, server.registry.info())
             elif self.path == "/stats":
@@ -169,13 +222,37 @@ def _make_handler(server: PredictionServer):
                 self._reply(400, {"error": "body needs 'rows' (list of "
                                            "feature lists) or 'row'"})
                 return
+            deadline_ms = req.get("deadline_ms")
+            if deadline_ms is not None:
+                if isinstance(deadline_ms, bool) or \
+                        not isinstance(deadline_ms, (int, float)):
+                    self._reply(400, {"error": "deadline_ms must be a "
+                                               "number of milliseconds"})
+                    return
+                deadline_ms = float(deadline_ms)
             try:
                 X = np.asarray(rows, np.float32)
                 if X.ndim != 2:
                     raise ValueError(f"rows must be 2-D, got shape {X.shape}")
-                out = server._predict(name, X, bool(req.get("raw_score")))
+                out = server._predict(name, X, bool(req.get("raw_score")),
+                                      deadline_ms=deadline_ms)
             except KeyError as exc:
                 self._reply(404, {"error": str(exc.args[0])})
+                return
+            except QueueFullError as exc:
+                # load shed: admission control refused the request; tell
+                # the client when the backlog should have drained
+                server._last_shed_t = time.monotonic()
+                self._reply(503, {"error": str(exc),
+                                  "retry_after_s": exc.retry_after},
+                            {"Retry-After":
+                             str(max(1, int(-(-exc.retry_after // 1))))})
+                return
+            except DeadlineExceeded as exc:
+                self._reply(504, {"error": str(exc)})
+                return
+            except ServerClosed as exc:
+                self._reply(503, {"error": str(exc)})
                 return
             except Exception as exc:
                 try:
@@ -219,6 +296,8 @@ def main(argv: List[str]) -> int:
 
     Keys: host (127.0.0.1), port (8080), name (single model's registry
     name), warmup (1), batching (1), max_batch (4096), max_wait_ms (2.0),
+    max_queue_rows (0 = unbounded; over-limit requests are shed with 503
+    + Retry-After), deadline_ms (0 = none; slow requests fail with 504),
     num_iteration (-1: all).  Multiple model files register under their
     basenames.
     """
@@ -254,7 +333,9 @@ def main(argv: List[str]) -> int:
         port=int(kv.get("port", 8080)),
         max_batch_rows=int(kv.get("max_batch", 4096)),
         max_wait_ms=float(kv.get("max_wait_ms", 2.0)),
-        batching=_parse_bool(kv.get("batching"), True))
+        batching=_parse_bool(kv.get("batching"), True),
+        max_queue_rows=int(kv.get("max_queue_rows", 0)),
+        deadline_ms=float(kv.get("deadline_ms", 0.0)))
     log_info(f"serve: listening on http://{srv.host}:{srv.port} "
              f"(models: {', '.join(registry.names())})")
     try:

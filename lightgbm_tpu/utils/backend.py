@@ -15,6 +15,22 @@ import jax
 from .log import log_warning
 
 _resolved: str | None = None
+_fallback_reason: str | None = None
+
+
+def fallback_reason() -> str | None:
+    """Why the probe degraded to CPU, or None when the backend came up
+    clean.  The serve tier's ``/healthz`` reports ``degraded`` while
+    this is set — traffic is still served, but on the CPU fallback."""
+    return _fallback_reason
+
+
+def _reset_probe_for_tests() -> None:
+    """Forget the cached probe result (chaos tests re-probe under an
+    armed device_loss fault)."""
+    global _resolved, _fallback_reason
+    _resolved = None
+    _fallback_reason = None
 
 
 def default_backend() -> str:
@@ -25,12 +41,17 @@ def default_backend() -> str:
     the broken plugin.  The result is cached: the backend cannot change
     within a process once a client is live.
     """
-    global _resolved
+    global _resolved, _fallback_reason
     if _resolved is not None:
         return _resolved
     try:
+        # chaos layer: an armed device_loss fault makes the probe behave
+        # exactly like a lost accelerator (resilience/faults.py)
+        from ..resilience.faults import faults
+        faults.check_device_probe()
         _resolved = jax.default_backend()
     except RuntimeError as exc:
+        _fallback_reason = str(exc)
         log_warning(f"accelerator backend unavailable ({exc}); "
                     "falling back to CPU")
         try:

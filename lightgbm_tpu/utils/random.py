@@ -19,3 +19,22 @@ def sample_indices(n: int, k: int, seed: int, stream: int = 0) -> np.ndarray:
     if k >= n:
         return np.arange(n, dtype=np.int32)
     return np.sort(rng.choice(n, size=k, replace=False)).astype(np.int32)
+
+
+# Seeds whose derived streams are part of the training trajectory: every
+# sampler above (and the jax.random fold_in sites in models/gbdt.py /
+# boosting.py) keys its generator on (one of these seeds, iteration), so
+# a checkpoint needs no opaque generator blobs — the seeds plus the
+# iteration counter ARE the RNG state, and restoring them reproduces the
+# bagging / feature-fraction / extra-trees / dropout / quantization
+# streams bit-for-bit.
+CHECKPOINT_SEED_KEYS = ("seed", "bagging_seed", "feature_fraction_seed",
+                       "extra_seed", "drop_seed")
+
+
+def rng_checkpoint_state(config) -> dict:
+    """The RNG state a checkpoint must carry (see CHECKPOINT_SEED_KEYS).
+
+    Checked — not merely recorded — on resume: a changed seed silently
+    forks the sampling trajectory, so restore fails loudly instead."""
+    return {k: int(getattr(config, k)) for k in CHECKPOINT_SEED_KEYS}

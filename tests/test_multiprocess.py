@@ -235,3 +235,90 @@ def test_two_process_pre_partition_sparse_and_linear(tmp_path, mode):
     X = rng.randn(n, 6)
     y = (X[:, 0] * 2 - X[:, 1] + 0.3 * rng.randn(n))
     assert np.mean((p0 - y) ** 2) < np.var(y) * 0.6
+
+
+# -- chaos: one worker of a collective dies mid-train ------------------------
+_WORKER_CHAOS = textwrap.dedent("""
+    import sys
+    rank = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
+    resume = sys.argv[4] == "resume"
+    sys.path.insert(0, {repo!r})
+    import os
+    import jax
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=2").strip()
+    import lightgbm_tpu as lgb
+    lgb.distributed.init(coordinator_address="127.0.0.1:" + port,
+                         num_processes=2, process_id=rank)
+    import numpy as np
+    from lightgbm_tpu.utils.log import set_verbosity
+    set_verbosity(-1)
+    rng = np.random.RandomState(11)
+    n = 700
+    X = rng.randn(n, 6)
+    y = ((X[:, 0] + 0.5 * X[:, 1] - X[:, 2] ** 2 * 0.2) > 0).astype(float)
+    P = {{"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+          "verbosity": -1, "tree_learner": "data",
+          "checkpoint_dir": f"{{outdir}}/ck_{{rank}}"}}
+    if resume:
+        P["resume"] = "latest"
+    bst = lgb.train(P, lgb.Dataset(X, y), 6)
+    np.save(f"{{outdir}}/cpred_{{rank}}.npy", bst.predict(X))
+""")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_worker_killed_mid_collective_job_resumes(tmp_path):
+    """PV-Tree-regime chaos (resilience/faults.py kill_at_iter+kill_rank):
+    rank 1 of a 2-process data-parallel run is hard-killed entering
+    iteration 3 — the host-side analogue of a preempted worker dying
+    mid-allreduce.  The orchestrator (this test) reaps the survivor and
+    relaunches the job with resume=latest; the resumed job completes
+    from the checkpoint ring and reproduces serial training."""
+    script = str(tmp_path / "worker_chaos.py")
+    with open(script, "w") as fh:
+        fh.write(_WORKER_CHAOS.format(repo=REPO))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS="", LGBM_TPU_FAULTS="kill_at_iter=3,kill_rank=1")
+    port = str(_free_port())
+    procs = [subprocess.Popen(
+        [sys.executable, script, str(r), port, str(tmp_path), "fresh"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    out1 = procs[1].communicate(timeout=420)[0].decode()
+    assert procs[1].returncode == 137, f"rank1 should die killed:\n{out1[-2000:]}"
+    # the survivor is stuck in (or erroring out of) a collective whose
+    # peer vanished; a real orchestrator reaps and reschedules the job
+    procs[0].kill()
+    procs[0].communicate(timeout=60)
+    ck1 = tmp_path / "ck_1"
+    assert ck1.is_dir() and any(f.startswith("ckpt_iter")
+                                for f in os.listdir(ck1))
+
+    env_resume = dict(os.environ, JAX_PLATFORMS="cpu",
+                      PALLAS_AXON_POOL_IPS="", XLA_FLAGS="")
+    port = str(_free_port())
+    procs = [subprocess.Popen(
+        [sys.executable, script, str(r), port, str(tmp_path), "resume"],
+        env=env_resume, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    outs = [p.communicate(timeout=420)[0].decode() for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"resumed worker failed:\n{out[-3000:]}"
+    p0 = np.load(tmp_path / "cpred_0.npy")
+    p1 = np.load(tmp_path / "cpred_1.npy")
+    np.testing.assert_allclose(p0, p1, atol=1e-7)
+
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(11)
+    n = 700
+    X = rng.randn(n, 6)
+    y = ((X[:, 0] + 0.5 * X[:, 1] - X[:, 2] ** 2 * 0.2) > 0).astype(float)
+    serial = lgb.train({"objective": "binary", "num_leaves": 7,
+                        "min_data_in_leaf": 5, "verbosity": -1},
+                       lgb.Dataset(X, y), 6).predict(X)
+    np.testing.assert_allclose(p0, serial, atol=2e-5)

@@ -342,3 +342,124 @@ def test_serve_cli_subprocess(tmp_path, booster, binary_data):
     finally:
         proc.terminate()
         proc.wait(timeout=30)
+
+
+# -- admission control / degradation (resilience subsystem) -----------------
+def _post_full(conn, path, payload):
+    """Like _post but also returns the response headers."""
+    conn.request("POST", path, json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    return resp.status, json.loads(resp.read()), dict(resp.getheaders())
+
+
+def _slow_registry(tmp_path, booster, delay):
+    import time
+    model_file = str(tmp_path / "model.txt")
+    booster.save_model(model_file)
+    reg = ModelRegistry()
+    reg.load("model", model_file, warmup=True)
+    pred = reg.get("model")
+    orig = pred.predict
+
+    def slow_predict(X, raw_score=False):
+        time.sleep(delay)
+        return orig(X, raw_score=raw_score)
+    pred.predict = slow_predict
+    return reg
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_serve_load_shed_503_and_degraded_healthz(tmp_path, binary_data,
+                                                  booster):
+    """Synthetic overload: a slow model + a 4-row queue bound. Admitted
+    requests succeed, over-limit requests are shed with 503 +
+    Retry-After, and /healthz flips to degraded while shedding."""
+    import http.client
+    X, _ = binary_data
+    reg = _slow_registry(tmp_path, booster, delay=0.4)
+    srv = PredictionServer(reg, port=0, max_wait_ms=0.5, max_batch_rows=4,
+                           max_queue_rows=4).start()
+    try:
+        row = X[0].tolist()
+        results = []
+        lock = threading.Lock()
+
+        def hit():
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=60)
+            out = _post_full(conn, "/predict", {"rows": [row]})
+            with lock:
+                results.append(out)
+            conn.close()
+
+        threads = [threading.Thread(target=hit) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        statuses = [r[0] for r in results]
+        assert statuses.count(200) >= 1, statuses
+        assert statuses.count(503) >= 1, statuses
+        shed = next(r for r in results if r[0] == 503)
+        assert "queue is full" in shed[1]["error"]
+        assert int(shed[2]["Retry-After"]) >= 1
+        # degraded while sheds are recent — still HTTP 200
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+        status, health = _get(conn, "/healthz")
+        assert status == 200
+        assert health["status"] == "degraded"
+        assert any("shedding" in r for r in health["reasons"])
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_serve_deadline_504(tmp_path, binary_data, booster):
+    """A request whose deadline elapses while the device is busy gets
+    504 instead of hanging its handler thread; an unhurried request on
+    the same server still succeeds."""
+    import http.client
+    X, _ = binary_data
+    reg = _slow_registry(tmp_path, booster, delay=0.5)
+    srv = PredictionServer(reg, port=0, max_wait_ms=0.5,
+                           max_batch_rows=1).start()
+    try:
+        row = X[0].tolist()
+        occupier = threading.Thread(target=lambda: _post(
+            http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60),
+            "/predict", {"rows": [row]}))
+        occupier.start()
+        import time
+        time.sleep(0.15)  # the occupier's batch is now on the device
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+        status, body, _ = _post_full(conn, "/predict",
+                                     {"rows": [row], "deadline_ms": 100})
+        assert status == 504, body
+        occupier.join(60)
+        status, body = _post(conn, "/predict", {"rows": [row]})
+        assert status == 200
+    finally:
+        srv.shutdown()
+
+
+def test_healthz_degraded_on_cpu_fallback(tmp_path, booster, monkeypatch):
+    """/healthz reports degraded (with the probe's reason) while the
+    process serves on the CPU fallback backend."""
+    from lightgbm_tpu.utils import backend
+    model_file = str(tmp_path / "model.txt")
+    booster.save_model(model_file)
+    reg = ModelRegistry()
+    reg.load("model", model_file, warmup=False)
+    srv = PredictionServer(reg, port=0)
+    try:
+        assert srv.health()["status"] == "ok"
+        monkeypatch.setattr(backend, "_fallback_reason",
+                            "plugin UNAVAILABLE (injected)")
+        health = srv.health()
+        assert health["status"] == "degraded"
+        assert any("cpu_fallback" in r for r in health["reasons"])
+    finally:
+        srv._httpd.server_close()
