@@ -35,6 +35,7 @@ from .config import Config
 from .dataset import Dataset
 from .engine import CVBooster, cv, train
 from .models.model_text import ModelCorruptError
+from .multitrain import ManyBooster, MultiTrainError, train_many
 from .resilience import (Checkpoint, CheckpointError, TrainingPreempted,
                          load_checkpoint)
 from .utils.log import register_log_callback, set_verbosity
@@ -52,6 +53,7 @@ from .plotting import (plot_importance, plot_metric, plot_tree,
 __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Booster", "Config", "train", "cv", "CVBooster",
+           "train_many", "ManyBooster", "MultiTrainError",
            "early_stopping", "print_evaluation", "log_evaluation",
            "record_evaluation", "reset_parameter", "EarlyStopException",
            "register_log_callback", "set_verbosity", "distributed",

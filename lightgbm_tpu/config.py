@@ -259,6 +259,19 @@ _PARAMS: List[ParamSpec] = [
     _p("num_grad_quant_bins", int, 4, check=">1"),
     _p("quant_train_renew_leaf", bool, False),
     _p("stochastic_rounding", bool, True),
+    # --- one-program multi-model training (lightgbm_tpu/multitrain/) ---
+    # tpu_cv_many: route engine.cv() through the vmapped train_many fast
+    # path (folds = models with held-out sample masks sharing ONE binned
+    # dataset and ONE compiled program) whenever the configuration
+    # supports it; False = the per-fold boosting loop.
+    _p("tpu_cv_many", bool, True),
+    # cap on models trained in one compiled batch; larger variant sets
+    # are chunked (HBM for stacked scores/histograms scales with M)
+    _p("tpu_multitrain_batch", int, 256, check=">0"),
+    # shard the model axis over local devices (pmap of the vmapped
+    # grower) when the batch width divides the device count — every
+    # chip grows M/k models concurrently; False = single-device vmap
+    _p("tpu_multitrain_shard", bool, True),
 ]
 
 PARAM_SCHEMA: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}

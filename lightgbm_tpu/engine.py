@@ -320,6 +320,62 @@ class CVBooster:
         return handler
 
 
+class CVAggregator:
+    """Per-iteration fold-metric aggregation + aggregated early stopping
+    shared by cv()'s fold loop and the multitrain fast path
+    (multitrain/cv.py) so the two can never fork semantics.
+
+    Early stopping tracks VALIDATION metrics only (reference cv
+    semantics; train metrics are reported but never gate stopping);
+    ``first_metric_only`` restricts to the first validation metric key.
+    Stop as soon as ANY tracked metric stalls ``early_stopping_round``
+    rounds (reference early_stopping callback semantics,
+    callback.py:147)."""
+
+    def __init__(self, cfg: Config, num_boost_round: int) -> None:
+        self._es_round = cfg.early_stopping_round
+        self._first_only = bool(cfg.first_metric_only)
+        self.results: Dict[str, List[float]] = collections.defaultdict(list)
+        self.best_iter = num_boost_round
+        self.stopped = False
+        self._best_signed: Dict[str, float] = {}
+        self._best_it: Dict[str, int] = {}
+
+    def update(self, it: int, agg: Dict[str, List[float]],
+               hib_map: Dict[str, bool]) -> bool:
+        """Fold one iteration's per-fold metric lists in; True = stop."""
+        es_keys = [k for k in agg if not k.startswith("train ")]
+        if self._first_only and es_keys:
+            es_keys = es_keys[:1]
+        for key, vals in agg.items():
+            self.results[f"{key}-mean"].append(float(np.mean(vals)))
+            self.results[f"{key}-stdv"].append(float(np.std(vals)))
+            if key not in es_keys:
+                continue
+            hib = hib_map.get(key, False)
+            cur = float(np.mean(vals))
+            signed = -cur if hib else cur
+            if key not in self._best_signed or signed < self._best_signed[key]:
+                self._best_signed[key] = signed
+                self._best_it[key] = it + 1
+        if self._es_round and self._es_round > 0:
+            for key in es_keys:
+                if it + 1 - self._best_it.get(key, it + 1) >= self._es_round:
+                    self.stopped = True
+                    self.best_iter = self._best_it[key]
+                    break
+        return self.stopped
+
+    def finalize(self, cvbooster: "CVBooster") -> Dict[str, List[float]]:
+        """Truncated results dict; stamps best_iteration when stopped."""
+        out = dict(self.results)
+        if self.stopped:
+            for k in out:
+                out[k] = out[k][:self.best_iter]
+            cvbooster.best_iteration = self.best_iter
+        return out
+
+
 def _make_n_folds(full_data: Dataset, nfold: int, params: Dict[str, Any],
                   seed: int, stratified: bool, shuffle: bool):
     full_data.construct(Config(params))
@@ -380,7 +436,29 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                                    and cfg.objective in ("binary", "multiclass",
                                                          "multiclassova"),
                                    shuffle))
-    results = collections.defaultdict(list)
+    else:
+        folds = list(folds)
+
+    # fast path: folds = models with held-out sample masks, all trained
+    # in ONE vmapped program over the parent dataset's binning
+    # (multitrain/cv.py); configs the model axis cannot express fall
+    # back to the per-fold loop below
+    if cfg.tpu_cv_many:
+        from .multitrain.cv import cv_many, cv_reject_reason
+        reason = cv_reject_reason(fobj, feval, fpreproc, init_model,
+                                  callbacks)
+        if reason is None:
+            from .multitrain.batched import MultiTrainError
+            from .resilience.checkpoint import CheckpointError
+            try:
+                return cv_many(params, train_set, num_boost_round, folds,
+                               cfg, eval_train_metric=eval_train_metric,
+                               return_cvbooster=return_cvbooster)
+            except (MultiTrainError, CheckpointError) as e:
+                reason = str(e)
+        log_info(f"cv: per-fold loop (batched fold driver unavailable: "
+                 f"{reason})")
+
     cvbooster = CVBooster()
     fold_data = []
     for train_idx, test_idx in folds:
@@ -390,16 +468,16 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
             tr, te, fold_params = fpreproc(tr, te, copy.deepcopy(params))
         else:
             fold_params = params
+        if eval_train_metric:
+            # without this the fold boosters never build train metrics
+            # and eval_train() below is a silent no-op
+            fold_params = {**fold_params, "is_provide_training_metric": True}
         bst = Booster(params=fold_params, train_set=tr)
         bst.add_valid(te, "valid")
         fold_data.append(bst)
         cvbooster.append(bst)
 
-    es_round = cfg.early_stopping_round
-    best_iter = num_boost_round
-    stopped = False
-    best_signed: Dict[str, float] = {}
-    best_it_per_key: Dict[str, int] = {}
+    aggr = CVAggregator(cfg, num_boost_round)
     for it in range(num_boost_round):
         agg = collections.defaultdict(list)
         hib_map = {}
@@ -411,38 +489,9 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
             if eval_train_metric:
                 for ds, name, val, hib in bst.eval_train(feval):
                     agg[f"train {name}"].append(val)
-        # early stopping tracks VALIDATION metrics only (reference cv
-        # semantics; train metrics are reported but never gate stopping);
-        # first_metric_only restricts to the first validation metric key.
-        # Stop as soon as ANY tracked metric stalls es_round rounds
-        # (reference early_stopping callback semantics, callback.py:147).
-        es_keys = [k for k in agg if not k.startswith("train ")]
-        if cfg.first_metric_only and es_keys:
-            es_keys = es_keys[:1]
-        for key, vals in agg.items():
-            results[f"{key}-mean"].append(float(np.mean(vals)))
-            results[f"{key}-stdv"].append(float(np.std(vals)))
-            if key not in es_keys:
-                continue
-            hib = hib_map.get(key, False)
-            cur = float(np.mean(vals))
-            signed = -cur if hib else cur
-            if key not in best_signed or signed < best_signed[key]:
-                best_signed[key] = signed
-                best_it_per_key[key] = it + 1
-        if es_round and es_round > 0:
-            for key in es_keys:
-                if it + 1 - best_it_per_key.get(key, it + 1) >= es_round:
-                    stopped = True
-                    best_iter = best_it_per_key[key]
-                    break
-            if stopped:
-                break
-    out = dict(results)
-    if stopped:
-        for k in out:
-            out[k] = out[k][:best_iter]
-        cvbooster.best_iteration = best_iter
+        if aggr.update(it, agg, hib_map):
+            break
+    out = aggr.finalize(cvbooster)
     if return_cvbooster:
         out["cvbooster"] = cvbooster
     return out

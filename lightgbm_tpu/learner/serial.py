@@ -787,50 +787,67 @@ class SerialTreeLearner:
                    impl, any_cat, wave_size, self._efb_dims, feature_contri,
                    qtuple, interaction_groups, cegb_lazy, spec_ramp,
                    spec_tol, forced_splits, mc_inter, endg)
+            from .wave import make_wave_grow_fn
+            self._grow_factory = make_wave_grow_fn
+            self._grow_kwargs = dict(
+                num_leaves=int(config.num_leaves),
+                num_features=num_features, max_bins=self.max_bins,
+                max_depth=int(config.max_depth),
+                split_params=self.split_params, hist_impl=impl,
+                any_cat=any_cat, wave_size=wave_size,
+                efb_dims=self._efb_dims, feature_contri=feature_contri,
+                quantized=self.quantized, gq_max=gq_max, hq_max=hq_max,
+                renew_leaf=bool(config.quant_train_renew_leaf),
+                stochastic=bool(config.stochastic_rounding),
+                interaction_groups=interaction_groups,
+                cegb_lazy=cegb_lazy, spec_ramp=spec_ramp,
+                spec_tol=spec_tol, forced_splits=forced_splits,
+                mc_inter=mc_inter, exact_endgame=endg)
             if key not in _GROW_FN_CACHE:
-                from .wave import make_wave_grow_fn
-                _cache_put(key, make_wave_grow_fn(
-                    num_leaves=int(config.num_leaves),
-                    num_features=num_features, max_bins=self.max_bins,
-                    max_depth=int(config.max_depth),
-                    split_params=self.split_params, hist_impl=impl,
-                    any_cat=any_cat, wave_size=wave_size,
-                    efb_dims=self._efb_dims, feature_contri=feature_contri,
-                    quantized=self.quantized, gq_max=gq_max, hq_max=hq_max,
-                    renew_leaf=bool(config.quant_train_renew_leaf),
-                    stochastic=bool(config.stochastic_rounding),
-                    interaction_groups=interaction_groups,
-                    cegb_lazy=cegb_lazy, spec_ramp=spec_ramp,
-                    spec_tol=spec_tol, forced_splits=forced_splits,
-                    mc_inter=mc_inter, exact_endgame=endg))
-            self._grow = _cache_hit(key)
+                _cache_put(key, self.build_grow_fn())
         elif self.partitioned:
             key = ("part", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
                    impl, forced_splits, self._efb_dims,
                    interaction_groups, feature_contri)
+            from .partitioned import make_partitioned_grow_fn
+            self._grow_factory = make_partitioned_grow_fn
+            self._grow_kwargs = dict(
+                num_leaves=int(config.num_leaves),
+                num_features=num_features, max_bins=self.max_bins,
+                max_depth=int(config.max_depth),
+                split_params=self.split_params, hist_impl=impl,
+                forced_splits=forced_splits, efb_dims=self._efb_dims,
+                interaction_groups=interaction_groups,
+                feature_contri=feature_contri)
             if key not in _GROW_FN_CACHE:
-                from .partitioned import make_partitioned_grow_fn
-                _cache_put(key, make_partitioned_grow_fn(
-                    num_leaves=int(config.num_leaves),
-                    num_features=num_features, max_bins=self.max_bins,
-                    max_depth=int(config.max_depth),
-                    split_params=self.split_params, hist_impl=impl,
-                    forced_splits=forced_splits, efb_dims=self._efb_dims,
-                    interaction_groups=interaction_groups,
-                    feature_contri=feature_contri))
+                _cache_put(key, self.build_grow_fn())
         else:
             key = ("serial", int(config.num_leaves), self.max_bins,
                    int(config.max_depth), self.split_params, impl,
                    int(config.tpu_rows_per_chunk), self.use_hist_pool)
+            self._grow_factory = make_grow_fn
+            self._grow_kwargs = dict(
+                num_leaves=int(config.num_leaves), max_bins=self.max_bins,
+                max_depth=int(config.max_depth),
+                split_params=self.split_params, hist_impl=impl,
+                rows_per_chunk=int(config.tpu_rows_per_chunk),
+                use_hist_pool=self.use_hist_pool)
             if key not in _GROW_FN_CACHE:
-                _cache_put(key, make_grow_fn(
-                    num_leaves=int(config.num_leaves), max_bins=self.max_bins,
-                    max_depth=int(config.max_depth),
-                    split_params=self.split_params, hist_impl=impl,
-                    rows_per_chunk=int(config.tpu_rows_per_chunk),
-                    use_hist_pool=self.use_hist_pool))
+                _cache_put(key, self.build_grow_fn())
         self._grow = _cache_hit(key)
+
+    def build_grow_fn(self, split_params=None, jit: bool = True):
+        """(Re)build this learner's grower from its recorded factory
+        configuration.  ``split_params`` overrides the static SplitParams —
+        the multi-model trainer (lightgbm_tpu/multitrain/) passes a
+        variant carrying traced per-model scalars (ops/split.py
+        TRACEABLE_PARAMS) and ``jit=False`` so it can vmap the raw grower
+        over the model axis inside its own jitted step."""
+        kw = dict(self._grow_kwargs)
+        if split_params is not None:
+            kw["split_params"] = split_params
+        return self._grow_factory(jit=jit, **kw)
 
     supports_extras = True  # cegb_penalty / node_key keyword args
 

@@ -137,6 +137,89 @@ def _update_score_by_leaf(score, row_leaf, leaf_value, shrinkage):
     return score + shrinkage * leaf_value[row_leaf]
 
 
+# -- host-side per-iteration sampling (pure functions of (config, iter)) ----
+# Single-sourced here so the multi-model trainer (lightgbm_tpu/multitrain/)
+# draws bit-identical bags/feature sets for every model in a batch: a
+# train_many() variant and a standalone train() with the same seeds MUST
+# sample the same rows/features or the bit-identity contract breaks.
+
+def bagging_mask_np(cfg, n: int, iteration: int,
+                    label: Optional[np.ndarray] = None,
+                    rows: Optional[np.ndarray] = None) -> Optional[np.ndarray]:
+    """Per-iteration bagging mask (gbdt.cpp:228 Bagging, resampled every
+    bagging_freq iters with a deterministic per-block seed).
+
+    Returns a float32 (n,) 0/1 mask, or None when bagging is inactive
+    (caller keeps/creates the all-ones mask).  ``rows`` restricts the draw
+    to those row indices: the generator then samples positions in
+    ``range(len(rows))`` — exactly the draws a standalone run on the
+    compacted ``dataset[rows]`` would make — and scatters back to full
+    length (the masked-fold CV path of train_many)."""
+    pos_neg = (cfg.objective == "binary" and
+               (cfg.pos_bagging_fraction < 1.0 or
+                cfg.neg_bagging_fraction < 1.0))
+    if not (cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0 or pos_neg)):
+        return None
+    block = iteration // cfg.bagging_freq
+    rng = host_rng(cfg.bagging_seed, block)
+    nn = n if rows is None else len(rows)
+    sub = np.zeros(nn, np.float32)
+    if pos_neg:
+        # balanced bagging (gbdt.cpp:199 BaggingHelper pos/neg fractions)
+        lab = label if rows is None else label[rows]
+        pos = np.nonzero(lab > 0)[0]
+        neg = np.nonzero(lab <= 0)[0]
+        kp = int(len(pos) * cfg.pos_bagging_fraction)
+        kn = int(len(neg) * cfg.neg_bagging_fraction)
+        if kp:
+            sub[rng.choice(pos, size=kp, replace=False)] = 1.0
+        if kn:
+            sub[rng.choice(neg, size=kn, replace=False)] = 1.0
+    else:
+        k = int(nn * cfg.bagging_fraction)
+        sub[rng.choice(nn, size=k, replace=False)] = 1.0
+    if rows is None:
+        return sub
+    mask = np.zeros(n, np.float32)
+    mask[rows] = sub
+    return mask
+
+
+def feature_mask_np(cfg, num_features: int,
+                    iteration: int) -> Optional[np.ndarray]:
+    """Per-iteration feature_fraction mask (ColSampler per-tree draw), or
+    None when feature_fraction is 1.0."""
+    if cfg.feature_fraction >= 1.0:
+        return None
+    rng = host_rng(cfg.feature_fraction_seed, iteration)
+    k = max(1, int(np.ceil(num_features * cfg.feature_fraction)))
+    idx = rng.choice(num_features, size=k, replace=False)
+    mask = np.zeros(num_features, bool)
+    mask[idx] = True
+    return mask
+
+
+def make_walk_fn(efb_walk, dense_ok: bool):
+    """Binned tree-walk selector shared by GBDT._walk and multitrain:
+    EFB-bundled datasets decode bundle columns; categorical-free datasets
+    take the dense matmul walk (no per-row gathers)."""
+    if efb_walk is not None:
+        if dense_ok:
+            def walk(bins, *tree_args):
+                (sf, tb, nb, _cm, dt, lc, rc, lv, nl) = tree_args
+                return _walk_binned_dense_efb(bins, efb_walk, sf, tb, nb,
+                                              dt, lc, rc, lv, nl)
+            return walk
+        return lambda bins, *tree_args: _walk_binned_efb(bins, efb_walk,
+                                                         *tree_args)
+    if dense_ok:
+        def walk(bins, *tree_args):
+            (sf, tb, nb, _cm, dt, lc, rc, lv, nl) = tree_args
+            return _walk_binned_dense(bins, sf, tb, nb, dt, lc, rc, lv, nl)
+        return walk
+    return _walk_binned
+
+
 from .tree import (_walk_binned,  # tree walk for valid-set score updates
                    _walk_binned_dense, _walk_binned_dense_efb,
                    _walk_binned_efb)
@@ -484,16 +567,9 @@ class GBDT:
         when the dataset is EFB-bundled (valid sets aligned to an EFB
         reference carry BUNDLE columns).  Categorical-free non-EFB
         datasets take the dense matmul walk (no per-row gathers)."""
-        if self._efb_walk is not None:
-            if getattr(self, "_walk_dense_ok", False):
-                (sf, tb, nb, _cm, dt, lc, rc, lv, nl) = tree_args
-                return _walk_binned_dense_efb(bins, self._efb_walk, sf, tb,
-                                              nb, dt, lc, rc, lv, nl)
-            return _walk_binned_efb(bins, self._efb_walk, *tree_args)
-        if getattr(self, "_walk_dense_ok", False):
-            (sf, tb, nb, _cm, dt, lc, rc, lv, nl) = tree_args
-            return _walk_binned_dense(bins, sf, tb, nb, dt, lc, rc, lv, nl)
-        return _walk_binned(bins, *tree_args)
+        return make_walk_fn(self._efb_walk,
+                            getattr(self, "_walk_dense_ok", False))(
+            bins, *tree_args)
 
     def add_valid(self, valid_set: Dataset, name: str) -> None:
         # a valid set must share the train set's bin mappers (and bundle
@@ -573,45 +649,19 @@ class GBDT:
         bagging_freq iters); GOSS/RF override."""
         cfg = self.config
         n = self.num_data
-        pos_neg = (cfg.objective == "binary" and
-                   (cfg.pos_bagging_fraction < 1.0 or
-                    cfg.neg_bagging_fraction < 1.0))
-        if cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0 or pos_neg):
-            # resample every bagging_freq iterations with a deterministic
-            # per-block seed (reference bagging_seed + iteration)
-            block = self.iter_ // cfg.bagging_freq
-            rng = host_rng(cfg.bagging_seed, block)
-            mask = np.zeros(n, np.float32)
-            if pos_neg:
-                # balanced bagging (gbdt.cpp:199 BaggingHelper pos/neg
-                # fractions over the binary label)
-                label = np.asarray(self.train_set.metadata.label)
-                pos = np.nonzero(label > 0)[0]
-                neg = np.nonzero(label <= 0)[0]
-                kp = int(len(pos) * cfg.pos_bagging_fraction)
-                kn = int(len(neg) * cfg.neg_bagging_fraction)
-                if kp:
-                    mask[rng.choice(pos, size=kp, replace=False)] = 1.0
-                if kn:
-                    mask[rng.choice(neg, size=kn, replace=False)] = 1.0
-            else:
-                k = int(n * cfg.bagging_fraction)
-                mask[rng.choice(n, size=k, replace=False)] = 1.0
+        label = (np.asarray(self.train_set.metadata.label)
+                 if cfg.objective == "binary" and
+                 self.train_set.metadata.label is not None else None)
+        mask = bagging_mask_np(cfg, n, self.iter_, label=label)
+        if mask is not None:
             self._bag_mask = jnp.asarray(mask)
         elif not hasattr(self, "_bag_mask") or self._bag_mask.shape[0] != n:
             self._bag_mask = jnp.ones(n, jnp.float32)
         return grad, hess, self._bag_mask
 
     def _feature_mask(self) -> Optional[jnp.ndarray]:
-        cfg = self.config
-        if cfg.feature_fraction >= 1.0:
-            return None
-        rng = host_rng(cfg.feature_fraction_seed, self.iter_)
-        k = max(1, int(np.ceil(self.num_features * cfg.feature_fraction)))
-        idx = rng.choice(self.num_features, size=k, replace=False)
-        mask = np.zeros(self.num_features, bool)
-        mask[idx] = True
-        return jnp.asarray(mask)
+        mask = feature_mask_np(self.config, self.num_features, self.iter_)
+        return None if mask is None else jnp.asarray(mask)
 
     # -- one boosting iteration (gbdt.cpp:369 TrainOneIter) ------------------
     def train_one_iter(self, grad: Optional[jnp.ndarray] = None,

@@ -1,0 +1,188 @@
+"""One-program multi-model training (``train_many``).
+
+Serving millions of users means thousands of per-cohort models,
+cross-validation folds and hyperparameter sweeps — and every standalone
+``train()`` call leaves the accelerator mostly idle on small datasets.
+``train_many`` stacks M boosters along a vmapped model axis and trains
+them all inside ONE compiled program, sharing the binned dataset and the
+compile cache, with every extracted model bit-identical to the booster a
+standalone ``train()`` with the same params would produce.
+
+    import lightgbm_tpu as lgb
+    mb = lgb.train_many(params, train_set,
+                        variants=[{"lambda_l1": v} for v in grid],
+                        num_boost_round=100)
+    mb[3].predict(X)           # a full standalone Booster
+
+Entry points:
+
+* :func:`train_many` — batch-train a variant list (or ``replicas=M``
+  bagging-decorrelated copies) of one base config.
+* :class:`GridSearchCVMany` (multitrain/sweep.py) — a
+  ``sklearn.model_selection.GridSearchCV``-compatible sweep where every
+  (combo, fold) model trains in the same program.
+* ``engine.cv`` routes through the batched fold driver
+  (multitrain/cv.py) automatically when ``tpu_cv_many`` (default true)
+  and the config supports it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset
+from ..utils.log import log_info, log_warning
+from .batched import BatchTrainer, MultiTrainError, batch_reject_reason
+from .variants import (HOST_SWEEP, SWEEPABLE, TRACED_SWEEP, group_variants,
+                       normalize_variants, structure_key)
+
+__all__ = ["train_many", "ManyBooster", "MultiTrainError",
+           "GridSearchCVMany", "TRACED_SWEEP", "HOST_SWEEP", "SWEEPABLE"]
+
+
+class ManyBooster:
+    """Result of :func:`train_many`: a list-like container of standalone
+    per-model :class:`~lightgbm_tpu.basic.Booster` handles plus the batch
+    bookkeeping (eval histories, which models batched vs fell back)."""
+
+    def __init__(self) -> None:
+        self.boosters: List = []
+        self.variant_params: List[Dict[str, Any]] = []
+        self.eval_histories: List[Dict] = []
+        self.batched_indices: List[int] = []
+        self.fallback_indices: List[int] = []
+        self.num_groups = 0
+
+    def __len__(self) -> int:
+        return len(self.boosters)
+
+    def __getitem__(self, i):
+        return self.boosters[i]
+
+    def __iter__(self):
+        return iter(self.boosters)
+
+    @property
+    def best_iteration(self) -> List[int]:
+        return [b.best_iteration for b in self.boosters]
+
+    def predict(self, X, **kwargs) -> np.ndarray:
+        """(M, rows[, ...]) stacked predictions of every model."""
+        return np.stack([b.predict(X, **kwargs) for b in self.boosters])
+
+
+def train_many(params: Dict[str, Any], train_set: Dataset,
+               num_boost_round: int = 100,
+               variants: Optional[Sequence[Dict[str, Any]]] = None,
+               replicas: Optional[int] = None,
+               sample_masks=None,
+               valid_sets: Optional[List[Dataset]] = None,
+               valid_names: Optional[List[str]] = None,
+               allow_fallback: bool = True,
+               force_traced: bool = False,
+               **kwargs: Any) -> ManyBooster:
+    """Train M boosters in one traced program.
+
+    Args:
+      params: base parameters (every variant inherits them).
+      variants: per-model override dicts, or a ``param -> list`` column
+        dict.  Sweepable params (``multitrain.SWEEPABLE``) batch into one
+        program; structurally differing variants group into same-structure
+        batches; unsupported ones fall back to sequential ``train()``.
+      replicas: instead of ``variants``, train M bagging-decorrelated
+        copies of the base params (per-model seeds derived by
+        ``utils.random.model_stream_seed`` and materialized into
+        ``result.variant_params``).
+      sample_masks: optional (M, N) per-model training-row masks
+        (fold/cohort training against the SHARED binned dataset; 0 rows
+        are excluded exactly like a row subset).
+      valid_sets/valid_names: shared validation Datasets (per-model
+        early stopping runs against per-model scores).
+      allow_fallback: False raises :class:`MultiTrainError` instead of
+        training unsupported variants sequentially.
+      force_traced: trace every sweepable hyperparameter even when it
+        does not vary (testing hook: exercises the traced program).
+
+    Returns:
+      :class:`ManyBooster`; ``result[m]`` is bit-identical to
+      ``train(result.variant_params[m], train_set, num_boost_round)``.
+    """
+    params = dict(params or {})
+    params.update(kwargs)
+    if sample_masks is not None:
+        sample_masks = np.asarray(sample_masks, np.float32)
+        num_models = sample_masks.shape[0]
+    else:
+        num_models = None
+    vparams = normalize_variants(params, variants, replicas,
+                                 num_models=num_models)
+    M = len(vparams)
+    if sample_masks is not None and sample_masks.shape[0] != M:
+        raise ValueError(f"sample_masks rows ({sample_masks.shape[0]}) != "
+                         f"number of variants ({M})")
+
+    result = ManyBooster()
+    result.boosters = [None] * M
+    result.eval_histories = [None] * M
+    result.variant_params = vparams
+
+    groups = group_variants(vparams)
+    result.num_groups = len(groups)
+    cap = max(1, int(Config(params).tpu_multitrain_batch))
+
+    def _fallback(indices: List[int], reason: str) -> None:
+        if not allow_fallback:
+            raise MultiTrainError(reason)
+        log_warning(f"train_many: {len(indices)} variant(s) fall back to "
+                    f"sequential train(): {reason}")
+        from ..engine import train as engine_train
+        from ..callback import record_evaluation
+        for i in indices:
+            if sample_masks is not None:
+                raise MultiTrainError(
+                    f"sample_masks with a non-batchable variant: {reason}")
+            hist: Dict = {}
+            bst = engine_train(vparams[i], train_set,
+                               num_boost_round=num_boost_round,
+                               valid_sets=valid_sets,
+                               valid_names=valid_names,
+                               callbacks=[record_evaluation(hist)])
+            result.boosters[i] = bst
+            result.eval_histories[i] = hist
+            result.fallback_indices.append(i)
+
+    for indices in groups:
+        for lo in range(0, len(indices), cap):
+            chunk = indices[lo:lo + cap]
+            sub_params = [vparams[i] for i in chunk]
+            sub_masks = (sample_masks[chunk] if sample_masks is not None
+                         else None)
+            try:
+                trainer = BatchTrainer(sub_params, train_set,
+                                       sample_masks=sub_masks,
+                                       valid_sets=valid_sets,
+                                       valid_names=valid_names,
+                                       force_traced=force_traced)
+            except MultiTrainError as e:
+                _fallback(chunk, str(e))
+                continue
+            trainer.run(num_boost_round)
+            boosters = trainer.finalize()
+            for i, bst, st in zip(chunk, boosters, trainer.states):
+                result.boosters[i] = bst
+                result.eval_histories[i] = st.history
+                result.batched_indices.append(i)
+            log_info(f"train_many: batched {len(chunk)} models in one "
+                     f"program ({trainer._steps} rounds)")
+    return result
+
+
+def __getattr__(name):
+    # lazy: sweep imports sklearn glue which may be absent
+    if name == "GridSearchCVMany":
+        from .sweep import GridSearchCVMany
+        return GridSearchCVMany
+    raise AttributeError(name)

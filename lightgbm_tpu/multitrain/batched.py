@@ -1,0 +1,663 @@
+"""One-program multi-model training: the vmapped batch boosting driver.
+
+M boosters train inside ONE compiled program: per-model state (scores,
+gradients, bagging/feature masks, RNG keys, swept hyperparameters) is
+stacked along a leading model axis and the single-tree grower — the SAME
+factory-built function a standalone ``train()`` uses
+(learner/serial.py ``SerialTreeLearner.build_grow_fn``) — is ``jax.vmap``-ed
+over it.  The binned dataset, the feature descriptors and the compiled
+step are shared across all M models.
+
+Bit-identity contract: model m of a batch is bit-identical to the model a
+standalone ``train(variants[m])`` with the same seeds would produce.
+This holds because
+
+* the grower's histogram build + split scan are value-deterministic
+  under vmap (each model's lane runs the same reduction tree — asserted
+  by tests/test_multitrain.py on the partition and wave paths);
+* host-side sampling draws are single-sourced
+  (models/gbdt.py ``bagging_mask_np`` / ``feature_mask_np``) and keyed
+  per model by the variant's own seeds;
+* swept hyperparameters enter the traced program as per-model scalars
+  that flow through the exact arithmetic the constant-folded standalone
+  program runs (ops/split.py ``TRACEABLE_PARAMS``);
+* the per-iteration dispatch BOUNDARIES mirror the standalone loop
+  (eager gradients, one jitted grower program, an eager
+  ``leaf_value * lr`` multiply, the jitted gather+add score update, the
+  jitted valid-set walk plus an eager add).  Fusing them into one
+  program is NOT value-safe: XLA contracts the multiply into the score
+  add as a single-rounding FMA — ``optimization_barrier`` does not stop
+  it on the CPU backend — and drifts 1 ulp off the standalone
+  trajectory.
+
+The per-iteration host work is only mask refreshes and metric
+evaluation; the heavy lifting (histogram build + split scan for all M
+models) is the single vmapped grower program per iteration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..basic import Booster
+from ..callback import CallbackEnv, EarlyStopException, early_stopping
+from ..config import Config
+from ..dataset import Dataset, Metadata
+from ..learner.serial import GrownTree, SerialTreeLearner
+from ..metric import create_metrics
+from ..models.gbdt import (EPSILON, _grown_to_tree, _mappers_equal,
+                           _update_score_by_leaf, bagging_mask_np,
+                           feature_mask_np, make_walk_fn)
+from ..objective import create_objective
+from ..resilience.checkpoint import reject_checkpointing
+from ..resilience.faults import faults
+from ..telemetry.metrics import default_registry
+from ..telemetry.train_record import TrainRecord, set_last_train_record
+from .variants import TRACED_SWEEP
+
+__all__ = ["MultiTrainError", "BatchTrainer", "batch_reject_reason"]
+
+
+class MultiTrainError(ValueError):
+    """The configuration cannot train on the vmapped model axis."""
+
+
+# objectives whose gradients are elementwise in the score (vmap-exact)
+# and whose leaf values need no host-side percentile refit
+_UNSUPPORTED_OBJECTIVES = ("lambdarank", "rank_xendcg", "none",
+                           "multiclass", "multiclassova", "softmax")
+
+
+def batch_reject_reason(cfg: Config, train_set: Dataset) -> Optional[str]:
+    """Why this config cannot ride the vmapped model axis (None = it can).
+
+    The excluded features either keep per-tree host state the batch
+    cannot stack (CEGB used-sets, linear-leaf refits, L1-style leaf
+    renewal, DART tree drops), need gradient-dependent host sampling
+    (GOSS), or change the traced program per model (multiclass,
+    distributed learners)."""
+    if cfg.boosting not in ("gbdt", ""):
+        return f"boosting={cfg.boosting} (per-iteration host state)"
+    if cfg.objective in _UNSUPPORTED_OBJECTIVES:
+        return f"objective={cfg.objective}"
+    if int(cfg.num_class) > 1:
+        return "num_class>1 (per-class tree axis)"
+    if cfg.tree_learner not in ("serial", ""):
+        return f"tree_learner={cfg.tree_learner} (mesh collectives)"
+    if cfg.linear_tree:
+        return "linear_tree (host-side leaf fits)"
+    if (cfg.cegb_penalty_split > 0 or cfg.cegb_penalty_feature_coupled or
+            cfg.cegb_penalty_feature_lazy):
+        return "CEGB penalties (cross-tree used-feature state)"
+    if getattr(train_set, "distributed_rows", False):
+        return "pre_partition-ed multi-process dataset"
+    if train_set.metadata.group is not None:
+        return "ranking/query data"
+    return None
+
+
+def _objective_reject_reason(objective) -> Optional[str]:
+    if objective is None:
+        return "custom objective (fobj)"
+    if getattr(objective, "is_renew_tree_output", False):
+        return (f"objective {type(objective).__name__} renews leaf values "
+                "host-side per tree")
+    if objective.num_model_per_iteration != 1:
+        return "multi-model-per-iteration objective"
+    return None
+
+
+def _subset_metadata(md: Metadata, rows: np.ndarray,
+                     mask_vals: Optional[np.ndarray] = None) -> Metadata:
+    """Metadata restricted to ``rows`` (the standalone counterpart's
+    ``Dataset.subset`` view).  Fractional mask values fold into the
+    weights so a soft-masked model's boost_from_average matches its
+    effective objective."""
+    sub = Metadata()
+    if md.label is not None:
+        sub.set_label(np.asarray(md.label)[rows])
+    w = None if md.weight is None else np.asarray(md.weight)[rows]
+    if mask_vals is not None and not np.all(mask_vals == 1.0):
+        w = mask_vals if w is None else w * mask_vals
+    if w is not None:
+        sub.set_weight(w)
+    if md.init_score is not None:
+        sub.set_init_score(np.asarray(md.init_score)[rows])
+    return sub
+
+
+class _ModelState:
+    """Host bookkeeping of one model lane."""
+
+    __slots__ = ("cfg", "params", "rows", "mask_vals", "bias", "active",
+                 "kept_iters", "best_iteration", "best_score", "stopper",
+                 "history", "metrics_per_valid", "stop_reason")
+
+    def __init__(self, cfg: Config, params: Dict[str, Any]) -> None:
+        self.cfg = cfg
+        self.params = params
+        self.rows: Optional[np.ndarray] = None
+        self.mask_vals: Optional[np.ndarray] = None
+        self.bias = 0.0
+        self.active = True
+        self.kept_iters = 0
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self.stopper = None
+        self.history: Dict[str, Dict[str, List[float]]] = {}
+        self.metrics_per_valid: List[list] = []
+        self.stop_reason = ""
+
+
+class BatchTrainer:
+    """Trains one same-structure group of M variants in one program.
+
+    Drivers (``train_many``, the CV fast path, the sweep) construct it,
+    call :meth:`run` or drive :meth:`step_once` themselves, then
+    :meth:`finalize` to extract per-model standalone ``Booster``s."""
+
+    def __init__(self, variant_params: List[Dict[str, Any]],
+                 train_set: Dataset,
+                 sample_rows: Optional[List[Optional[np.ndarray]]] = None,
+                 sample_masks: Optional[np.ndarray] = None,
+                 valid_sets: Optional[List[Dataset]] = None,
+                 valid_names: Optional[List[str]] = None,
+                 force_traced: bool = False) -> None:
+        self.M = len(variant_params)
+        if self.M == 0:
+            raise MultiTrainError("empty variant batch")
+        self.params = [dict(p) for p in variant_params]
+        self.cfgs = [Config(p) for p in self.params]
+        cfg = self.cfgs[0]
+        self.cfg = cfg
+        reject_checkpointing(cfg, "train_many")
+        train_set.construct(cfg)
+        reason = batch_reject_reason(cfg, train_set)
+        if reason:
+            raise MultiTrainError(reason)
+        self.train_set = train_set
+        self.n = train_set.num_data()
+        self.num_features = train_set.num_feature()
+
+        # the shared objective: gradients are elementwise per row, so one
+        # instance initialized on the FULL metadata serves every model
+        # (per-model row masks never reach gradient VALUES)
+        self.objective = (create_objective(cfg.objective, cfg)
+                          if cfg.objective != "none" else None)
+        reason = _objective_reject_reason(self.objective)
+        if reason:
+            raise MultiTrainError(reason)
+        self.objective.init(train_set.metadata, self.n)
+
+        # the learner: same selection path as GBDT._init_train
+        from ..binning import MissingType
+        mappers = [train_set.bin_mappers[j] for j in train_set.used_feature_map]
+        self.max_bins = int(max(m.num_bin for m in mappers))
+        num_bins = np.array([m.num_bin for m in mappers], np.int32)
+        is_cat = np.array([m.is_categorical for m in mappers], bool)
+        has_nan = np.array(
+            [m.missing_type == MissingType.NAN for m in mappers], bool)
+        from ..models.gbdt import GBDT
+        shim = GBDT.__new__(GBDT)
+        shim.config = cfg
+        shim.train_set = train_set
+        shim.num_features = self.num_features
+        shim.max_bins = self.max_bins
+        monotone = GBDT._inner_monotone(shim)
+        self.learner = SerialTreeLearner(
+            cfg, self.num_features, self.max_bins, num_bins, is_cat,
+            has_nan, monotone, GBDT._parse_forced_splits(shim),
+            efb=train_set.efb,
+            interaction_groups=GBDT._parse_interaction_constraints(shim),
+            feature_contri=GBDT._inner_contri(shim),
+            cegb_lazy=())
+        if self.learner.grow_mode == "masked":
+            raise MultiTrainError(
+                "pool-less (masked) grower: histogram pool exceeds budget")
+        if getattr(self.learner, "pallas", False):
+            raise MultiTrainError(
+                "pallas histogram kernel (vmap batching of pallas_call is "
+                "unverified on this jax); set tpu_histogram_impl=segment "
+                "or onehot to batch on TPU")
+
+        # per-model lanes
+        self.states = [_ModelState(c, p)
+                       for c, p in zip(self.cfgs, self.params)]
+        if sample_rows is not None:
+            for st, rows in zip(self.states, sample_rows):
+                if rows is not None:
+                    st.rows = np.asarray(rows, np.int64)
+        if sample_masks is not None:
+            sample_masks = np.asarray(sample_masks, np.float32)
+            if sample_masks.shape != (self.M, self.n):
+                raise MultiTrainError(
+                    f"sample_masks shape {sample_masks.shape} != "
+                    f"({self.M}, {self.n})")
+            for m, st in enumerate(self.states):
+                nz = np.nonzero(sample_masks[m] > 0)[0]
+                st.rows = nz
+                st.mask_vals = sample_masks[m][nz]
+        if any(st.rows is not None for st in self.states) and \
+                cfg.objective == "binary" and cfg.is_unbalance:
+            # the shared objective derives is_unbalance's label_weight
+            # from the FULL dataset's pos/neg counts; a fold/cohort
+            # model's standalone counterpart derives it from ITS rows —
+            # masked gradients would silently weight wrong
+            raise MultiTrainError(
+                "is_unbalance with per-model sample masks (label_weight "
+                "depends on the fold's own pos/neg counts)")
+
+        # swept hyperparameters -> traced (M, S) matrix; fields equal
+        # across the batch stay static (max constant folding)
+        self.sweep_fields = tuple(
+            f for f in TRACED_SWEEP
+            if force_traced or len({float(getattr(c, f))
+                                    for c in self.cfgs}) > 1)
+        self.sweep = np.asarray(
+            [[np.float32(getattr(c, f)) for f in self.sweep_fields]
+             for c in self.cfgs], np.float32).reshape(self.M,
+                                                      len(self.sweep_fields))
+        self.lr = np.asarray([np.float32(c.learning_rate)
+                              for c in self.cfgs], np.float32)
+
+        self._init_scores()
+        self._init_valid(valid_sets or [], valid_names or [])
+        self._init_keys()
+        self._build_step()
+
+        self._grown: List[GrownTree] = []       # stacked per-iteration
+        self._leaves: List[Any] = []            # device (M,) per iteration
+        self._steps = 0
+        self.record = TrainRecord(meta={
+            "boosting": "gbdt", "objective": str(cfg.objective),
+            "tree_learner": "serial",
+            "multitrain_models": self.M,
+            "num_leaves": int(cfg.num_leaves),
+            "num_data": int(self.n),
+            "num_features": int(self.num_features),
+        })
+        set_last_train_record(self.record)
+        reg = default_registry()
+        reg.counter("multitrain_batches_total",
+                    "vmapped train_many batches started").inc()
+        reg.counter("multitrain_models_total",
+                    "models trained on the vmapped model axis").inc(self.M)
+
+    # -- setup ---------------------------------------------------------------
+    def _init_scores(self) -> None:
+        md = self.train_set.metadata
+        score0 = np.zeros((self.M, self.n), np.float32)
+        for m, st in enumerate(self.states):
+            if md.init_score is not None:
+                score0[m] += md.init_score.reshape(self.n).astype(np.float32)
+            elif st.cfg.boost_from_average:
+                if st.rows is None:
+                    st.bias = self.objective.boost_from_score(0)
+                else:
+                    # fold/cohort models: the standalone counterpart
+                    # computes its average over ITS rows only
+                    obj = create_objective(st.cfg.objective, st.cfg)
+                    obj.init(_subset_metadata(md, st.rows, st.mask_vals),
+                             len(st.rows))
+                    st.bias = obj.boost_from_score(0)
+                score0[m] += np.float32(st.bias)
+        self.score = jnp.asarray(score0)
+
+    def _init_valid(self, valid_sets: List[Dataset],
+                    valid_names: List[str]) -> None:
+        self.valid_sets: List[Tuple[str, Dataset]] = []
+        self.vbins: List[jnp.ndarray] = []
+        vscores = []
+        for i, vs in enumerate(valid_sets):
+            if vs is self.train_set:
+                raise MultiTrainError(
+                    "valid_sets containing the train set (training "
+                    "metrics) is not batched; drop it or use train()")
+            name = (valid_names[i] if i < len(valid_names)
+                    else f"valid_{i}")
+            if not vs.constructed and \
+                    getattr(vs, "reference", None) is not self.train_set:
+                vs.reference = self.train_set
+            vs.construct(self.cfg)
+            if vs.bin_mappers is not self.train_set.bin_mappers and \
+                    not _mappers_equal(vs.bin_mappers,
+                                       self.train_set.bin_mappers):
+                raise ValueError(
+                    "cannot add validation data: it was constructed "
+                    "without reference to the training Dataset")
+            nv = vs.num_data()
+            v0 = np.zeros((self.M, nv), np.float32)
+            for m, st in enumerate(self.states):
+                if vs.metadata.init_score is not None:
+                    v0[m] += vs.metadata.init_score.reshape(nv).astype(
+                        np.float32)
+                elif st.cfg.boost_from_average:
+                    v0[m] += np.float32(st.bias)
+            if "bins" not in vs._device_cache:
+                vs._device_cache["bins"] = jnp.asarray(vs.X_binned)
+            self.valid_sets.append((name, vs))
+            self.vbins.append(vs._device_cache["bins"])
+            vscores.append(jnp.asarray(v0))
+            for st in self.states:
+                metrics = create_metrics(st.cfg)
+                for mt in metrics:
+                    mt.init(vs.metadata, nv)
+                st.metrics_per_valid.append(metrics)
+        self.vscores = tuple(vscores)
+
+    def _init_keys(self) -> None:
+        lrn = self.learner
+        self._need_quant_key = bool(lrn.quantized)
+        sp = lrn.split_params
+        self._need_node_key = (sp.feature_fraction_bynode < 1.0 or
+                               sp.extra_trees)
+        if self._need_quant_key:
+            self._quant_base = jnp.stack(
+                [jax.random.PRNGKey(int(st.cfg.seed))
+                 for st in self.states])
+        if self._need_node_key:
+            self._node_base = jnp.stack([jnp.stack([
+                jax.random.PRNGKey(int(st.cfg.feature_fraction_seed)),
+                jax.random.PRNGKey(int(st.cfg.extra_seed))])
+                for st in self.states])
+        self._fold_one = jax.jit(jax.vmap(jax.random.fold_in,
+                                          in_axes=(0, None)))
+        self._fold_two = jax.jit(jax.vmap(jax.vmap(jax.random.fold_in,
+                                                   in_axes=(0, None)),
+                                          in_axes=(0, None)))
+
+    def _build_step(self) -> None:
+        lrn = self.learner
+        # no row padding: the pallas impl (the only padded layout) is
+        # rejected in __init__
+        X_dev = jnp.asarray(self.train_set.X_binned)
+        wave = lrn.grow_mode == "wave"
+        self._X_arg = jnp.asarray(jnp.swapaxes(X_dev, 0, 1)) if wave \
+            else X_dev
+
+        base_sp = lrn.split_params
+        sweep_fields = self.sweep_fields
+        efb_args = lrn._efb_args
+        num_bins, is_cat, has_nan = lrn.num_bins, lrn.is_cat, lrn.has_nan
+        monotone = lrn.monotone
+        F = self.num_features
+        quantized = self._need_quant_key
+        need_nk = self._need_node_key
+        objective = self.objective
+        walk_fn = make_walk_fn(
+            None if self.train_set.efb is None else (
+                None, jnp.asarray(self.train_set.efb.f_bundle),
+                jnp.asarray(self.train_set.efb.f_offset),
+                jnp.asarray(self.train_set.efb.f_default),
+                jnp.asarray(self.train_set.efb.f_nbins),
+                jnp.asarray(self.train_set.efb.f_single)),
+            not bool(np.any(np.asarray(lrn.is_cat))))
+
+        def one_grow(X_arg, g, h, mk, fmask, sweep, qkey, nkey):
+            sp = base_sp
+            if sweep_fields:
+                sp = sp._replace(**{f: sweep[i]
+                                    for i, f in enumerate(sweep_fields)})
+            grow = lrn.build_grow_fn(split_params=sp, jit=False)
+            cegb0 = jnp.zeros((F,), jnp.float32)
+            if wave:
+                kw = {}
+                if quantized:
+                    kw["quant_key"] = qkey
+                if need_nk:
+                    kw["node_key"] = nkey
+                return grow(X_arg, g, h, mk, num_bins, is_cat, has_nan,
+                            monotone, cegb0, efb_args, fmask, **kw)
+            nk = nkey if need_nk else jnp.zeros((2, 2), jnp.uint32)
+            return grow(X_arg, g, h, mk, num_bins, is_cat, has_nan,
+                        monotone, cegb0, nk, efb_args, fmask)
+
+        # dispatch boundaries mirror the standalone loop (see module
+        # docstring): gradients stay EAGER vmap (elementwise primitives
+        # batch with the same per-op rounding the standalone's eager
+        # get_gradients dispatches), the grower is ONE jitted program,
+        # the score/valid updates ride the standalone's own jitted
+        # helpers under eager vmap
+        self._vm_grad = jax.vmap(objective.get_gradients)
+        vm_grow = jax.vmap(one_grow, in_axes=(None, 0, 0, 0, 0, 0, 0, 0))
+        # model-axis sharding: pmap the vmapped grower so each device
+        # grows M/k models concurrently.  Per-lane values are identical
+        # either way (a vmap lane's arithmetic is batch-width
+        # independent — the bit-identity suite pins this), so sharding
+        # is purely a throughput choice.
+        ndev = jax.local_device_count()
+        self._shard = (bool(self.cfg.tpu_multitrain_shard) and ndev > 1
+                       and self.M >= ndev and self.M % ndev == 0)
+        if self._shard:
+            self._ndev = ndev
+            self._vm_grow = jax.pmap(vm_grow,
+                                     in_axes=(None, 0, 0, 0, 0, 0, 0, 0))
+        else:
+            self._vm_grow = jax.jit(vm_grow)
+        self._vm_walk = jax.vmap(walk_fn,
+                                 in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0, 0))
+        self._vm_upd = jax.vmap(_update_score_by_leaf,
+                                in_axes=(0, 0, 0, None))
+        self._lr_dev = jnp.asarray(self.lr)
+        self._sweep_dev = jnp.asarray(self.sweep)
+
+    # -- per-iteration host inputs ------------------------------------------
+    def _masks_for_iter(self, it: int) -> Optional[np.ndarray]:
+        """(M, N) f32 training-row masks for this iteration, or None when
+        unchanged from the previous one (device array reused).  The bag
+        only moves at bagging-block boundaries (bagging_mask_np is a pure
+        function of the block), so off-boundary iterations skip the host
+        sampling AND the host->device transfer entirely."""
+        def _bagged(st):
+            c = st.cfg
+            pos_neg = (c.objective == "binary" and
+                       (c.pos_bagging_fraction < 1.0 or
+                        c.neg_bagging_fraction < 1.0))
+            return c.bagging_freq > 0 and (c.bagging_fraction < 1.0 or
+                                           pos_neg)
+        if it > 0 and not any(
+                _bagged(st) and it % max(1, int(st.cfg.bagging_freq)) == 0
+                for st in self.states):
+            return None
+        label = None
+        if self.cfg.objective == "binary" and \
+                self.train_set.metadata.label is not None:
+            label = np.asarray(self.train_set.metadata.label)
+        rows_out = []
+        for st in self.states:
+            base = bagging_mask_np(st.cfg, self.n, it, label=label,
+                                   rows=st.rows)
+            if base is None:
+                if st.rows is not None:
+                    base = np.zeros(self.n, np.float32)
+                    base[st.rows] = 1.0
+                else:
+                    base = np.ones(self.n, np.float32)
+            if st.mask_vals is not None and st.rows is not None:
+                sub = base[st.rows] * st.mask_vals
+                base = np.zeros(self.n, np.float32)
+                base[st.rows] = sub
+            rows_out.append(base)
+        return np.stack(rows_out)
+
+    def _fmask_for_iter(self, it: int) -> Optional[np.ndarray]:
+        any_ff = any(st.cfg.feature_fraction < 1.0 for st in self.states)
+        if not any_ff:
+            return None if it > 0 else np.ones((self.M, self.num_features),
+                                               bool)
+        out = np.ones((self.M, self.num_features), bool)
+        for m, st in enumerate(self.states):
+            fm = feature_mask_np(st.cfg, self.num_features, it)
+            if fm is not None:
+                out[m] = fm
+        return out
+
+    def step_once(self, it: int) -> None:
+        faults.check_train_iter(it)
+        masks = self._masks_for_iter(it)
+        if masks is not None:
+            self._mask_dev = jnp.asarray(masks)
+        fmask = self._fmask_for_iter(it)
+        if fmask is not None:
+            self._fmask_dev = jnp.asarray(fmask)
+        qk = (self._fold_one(self._quant_base, it)
+              if self._need_quant_key else self._dummy_qk())
+        nk = (self._fold_two(self._node_base, it)
+              if self._need_node_key else self._dummy_nk())
+        with self.record.phase("gradients"):
+            grad, hess = self._vm_grad(self.score)
+        with self.record.phase("grow"):
+            if self._shard:
+                k = self._ndev
+                dev = lambda a: a.reshape((k, self.M // k) + a.shape[1:])
+                grown = self._vm_grow(self._X_arg, dev(grad), dev(hess),
+                                      dev(self._mask_dev),
+                                      dev(self._fmask_dev),
+                                      dev(self._sweep_dev), dev(qk), dev(nk))
+                grown = jax.tree_util.tree_map(
+                    lambda a: a.reshape((self.M,) + a.shape[2:]), grown)
+            else:
+                grown = self._vm_grow(self._X_arg, grad, hess,
+                                      self._mask_dev, self._fmask_dev,
+                                      self._sweep_dev, qk, nk)
+        # eager multiply: its rounding is the standalone
+        # `grown.leaf_value * shrinkage` dispatch's rounding
+        lv = grown.leaf_value * self._lr_dev[:, None]
+        self.score = self._vm_upd(self.score, grown.row_leaf, lv, 1.0)
+        self.vscores = tuple(
+            vs + self._vm_walk(vb, grown.split_feature, grown.threshold_bin,
+                               grown.nan_bin, grown.cat_member,
+                               grown.decision_type, grown.left_child,
+                               grown.right_child, lv, grown.num_leaves)
+            for vb, vs in zip(self.vbins, self.vscores))
+        grown = grown._replace(row_leaf=jnp.zeros((self.M, 0), jnp.int32))
+        self._grown.append(grown)
+        leaves = grown.num_leaves
+        if hasattr(leaves, "copy_to_host_async"):
+            leaves.copy_to_host_async()
+        self._leaves.append(leaves)
+        self._steps += 1
+        for m, st in enumerate(self.states):
+            if st.active:
+                st.kept_iters = self._steps
+        self.record.add_tree(it, 0, grown.hist_passes[0],
+                             grown.num_leaves[0])
+
+    def _dummy_qk(self):
+        if not hasattr(self, "_qk0"):
+            self._qk0 = jnp.zeros((self.M, 2), jnp.uint32)
+        return self._qk0
+
+    def _dummy_nk(self):
+        if not hasattr(self, "_nk0"):
+            self._nk0 = jnp.zeros((self.M, 2, 2), jnp.uint32)
+        return self._nk0
+
+    # -- stump stop (lagged, like GBDT.train_one_iter) -----------------------
+    def check_stumps(self, it: int) -> None:
+        """Before stepping iteration ``it``: a model whose ENTIRE previous
+        iteration grew no split stops (the standalone loop pops those
+        trees and breaks, gbdt.cpp:430-450)."""
+        if it < 1 or it - 1 >= len(self._leaves):
+            return
+        prev = np.asarray(jax.device_get(self._leaves[it - 1]))
+        for m, st in enumerate(self.states):
+            if st.active and prev[m] <= 1:
+                st.active = False
+                st.stop_reason = "no-split"
+                # the stump iteration's trees are popped unless they are
+                # the model's only iteration (they carry the init bias)
+                st.kept_iters = max(1, it - 1)
+
+    # -- evaluation / early stopping ----------------------------------------
+    def _needs_eval(self) -> bool:
+        return bool(self.valid_sets)
+
+    def eval_all(self, it: int, num_boost_round: int) -> None:
+        if not self._needs_eval():
+            return
+        with self.record.phase("eval"):
+            host_vs = [np.asarray(vs) for vs in self.vscores]
+            for m, st in enumerate(self.states):
+                if not st.active:
+                    continue
+                rows = []
+                for vi, (vname, _) in enumerate(self.valid_sets):
+                    for mt in st.metrics_per_valid[vi]:
+                        for name, val, hib in mt.eval(host_vs[vi][m]):
+                            rows.append((vname, name, val, hib))
+                for dn, en, val, _ in rows:
+                    st.history.setdefault(dn, {}).setdefault(
+                        en, []).append(val)
+                if st.stopper is None and \
+                        st.cfg.early_stopping_round and \
+                        int(st.cfg.early_stopping_round) > 0:
+                    st.stopper = early_stopping(
+                        int(st.cfg.early_stopping_round),
+                        st.cfg.first_metric_only, verbose=False)
+                if st.stopper is not None:
+                    env = CallbackEnv(None, {}, it, 0, num_boost_round,
+                                      rows)
+                    try:
+                        st.stopper(env)
+                    except EarlyStopException as e:
+                        st.active = False
+                        st.stop_reason = "early-stop"
+                        st.kept_iters = it + 1
+                        st.best_iteration = e.best_iteration + 1
+                        for dn, en, sc, _ in e.best_score:
+                            st.best_score.setdefault(dn, {})[en] = sc
+
+    # -- driver loop ---------------------------------------------------------
+    def run(self, num_boost_round: int) -> "BatchTrainer":
+        for it in range(num_boost_round):
+            self.check_stumps(it)
+            if not any(st.active for st in self.states):
+                break
+            self.step_once(it)
+            self.eval_all(it, num_boost_round)
+            if not any(st.active for st in self.states):
+                break
+        return self
+
+    # -- extraction ----------------------------------------------------------
+    def finalize(self) -> List[Booster]:
+        with self.record.phase("record"):
+            pulled = jax.device_get(self._grown)
+            scores = self.score
+            boosters = []
+            for m, st in enumerate(self.states):
+                trees = []
+                shrink = float(st.cfg.learning_rate)
+                for t in range(st.kept_iters):
+                    g = GrownTree(*[np.asarray(f)[m] for f in pulled[t]])
+                    tree = _grown_to_tree(g, shrink, self.train_set)
+                    if t == 0 and abs(st.bias) > EPSILON:
+                        tree.add_bias(st.bias)
+                    trees.append(tree)
+                bst = Booster(params=st.params, train_set=self.train_set)
+                gb = bst._gbdt
+                gb.models = trees
+                gb.iter_ = st.kept_iters
+                gb.score = scores[m]
+                bst.best_iteration = st.best_iteration
+                bst.best_score = st.best_score
+                rec = TrainRecord(meta={
+                    "boosting": "gbdt",
+                    "objective": str(st.cfg.objective),
+                    "tree_learner": "serial",
+                    "multitrain_model_index": m,
+                    "multitrain_models": self.M,
+                    "num_leaves": int(st.cfg.num_leaves),
+                    "num_data": int(self.n),
+                    "num_features": int(self.num_features),
+                })
+                for t, tr in enumerate(trees):
+                    rec.add_tree(t, 0, 0, tr.num_leaves)
+                gb.train_record = rec
+                boosters.append(bst)
+            return boosters

@@ -1,0 +1,136 @@
+"""Cross-validation through the vmapped model axis (the ``engine.cv``
+fast path).
+
+Folds are just models: fold k trains with a held-out sample mask over
+the PARENT dataset — binning happens once, the binned matrix lives on
+device once, and all folds grow their trees inside one compiled grower
+program (``batched.BatchTrainer``).  Because the grower assigns EVERY
+row to a leaf (masked-out rows contribute zero to the histogram sums
+but still ride the partition), each fold's held-out predictions are
+already sitting in the trainer's (M, N) score matrix — the per-fold
+validation metric reads its test rows straight out of the training
+scores, with no separate tree walk.
+
+Aggregation and early stopping are engine.cv's OWN bookkeeping
+(the shared ``engine.CVAggregator``): per-iteration mean/stdv across folds, stopping on the
+aggregated validation means (``first_metric_only`` restricts to the
+first metric key), results truncated to the best iteration.
+
+Fold models are bit-identical to a ``BatchTrainer`` run on the same
+masks; versus the legacy per-fold loop (compacted ``Dataset.subset``
+row copies) the values agree to float32 reduction tolerance — the
+masked histogram sums run over N rows where the compacted ones run over
+the fold's subset, so XLA picks different (but per-run deterministic)
+reduction shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset
+from ..metric import create_metrics
+from ..utils.log import log_info
+from .batched import BatchTrainer, MultiTrainError, _subset_metadata
+
+__all__ = ["cv_many"]
+
+
+def cv_reject_reason(fobj, feval, fpreproc, init_model,
+                     callbacks) -> Optional[str]:
+    """Why engine.cv cannot route through the batched fold driver (None
+    = it can; config-level limits are checked by BatchTrainer itself)."""
+    if fobj is not None:
+        return "custom objective (fobj)"
+    if feval is not None:
+        return "custom metric (feval)"
+    if fpreproc is not None:
+        return "fpreproc rewrites per-fold params"
+    if init_model is not None:
+        return "init_model continuation"
+    if callbacks:
+        return "user callbacks observe per-fold boosters"
+    return None
+
+
+def cv_many(params: Dict[str, Any], train_set: Dataset,
+            num_boost_round: int, folds, cfg: Config,
+            eval_train_metric: bool = False,
+            return_cvbooster: bool = False) -> Dict[str, Any]:
+    """Run ``engine.cv``'s fold loop as ONE vmapped training batch.
+
+    ``folds`` is the materialized list of (train_idx, test_idx) pairs
+    engine.cv built (user-supplied or ``_make_n_folds``).  Raises
+    :class:`MultiTrainError` when the config cannot batch — the caller
+    falls back to the legacy per-fold loop."""
+    from ..engine import CVAggregator, CVBooster  # deferred: engine
+    # imports this module lazily inside cv()
+
+    nfold = len(folds)
+    if nfold == 0:
+        raise MultiTrainError("empty fold list")
+    n = train_set.num_data()
+    masks = np.zeros((nfold, n), np.float32)
+    for k, (train_idx, _) in enumerate(folds):
+        masks[k, np.asarray(train_idx, np.int64)] = 1.0
+
+    trainer = BatchTrainer([dict(params) for _ in range(nfold)], train_set,
+                           sample_masks=masks)
+    md = train_set.metadata
+
+    # per-fold metric sets over the held-out (and optionally in-fold)
+    # rows; device-side row indices so the per-iteration host pull is
+    # only the rows the metrics read, never the (nfold, N) matrix
+    test_rows_dev: List[jnp.ndarray] = []
+    valid_metrics: List[list] = []
+    train_metrics: List[list] = []
+    train_rows_dev: List[jnp.ndarray] = []
+    for k, (train_idx, test_idx) in enumerate(folds):
+        test_idx = np.asarray(test_idx, np.int64)
+        test_rows_dev.append(jnp.asarray(test_idx))
+        mts = create_metrics(trainer.cfgs[k])
+        for mt in mts:
+            mt.init(_subset_metadata(md, test_idx), len(test_idx))
+        valid_metrics.append(mts)
+        if eval_train_metric:
+            train_idx = np.asarray(train_idx, np.int64)
+            train_rows_dev.append(jnp.asarray(train_idx))
+            mts = create_metrics(trainer.cfgs[k])
+            for mt in mts:
+                mt.init(_subset_metadata(md, train_idx), len(train_idx))
+            train_metrics.append(mts)
+
+    aggr = CVAggregator(cfg, num_boost_round)
+    for it in range(num_boost_round):
+        trainer.step_once(it)
+        agg = collections.defaultdict(list)
+        hib_map: Dict[str, bool] = {}
+        for k in range(nfold):
+            held_out = np.asarray(trainer.score[k][test_rows_dev[k]])
+            for mt in valid_metrics[k]:
+                for name, val, hib in mt.eval(held_out):
+                    agg[f"valid {name}"].append(val)
+                    hib_map[f"valid {name}"] = hib
+            if eval_train_metric:
+                in_fold = np.asarray(trainer.score[k][train_rows_dev[k]])
+                for mt in train_metrics[k]:
+                    for name, val, _ in mt.eval(in_fold):
+                        agg[f"train {name}"].append(val)
+        if aggr.update(it, agg, hib_map):
+            break
+
+    log_info(f"cv: trained {nfold} folds in one vmapped program "
+             f"({trainer._steps} rounds)")
+    cvbooster = CVBooster()
+    out: Dict[str, Any] = aggr.finalize(cvbooster)
+    if return_cvbooster:
+        for bst in trainer.finalize():
+            cvbooster.append(bst)
+        out["cvbooster"] = cvbooster
+    return out

@@ -76,6 +76,22 @@ class SplitParams(NamedTuple):
 
 BIG = 1e30  # "unbounded" leaf-output constraint sentinel
 
+# SplitParams fields that MAY arrive as traced jax scalars instead of
+# Python numbers: the multi-model trainer (lightgbm_tpu/multitrain/)
+# sweeps them along a vmapped model axis, so one compiled program serves
+# every hyperparameter variant.  They only ever flow through jnp
+# arithmetic/comparisons below — never Python control flow — which keeps
+# the traced and the constant-folded programs value-identical.
+TRACEABLE_PARAMS = ("lambda_l1", "lambda_l2", "min_sum_hessian_in_leaf",
+                    "min_data_in_leaf", "min_gain_to_split")
+
+
+def params_are_static(params: "SplitParams") -> bool:
+    """True when every traceable field is a plain Python number (the
+    jit-with-static-params fast path); False when any is a jax value."""
+    return not any(isinstance(getattr(params, k), (jax.Array, jax.core.Tracer))
+                   for k in TRACEABLE_PARAMS)
+
 
 class FeatureSplits(NamedTuple):
     """Per-feature best split (the vectorized SplitInfo,
@@ -144,7 +160,6 @@ def monotone_penalty_factor(depth, penalty: float):
                                1.0 - jnp.exp2(penalty - 1.0 - d) + eps))
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
 def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                            num_bins: jnp.ndarray, is_cat: jnp.ndarray,
                            has_nan: jnp.ndarray,
@@ -157,6 +172,30 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
                            parent_out: Optional[jnp.ndarray] = None,
                            rand_bins: Optional[jnp.ndarray] = None
                            ) -> FeatureSplits:
+    """Dispatch wrapper: static params take the jitted fast path (params
+    hashable -> jit static arg); traced params (TRACEABLE_PARAMS carrying
+    jax scalars, see multitrain) inline into the caller's trace."""
+    if params_are_static(params):
+        return _best_split_jit(hist, parent_sum, num_bins, is_cat, has_nan,
+                               params, monotone, bound, depth, cegb_penalty,
+                               gain_scale, parent_out, rand_bins)
+    return _best_split_impl(hist, parent_sum, num_bins, is_cat, has_nan,
+                            params, monotone, bound, depth, cegb_penalty,
+                            gain_scale, parent_out, rand_bins)
+
+
+def _best_split_impl(hist: jnp.ndarray, parent_sum: jnp.ndarray,
+                     num_bins: jnp.ndarray, is_cat: jnp.ndarray,
+                     has_nan: jnp.ndarray,
+                     params: SplitParams,
+                     monotone: Optional[jnp.ndarray] = None,
+                     bound: Optional[jnp.ndarray] = None,
+                     depth: Optional[jnp.ndarray] = None,
+                     cegb_penalty: Optional[jnp.ndarray] = None,
+                     gain_scale: Optional[jnp.ndarray] = None,
+                     parent_out: Optional[jnp.ndarray] = None,
+                     rand_bins: Optional[jnp.ndarray] = None
+                     ) -> FeatureSplits:
     """Best split per feature from one leaf's histograms.
 
     Args:
@@ -187,7 +226,10 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
     f, b, _ = hist.shape
     l1, l2 = params.lambda_l1, params.lambda_l2
     min_h = params.min_sum_hessian_in_leaf
-    min_cnt = float(params.min_data_in_leaf)
+    mdl = params.min_data_in_leaf
+    min_cnt = (mdl.astype(jnp.float32)
+               if isinstance(mdl, (jax.Array, jax.core.Tracer))
+               else float(mdl))
     use_mc = params.use_monotone
     use_sm = params.path_smooth > 0.0
     use_out = use_mc or use_sm   # gains via explicit (possibly
@@ -494,3 +536,7 @@ def best_split_per_feature(hist: jnp.ndarray, parent_sum: jnp.ndarray,
         right_sum=right_sum,
         cat_member=cat_member,
     )
+
+
+_best_split_jit = functools.partial(jax.jit, static_argnames=("params",))(
+    _best_split_impl)

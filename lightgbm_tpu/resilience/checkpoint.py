@@ -49,7 +49,7 @@ from ..utils.log import log_info, log_warning
 
 __all__ = ["Checkpoint", "CheckpointError", "CheckpointManager",
            "TrainingPreempted", "load_checkpoint", "resolve_checkpoint",
-           "PreemptionGuard"]
+           "PreemptionGuard", "reject_checkpointing"]
 
 FORMAT_VERSION = 1
 LATEST = "LATEST"
@@ -67,6 +67,30 @@ CKPT_SOFT_KEYS = ("num_leaves", "learning_rate", "bagging_fraction",
 
 class CheckpointError(ValueError):
     """A checkpoint could not be written, read, or safely restored."""
+
+
+def reject_checkpointing(cfg, context: str) -> None:
+    """Raise a typed :class:`CheckpointError` when checkpoint/resume
+    params are set in a training mode that cannot honor them.
+
+    The multi-model trainer (``train_many``) stacks M boosters' state
+    along a vmapped model axis — a shape the per-model bundle format
+    cannot capture yet — so a checkpoint written there would resume
+    wrong.  The contract is "checkpoint correctly or fail loudly":
+    never train silently without the fault tolerance the params asked
+    for (covered by the chaos-marked multitrain test)."""
+    offending = [k for k, v in (
+        ("checkpoint_dir", str(cfg.checkpoint_dir or "")),
+        ("snapshot_freq", int(cfg.snapshot_freq) > 0 and
+         str(cfg.snapshot_freq)),
+        ("resume", str(cfg.resume or "").strip()),
+    ) if v]
+    if offending:
+        raise CheckpointError(
+            f"checkpointing/resume ({', '.join(offending)}) is unsupported "
+            f"in {context}: the stacked multi-model state cannot be "
+            f"captured as per-model bundles yet; drop those params or "
+            f"train the models individually via train()")
 
 
 class TrainingPreempted(RuntimeError):

@@ -8,8 +8,32 @@ from __future__ import annotations
 import numpy as np
 
 
-def host_rng(seed: int, stream: int = 0) -> np.random.Generator:
-    return np.random.Generator(np.random.Philox(key=(seed & 0xFFFFFFFF) + (stream << 32)))
+def host_rng(seed: int, stream: int = 0,
+             model: int = 0) -> np.random.Generator:
+    """Philox generator keyed on (seed, stream[, model]).
+
+    ``model`` joins the key as an independent Philox key word so a
+    multi-model training batch (lightgbm_tpu/multitrain/) can derive
+    decorrelated per-model streams from one base seed as a PURE function
+    of (seed, stream, model) — no sequential state.  ``model=0`` keys the
+    generator exactly like the historical 1-word form (Philox pads the
+    key with zero words), so every existing single-model stream — and a
+    ``train_many`` batch of one — is bit-identical to before."""
+    key = (seed & 0xFFFFFFFF) + (stream << 32)
+    return np.random.Generator(np.random.Philox(
+        key=key if model == 0 else (key, model)))
+
+
+def model_stream_seed(seed: int, model: int) -> int:
+    """Derive a per-model 32-bit seed from a base seed as a pure function
+    of (seed, model) — used by ``train_many(replicas=M)`` to materialize
+    per-model bagging/quantization seeds INTO the variant params, so the
+    standalone counterpart ``train(params_m)`` reproduces model m
+    bit-for-bit.  Model 0 keeps the base seed."""
+    if model == 0:
+        return int(seed)
+    return int(host_rng(seed, stream=0x5EED, model=model)
+               .integers(0, 1 << 31))
 
 
 def sample_indices(n: int, k: int, seed: int, stream: int = 0) -> np.ndarray:
