@@ -204,7 +204,22 @@ _PARAMS: List[ParamSpec] = [
     _p("gpu_use_dp", bool, False),
     _p("num_gpu", int, 1, check=">0"),
     # TPU-specific knobs (new in this framework)
-    _p("tpu_histogram_impl", str, "auto"),   # auto | segment | onehot | pallas
+    # auto | segment | onehot | pallas | packed4 ("packed4" = the XLA
+    # joint-nibble scatter formulation for max_bin<=16 data — two 4-bit
+    # codes share one byte and one scatter builds BOTH features'
+    # histograms; the CPU analog of the Pallas kernels' packed layout)
+    _p("tpu_histogram_impl", str, "auto"),
+    # Pallas histogram kernel pipeline: auto (= dma on TPU, blockspec
+    # under off-TPU interpretation) | dma (explicit
+    # double-buffered HBM->VMEM async-copy streaming overlapping the MXU
+    # contraction) | blockspec (the v1 implicit per-grid-step fetch,
+    # kept for A/B re-probing per PERF.md's measured-dead-ends rule)
+    _p("tpu_pallas_pipeline", str, "auto"),
+    # 4-bit bin packing (reference src/io/dense_bin.hpp 4-bit bins):
+    # when every feature fits a nibble (max_bin <= 16) the wave grower's
+    # device bin matrix stores two bin codes per int8 lane and the
+    # Pallas kernels unpack in VMEM — half the streamed/held bin bytes
+    _p("tpu_hist_pack4", bool, True),
     _p("tpu_rows_per_chunk", int, 0),        # 0 = auto-tune
     _p("tpu_double_precision_gain", bool, False),  # like gpu_use_dp for split gains
     # tree_grow_mode: auto | wave | partition.  "wave" = leaf-wise growth
@@ -414,8 +429,11 @@ class Config:
             (self.tree_grow_mode in ("auto", "wave", "partition"),
              "tree_grow_mode must be one of auto|wave|partition"),
             (self.tpu_histogram_impl in ("auto", "segment", "onehot",
-                                         "pallas"),
-             "tpu_histogram_impl must be auto|segment|onehot|pallas"),
+                                         "pallas", "packed4"),
+             "tpu_histogram_impl must be auto|segment|onehot|pallas|"
+             "packed4"),
+            (self.tpu_pallas_pipeline in ("auto", "dma", "blockspec"),
+             "tpu_pallas_pipeline must be auto|dma|blockspec"),
         ]
         for ok, msg in checks:
             if not ok:

@@ -684,3 +684,30 @@ class Dataset:
         if key not in self._device_cache:
             self._device_cache[key] = jnp.asarray(self.X_binned)
         return self._device_cache[key]
+
+    def device_bins_packed4(self, row_block: int = 4096):
+        """FEATURE-MAJOR nibble-packed device bins: two 4-bit bin codes
+        per int8 lane (reference src/io/dense_bin.hpp 4-bit dense bins),
+        rows padded to the Pallas kernel row block — the layout the
+        packed histogram kernels stream (half the HBM bytes of the
+        uint8 matrix).  Requires every used feature to fit 16 bins.
+        Cached per row_block."""
+        self._check_constructed()
+        import numpy as _np
+        import jax.numpy as jnp
+        from .ops.histogram_pallas import (PACK4_MAX_BINS, pack_bins4,
+                                           pad_rows)
+        key = ("bins_packed4", row_block)
+        if key not in self._device_cache:
+            max_b = int(_np.max(self.num_bins_per_feature))
+            if max_b > PACK4_MAX_BINS:
+                raise ValueError(
+                    f"device_bins_packed4 requires every feature to fit "
+                    f"{PACK4_MAX_BINS} bins (max is {max_b}); set "
+                    f"max_bin<={PACK4_MAX_BINS}")
+            n = self.X_binned.shape[0]
+            n_pad = pad_rows(n, row_block)
+            xp = _np.pad(self.X_binned, ((0, n_pad - n), (0, 0)))
+            self._device_cache[key] = pack_bins4(
+                jnp.asarray(_np.ascontiguousarray(xp.T), jnp.uint8))
+        return self._device_cache[key]

@@ -64,7 +64,8 @@ def _round_up(x: int, m: int) -> int:
 
 def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
                              max_bins: int, max_depth: int, split_params,
-                             hist_impl: str, interpret: bool = False,
+                             hist_impl: str, interpret: bool = None,
+                             pipeline: str = None,
                              jit: bool = True, forced_splits: tuple = (),
                              efb_dims=None, interaction_groups: tuple = (),
                              feature_contri: tuple = ()):
@@ -139,7 +140,7 @@ def make_partitioned_grow_fn(*, num_leaves: int, num_features: int,
         if pallas:
             return build_histogram_pallas(
                 jnp.swapaxes(bins_rows, 0, 1), gm, hm, mask,
-                num_bins=Bb, interpret=interpret)
+                num_bins=Bb, interpret=interpret, pipeline=pipeline)
         return build_histogram(bins_rows, gm, hm, mask, num_bins=Bb,
                                impl=hist_impl)
 

@@ -557,6 +557,11 @@ def resolve_hist_impl(config: Config, parallel: bool = False,
             impl = "segment"
     elif impl == "pallas" and parallel and not wave:
         impl = "onehot"
+    if impl == "packed4" and max_bins > 16:
+        from ..utils.log import log_warning
+        log_warning(f"tpu_histogram_impl=packed4 requires max_bin<=16 "
+                    f"(got {max_bins}); using the segment path")
+        impl = "segment"
     if impl == "pallas" and max_bins > 256:
         from ..utils.log import log_warning
         log_warning(f"max_bin={max_bins} exceeds the Pallas kernels' uint8 "
@@ -721,6 +726,10 @@ class SerialTreeLearner:
             raise ValueError("EFB requires the partitioned grower; raise "
                              "histogram_pool_size or disable enable_bundle")
         impl = resolve_hist_impl(config, max_bins=self.max_bins)
+        if impl == "packed4" and efb is not None:
+            # EFB histograms run in BUNDLE space whose bin count can
+            # exceed the 4-bit range even when every feature fits it
+            impl = "segment"
         if not self.use_hist_pool and impl == "pallas":
             # the pool-less fallback grower takes no transposed X and no row
             # padding — downgrade to the XLA onehot formulation (same MXU
@@ -766,10 +775,24 @@ class SerialTreeLearner:
             log_warning("use_quantized_grad requires the wave grower "
                         "(tree_grow_mode=wave/auto on TPU); training "
                         "with exact gradients instead")
+        # kernel-v2 knobs: the DMA/blockspec pipeline choice and the
+        # 4-bit packed bin layout (two codes per int8 lane when every
+        # feature fits a nibble — reference dense_bin.hpp's 4-bit bins)
+        from ..ops.histogram_pallas import PACK4_MAX_BINS
+        self.pallas_pipeline = (None if config.tpu_pallas_pipeline == "auto"
+                                else str(config.tpu_pallas_pipeline))
+        self.pack4 = False
         if self.grow_mode == "wave":
             from ..ops.quantize import quant_levels
             wave_size = int(config.tpu_wave_size)
             any_cat = bool(np.any(np.asarray(is_cat)))
+            # pack4 exists only on the DMA pipeline: an explicit
+            # blockspec request (the measured-dead-ends A/B knob) must
+            # actually run the v1 layout, so it disables packing
+            self.pack4 = bool(
+                config.tpu_hist_pack4 and impl == "pallas" and
+                self.max_bins <= PACK4_MAX_BINS and not any_cat and
+                efb is None and self.pallas_pipeline != "blockspec")
             gq_max, hq_max = quant_levels(int(config.num_grad_quant_bins))
             # in exact mode the quant params don't affect the traced fn —
             # collapse the cache key so sweeps over them don't recompile
@@ -786,7 +809,8 @@ class SerialTreeLearner:
                    self.max_bins, int(config.max_depth), self.split_params,
                    impl, any_cat, wave_size, self._efb_dims, feature_contri,
                    qtuple, interaction_groups, cegb_lazy, spec_ramp,
-                   spec_tol, forced_splits, mc_inter, endg)
+                   spec_tol, forced_splits, mc_inter, endg,
+                   self.pack4, self.pallas_pipeline)
             from .wave import make_wave_grow_fn
             self._grow_factory = make_wave_grow_fn
             self._grow_kwargs = dict(
@@ -795,6 +819,7 @@ class SerialTreeLearner:
                 max_depth=int(config.max_depth),
                 split_params=self.split_params, hist_impl=impl,
                 any_cat=any_cat, wave_size=wave_size,
+                pack4=self.pack4, pipeline=self.pallas_pipeline,
                 efb_dims=self._efb_dims, feature_contri=feature_contri,
                 quantized=self.quantized, gq_max=gq_max, hq_max=hq_max,
                 renew_leaf=bool(config.quant_train_renew_leaf),
@@ -809,7 +834,8 @@ class SerialTreeLearner:
             key = ("part", int(config.num_leaves), num_features,
                    self.max_bins, int(config.max_depth), self.split_params,
                    impl, forced_splits, self._efb_dims,
-                   interaction_groups, feature_contri)
+                   interaction_groups, feature_contri,
+                   self.pallas_pipeline)
             from .partitioned import make_partitioned_grow_fn
             self._grow_factory = make_partitioned_grow_fn
             self._grow_kwargs = dict(
@@ -817,6 +843,7 @@ class SerialTreeLearner:
                 num_features=num_features, max_bins=self.max_bins,
                 max_depth=int(config.max_depth),
                 split_params=self.split_params, hist_impl=impl,
+                pipeline=self.pallas_pipeline,
                 forced_splits=forced_splits, efb_dims=self._efb_dims,
                 interaction_groups=interaction_groups,
                 feature_contri=feature_contri)
@@ -884,8 +911,14 @@ class SerialTreeLearner:
                 if n_pad != n else X_dev
             if self.grow_mode == "wave":
                 # only the feature-major copy is consumed; do not keep the
-                # padded row-major matrix alive next to it in HBM
-                self._XpT = jnp.asarray(jnp.swapaxes(Xp, 0, 1))
+                # padded row-major matrix alive next to it in HBM — and
+                # under pack4 only the nibble-packed HALF-width matrix
+                # (two 4-bit codes per int8 lane) lives on device
+                xpt = jnp.asarray(jnp.swapaxes(Xp, 0, 1))
+                if self.pack4:
+                    from ..ops.histogram_pallas import pack_bins4
+                    xpt = pack_bins4(xpt.astype(jnp.uint8))
+                self._XpT = xpt
                 self._Xp = None
             else:
                 self._Xp = Xp

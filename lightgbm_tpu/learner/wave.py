@@ -100,7 +100,8 @@ from ..ops.histogram_pallas import Q_LEAF_CHANNELS as Q_WAVE_SIZE  # 42/pass
 
 def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                       max_depth: int, split_params, hist_impl: str,
-                      any_cat: bool = True, interpret: bool = False,
+                      any_cat: bool = True, interpret: bool = None,
+                      pack4: bool = False, pipeline: str = None,
                       jit: bool = True, wave_size: int = 0,
                       efb_dims=None, feature_contri: tuple = (),
                       strategy=None, quantized: bool = False,
@@ -150,7 +151,12 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
         from ..ops.histogram_pallas import (
             build_histogram_pallas, build_histogram_pallas_leaves,
             build_histogram_pallas_leaves_q8, pack_weights8,
-            wave_row_update_pallas)
+            unpack_bins4, wave_row_update_pallas)
+    if pack4 and not pallas:
+        raise ValueError("pack4 bins require hist_impl='pallas'")
+    if pack4 and (efb_dims is not None or max_bins > 16 or any_cat):
+        raise ValueError("pack4 bins require numeric non-EFB data with "
+                         "max_bins <= 16")
 
     sp = split_params
     use_mc = split_params.use_monotone
@@ -301,7 +307,16 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
              quant_key: jnp.ndarray = None,
              node_key: jnp.ndarray = None,
              lazy_used: jnp.ndarray = None):
-        n = X_T.shape[1]
+        # Under ``pack4`` X_T is the nibble-packed (G, N//2) byte matrix
+        # (ops/histogram_pallas.pack_bins4): the histogram kernels
+        # consume it directly (half the streamed bin bytes) and the few
+        # per-wave winning-feature column fetches unpack on the fly.
+        n = X_T.shape[1] * 2 if pack4 else X_T.shape[1]
+
+        def take_cols(feats):
+            """(k, N) UNPACKED bin columns of the given features."""
+            cols = jnp.take(X_T, feats, axis=0)
+            return unpack_bins4(cols) if pack4 else cols
         if strategy is not None:
             # shallow per-trace copy: traced array attributes must not
             # outlive the trace on the learner's long-lived strategy object
@@ -455,7 +470,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             if quantized:
                 if pallas:
                     h = build_histogram_pallas_leaves_q8(
-                        X_T, wch0, ch, num_bins=Bb, interpret=interpret)
+                        X_T, wch0, ch, num_bins=Bb, interpret=interpret,
+                        pipeline=pipeline, bins_packed=pack4)
                 else:
                     # off-TPU emulation: f32 sums of integer levels are
                     # exact while |sum| < 2^24 per bin — ample for the
@@ -469,7 +485,9 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     h = jnp.round(h).astype(jnp.int32)
             elif pallas:
                 h = build_histogram_pallas_leaves(X_T, w8, ch, num_bins=Bb,
-                                                  interpret=interpret)
+                                                  interpret=interpret,
+                                                  pipeline=pipeline,
+                                                  bins_packed=pack4)
             else:
                 h = build_histogram_leaves(
                     bins_rows, gm, hm, cnt_mask, ch,
@@ -480,6 +498,9 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             """FEATURE-space bin codes (N,) of one feature (decoded from
             its bundle column under EFB; efb.make_bundle_decode)."""
             g = f_bundle[feat] if use_efb else feat
+            if pack4:
+                return unpack_bins4(
+                    jax.lax.dynamic_slice(X_T, (g, 0), (1, n // 2)))[0]
             v = jax.lax.dynamic_slice(X_T, (g, 0), (1, n))[0]
             if small_bins:
                 return v                                     # uint8
@@ -598,9 +619,18 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             stride = max(1, n // max(int(spec_subsample) // spec_shards,
                                      4096))
             n_ss = max((n // stride) // 4096 * 4096, 4096)
-            X_ss = X_T[:, ::stride][:, :n_ss]
             w_src = wch0 if quantized else w8
-            w_ss = w_src[:, ::stride][:, :n_ss]
+            if pack4:
+                # stride over packed BYTES: the subsample keeps adjacent
+                # row pairs (one byte each) so the packed kernels consume
+                # it directly; weights follow the same pair selection
+                X_ss = X_T[:, ::stride][:, :n_ss // 2]
+                w_ss = w_src.reshape(w_src.shape[0], -1, 2)[
+                    :, ::stride][:, :n_ss // 2].reshape(w_src.shape[0],
+                                                        n_ss)
+            else:
+                X_ss = X_T[:, ::stride][:, :n_ss]
+                w_ss = w_src[:, ::stride][:, :n_ss]
             nan_of = jnp.where(hn_full, nb_full - 1, -1)       # (F,)
             fm_k = jnp.broadcast_to(feature_mask, (Kc, F))
             jar = jnp.arange(Kc, dtype=jnp.int32)
@@ -630,11 +660,13 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 if quantized:
                     h_ss = build_histogram_pallas_leaves_q8(
                         X_ss, w_ss, rl_ss.astype(jnp.int8), num_bins=Bb,
-                        interpret=interpret)[:Kc]
+                        interpret=interpret, pipeline=pipeline,
+                        bins_packed=pack4)[:Kc]
                 else:
                     h_ss = build_histogram_pallas_leaves(
                         X_ss, w_ss, rl_ss.astype(jnp.int8), num_bins=Bb,
-                        interpret=interpret)[:Kc]
+                        interpret=interpret, pipeline=pipeline,
+                        bins_packed=pack4)[:Kc]
                 # DP: the one histogram collective of this provisional
                 # pass — the provisional batches ride the same merge mode
                 # as committed waves (psum, or the feature-sliced
@@ -686,8 +718,11 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     sel_l, newids, sel.astype(jnp.int32),
                     jnp.zeros((Kc,), jnp.int32)])
                 cols_ss = jnp.take(X_ss, feats_cl, axis=0)
+                if pack4:
+                    cols_ss = unpack_bins4(cols_ss)
                 rl2, _ = wave_row_update_pallas(cols_ss, rl_ss, tab,
-                                                interpret=interpret)
+                                                interpret=interpret,
+                                                pipeline=pipeline)
                 rl_ss = rl2.astype(jnp.uint8)
                 tabs.append((tab, feats_cl))
                 nlp = nlp + prefix[-1]
@@ -697,9 +732,10 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             # partition matches how committed splits will route) --
             rl_full = jnp.zeros((n,), jnp.uint8)
             for tab, feats_cl in tabs:
-                cols = jnp.take(X_T, feats_cl, axis=0)
+                cols = take_cols(feats_cl)
                 rlf, _ = wave_row_update_pallas(cols, rl_full, tab,
-                                                interpret=interpret)
+                                                interpret=interpret,
+                                                pipeline=pipeline)
                 rl_full = rlf.astype(jnp.uint8)
 
             # -- ONE full-data pass: exact per-prov-leaf channel sums --
@@ -1045,13 +1081,14 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 # one fused kernel pass instead of W masked XLA sweeps
                 # (each sweep's fused-loop launch overhead alone costs
                 # ~0.7 ms at 10.5M rows)
-                cols_w = jnp.take(X_T, feat, axis=0)          # (W, N) u8
+                cols_w = take_cols(feat)                      # (W, N) u8
                 tab = jnp.stack([
                     thr, f_nan_bin, dleft.astype(jnp.int32),
                     left_smaller.astype(jnp.int32), sel_leaves, new_ids,
                     sel.astype(jnp.int32), jnp.zeros_like(thr)])
                 rl_new, ch = wave_row_update_pallas(
-                    cols_w, rl, tab, interpret=interpret)
+                    cols_w, rl, tab, interpret=interpret,
+                    pipeline=pipeline)
                 rl = rl_new.astype(rl.dtype)
             else:
                 # Vectorized XLA fallback (categorical / EFB / wide-bin
@@ -1446,7 +1483,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     if pallas:
                         for c in range(EG // W):
                             sl = slice(c * W, (c + 1) * W)
-                            cols = jnp.take(X_T, pend["feat"][sl], axis=0)
+                            cols = take_cols(pend["feat"][sl])
                             tab = jnp.stack([
                                 pend["thr"][sl], pend["nan"][sl],
                                 pend["dleft"][sl],
@@ -1455,7 +1492,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                                 pend["act"][sl],
                                 jnp.zeros((W,), jnp.int32)])
                             rl2, _ = wave_row_update_pallas(
-                                cols, rl, tab, interpret=interpret)
+                                cols, rl, tab, interpret=interpret,
+                                pipeline=pipeline)
                             rl = rl2.astype(rl_dtype)
                         return rl
 
@@ -1480,10 +1518,10 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 if pallas:
                     from ..ops.histogram_pallas import (
                         wave_trial_channels_pallas)
-                    cols = jnp.take(X_T, feat, axis=0)
+                    cols = take_cols(feat)
                     return wave_trial_channels_pallas(
                         cols, rl, sel_leaves, thr, fnanb, dleft, small,
-                        sel, interpret=interpret)
+                        sel, interpret=interpret, pipeline=pipeline)
                 cols = jax.vmap(feature_col)(feat).astype(jnp.int32)
                 go = jnp.where(cols == fnanb[:, None], dleft[:, None],
                                cols <= thr[:, None])
@@ -1651,7 +1689,8 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     bins1 = (rl % 256).astype(jnp.uint8)[None, :]
                     parts.append(build_histogram_pallas(
                         bins1, grad, hess, m, num_bins=256,
-                        interpret=interpret, kr=4096)[0])
+                        interpret=interpret, kr=4096,
+                        pipeline=pipeline)[0])
                 gh = jnp.concatenate(parts, axis=0)[:L, :2]       # (L, 2)
             else:
                 gh = jax.ops.segment_sum(

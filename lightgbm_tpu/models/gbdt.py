@@ -280,21 +280,35 @@ class GBDT:
                            bool)
         learner_cfg = cfg
         from ..utils.backend import default_backend as _safe_backend
-        if (cfg.tpu_histogram_impl == "auto" and
-                _safe_backend() == "tpu" and
-                train_set.X_binned.size <= (1 << 22) and
-                self.max_bins <= 256 and
-                cfg.tree_learner in ("serial", "")):
-            # small shapes: time pallas vs onehot on the real data once
-            # (dataset.cpp:659-670's ShareStates timing, TPU analog);
-            # large shapes keep the measured static choice.  The winner
-            # goes to a COPY so the user's 'auto' survives param
-            # round-trips.
-            from ..learner.autotune import pick_hist_impl
+        _backend = _safe_backend()
+        _autotune_ok = (
+            cfg.tpu_histogram_impl == "auto" and
+            train_set.X_binned.size <= (1 << 22) and
+            self.max_bins <= 256 and
+            cfg.tree_learner in ("serial", "") and
+            # EFB bundles histogram in BUNDLE space (bundle_bins can
+            # exceed the per-feature max) and the probe would time the
+            # wrong shapes — keep the static choice there
+            train_set.efb is None and
+            (_backend == "tpu" or
+             # CPU: the joint-nibble packed4 scatter only competes when
+             # every feature fits 4-bit bins, and the probe's compiles
+             # only pay off past benchmark-ish scale
+             (self.max_bins <= 16 and
+              train_set.X_binned.size >= (1 << 18))))
+        if _autotune_ok:
+            # small shapes: time the kernel variants (pallas dma /
+            # blockspec / packed / onehot on TPU; segment vs packed4 on
+            # CPU) on the real data once (dataset.cpp:659-670's
+            # ShareStates timing analog); large shapes keep the measured
+            # static choice.  Winners persist per (shape, backend) in
+            # the autotune disk cache, and go to a COPY so the user's
+            # 'auto' survives param round-trips.
+            from ..learner.autotune import apply_winner, pick_hist_impl
             import copy as _copy
             learner_cfg = _copy.copy(cfg)
-            learner_cfg.tpu_histogram_impl = pick_hist_impl(
-                train_set.X_binned, self.max_bins)
+            apply_winner(learner_cfg,
+                         pick_hist_impl(train_set.X_binned, self.max_bins))
         self.learner = self._create_learner(num_bins, is_cat, has_nan,
                                             self._inner_monotone(),
                                             cfg=learner_cfg)

@@ -218,11 +218,13 @@ class BatchTrainer:
         if self.learner.grow_mode == "masked":
             raise MultiTrainError(
                 "pool-less (masked) grower: histogram pool exceeds budget")
-        if getattr(self.learner, "pallas", False):
-            raise MultiTrainError(
-                "pallas histogram kernel (vmap batching of pallas_call is "
-                "unverified on this jax); set tpu_histogram_impl=segment "
-                "or onehot to batch on TPU")
+        # The pallas histogram kernels batch on the model axis through
+        # jax's pallas_call batching rule (the vmap axis becomes a
+        # leading grid dimension), so batched training rides the SAME
+        # fast kernels a standalone train() uses — per-lane bit-identity
+        # vs standalone is asserted by tests/test_multitrain.py with the
+        # interpret-mode kernels.  Only the row-padding contract differs:
+        # _build_step pads the batch to the kernel row block.
 
         # per-model lanes
         self.states = [_ModelState(c, p)
@@ -372,12 +374,26 @@ class BatchTrainer:
 
     def _build_step(self) -> None:
         lrn = self.learner
-        # no row padding: the pallas impl (the only padded layout) is
-        # rejected in __init__
-        X_dev = jnp.asarray(self.train_set.X_binned)
         wave = lrn.grow_mode == "wave"
-        self._X_arg = jnp.asarray(jnp.swapaxes(X_dev, 0, 1)) if wave \
-            else X_dev
+        # the pallas kernels' padded-row layout (pad_rows): the binned
+        # matrix pads ONCE here; per-model gradient/mask lanes pad inside
+        # the vmapped grower and row_leaf trims back to N
+        n_pad = self.n
+        if getattr(lrn, "pallas", False):
+            from ..ops.histogram_pallas import pad_rows
+            n_pad = pad_rows(self.n)
+        self._row_pad = n_pad - self.n
+        if wave and getattr(lrn, "pack4", False):
+            # the Dataset caches the packed feature-major layout (half
+            # the bytes), so repeated BatchTrainers (cv folds, sweeps)
+            # share it — the row-major matrix never reaches the device
+            self._X_arg = self.train_set.device_bins_packed4()
+        else:
+            X_dev = jnp.asarray(self.train_set.X_binned)
+            if self._row_pad:
+                X_dev = jnp.pad(X_dev, ((0, self._row_pad), (0, 0)))
+            self._X_arg = jnp.asarray(jnp.swapaxes(X_dev, 0, 1)) if wave \
+                else X_dev
 
         base_sp = lrn.split_params
         sweep_fields = self.sweep_fields
@@ -397,6 +413,9 @@ class BatchTrainer:
                 jnp.asarray(self.train_set.efb.f_single)),
             not bool(np.any(np.asarray(lrn.is_cat))))
 
+        row_pad = self._row_pad
+        lrn_n = self.n
+
         def one_grow(X_arg, g, h, mk, fmask, sweep, qkey, nkey):
             sp = base_sp
             if sweep_fields:
@@ -404,17 +423,28 @@ class BatchTrainer:
                                     for i, f in enumerate(sweep_fields)})
             grow = lrn.build_grow_fn(split_params=sp, jit=False)
             cegb0 = jnp.zeros((F,), jnp.float32)
+            if row_pad:
+                # pallas row-block padding: padded rows carry mask 0 and
+                # contribute nothing (the standalone learner pads the
+                # same way in SerialTreeLearner.train)
+                g = jnp.pad(g, (0, row_pad))
+                h = jnp.pad(h, (0, row_pad))
+                mk = jnp.pad(mk, (0, row_pad))
             if wave:
                 kw = {}
                 if quantized:
                     kw["quant_key"] = qkey
                 if need_nk:
                     kw["node_key"] = nkey
-                return grow(X_arg, g, h, mk, num_bins, is_cat, has_nan,
-                            monotone, cegb0, efb_args, fmask, **kw)
-            nk = nkey if need_nk else jnp.zeros((2, 2), jnp.uint32)
-            return grow(X_arg, g, h, mk, num_bins, is_cat, has_nan,
-                        monotone, cegb0, nk, efb_args, fmask)
+                grown = grow(X_arg, g, h, mk, num_bins, is_cat, has_nan,
+                             monotone, cegb0, efb_args, fmask, **kw)
+            else:
+                nk = nkey if need_nk else jnp.zeros((2, 2), jnp.uint32)
+                grown = grow(X_arg, g, h, mk, num_bins, is_cat, has_nan,
+                             monotone, cegb0, nk, efb_args, fmask)
+            if row_pad:
+                grown = grown._replace(row_leaf=grown.row_leaf[:lrn_n])
+            return grown
 
         # dispatch boundaries mirror the standalone loop (see module
         # docstring): gradients stay EAGER vmap (elementwise primitives

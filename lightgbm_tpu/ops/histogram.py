@@ -16,6 +16,13 @@ TPUs have no fast global atomics, so scatter-add is reformulated:
   materialization into VMEM.
 * ``segment`` — flat ``scatter-add`` (XLA lowers to sorted segment sums);
   portable reference path used on CPU and in tests.
+* ``packed4`` — joint-nibble scatter for ``max_bin <= 16`` data: a
+  feature PAIR shares one byte (two 4-bit codes, the reference
+  dense_bin.hpp 4-bit layout), one scatter builds the pair's joint
+  256-bin histogram and both 16-bin marginals fall out as cheap sums —
+  half the scatter volume, ~2x on the scatter-bound CPU backend
+  (PERF.md round 10).  The device analog is the Pallas kernels'
+  ``bins_packed`` path (histogram_pallas.pack_bins4).
 
 All accumulation is float32 (like the reference GPU learner's single-precision
 ``gpu_hist_t``, gpu_tree_learner.h:79; the reference CPU path uses float64 —
@@ -88,6 +95,34 @@ def _hist_segment_chunk(bins_chunk: jnp.ndarray, w_chunk: jnp.ndarray,
     return flat.reshape(f, num_bins, 3)
 
 
+def _hist_packed4_chunk(bins_chunk: jnp.ndarray, w_chunk: jnp.ndarray,
+                        num_bins: int) -> jnp.ndarray:
+    """Joint-nibble scatter formulation for max_bin<=16 data (the XLA
+    analog of the reference's 4-bit dense_bin.hpp bins and of the Pallas
+    kernels' packed layout).  Feature pairs (2j, 2j+1) share one byte
+    (lo | hi<<4); ONE scatter of n*ceil(F/2) updates builds the pairs'
+    JOINT 256-bin histograms, and both marginals fall out as cheap
+    16-way sums — half the scatter volume of the ``segment`` path, which
+    is what the scatter-bound CPU backend pays for."""
+    n, f = bins_chunk.shape
+    fp = (f + 1) // 2
+    lo = bins_chunk[:, 0::2].astype(jnp.int32)
+    hi = bins_chunk[:, 1::2].astype(jnp.int32)
+    if f % 2:
+        # odd F: the last feature pairs with a virtual all-zeros column
+        # whose marginal is discarded below
+        hi = jnp.concatenate([hi, jnp.zeros((n, 1), jnp.int32)], axis=1)
+    ids = (lo | (hi << 4)) + (jnp.arange(fp, dtype=jnp.int32) * 256)[None, :]
+    flat = jnp.zeros((fp * 256, 3), dtype=jnp.float32)
+    upd = jnp.broadcast_to(w_chunk[:, None, :], (n, fp, 3)).reshape(-1, 3)
+    joint = flat.at[ids.reshape(-1)].add(upd, mode="drop")
+    joint = joint.reshape(fp, 16, 16, 3)          # [pair, hi bin, lo bin]
+    lo_h = joint.sum(axis=1)                      # (fp, 16, 3) even feats
+    hi_h = joint.sum(axis=2)                      # (fp, 16, 3) odd feats
+    out = jnp.stack([lo_h, hi_h], axis=1).reshape(fp * 2, 16, 3)
+    return out[:f, :num_bins, :]
+
+
 def _auto_impl() -> str:
     # route through the probing wrapper: a broken TPU plugin raises
     # RuntimeError from the raw jax.default_backend() before any CPU
@@ -121,7 +156,15 @@ def build_histogram(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     n, f = bins.shape
     w = jnp.stack([grad * mask, hess * mask, mask], axis=-1)  # (N, 3)
 
-    chunk_fn = _hist_onehot_chunk if impl == "onehot" else _hist_segment_chunk
+    if impl == "packed4":
+        if num_bins > 16:
+            raise ValueError("impl='packed4' requires num_bins <= 16 "
+                             f"(got {num_bins}); use segment/onehot")
+        chunk_fn = _hist_packed4_chunk
+    elif impl == "onehot":
+        chunk_fn = _hist_onehot_chunk
+    else:
+        chunk_fn = _hist_segment_chunk
 
     if rows_per_chunk <= 0:
         # bound the one-hot tile to ~64 MB f32
@@ -161,6 +204,8 @@ def build_histogram_leaves(bins: jnp.ndarray, grad: jnp.ndarray,
     """
     if impl == "auto":
         impl = _auto_impl()
+    if impl == "packed4":
+        impl = "segment"  # the joint-nibble trick has no leaf-channel form
     n, f = bins.shape
     k = num_channels
     w = jnp.stack([grad * mask, hess * mask, mask], axis=-1)      # (N, 3)

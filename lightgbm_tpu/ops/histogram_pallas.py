@@ -28,12 +28,33 @@ MXU cycle floor: F * ceil(B/128) * N K-slices per full build — at Higgs
 scale (10.5M x 28, B=256) ~0.1 s/full build; the tree grower's subtraction
 trick (ops/histogram.py histogram_subtract) keeps builds to ~4 full-N
 equivalents per 255-leaf tree.
+
+Kernel v2 (PERF.md round 10): every entry point carries a ``pipeline``
+switch — ``"dma"`` (the on-TPU default) streams the bins +
+packed-weight row blocks HBM->VMEM through explicitly double-buffered
+``make_async_copy`` pairs that overlap the contraction (the kernels
+were measured 1.43x above the MXU floor on the implicit fetch; this
+targets that residue), ``"blockspec"`` keeps the v1 implicit
+per-grid-step fetch for A/B re-probing (and is the default under
+off-TPU interpretation, where DMA machinery is emulation overhead).  When ``max_bin <= PACK4_MAX_BINS`` the bins may arrive
+nibble-PACKED (``pack_bins4``: two 4-bit codes per int8 lane, the
+reference dense_bin.hpp 4-bit layout) — half the streamed bin bytes;
+the kernel unpacks in VMEM against pre-split even/odd weight halves.
+Small-B one-hot tiles group MORE features per 128-row MXU tile instead
+of padding bins (``_tile_params``).  Contract: quantized int32 sums
+are bit-for-bit identical across every variant; f32 stays within the
+hi/lo exactness budget.  ``interpret=None`` auto-interprets off TPU,
+so all of this is testable on CPU, and the entry points batch under
+``vmap`` through jax's pallas_call batching rule (the batch axis
+becomes a leading grid dimension — what lets multitrain ride these
+kernels).
 """
 
 from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -42,9 +63,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["build_histogram_pallas", "build_histogram_pallas_leaves",
            "build_histogram_pallas_leaves_q8", "pack_weights8",
-           "wave_trial_channels_pallas",
+           "wave_trial_channels_pallas", "wave_row_update_pallas",
            "DEFAULT_ROW_BLOCK", "pad_rows", "LEAF_CHANNELS",
-           "Q_LEAF_CHANNELS"]
+           "Q_LEAF_CHANNELS", "DEFAULT_PIPELINE", "resolve_pipeline",
+           "resolve_interpret", "pack_bins4", "unpack_bins4",
+           "PACK4_MAX_BINS"]
 
 DEFAULT_ROW_BLOCK = 4096
 _C = 8  # weight channels (5 used), padded to a power of two for clean tiles
@@ -52,6 +75,41 @@ _CB = 5  # channels per leaf block in the leaf-batched kernel (no padding)
 LEAF_CHANNELS = 128 // _CB  # 25 leaves per pass (25*5 = 125 <= 128 lanes)
 _QCB = 3  # quantized channels per leaf: g_q, h_q, count
 Q_LEAF_CHANNELS = 128 // _QCB  # 42 leaves per pass (42*3 = 126 <= 128)
+
+# 4-bit bin packing (reference src/io/dense_bin.hpp IS_4BIT specialization):
+# two bin codes per int8 lane, applicable when every bin fits a nibble
+PACK4_MAX_BINS = 16
+
+# Kernel pipeline: "dma" streams row blocks of bins + packed weights
+# HBM->VMEM through explicitly double-buffered async copies that overlap
+# the MXU one-hot contraction; "blockspec" is the original implicit
+# per-grid-step operand fetch.  Default: dma ON TPU (where the overlap
+# is real); off-TPU the kernels run the interpreter, where the DMA
+# machinery is pure emulation overhead, so unresolved calls default to
+# the cheaper-to-emulate blockspec form — explicit pipeline="dma"
+# forces the DMA form anywhere (the parity tests do).  Overridable via
+# the environment (the measured-dead-ends guard rail: re-probe with
+# LGBM_TPU_PALLAS_PIPELINE=blockspec before trusting a regression).
+DEFAULT_PIPELINE = os.environ.get("LGBM_TPU_PALLAS_PIPELINE", "")
+
+
+def resolve_pipeline(pipeline=None) -> str:
+    p = pipeline or DEFAULT_PIPELINE
+    if not p:
+        from ..utils.backend import default_backend
+        p = "dma" if default_backend() == "tpu" else "blockspec"
+    if p not in ("dma", "blockspec"):
+        raise ValueError(f"pallas pipeline must be dma|blockspec, got {p!r}")
+    return p
+
+
+def resolve_interpret(interpret=None) -> bool:
+    """None -> interpret off TPU (Mosaic cannot lower elsewhere), so the
+    kernels are runnable — and testable — on every backend."""
+    if interpret is not None:
+        return bool(interpret)
+    from ..utils.backend import default_backend
+    return default_backend() != "tpu"
 
 
 def _round_up(x: int, m: int) -> int:
@@ -61,6 +119,78 @@ def _round_up(x: int, m: int) -> int:
 def pad_rows(n: int, row_block: int = DEFAULT_ROW_BLOCK) -> int:
     """Rows the caller must pad to for the pallas path."""
     return _round_up(max(n, row_block), row_block)
+
+
+def _check_rows(n: int, row_block: int, kernel: str) -> None:
+    if n % row_block != 0 or n == 0:
+        raise ValueError(
+            f"{kernel} requires the row count to be a non-zero multiple of "
+            f"row_block={row_block}, got N={n}; pad inputs to pad_rows(N) "
+            f"== {pad_rows(max(n, 1), row_block)} first (masked/padded rows "
+            "carry weight 0 and contribute nothing)")
+
+
+def _check_same_rows(kernel: str, n: int, **named) -> None:
+    for name, got in named.items():
+        if got != n:
+            raise ValueError(
+                f"{kernel}: {name} carries {got} rows but the bin matrix "
+                f"carries {n}; all row-aligned operands must be padded to "
+                "the same pad_rows() length")
+
+
+@jax.jit
+def pack_bins4(bins_t: jnp.ndarray) -> jnp.ndarray:
+    """(F, N) uint8 bin codes (all < 16) -> (F, N//2) nibble-packed bytes.
+
+    Row 2j lives in the LOW nibble of byte j, row 2j+1 in the HIGH nibble
+    (the reference's 4-bit dense_bin layout along the row axis).  N must
+    be even — the pallas row blocks always are."""
+    f, n = bins_t.shape
+    lo = bins_t[:, 0::2]
+    hi = bins_t[:, 1::2]
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+@jax.jit
+def unpack_bins4(packed: jnp.ndarray) -> jnp.ndarray:
+    """(..., N//2) packed bytes -> (..., N) interleaved bin codes."""
+    lo = packed & jnp.uint8(0xF)
+    hi = packed >> 4
+    out = jnp.stack([lo, hi], axis=-1)
+    return out.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
+
+
+def _tile_params(num_bins: int, f: int, m_cap: int):
+    """(padded bin count b, feature group g) for the one-hot contraction.
+
+    The stacked one-hot M dim is g*b; g*b must be a whole number of
+    128-row MXU tiles.  Unlike the v1 kernels (which padded b to 64/128),
+    b here rounds to a multiple of 8 and small-B shapes fill the tile by
+    stacking MORE features per contraction instead of padding bins: at
+    B<=16, b=16 with g=8 runs the same 128-row tile with zero padded-bin
+    waste (4x fewer MXU flops than b=64).  Per-(feature, bin) sums are
+    unchanged — only dead padding moves — so this is bit-compatible."""
+    b = max(16, _round_up(num_bins, 8))
+    group = 1
+    while (group * b) % 128 != 0 and group < 256:
+        group *= 2
+    while group * 2 <= f and group * 2 * b <= m_cap:
+        group *= 2
+    if group > f or (group * b) % 128 != 0:
+        b = _round_up(num_bins, 128)
+        group = 1
+    return b, group
+
+
+def _note_kernel(site: str, streamed_bytes: int) -> None:
+    """Tally one kernel build (trace-time inside jitted growers; per call
+    on eager paths) — exported by TrainRecord like the collective sites."""
+    try:
+        from ..telemetry.train_record import note_hist_kernel
+        note_hist_kernel(site, streamed_bytes)
+    except Exception:
+        pass
 
 
 def _split_hi_lo(v: jnp.ndarray):
@@ -120,24 +250,15 @@ def _hist_kernel(bins_ref, w_ref, out_ref, *, num_features: int,
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "row_block", "interpret",
                                     "kr"))
-def build_histogram_pallas(bins_t: jnp.ndarray, grad: jnp.ndarray,
-                           hess: jnp.ndarray, mask: jnp.ndarray, *,
-                           num_bins: int,
-                           row_block: int = DEFAULT_ROW_BLOCK,
-                           interpret: bool = False,
-                           kr: int = 0) -> jnp.ndarray:
-    """(F, B, 3) histogram over masked rows from feature-major bin codes.
-
-    Args:
-      bins_t: (F, N) integer bin codes, N a multiple of ``row_block``.
-      grad, hess, mask: (N,) f32; mask is 0.0 for out-of-leaf / padded rows.
-      num_bins: static global bin count B (padded to a lane-friendly size
-        internally; trailing bins stay zero).
-    """
+def _build_histogram_pallas_bs(bins_t: jnp.ndarray, grad: jnp.ndarray,
+                               hess: jnp.ndarray, mask: jnp.ndarray, *,
+                               num_bins: int,
+                               row_block: int = DEFAULT_ROW_BLOCK,
+                               interpret: bool = False,
+                               kr: int = 0) -> jnp.ndarray:
+    """Implicit-pipeline (BlockSpec-fetched) form of the single-leaf
+    histogram kernel — the v1 layout, kept for A/B re-probing."""
     f, n = bins_t.shape
-    if n % row_block != 0:
-        raise ValueError(f"pallas histogram needs N % {row_block} == 0, "
-                         f"got N={n} (use pad_rows)")
     # Pad bins to a multiple of 64 and pack `group` features per contraction
     # so the stacked one-hot M dim (group*b) fills whole 128-row MXU tiles:
     # at max_bin=63 (the reference's accelerator-recommended setting,
@@ -201,6 +322,200 @@ def build_histogram_pallas(bins_t: jnp.ndarray, grad: jnp.ndarray,
                       out[:, :, 2] + out[:, :, 3],
                       out[:, :, 4]], axis=-1)
     return hist[:f, :num_bins, :]
+
+
+def _hist_kernel_dma(bins_hbm, w_hbm, out_ref, *, num_features: int,
+                     num_bins: int, group: int, fstep: int, kr: int,
+                     nsteps: int, packed: bool):
+    """DMA-pipelined form: bins and weight row blocks stream HBM->VMEM
+    through two explicitly double-buffered async copies; the copy of
+    chunk j+1 is in flight while chunk j feeds the MXU contraction.  The
+    whole row sweep lives inside ONE grid step per feature tile, so the
+    f32 accumulator block is VMEM-resident start to finish.
+
+    ``packed`` consumes nibble-packed bins (two rows per byte): the
+    chunk unpacks in VMEM and contracts each nibble half against its
+    half of the pre-split weights — half the streamed bin bytes for the
+    same per-(feature, bin) sums."""
+    out_ref[...] = jnp.zeros_like(out_ref)
+    ft = num_features
+    b = num_bins
+    f0 = pl.program_id(0) * ft
+    kb = kr // 2 if packed else kr            # bin BYTES per chunk lane
+    iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, kb), 0) % b
+
+    def body(bbuf, wbuf, bsem, wsem):
+        def bins_dma(slot, j):
+            return pltpu.make_async_copy(
+                bins_hbm.at[pl.ds(f0, ft), pl.ds(j * kb, kb)],
+                bbuf.at[slot], bsem.at[slot])
+
+        def w_dma(slot, j):
+            if packed:
+                return pltpu.make_async_copy(
+                    w_hbm.at[:, pl.ds(j * kb, kb), :], wbuf.at[slot],
+                    wsem.at[slot])
+            return pltpu.make_async_copy(
+                w_hbm.at[pl.ds(j * kr, kr), :], wbuf.at[slot],
+                wsem.at[slot])
+
+        bins_dma(0, 0).start()
+        w_dma(0, 0).start()
+
+        def step(j, carry):
+            slot = j % 2
+
+            @pl.when(j + 1 < nsteps)
+            def _():
+                bins_dma((j + 1) % 2, j + 1).start()
+                w_dma((j + 1) % 2, j + 1).start()
+
+            bins_dma(slot, j).wait()
+            w_dma(slot, j).wait()
+            blk = bbuf[slot]                         # (ft, kb) bin bytes
+            if packed:
+                w_halves = (wbuf[slot, 0], wbuf[slot, 1])   # (kb, C) each
+            else:
+                w_halves = (wbuf[slot],)                    # (kr, C)
+
+            def do(i, c):
+                fi = i * fstep
+                cols_blk = jax.lax.dynamic_slice_in_dim(
+                    blk, fi, fstep, 0).astype(jnp.int32)
+                nibs = (cols_blk & 0xF, cols_blk >> 4) if packed \
+                    else (cols_blk,)
+                for k in range(fstep // group):
+                    part = None
+                    for nib, wh in zip(nibs, w_halves):
+                        cols = nib[k * group:(k + 1) * group]    # (g, kb)
+                        colrep = jnp.repeat(cols, b, axis=0)     # (g*B, kb)
+                        onehot = (colrep == iota_gb).astype(jnp.bfloat16)
+                        p = jax.lax.dot_general(
+                            onehot, wh, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (g*B, C)
+                        part = p if part is None else part + p
+                    out_ref[pl.ds((fi + k * group) * b, group * b)] += part
+                return c
+
+            jax.lax.fori_loop(0, num_features // fstep, do, 0)
+            return carry
+
+        jax.lax.fori_loop(0, nsteps, step, 0)
+
+    wshape = (2, 2, kb, _C) if packed else (2, kr, _C)
+    pl.run_scoped(body,
+                  pltpu.VMEM((2, ft, kb), bins_hbm.dtype),
+                  pltpu.VMEM(wshape, jnp.bfloat16),
+                  pltpu.SemaphoreType.DMA((2,)),
+                  pltpu.SemaphoreType.DMA((2,)))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "row_block", "interpret",
+                                    "kr", "packed"))
+def _build_histogram_pallas_dma(bins_t: jnp.ndarray, grad: jnp.ndarray,
+                                hess: jnp.ndarray, mask: jnp.ndarray, *,
+                                num_bins: int, row_block: int,
+                                interpret: bool, kr: int,
+                                packed: bool) -> jnp.ndarray:
+    f = bins_t.shape[0]
+    n = bins_t.shape[1] * (2 if packed else 1)
+    b, group = _tile_params(num_bins, f, 512)
+
+    gm = grad * mask
+    hm = hess * mask
+    g_hi, g_lo = _split_hi_lo(gm)
+    h_hi, h_lo = _split_hi_lo(hm)
+    z = jnp.zeros_like(g_hi)
+    w8 = jnp.stack([g_hi, g_lo, h_hi, h_lo, mask.astype(jnp.bfloat16),
+                    z, z, z], axis=-1)                     # (N, C)
+    if packed:
+        # pre-split weight halves pair each nibble with its own rows, so
+        # the kernel never lane-interleaves (Mosaic-unfriendly): half 0
+        # carries even rows (low nibbles), half 1 odd rows (high nibbles)
+        w8 = jnp.stack([w8[0::2], w8[1::2]])               # (2, N/2, C)
+
+    fstep = max(group, 8)
+    ft_cap = max(fstep, 8192 // b // fstep * fstep)
+    ft = min(_round_up(f, fstep), ft_cap)
+    f_pad = _round_up(f, ft)
+    if f_pad != f:
+        bins_t = jnp.pad(bins_t, ((0, f_pad - f), (0, 0)))
+    kr = kr or math.gcd(row_block, 1024)
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_dma, num_features=ft, num_bins=b,
+                          group=group, fstep=fstep, kr=kr, nsteps=n // kr,
+                          packed=packed),
+        grid=(f_pad // ft,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec((ft * b, _C), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f_pad * b, _C), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * f_pad * b * n * _C,
+            bytes_accessed=f_pad * (n // 2 if packed else n) +
+            n * _C * 2 + f_pad * b * _C * 4,
+            transcendentals=0),
+        interpret=interpret,
+    )(bins_t, w8)
+
+    out = out.reshape(f_pad, b, _C)
+    hist = jnp.stack([out[:, :, 0] + out[:, :, 1],
+                      out[:, :, 2] + out[:, :, 3],
+                      out[:, :, 4]], axis=-1)
+    return hist[:f, :num_bins, :]
+
+
+def build_histogram_pallas(bins_t: jnp.ndarray, grad: jnp.ndarray,
+                           hess: jnp.ndarray, mask: jnp.ndarray, *,
+                           num_bins: int,
+                           row_block: int = DEFAULT_ROW_BLOCK,
+                           interpret: bool = None,
+                           kr: int = 0, pipeline: str = None,
+                           bins_packed: bool = False) -> jnp.ndarray:
+    """(F, B, 3) histogram over masked rows from feature-major bin codes.
+
+    Args:
+      bins_t: (F, N) integer bin codes — or, with ``bins_packed``, the
+        (F, N//2) nibble-packed bytes from :func:`pack_bins4`.  N must be
+        a multiple of ``row_block`` (use :func:`pad_rows`).
+      grad, hess, mask: (N,) f32; mask is 0.0 for out-of-leaf / padded
+        rows.
+      num_bins: static global bin count B (padded to a lane-friendly size
+        internally; trailing bins stay zero).
+      interpret: None = auto (interpret off TPU).
+      pipeline: "dma" (explicit double-buffered HBM->VMEM streaming,
+        default) or "blockspec" (v1 implicit fetch); None = module
+        default.
+      bins_packed: bins_t holds two 4-bit codes per byte (requires
+        ``num_bins <= PACK4_MAX_BINS``; DMA pipeline only).
+    """
+    f, np_ = bins_t.shape
+    n = np_ * 2 if bins_packed else np_
+    _check_rows(n, row_block, "build_histogram_pallas")
+    _check_same_rows("build_histogram_pallas", n, grad=grad.shape[0],
+                     hess=hess.shape[0], mask=mask.shape[0])
+    pipeline = resolve_pipeline(pipeline)
+    interpret = resolve_interpret(interpret)
+    if bins_packed:
+        if num_bins > PACK4_MAX_BINS:
+            raise ValueError(f"bins_packed requires num_bins <= "
+                             f"{PACK4_MAX_BINS}, got {num_bins}")
+        pipeline = "dma"  # the packed layout exists only on the DMA path
+    _note_kernel(f"ops/hist_kernel/single/{pipeline}"
+                 + ("/packed4" if bins_packed else ""),
+                 f * np_ * bins_t.dtype.itemsize + n * _C * 2 +
+                 f * num_bins * 3 * 4)
+    if pipeline == "dma":
+        return _build_histogram_pallas_dma(
+            bins_t, grad, hess, mask, num_bins=num_bins,
+            row_block=row_block, interpret=interpret, kr=kr,
+            packed=bins_packed)
+    return _build_histogram_pallas_bs(
+        bins_t, grad, hess, mask, num_bins=num_bins, row_block=row_block,
+        interpret=interpret, kr=kr)
 
 
 # ---------------------------------------------------------------------------
@@ -293,23 +608,13 @@ def _hist_leaves_kernel(bins_ref, w_ref, ch_ref, out_ref, *,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "row_block", "interpret"))
-def build_histogram_pallas_leaves(bins_t: jnp.ndarray, w8: jnp.ndarray,
-                                  ch: jnp.ndarray, *, num_bins: int,
-                                  row_block: int = DEFAULT_ROW_BLOCK,
-                                  interpret: bool = False) -> jnp.ndarray:
-    """(LEAF_CHANNELS, F, B, 3) histograms of 25 leaf channels in one pass.
-
-    Args:
-      bins_t: (F, N) integer bin codes, N a multiple of ``row_block``.
-      w8: (8, N) bf16 FEATURE-MAJOR weight rows from :func:`pack_weights8`.
-      ch: (N,) integer leaf channel in [0, LEAF_CHANNELS), or -1 for rows
-        that belong to no batched leaf (they contribute nothing).
-      num_bins: static global bin count B.
-    """
+def _build_histogram_pallas_leaves_bs(bins_t: jnp.ndarray, w8: jnp.ndarray,
+                                      ch: jnp.ndarray, *, num_bins: int,
+                                      row_block: int = DEFAULT_ROW_BLOCK,
+                                      interpret: bool = False
+                                      ) -> jnp.ndarray:
+    """Implicit-pipeline (BlockSpec-fetched) 25-leaf kernel (v1 layout)."""
     f, n = bins_t.shape
-    if n % row_block != 0:
-        raise ValueError(f"pallas histogram needs N % {row_block} == 0, "
-                         f"got N={n} (use pad_rows)")
     b = _round_up(num_bins, 64)
     group = next((g for g in (2, 4, 8) if (g * b) % 128 == 0), 1)
     while group * 2 <= f and group * 2 * b <= 1024:
@@ -360,6 +665,231 @@ def build_histogram_pallas_leaves(bins_t: jnp.ndarray, w8: jnp.ndarray,
                       out[..., 2] + out[..., 3],
                       out[..., 4]], axis=-1)              # (F, B, 25, 3)
     return jnp.transpose(hist, (2, 0, 1, 3))[:, :f, :num_bins, :]
+
+
+def _leaves_dma_common(bins_hbm, w_hbm, ch_hbm, out_ref, *, num_features,
+                       num_bins, group, fstep, kr, nsteps, packed,
+                       make_w128, onehot_dtype, acc_dtype):
+    """Shared DMA pipeline of the two leaf-batched kernels: bins,
+    feature-major weights and the leaf-channel row stream HBM->VMEM via
+    double-buffered async copies overlapping the contraction.
+    ``make_w128(w_chunk, ch_chunk)`` expands the (8, r) weights into the
+    lane-packed (128, r) right operand (bf16 hi/lo or int8 form)."""
+    out_ref[...] = jnp.zeros_like(out_ref)
+    ft = num_features
+    b = num_bins
+    f0 = pl.program_id(0) * ft
+    kb = kr // 2 if packed else kr
+    iota_gb = jax.lax.broadcasted_iota(jnp.int32, (group * b, kb), 0) % b
+
+    def body(bbuf, wbuf, cbuf, bsem, wsem, csem):
+        def bins_dma(slot, j):
+            return pltpu.make_async_copy(
+                bins_hbm.at[pl.ds(f0, ft), pl.ds(j * kb, kb)],
+                bbuf.at[slot], bsem.at[slot])
+
+        def w_dma(slot, j):
+            if packed:
+                return pltpu.make_async_copy(
+                    w_hbm.at[:, :, pl.ds(j * kb, kb)], wbuf.at[slot],
+                    wsem.at[slot])
+            return pltpu.make_async_copy(
+                w_hbm.at[:, pl.ds(j * kr, kr)], wbuf.at[slot],
+                wsem.at[slot])
+
+        def ch_dma(slot, j):
+            if packed:
+                return pltpu.make_async_copy(
+                    ch_hbm.at[:, :, pl.ds(j * kb, kb)], cbuf.at[slot],
+                    csem.at[slot])
+            return pltpu.make_async_copy(
+                ch_hbm.at[:, pl.ds(j * kr, kr)], cbuf.at[slot],
+                csem.at[slot])
+
+        def start(slot, j):
+            bins_dma(slot, j).start()
+            w_dma(slot, j).start()
+            ch_dma(slot, j).start()
+
+        start(0, 0)
+
+        def step(j, carry):
+            slot = j % 2
+
+            @pl.when(j + 1 < nsteps)
+            def _():
+                start((j + 1) % 2, j + 1)
+
+            bins_dma(slot, j).wait()
+            w_dma(slot, j).wait()
+            ch_dma(slot, j).wait()
+            blk = bbuf[slot]
+            if packed:
+                w128s = (make_w128(wbuf[slot, 0], cbuf[slot, 0]),
+                         make_w128(wbuf[slot, 1], cbuf[slot, 1]))
+            else:
+                w128s = (make_w128(wbuf[slot], cbuf[slot]),)
+
+            def do(i, c):
+                fi = i * fstep
+                cols_blk = jax.lax.dynamic_slice_in_dim(
+                    blk, fi, fstep, 0).astype(jnp.int32)
+                nibs = (cols_blk & 0xF, cols_blk >> 4) if packed \
+                    else (cols_blk,)
+                for k in range(fstep // group):
+                    part = None
+                    for nib, w128t in zip(nibs, w128s):
+                        cols = nib[k * group:(k + 1) * group]
+                        colrep = jnp.repeat(cols, b, axis=0)
+                        onehot = (colrep == iota_gb).astype(onehot_dtype)
+                        p = jax.lax.dot_general(
+                            onehot, w128t, (((1,), (1,)), ((), ())),
+                            preferred_element_type=acc_dtype)  # (g*B, 128)
+                        part = p if part is None else part + p
+                    out_ref[pl.ds((fi + k * group) * b, group * b)] += part
+                return c
+
+            jax.lax.fori_loop(0, num_features // fstep, do, 0)
+            return carry
+
+        jax.lax.fori_loop(0, nsteps, step, 0)
+
+    if packed:
+        wshape, cshape = (2, 2, _C, kb), (2, 2, 1, kb)
+    else:
+        wshape, cshape = (2, _C, kr), (2, 1, kr)
+    pl.run_scoped(body,
+                  pltpu.VMEM((2, ft, kb), bins_hbm.dtype),
+                  pltpu.VMEM(wshape, w_hbm.dtype),
+                  pltpu.VMEM(cshape, ch_hbm.dtype),
+                  pltpu.SemaphoreType.DMA((2,)),
+                  pltpu.SemaphoreType.DMA((2,)),
+                  pltpu.SemaphoreType.DMA((2,)))
+
+
+def _make_w128_bf16(w, ch):
+    """(8, r) bf16 weights + (1, r) i32 channels -> (128, r) lane-packed
+    right operand (same arithmetic as _hist_leaves_kernel)."""
+    r = w.shape[1]
+    subl = jax.lax.broadcasted_iota(jnp.int32, (128, r), 0)
+    d = (ch.astype(jnp.int32) - subl // _CB).astype(jnp.float32)
+    sel = jnp.maximum(0.0, 1.0 - jnp.abs(d)).astype(jnp.bfloat16)
+    wtile = jnp.concatenate([w[:_CB]] * (128 // _CB + 1), axis=0)[:128]
+    return wtile * sel
+
+
+def _make_w128_q8(w, ch):
+    """(8, r) i8 weights + (1, r) i8 channels -> (128, r) int8 operand
+    (same arithmetic as _hist_leaves_q8_kernel: 32-bit build, i8 pack)."""
+    r = w.shape[1]
+    subl = jax.lax.broadcasted_iota(jnp.int32, (128, r), 0)
+    sel = (ch.astype(jnp.int32) == subl // _QCB).astype(jnp.int32)
+    w3 = w[:_QCB].astype(jnp.int32)
+    wtile = jnp.concatenate([w3] * (128 // _QCB + 1), axis=0)[:128]
+    return (wtile * sel).astype(jnp.int8)
+
+
+def _leaves_dma_call(bins_t, w, ch2, *, num_bins, interpret, packed,
+                     m_cap, kr0, make_w128, onehot_dtype, acc_dtype,
+                     out_dtype, row_block):
+    """Shared wrapper plumbing of the two DMA leaf-kernel builders."""
+    f = bins_t.shape[0]
+    n = bins_t.shape[1] * (2 if packed else 1)
+    b, group = _tile_params(num_bins, f, m_cap)
+    if packed:
+        w = jnp.stack([w[:, 0::2], w[:, 1::2]])       # (2, 8, N/2)
+        ch2 = jnp.stack([ch2[:, 0::2], ch2[:, 1::2]])  # (2, 1, N/2)
+    fstep = max(group, 8)
+    ft_cap = max(fstep, 8192 // b // fstep * fstep)
+    ft = min(_round_up(f, fstep), ft_cap)
+    f_pad = _round_up(f, ft)
+    if f_pad != f:
+        bins_t = jnp.pad(bins_t, ((0, f_pad - f), (0, 0)))
+    kr = math.gcd(row_block, kr0)
+    out = pl.pallas_call(
+        functools.partial(_leaves_dma_common, num_features=ft, num_bins=b,
+                          group=group, fstep=fstep, kr=kr, nsteps=n // kr,
+                          packed=packed, make_w128=make_w128,
+                          onehot_dtype=onehot_dtype, acc_dtype=acc_dtype),
+        grid=(f_pad // ft,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec((ft * b, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f_pad * b, 128), out_dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * f_pad * b * n * 128,
+            bytes_accessed=f_pad * (n // 2 if packed else n) +
+            n * (_C * 2 + 4) + f_pad * b * 512,
+            transcendentals=0),
+        interpret=interpret,
+    )(bins_t, w, ch2)
+    return out, f_pad
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "row_block", "interpret",
+                                    "packed"))
+def _build_histogram_pallas_leaves_dma(bins_t, w8, ch, *, num_bins,
+                                       row_block, interpret, packed):
+    n = w8.shape[1]
+    ch2 = ch.astype(jnp.int32).reshape(1, n)
+    out, f_pad = _leaves_dma_call(
+        bins_t, w8, ch2, num_bins=num_bins, interpret=interpret,
+        packed=packed, m_cap=1024, kr0=4096, make_w128=_make_w128_bf16,
+        onehot_dtype=jnp.bfloat16, acc_dtype=jnp.float32,
+        out_dtype=jnp.float32, row_block=row_block)
+    f = bins_t.shape[0]
+    b = out.shape[0] // f_pad
+    out = out[:, :LEAF_CHANNELS * _CB].reshape(f_pad, b, LEAF_CHANNELS, _CB)
+    hist = jnp.stack([out[..., 0] + out[..., 1],
+                      out[..., 2] + out[..., 3],
+                      out[..., 4]], axis=-1)
+    return jnp.transpose(hist, (2, 0, 1, 3))[:, :f, :num_bins, :]
+
+
+def build_histogram_pallas_leaves(bins_t: jnp.ndarray, w8: jnp.ndarray,
+                                  ch: jnp.ndarray, *, num_bins: int,
+                                  row_block: int = DEFAULT_ROW_BLOCK,
+                                  interpret: bool = None,
+                                  pipeline: str = None,
+                                  bins_packed: bool = False) -> jnp.ndarray:
+    """(LEAF_CHANNELS, F, B, 3) histograms of 25 leaf channels in one pass.
+
+    Args:
+      bins_t: (F, N) integer bin codes — or, with ``bins_packed``, the
+        (F, N//2) nibble-packed bytes from :func:`pack_bins4`.  N must be
+        a multiple of ``row_block``.
+      w8: (8, N) bf16 FEATURE-MAJOR weight rows from :func:`pack_weights8`.
+      ch: (N,) integer leaf channel in [0, LEAF_CHANNELS), or -1 for rows
+        that belong to no batched leaf (they contribute nothing).
+      num_bins: static global bin count B.
+      interpret / pipeline / bins_packed: as :func:`build_histogram_pallas`.
+    """
+    f, np_ = bins_t.shape
+    n = np_ * 2 if bins_packed else np_
+    _check_rows(n, row_block, "build_histogram_pallas_leaves")
+    _check_same_rows("build_histogram_pallas_leaves", n, w8=w8.shape[1],
+                     ch=ch.shape[0])
+    pipeline = resolve_pipeline(pipeline)
+    interpret = resolve_interpret(interpret)
+    if bins_packed:
+        if num_bins > PACK4_MAX_BINS:
+            raise ValueError(f"bins_packed requires num_bins <= "
+                             f"{PACK4_MAX_BINS}, got {num_bins}")
+        pipeline = "dma"
+    _note_kernel(f"ops/hist_kernel/leaves/{pipeline}"
+                 + ("/packed4" if bins_packed else ""),
+                 f * np_ * bins_t.dtype.itemsize + n * (_C * 2 + 4) +
+                 LEAF_CHANNELS * f * num_bins * 3 * 4)
+    if pipeline == "dma":
+        return _build_histogram_pallas_leaves_dma(
+            bins_t, w8, ch, num_bins=num_bins, row_block=row_block,
+            interpret=interpret, packed=bins_packed)
+    return _build_histogram_pallas_leaves_bs(
+        bins_t, w8, ch, num_bins=num_bins, row_block=row_block,
+        interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -425,28 +955,15 @@ def _hist_leaves_q8_kernel(bins_ref, wch_ref, ch_ref, out_ref, *,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "row_block", "interpret"))
-def build_histogram_pallas_leaves_q8(bins_t: jnp.ndarray, wch: jnp.ndarray,
-                                     ch: jnp.ndarray, *, num_bins: int,
-                                     row_block: int = DEFAULT_ROW_BLOCK,
-                                     interpret: bool = False) -> jnp.ndarray:
-    """(Q_LEAF_CHANNELS, F, B, 3) int32 histograms of 42 leaf channels.
-
-    Args:
-      bins_t: (F, N) uint8 bin codes, N a multiple of ``row_block``.
-      wch: (8, N) int8 FEATURE-MAJOR rows [g_q, h_q, count, 0*5] —
-        static per tree (quantize once; no per-wave rewrite).
-      ch: (N,) int8 leaf channel in [0, Q_LEAF_CHANNELS), or -1 for
-        inactive rows (they contribute nothing regardless of their
-        weight lanes).
-      num_bins: static global bin count B (<= 256).
-    Returns:
-      (42, F, B, 3) int32: channel sums (sum g_q, sum h_q, count).
-    """
+def _build_histogram_pallas_leaves_q8_bs(bins_t: jnp.ndarray,
+                                         wch: jnp.ndarray,
+                                         ch: jnp.ndarray, *, num_bins: int,
+                                         row_block: int = DEFAULT_ROW_BLOCK,
+                                         interpret: bool = False
+                                         ) -> jnp.ndarray:
+    """Implicit-pipeline (BlockSpec-fetched) 42-leaf q8 kernel (v1)."""
     _, n = wch.shape
     f = bins_t.shape[0]
-    if n % row_block != 0:
-        raise ValueError(f"pallas histogram needs N % {row_block} == 0, "
-                         f"got N={n} (use pad_rows)")
     b = _round_up(num_bins, 64)
     # largest power-of-two feature group with (g*b) % 128 == 0 and the
     # stacked one-hot M dim capped at 2048 (measured best at B=256)
@@ -491,6 +1008,75 @@ def build_histogram_pallas_leaves_q8(bins_t: jnp.ndarray, wch: jnp.ndarray,
     return jnp.transpose(out, (2, 0, 1, 3))[:, :f, :num_bins, :]
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "row_block", "interpret",
+                                    "packed"))
+def _build_histogram_pallas_leaves_q8_dma(bins_t, wch, ch, *, num_bins,
+                                          row_block, interpret, packed):
+    n = wch.shape[1]
+    ch2 = ch.astype(jnp.int8).reshape(1, n)
+    out, f_pad = _leaves_dma_call(
+        bins_t, wch, ch2, num_bins=num_bins, interpret=interpret,
+        packed=packed, m_cap=2048, kr0=4096, make_w128=_make_w128_q8,
+        onehot_dtype=jnp.int8, acc_dtype=jnp.int32,
+        out_dtype=jnp.int32, row_block=row_block)
+    f = bins_t.shape[0]
+    b = out.shape[0] // f_pad
+    out = out[:, :Q_LEAF_CHANNELS * _QCB].reshape(f_pad, b,
+                                                  Q_LEAF_CHANNELS, _QCB)
+    return jnp.transpose(out, (2, 0, 1, 3))[:, :f, :num_bins, :]
+
+
+def build_histogram_pallas_leaves_q8(bins_t: jnp.ndarray, wch: jnp.ndarray,
+                                     ch: jnp.ndarray, *, num_bins: int,
+                                     row_block: int = DEFAULT_ROW_BLOCK,
+                                     interpret: bool = None,
+                                     pipeline: str = None,
+                                     bins_packed: bool = False
+                                     ) -> jnp.ndarray:
+    """(Q_LEAF_CHANNELS, F, B, 3) int32 histograms of 42 leaf channels.
+
+    Args:
+      bins_t: (F, N) uint8 bin codes — or, with ``bins_packed``, the
+        (F, N//2) nibble-packed bytes from :func:`pack_bins4`.  N must be
+        a multiple of ``row_block``.
+      wch: (8, N) int8 FEATURE-MAJOR rows [g_q, h_q, count, 0*5] —
+        static per tree (quantize once; no per-wave rewrite).
+      ch: (N,) int8 leaf channel in [0, Q_LEAF_CHANNELS), or -1 for
+        inactive rows (they contribute nothing regardless of their
+        weight lanes).
+      num_bins: static global bin count B (<= 256).
+      interpret / pipeline / bins_packed: as :func:`build_histogram_pallas`.
+    Returns:
+      (42, F, B, 3) int32: channel sums (sum g_q, sum h_q, count) —
+      exact integer sums, so every pipeline/packing variant is
+      bit-for-bit identical.
+    """
+    f, np_ = bins_t.shape
+    n = np_ * 2 if bins_packed else np_
+    _check_rows(n, row_block, "build_histogram_pallas_leaves_q8")
+    _check_same_rows("build_histogram_pallas_leaves_q8", n,
+                     wch=wch.shape[1], ch=ch.shape[0])
+    pipeline = resolve_pipeline(pipeline)
+    interpret = resolve_interpret(interpret)
+    if bins_packed:
+        if num_bins > PACK4_MAX_BINS:
+            raise ValueError(f"bins_packed requires num_bins <= "
+                             f"{PACK4_MAX_BINS}, got {num_bins}")
+        pipeline = "dma"
+    _note_kernel(f"ops/hist_kernel/leaves_q8/{pipeline}"
+                 + ("/packed4" if bins_packed else ""),
+                 f * np_ * bins_t.dtype.itemsize + n * 9 +
+                 Q_LEAF_CHANNELS * f * num_bins * 3 * 4)
+    if pipeline == "dma":
+        return _build_histogram_pallas_leaves_q8_dma(
+            bins_t, wch, ch, num_bins=num_bins, row_block=row_block,
+            interpret=interpret, packed=bins_packed)
+    return _build_histogram_pallas_leaves_q8_bs(
+        bins_t, wch, ch, num_bins=num_bins, row_block=row_block,
+        interpret=interpret)
+
+
 # ---------------------------------------------------------------------------
 # Wave row update: one fused pass assigning rows to their post-wave leaf
 # and leaf channel.  The XLA form (learner/wave.py's W sequential masked
@@ -529,28 +1115,136 @@ def _row_update_kernel(cols_ref, rl_ref, tab_ref, rl_out, ch_out, *,
     ch_out[...] = ch.astype(jnp.int8)
 
 
-@functools.partial(jax.jit, static_argnames=("row_block", "interpret"))
-def wave_row_update_pallas(cols_w: jnp.ndarray, rl: jnp.ndarray,
-                           tab: jnp.ndarray, *,
-                           row_block: int = DEFAULT_ROW_BLOCK,
-                           interpret: bool = False):
-    """Apply a wave's W numeric splits to every row in one fused pass.
+def _row_update_kernel_dma(cols_hbm, rl_hbm, tab_ref, rl_out, ch_out, *,
+                           w: int, krd: int, nsteps: int):
+    """Fully manual DMA pipeline of the wave row update: the W winning
+    feature columns and the row->leaf vector stream in through
+    double-buffered async copies, the updated rl/ch blocks stream back
+    out, and the copy of block j+1 overlaps block j's W-split sweep —
+    the kernel is pure VPU work, so it is bandwidth-bound end to end."""
 
-    Args:
-      cols_w: (W, N) uint8 — the wave's winning feature columns
-        (``jnp.take(X_T, feat, axis=0)``), N a multiple of ``row_block``.
-      rl: (N,) integer row->leaf vector (any integer dtype).
-      tab: (8, W) int32 per-split table: rows are [threshold_bin,
-        nan_bin (-1 = none), default_left, left_is_smaller, split_leaf,
-        new_right_id, active, unused].
-    Returns:
-      (rl_new int32 (N,), ch int8 (N,)) — post-wave leaf ids and the
-      smaller-child channel (-1 = row not in any split's smaller child).
-    """
+    def body(cbuf, ibuf, robuf, cobuf, csem, isem, rosem, cosem):
+        def cols_dma(slot, j):
+            return pltpu.make_async_copy(
+                cols_hbm.at[:, :, pl.ds(j * krd, krd)], cbuf.at[slot],
+                csem.at[slot])
+
+        def rl_dma(slot, j):
+            return pltpu.make_async_copy(
+                rl_hbm.at[:, pl.ds(j * krd, krd)], ibuf.at[slot],
+                isem.at[slot])
+
+        def ro_dma(slot, j):
+            return pltpu.make_async_copy(
+                robuf.at[slot], rl_out.at[:, pl.ds(j * krd, krd)],
+                rosem.at[slot])
+
+        def co_dma(slot, j):
+            return pltpu.make_async_copy(
+                cobuf.at[slot], ch_out.at[:, pl.ds(j * krd, krd)],
+                cosem.at[slot])
+
+        cols_dma(0, 0).start()
+        rl_dma(0, 0).start()
+
+        def step(j, carry):
+            slot = j % 2
+
+            @pl.when(j + 1 < nsteps)
+            def _():
+                cols_dma((j + 1) % 2, j + 1).start()
+                rl_dma((j + 1) % 2, j + 1).start()
+
+            cols_dma(slot, j).wait()
+            rl_dma(slot, j).wait()
+            rl = ibuf[slot].astype(jnp.int32)            # (8, KRD)
+            ch = jnp.full_like(rl, -1)
+            for jj in range(w):
+                col = cbuf[slot, jj].astype(jnp.int32)   # (8, KRD)
+                thr = tab_ref[0, jj]
+                nanb = tab_ref[1, jj]
+                dlft = tab_ref[2, jj]
+                small = tab_ref[3, jj]
+                selj = tab_ref[4, jj]
+                newid = tab_ref[5, jj]
+                act = tab_ref[6, jj]
+                go_left = jnp.where(col == nanb, dlft,
+                                    (col <= thr).astype(jnp.int32))
+                upd = (rl == selj) & (act > 0)
+                ch = jnp.where(upd & (go_left == small), jj, ch)
+                rl = jnp.where(upd & (go_left == 0), newid, rl)
+
+            # the out buffers double-buffer too: wait this slot's
+            # previous write-back before overwriting it
+            @pl.when(j >= 2)
+            def _():
+                ro_dma(slot, j - 2).wait()
+                co_dma(slot, j - 2).wait()
+
+            robuf[slot] = rl
+            cobuf[slot] = ch.astype(jnp.int8)
+            ro_dma(slot, j).start()
+            co_dma(slot, j).start()
+            return carry
+
+        jax.lax.fori_loop(0, nsteps, step, 0)
+        # drain the last two in-flight write-backs
+        if nsteps >= 2:
+            ro_dma((nsteps - 2) % 2, nsteps - 2).wait()
+            co_dma((nsteps - 2) % 2, nsteps - 2).wait()
+        ro_dma((nsteps - 1) % 2, nsteps - 1).wait()
+        co_dma((nsteps - 1) % 2, nsteps - 1).wait()
+
+    pl.run_scoped(body,
+                  pltpu.VMEM((2, w, 8, krd), cols_hbm.dtype),
+                  pltpu.VMEM((2, 8, krd), rl_hbm.dtype),
+                  pltpu.VMEM((2, 8, krd), jnp.int32),
+                  pltpu.VMEM((2, 8, krd), jnp.int8),
+                  pltpu.SemaphoreType.DMA((2,)),
+                  pltpu.SemaphoreType.DMA((2,)),
+                  pltpu.SemaphoreType.DMA((2,)),
+                  pltpu.SemaphoreType.DMA((2,)))
+
+
+@functools.partial(jax.jit, static_argnames=("row_block", "interpret"))
+def _wave_row_update_dma(cols_w: jnp.ndarray, rl: jnp.ndarray,
+                         tab: jnp.ndarray, *,
+                         row_block: int = DEFAULT_ROW_BLOCK,
+                         interpret: bool = False):
     w, n = cols_w.shape
-    if n % row_block != 0:
-        raise ValueError(f"wave_row_update needs N % {row_block} == 0, "
-                         f"got N={n}")
+    kr = math.gcd(row_block, 4096)
+    krd = kr // 8
+    nd = n // 8
+    cols3 = cols_w.reshape(w, 8, nd)
+    rl2 = rl.astype(jnp.int32).reshape(8, nd)
+    rl_new, ch = pl.pallas_call(
+        functools.partial(_row_update_kernel_dma, w=w, krd=krd,
+                          nsteps=n // kr),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((8, nd), jnp.int32),
+            jax.ShapeDtypeStruct((8, nd), jnp.int8),
+        ],
+        interpret=interpret,
+    )(cols3, rl2, tab)
+    return rl_new.reshape(n), ch.reshape(n)
+
+
+@functools.partial(jax.jit, static_argnames=("row_block", "interpret"))
+def _wave_row_update_bs(cols_w: jnp.ndarray, rl: jnp.ndarray,
+                        tab: jnp.ndarray, *,
+                        row_block: int = DEFAULT_ROW_BLOCK,
+                        interpret: bool = False):
+    """Implicit-pipeline (BlockSpec-fetched) row update (v1 layout)."""
+    w, n = cols_w.shape
     kr = math.gcd(row_block, 4096)
     krd = kr // 8
     nd = n // 8
@@ -583,12 +1277,47 @@ def wave_row_update_pallas(cols_w: jnp.ndarray, rl: jnp.ndarray,
     return rl_new.reshape(n), ch.reshape(n)
 
 
+def wave_row_update_pallas(cols_w: jnp.ndarray, rl: jnp.ndarray,
+                           tab: jnp.ndarray, *,
+                           row_block: int = DEFAULT_ROW_BLOCK,
+                           interpret: bool = None, pipeline: str = None):
+    """Apply a wave's W numeric splits to every row in one fused pass.
+
+    Args:
+      cols_w: (W, N) uint8 — the wave's winning feature columns
+        (``jnp.take(X_T, feat, axis=0)``), N a multiple of ``row_block``.
+      rl: (N,) integer row->leaf vector (any integer dtype).
+      tab: (8, W) int32 per-split table: rows are [threshold_bin,
+        nan_bin (-1 = none), default_left, left_is_smaller, split_leaf,
+        new_right_id, active, unused].
+      interpret / pipeline: as :func:`build_histogram_pallas` ("dma"
+        streams the column blocks AND the rl/ch write-backs through
+        double-buffered async copies).
+    Returns:
+      (rl_new int32 (N,), ch int8 (N,)) — post-wave leaf ids and the
+      smaller-child channel (-1 = row not in any split's smaller child).
+    """
+    w, n = cols_w.shape
+    _check_rows(n, row_block, "wave_row_update_pallas")
+    _check_same_rows("wave_row_update_pallas", n, rl=rl.shape[0])
+    pipeline = resolve_pipeline(pipeline)
+    interpret = resolve_interpret(interpret)
+    _note_kernel(f"ops/hist_kernel/row_update/{pipeline}",
+                 w * n * cols_w.dtype.itemsize + n * 4 + n * 5)
+    if pipeline == "dma":
+        return _wave_row_update_dma(cols_w, rl, tab, row_block=row_block,
+                                    interpret=interpret)
+    return _wave_row_update_bs(cols_w, rl, tab, row_block=row_block,
+                               interpret=interpret)
+
+
 def wave_trial_channels_pallas(cols_w: jnp.ndarray, rl: jnp.ndarray,
                                sel_leaves: jnp.ndarray, thr: jnp.ndarray,
                                nan_bin: jnp.ndarray, default_left: jnp.ndarray,
                                left_smaller: jnp.ndarray, active: jnp.ndarray,
                                *, row_block: int = DEFAULT_ROW_BLOCK,
-                               interpret: bool = False) -> jnp.ndarray:
+                               interpret: bool = None,
+                               pipeline: str = None) -> jnp.ndarray:
     """TRIAL leaf-channel assignment for W *candidate* splits.
 
     Same fused kernel as :func:`wave_row_update_pallas`, but the splits are
@@ -606,5 +1335,5 @@ def wave_trial_channels_pallas(cols_w: jnp.ndarray, rl: jnp.ndarray,
                      left_smaller.astype(jnp.int32), sel_leaves, sel_leaves,
                      active.astype(jnp.int32), jnp.zeros_like(thr)])
     _, ch = wave_row_update_pallas(cols_w, rl, tab, row_block=row_block,
-                                   interpret=interpret)
+                                   interpret=interpret, pipeline=pipeline)
     return ch

@@ -44,7 +44,9 @@ from .trace import span
 
 __all__ = ["TrainRecord", "note_collective", "collectives_snapshot",
            "collectives_reset", "last_train_record",
-           "set_last_train_record", "device_memory_peak"]
+           "set_last_train_record", "device_memory_peak",
+           "note_hist_kernel", "hist_kernel_snapshot",
+           "hist_kernel_reset"]
 
 
 # ---------------------------------------------------------------------------
@@ -88,6 +90,42 @@ def collectives_snapshot() -> Dict[str, Dict[str, Any]]:
 def collectives_reset() -> None:
     with _coll_lock:
         _collectives.clear()
+
+
+# ---------------------------------------------------------------------------
+# Histogram-kernel tally — incremented by the ops/histogram_pallas entry
+# points.  Inside a jitted grower the entry wrapper runs at TRACE time
+# (one tally per traced program, like the collective sites); on eager
+# paths (autotune probes, benchmarks, the leaf-refit pass) it counts per
+# build.  ``bytes`` is the kernel's streamed-byte estimate (bins +
+# packed weights in, histogram block out) — the quantity the DMA
+# pipeline and the 4-bit bin packing attack.
+# ---------------------------------------------------------------------------
+
+_hist_lock = threading.Lock()
+# site -> {"count": int, "bytes": int}
+_hist_kernels: Dict[str, Dict[str, int]] = {}
+
+
+def note_hist_kernel(site: str, streamed_bytes: int) -> None:
+    if not _config.enabled():
+        return
+    with _hist_lock:
+        rec = _hist_kernels.get(site)
+        if rec is None:
+            rec = _hist_kernels[site] = {"count": 0, "bytes": 0}
+        rec["count"] += 1
+        rec["bytes"] += int(streamed_bytes)
+
+
+def hist_kernel_snapshot() -> Dict[str, Dict[str, int]]:
+    with _hist_lock:
+        return {k: dict(v) for k, v in _hist_kernels.items()}
+
+
+def hist_kernel_reset() -> None:
+    with _hist_lock:
+        _hist_kernels.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -231,6 +269,7 @@ class TrainRecord:
         self._trees: List[Dict[str, int]] = []
         self._mem_peak: Optional[int] = None
         self._coll_base = collectives_snapshot()
+        self._hist_base = hist_kernel_snapshot()
         _ensure_monitoring()
         self._mon_base, self._mon_secs_base = _monitoring_snapshot()
 
@@ -302,6 +341,14 @@ class TrainRecord:
             db = rec["bytes"] - base["bytes"]
             if dc > 0:
                 coll[site] = {"op": rec["op"], "count": dc, "bytes": db}
+        hk_now = hist_kernel_snapshot()
+        hist_kernels = {}
+        for site, rec in hk_now.items():
+            base = self._hist_base.get(site, {"count": 0, "bytes": 0})
+            dc = rec["count"] - base["count"]
+            db = rec["bytes"] - base["bytes"]
+            if dc > 0:
+                hist_kernels[site] = {"count": dc, "bytes": db}
         mon_counts, mon_secs = _monitoring_snapshot()
         events = {}
         for k, v in _compile_events(mon_counts).items():
@@ -324,6 +371,7 @@ class TrainRecord:
             "phase_seconds": {k: round(v, 6) for k, v in phase_s.items()},
             "phase_calls": phase_n,
             "collectives_traced": coll,
+            "hist_kernel": hist_kernels,
             "compile_events": events,
             "compile_seconds": secs,
             "device_memory_peak_bytes": mem_peak,

@@ -1,67 +1,12 @@
-"""Micro-benchmark: histogram implementations on the real device."""
+"""Deprecated shim: the histogram micro-benchmark moved to
+benchmarks/hist_kernel.py (bench-matrix-v1 records, impl x B x
+row_block ladder).  This wrapper keeps old invocations working."""
 import os
+import runpy
 import sys
-import time
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from lightgbm_tpu.ops.histogram import build_histogram
-from lightgbm_tpu.ops.histogram_pallas import build_histogram_pallas, pad_rows
-
-
-def timeit(fn, *args, reps=5, **kw):
-    out = fn(*args, **kw)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args, **kw)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps, out
-
-
-def main():
-    n, f, b = 4_194_304, 28, 255
-    rng = np.random.RandomState(0)
-    bins = rng.randint(0, b, (n, f)).astype(np.uint8)
-    grad = rng.randn(n).astype(np.float32)
-    hess = np.abs(rng.randn(n)).astype(np.float32)
-    mask = (rng.rand(n) < 0.8).astype(np.float32)
-
-    bins_d = jnp.asarray(bins)
-    bins_t = jnp.asarray(bins.T.copy())
-    g, h, m = jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(mask)
-    assert pad_rows(n) == n, pad_rows(n)
-
-    t_pal, hist_pal = timeit(build_histogram_pallas, bins_t, g, h, m,
-                             num_bins=b)
-    print(f"pallas:  {t_pal*1e3:9.2f} ms  ({n/t_pal/1e9:.2f} Grows/s)")
-
-    # f64 reference on host for exactness check
-    w = (grad.astype(np.float64) * mask, hess.astype(np.float64) * mask,
-         mask.astype(np.float64))
-    sub = slice(0, 262144)
-    ref = np.zeros((f, b, 3))
-    for c, wc in enumerate(w):
-        for j in range(f):
-            ref[j, :, c] = np.bincount(bins[sub, j], weights=wc[sub],
-                                       minlength=b)
-    t_pal_s, hist_pal_s = timeit(build_histogram_pallas,
-                                 jnp.asarray(bins[sub].T.copy()), g[sub],
-                                 h[sub], m[sub], num_bins=b)
-    err = np.max(np.abs(np.asarray(hist_pal_s) - ref) /
-                 np.maximum(1.0, np.abs(ref)))
-    print(f"pallas small: {t_pal_s*1e3:7.2f} ms   max rel err vs f64: {err:.2e}")
-
-    t_oh, hist_oh = timeit(build_histogram, bins_d, g, h, m, num_bins=b,
-                           impl="onehot")
-    print(f"onehot:  {t_oh*1e3:9.2f} ms  ({n/t_oh/1e9:.2f} Grows/s)")
-    d = np.max(np.abs(np.asarray(hist_oh) - np.asarray(hist_pal)))
-    print(f"max abs diff pallas vs onehot: {d:.3e}")
-
-
-if __name__ == "__main__":
-    main()
+sys.stderr.write("scripts/bench_hist.py moved to benchmarks/"
+                 "hist_kernel.py; delegating\n")
+runpy.run_path(os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks", "hist_kernel.py"),
+    run_name="__main__")

@@ -1,0 +1,337 @@
+"""Histogram kernel v2 (ISSUE 8): interpret-mode tier-1 coverage of the
+four Pallas kernels — DMA pipeline vs BlockSpec vs 4-bit-packed bins —
+against the XLA reference impls, plus the vmap-to-grid batching rule,
+the pad_rows() error contract, the packed4 XLA scatter, the autotune
+disk cache and the hist_kernel telemetry site.
+
+Shapes are deliberately tiny and SHARED across tests (the interpret
+kernels compile once per (shape, variant) and the jit cache is
+process-wide), keeping the file cheap inside the tier-1 budget."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.histogram import build_histogram, build_histogram_leaves
+from lightgbm_tpu.ops.histogram_pallas import (
+    LEAF_CHANNELS, Q_LEAF_CHANNELS, build_histogram_pallas,
+    build_histogram_pallas_leaves, build_histogram_pallas_leaves_q8,
+    pack_bins4, pack_weights8, pad_rows, unpack_bins4,
+    wave_row_update_pallas, wave_trial_channels_pallas)
+
+N, F = 4096, 5  # one exact row block — the boundary shape
+
+
+def _data(n=N, f=F, B=16, seed=0):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, B, (n, f)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.abs(rng.randn(n)).astype(np.float32)
+    # masked rows (w=0) must contribute nothing
+    mask = (rng.rand(n) > 0.3).astype(np.float32)
+    return bins, grad, hess, mask
+
+
+# -- single-leaf kernel: every variant vs the XLA segment reference ----------
+
+@pytest.mark.parametrize("B", [16, 64, 255])
+def test_single_kernel_variants_vs_reference(B):
+    bins, grad, hess, mask = _data(B=B)
+    bt = jnp.asarray(bins.T.copy())
+    g, h, m = map(jnp.asarray, (grad, hess, mask))
+    ref = np.asarray(build_histogram(jnp.asarray(bins), g, h, m,
+                                     num_bins=B, impl="segment"))
+    scale = max(1.0, np.abs(ref).max())
+    variants = [dict(pipeline="blockspec"), dict(pipeline="dma")]
+    if B <= 16:
+        variants.append(dict(bins_packed=True))
+    outs = {}
+    for kw in variants:
+        src = pack_bins4(bt) if kw.get("bins_packed") else bt
+        got = np.asarray(build_histogram_pallas(src, g, h, m,
+                                                num_bins=B, **kw))
+        name = "packed" if kw.get("bins_packed") else kw["pipeline"]
+        outs[name] = got
+        # f32 hi/lo exactness contract vs the f32 reference
+        assert np.abs(got - ref).max() / scale < 1e-5, name
+        # the count channel sums exact small integers — bitwise in any
+        # accumulation order
+        np.testing.assert_array_equal(got[..., 2], ref[..., 2], err_msg=name)
+
+
+def test_single_kernel_n_plus_one_raises():
+    bins, grad, hess, mask = _data(n=N + 1)
+    with pytest.raises(ValueError, match="pad_rows"):
+        build_histogram_pallas(jnp.asarray(bins.T.copy()),
+                               jnp.asarray(grad), jnp.asarray(hess),
+                               jnp.asarray(mask), num_bins=16)
+    # row-aligned operand mismatch is caught by name
+    bins, grad, hess, mask = _data()
+    with pytest.raises(ValueError, match="grad"):
+        build_histogram_pallas(jnp.asarray(bins.T.copy()),
+                               jnp.asarray(grad[: N // 2]),
+                               jnp.asarray(hess), jnp.asarray(mask),
+                               num_bins=16)
+
+
+def test_single_kernel_pad_boundary():
+    """N=block data padded to 2 blocks with w=0 rows == unpadded build."""
+    bins, grad, hess, mask = _data(B=16)
+    bt = jnp.asarray(bins.T.copy())
+    base = np.asarray(build_histogram_pallas(
+        bt, jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(mask),
+        num_bins=16))
+    n2 = pad_rows(N + 1)
+    assert n2 == 2 * N
+    bp = jnp.asarray(np.pad(bins, ((0, n2 - N), (0, 0))).T.copy())
+    padded = np.asarray(build_histogram_pallas(
+        bp, jnp.asarray(np.pad(grad, (0, n2 - N))),
+        jnp.asarray(np.pad(hess, (0, n2 - N))),
+        jnp.asarray(np.pad(mask, (0, n2 - N))), num_bins=16))
+    np.testing.assert_allclose(padded, base, rtol=1e-6, atol=1e-6)
+
+
+def test_pack_bins4_roundtrip():
+    bins, *_ = _data(B=16)
+    bt = jnp.asarray(bins.T.copy())
+    np.testing.assert_array_equal(np.asarray(unpack_bins4(pack_bins4(bt))),
+                                  bins.T)
+
+
+# -- leaf-batched kernels ----------------------------------------------------
+
+def test_leaves_kernel_variants_vs_reference():
+    bins, grad, hess, mask = _data(B=16, f=6)
+    rng = np.random.RandomState(1)
+    ch = rng.randint(-1, LEAF_CHANNELS, N).astype(np.int32)
+    bt = jnp.asarray(bins.T.copy())
+    g, h, m, chd = map(jnp.asarray, (grad, hess, mask, ch))
+    w8 = pack_weights8(g, h, m)
+    ref = np.asarray(build_histogram_leaves(
+        jnp.asarray(bins), g, h, m, chd, num_channels=LEAF_CHANNELS,
+        num_bins=16, impl="segment"))
+    scale = max(1.0, np.abs(ref).max())
+    for kw in [dict(pipeline="blockspec"), dict(pipeline="dma"),
+               dict(bins_packed=True)]:
+        src = pack_bins4(bt) if kw.get("bins_packed") else bt
+        got = np.asarray(build_histogram_pallas_leaves(
+            src, w8, chd, num_bins=16, **kw))
+        assert np.abs(got - ref).max() / scale < 1e-5, kw
+        np.testing.assert_array_equal(got[..., 2], ref[..., 2])
+
+
+def test_q8_kernel_bitwise_across_variants():
+    """Quantized path: int32 sums are exact — every pipeline/packing
+    variant must agree bit-for-bit (the ISSUE 8 kernel contract)."""
+    bins, _, _, mask = _data(B=16, f=6)
+    rng = np.random.RandomState(2)
+    wch = np.zeros((8, N), np.int8)
+    act = (mask > 0)
+    wch[0] = rng.randint(-127, 128, N) * act
+    wch[1] = rng.randint(0, 128, N) * act
+    wch[2] = act
+    ch = rng.randint(-1, Q_LEAF_CHANNELS, N).astype(np.int8)
+    bt = jnp.asarray(bins.T.copy())
+    wchd, chd = jnp.asarray(wch), jnp.asarray(ch)
+    base = np.asarray(build_histogram_pallas_leaves_q8(
+        bt, wchd, chd, num_bins=16, pipeline="blockspec"))
+    # reference check: histogram of channel 0 == per-leaf bincount
+    want0 = np.zeros((16,), np.int64)
+    sel = (ch == 0) & act
+    for j in np.nonzero(sel)[0]:
+        want0[bins[j, 0]] += int(wch[0, j])
+    np.testing.assert_array_equal(base[0, 0, :, 0], want0)
+    for kw in [dict(pipeline="dma"), dict(bins_packed=True)]:
+        src = pack_bins4(bt) if kw.get("bins_packed") else bt
+        got = np.asarray(build_histogram_pallas_leaves_q8(
+            src, wchd, chd, num_bins=16, **kw))
+        np.testing.assert_array_equal(got, base, err_msg=str(kw))
+
+
+def test_leaves_kernels_bad_rows_raise():
+    bins, grad, hess, mask = _data(B=16)
+    bt = jnp.asarray(bins.T.copy())
+    w8 = pack_weights8(*map(jnp.asarray, (grad, hess, mask)))
+    ch = jnp.zeros((N,), jnp.int32)
+    with pytest.raises(ValueError, match="pad_rows"):
+        build_histogram_pallas_leaves(bt[:, :-8], w8[:, :-8], ch[:-8],
+                                      num_bins=16)
+    with pytest.raises(ValueError, match="wch"):
+        build_histogram_pallas_leaves_q8(
+            bt, jnp.zeros((8, N // 2), jnp.int8), ch.astype(jnp.int8),
+            num_bins=16)
+
+
+# -- row-update / trial-channel kernel ---------------------------------------
+
+def test_row_update_dma_bitwise_and_trial():
+    bins, *_ = _data(B=16, f=6)
+    rng = np.random.RandomState(3)
+    W = 4
+    cols_w = jnp.asarray(bins.T[:W].copy())
+    rl = jnp.asarray(rng.randint(0, 3, N).astype(np.int32))
+    tab = jnp.asarray(np.stack([
+        rng.randint(0, 16, W), np.full(W, -1), rng.randint(0, 2, W),
+        rng.randint(0, 2, W), rng.randint(0, 3, W), np.arange(3, 3 + W),
+        np.ones(W, int), np.zeros(W, int)]).astype(np.int32))
+    rb, cb = wave_row_update_pallas(cols_w, rl, tab, pipeline="blockspec")
+    rd, cd = wave_row_update_pallas(cols_w, rl, tab, pipeline="dma")
+    np.testing.assert_array_equal(np.asarray(rb), np.asarray(rd))
+    np.testing.assert_array_equal(np.asarray(cb), np.asarray(cd))
+    # trial form commits nothing
+    sel_leaves = tab[4]
+    ch = wave_trial_channels_pallas(
+        cols_w, rl, sel_leaves, tab[0], tab[1], tab[2] > 0, tab[3],
+        tab[6] > 0, pipeline="dma")
+    assert ch.shape == (N,)
+
+
+# -- vmap-to-grid batching rule (the multitrain unlock) ----------------------
+
+def test_vmap_batching_bitwise():
+    """jax's pallas_call batching rule lowers the model axis to a
+    leading grid dimension; per-lane results must be bit-identical to
+    the unbatched calls for BOTH pipelines (lifts the multitrain
+    segment|onehot gate, ROADMAP item 4)."""
+    bins, _, _, mask = _data(B=16, f=6)
+    rng = np.random.RandomState(4)
+    M = 2
+    wch = np.zeros((M, 8, N), np.int8)
+    for k in range(M):
+        wch[k, 0] = rng.randint(-50, 50, N)
+        wch[k, 1] = rng.randint(0, 50, N)
+        wch[k, 2] = 1
+    ch = jnp.asarray(rng.randint(-1, Q_LEAF_CHANNELS, N).astype(np.int8))
+    bt = jnp.asarray(bins.T.copy())
+    wchb = jnp.asarray(wch)
+    for pipe in ("blockspec", "dma"):
+        def one(w_, pipe=pipe):
+            return build_histogram_pallas_leaves_q8(bt, w_, ch,
+                                                    num_bins=16,
+                                                    pipeline=pipe)
+        got = np.asarray(jax.jit(jax.vmap(one))(wchb))
+        want = np.stack([np.asarray(one(wchb[k])) for k in range(M)])
+        np.testing.assert_array_equal(got, want, err_msg=pipe)
+
+
+# -- packed4 XLA scatter impl ------------------------------------------------
+
+@pytest.mark.parametrize("f", [4, 5])
+def test_packed4_xla_impl(f):
+    bins, grad, hess, mask = _data(f=f, B=13)
+    args = (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(mask))
+    ref = np.asarray(build_histogram(*args, num_bins=13, impl="segment"))
+    got = np.asarray(build_histogram(*args, num_bins=13, impl="packed4"))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError, match="packed4"):
+        build_histogram(*args, num_bins=64, impl="packed4")
+
+
+# -- autotune: variant candidates + on-disk winner cache ---------------------
+
+def test_autotune_disk_cache(tmp_path, monkeypatch):
+    cache = tmp_path / "hist_autotune.json"
+    monkeypatch.setenv("LGBM_TPU_AUTOTUNE_CACHE", str(cache))
+    from lightgbm_tpu.learner import autotune
+    X = np.random.RandomState(0).randint(0, 13, (N, 4)).astype(np.uint8)
+    win = autotune.pick_hist_impl(X, 13, candidates=("segment", "packed4"),
+                                  reps=2)
+    assert win in ("segment", "packed4")
+    assert cache.exists()
+    # a fresh process (simulated: cleared in-memory caches) skips the
+    # re-measurement pass and returns the persisted winner
+    autotune._CACHE.clear()
+    autotune._DISK_LOADED.clear()
+    assert autotune.pick_hist_impl(
+        X, 13, candidates=("segment", "packed4"), reps=2) == win
+
+
+def test_autotune_default_candidates():
+    from lightgbm_tpu.learner.autotune import default_candidates
+    assert default_candidates("tpu", 255) == ("pallas", "pallas:blockspec",
+                                              "onehot")
+    assert "pallas:packed4" in default_candidates("tpu", 16)
+    assert default_candidates("cpu", 16) == ("segment", "packed4")
+    assert default_candidates("cpu", 255) == ("segment",)
+
+
+def test_autotune_apply_winner():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.learner.autotune import apply_winner
+    cfg = Config({})
+    apply_winner(cfg, "pallas:blockspec")
+    assert cfg.tpu_histogram_impl == "pallas"
+    assert cfg.tpu_pallas_pipeline == "blockspec"
+    assert cfg.tpu_hist_pack4 is False  # blockspec beat the packed DMA form
+    # a PLAIN pallas winner beat the packed candidate: pack4 must clear,
+    # else training would run the variant the probe just rejected
+    apply_winner(cfg, "pallas")
+    assert cfg.tpu_hist_pack4 is False
+    assert cfg.tpu_pallas_pipeline == "dma"
+    apply_winner(cfg, "pallas:packed4")
+    assert cfg.tpu_hist_pack4 is True
+    apply_winner(cfg, "segment")
+    assert cfg.tpu_histogram_impl == "segment"
+
+
+def test_pipeline_blockspec_disables_pack4():
+    """Explicit tpu_pallas_pipeline=blockspec is the measured-dead-ends
+    A/B knob: it must actually run the v1 layout, so pack4 (a DMA-only
+    layout) turns off instead of silently forcing the pipeline back."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.learner.serial import SerialTreeLearner
+    nb = np.full(4, 15, np.int32)
+    flags = np.zeros(4, bool)
+    mk = lambda pipe: SerialTreeLearner(
+        Config({"num_leaves": 7, "tree_grow_mode": "wave",
+                "tpu_histogram_impl": "pallas", "max_bin": 15,
+                "tpu_pallas_pipeline": pipe, "verbosity": -1}),
+        4, 15, nb, flags, flags)
+    assert mk("auto").pack4 is True
+    assert mk("dma").pack4 is True
+    assert mk("blockspec").pack4 is False
+
+
+# -- telemetry: the hist_kernel site -----------------------------------------
+
+def test_hist_kernel_telemetry_site():
+    from lightgbm_tpu.telemetry.train_record import (TrainRecord,
+                                                     hist_kernel_snapshot)
+    bins, grad, hess, mask = _data(B=16)
+    rec = TrainRecord()
+    build_histogram_pallas(jnp.asarray(bins.T.copy()), jnp.asarray(grad),
+                           jnp.asarray(hess), jnp.asarray(mask),
+                           num_bins=16, pipeline="dma")
+    snap = rec.snapshot()
+    sites = snap["hist_kernel"]
+    assert any(k.startswith("ops/hist_kernel/single/dma") for k in sites)
+    site = next(k for k in sites if k.startswith("ops/hist_kernel/single"))
+    assert sites[site]["count"] >= 1
+    assert sites[site]["bytes"] >= N * F  # at least the bin bytes
+    assert hist_kernel_snapshot()  # process-wide tally holds it too
+
+
+# -- Dataset 4-bit packed storage --------------------------------------------
+
+def test_dataset_device_bins_packed4():
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4)
+    ds = lgb.Dataset(X, rng.rand(500), params={"max_bin": 15,
+                                               "verbosity": -1})
+    ds.construct(None)
+    pk = ds.device_bins_packed4()
+    n_pad = pad_rows(500)
+    assert pk.shape == (ds.num_feature(), n_pad // 2)
+    assert pk.dtype == jnp.uint8
+    got = np.asarray(unpack_bins4(pk))[:, :500]
+    np.testing.assert_array_equal(got, ds.X_binned.T)
+    assert ds.device_bins_packed4() is pk  # cached
+    ds255 = lgb.Dataset(X, rng.rand(500), params={"verbosity": -1})
+    ds255.construct(None)
+    if int(np.max(ds255.num_bins_per_feature)) > 16:
+        with pytest.raises(ValueError, match="max_bin"):
+            ds255.device_bins_packed4()

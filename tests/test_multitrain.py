@@ -73,6 +73,25 @@ def test_bit_identity_sweep(mode_params):
                           X, y, 5)
 
 
+def test_bit_identity_pallas_wave():
+    """ISSUE 8: the vmap gate is lifted — batched training rides the
+    Pallas histogram kernels (interpret-mode off TPU) through jax's
+    pallas_call batching rule, bit-identical per model to a standalone
+    pallas train().  Small geometry: the interpret kernels are a
+    correctness proxy, not a speed path, on this env."""
+    X, y = _data()
+    params = {**BASE, "num_leaves": 7, "tree_grow_mode": "wave",
+              "tpu_wave_size": 2, "tpu_histogram_impl": "pallas",
+              "tpu_speculative_ramp": False}
+    variants = [{"lambda_l2": 0.0}, {"lambda_l2": 2.0}]
+    mb = train_many(params, lgb.Dataset(X, y), num_boost_round=2,
+                    variants=variants)
+    assert mb.fallback_indices == []
+    base = {k: v for k, v in params.items() if k not in BASE or k in
+            ("num_leaves",)}
+    _assert_bit_identical(mb, [{**base, **v} for v in variants], X, y, 2)
+
+
 def test_bit_identity_bagging_and_feature_fraction():
     """The per-model RNG satellite: the batch's host-side bagging and
     feature_fraction draws must be the standalone draws, per model."""

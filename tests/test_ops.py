@@ -184,15 +184,18 @@ def test_leaf_output():
     np.testing.assert_allclose(float(out2), -0.5)  # clipped
 
 
-def test_hist_impl_autotune_times_both():
+def test_hist_impl_autotune_times_both(monkeypatch):
     """ShareStates-style one-shot timing on real shapes
     (learner/autotune.py; dataset.cpp:659-670 analog)."""
     import numpy as np
+    monkeypatch.setenv("LGBM_TPU_AUTOTUNE_CACHE", "")  # no disk writes
     from lightgbm_tpu.learner.autotune import _CACHE, pick_hist_impl
+    from lightgbm_tpu.utils.backend import default_backend
     rng = np.random.RandomState(0)
     X = rng.randint(0, 63, (2000, 5)).astype(np.uint8)
     win = pick_hist_impl(X, 63, candidates=("onehot", "segment"))
     assert win in ("onehot", "segment")
-    assert (2000, 5, 63) in _CACHE
+    assert (default_backend(), 2000, 5, 63,
+            ("onehot", "segment")) in _CACHE
     # cached second call returns instantly with the same answer
     assert pick_hist_impl(X, 63, candidates=("onehot", "segment")) == win
