@@ -27,7 +27,7 @@ if "cpu" in _os.environ.get("JAX_PLATFORMS", ""):
         pass  # a backend already initialized; too late to switch
 
 
-from . import distributed, resilience, telemetry
+from . import analysis, distributed, resilience, telemetry
 from .basic import Booster
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        print_evaluation, record_evaluation, reset_parameter)
@@ -56,7 +56,8 @@ __all__ = ["Dataset", "Booster", "Config", "train", "cv", "CVBooster",
            "train_many", "ManyBooster", "MultiTrainError",
            "early_stopping", "print_evaluation", "log_evaluation",
            "record_evaluation", "reset_parameter", "EarlyStopException",
-           "register_log_callback", "set_verbosity", "distributed",
+           "register_log_callback", "set_verbosity", "analysis",
+           "distributed",
            "telemetry", "resilience", "Checkpoint", "CheckpointError",
            "TrainingPreempted", "load_checkpoint", "ModelCorruptError",
            "plot_importance", "plot_metric", "plot_tree",

@@ -1,9 +1,13 @@
 """``python -m lightgbm_tpu`` — the CLI entry point (reference
 src/main.cpp:11).  Tasks: train / predict / refit / convert_model via
 ``key=value`` args, plus the serving verb
-``python -m lightgbm_tpu serve model.txt [port=8080 ...]`` and the
+``python -m lightgbm_tpu serve model.txt [port=8080 ...]``, the
 profiling verb ``python -m lightgbm_tpu profile config=train.conf``
-(jax.profiler capture + telemetry dump)."""
+(jax.profiler capture + telemetry dump) and the trace-lint verb
+``python -m lightgbm_tpu lint-trace [configs=...] [out=report.json]``
+(static analysis of the traced program matrix against the declared
+collective/dtype/retrace/donation contracts; exits nonzero on any
+violation)."""
 
 import sys
 

@@ -1,0 +1,45 @@
+"""Static analysis of traced programs: the trace-lint subsystem.
+
+The distributed-performance story of this repo is a set of
+*traced-program shape* contracts — exactly one reduce_scatter per
+histogram-merge site, zero full-histogram psums on the sliced path,
+ceil(log2 W) spec-ramp collectives, no host syncs or silent f64 in hot
+programs, no giant constant-folded operands, zero retraces across
+boosting iterations and serve buckets, donated score buffers that
+really alias.  This package states those contracts once and machine
+checks them:
+
+* :mod:`.ir` — the recursive jaxpr walker every check rides
+  (supersedes the three test-local walkers of PRs 4-5);
+* :mod:`.contracts` — contract declarations living NEXT TO the code
+  they constrain, keyed by telemetry ``note_collective`` site names;
+* :mod:`.rules` — the rule engine (six checks);
+* :mod:`.lint` — the ``python -m lightgbm_tpu lint-trace`` matrix
+  driver (serial / wave / DP-scatter / spec-ramp / multitrain / serve),
+  a blocking CI step.
+"""
+
+from . import contracts, ir, lint, rules
+from .contracts import (CollectiveContract, DonationContract,
+                        all_contracts, collective_contract,
+                        contract_for, donation_contract)
+from .ir import (collect_collectives, collectives_of, count_primitive,
+                 is_collective, iter_consts, iter_eqns, stable_hash,
+                 subjaxprs, trace, walk_eqns)
+from .lint import MATRIX_CONFIGS, build_unit, run_lint
+from .rules import (DEFAULT_RULES, CollectiveBudgetRule, ConstantFoldRule,
+                    DonationRule, DtypeRule, HostSyncRule, RetraceRule,
+                    Rule, TraceUnit, Violation, run_rules)
+
+__all__ = [
+    "ir", "contracts", "rules", "lint",
+    "collect_collectives", "collectives_of", "count_primitive",
+    "is_collective", "iter_consts", "iter_eqns", "stable_hash",
+    "subjaxprs", "trace", "walk_eqns",
+    "CollectiveContract", "DonationContract", "all_contracts",
+    "collective_contract", "contract_for", "donation_contract",
+    "MATRIX_CONFIGS", "build_unit", "run_lint",
+    "DEFAULT_RULES", "CollectiveBudgetRule", "ConstantFoldRule",
+    "DonationRule", "DtypeRule", "HostSyncRule", "RetraceRule",
+    "Rule", "TraceUnit", "Violation", "run_rules",
+]

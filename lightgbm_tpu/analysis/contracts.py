@@ -1,0 +1,151 @@
+"""Program contracts: declared budgets the trace lint enforces.
+
+A *collective contract* is declared NEXT TO the code it constrains
+(``learner/wave.py`` declares the wave merge-site budget,
+``parallel/*.py`` declare their exchange/broadcast payloads) and keyed
+by the same site name the code passes to
+``telemetry.train_record.note_collective`` — so the contract, the
+telemetry tally and the collective call site are one named thing and
+cannot drift apart: the lint cross-checks (a) every tallied site has a
+declared contract, (b) tallied counts/bytes stay under the declared
+ceilings, and (c) the traced program's total collective op count equals
+the tally (an untallied collective in the jaxpr is itself a violation).
+
+This is the PV-Tree communication-budget analysis (arXiv:1611.01276) as
+a machine-checked invariant: the per-pass collective byte budget the
+papers argue with, stated once in code and validated on every PR.
+
+Ceilings may be ints or callables of a ``ctx`` dict (wave_size,
+nshards, features, bins, leaves, spec_ramp, itemsize ...) so one
+declaration covers every config the lint matrix traces.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+__all__ = ["CollectiveContract", "collective_contract", "contract_for",
+           "all_contracts", "resolve_limit", "DonationContract",
+           "donation_contract", "all_donation_contracts"]
+
+Limit = Union[int, Callable[[Dict[str, Any]], int], None]
+
+
+def resolve_limit(limit: Limit, ctx: Dict[str, Any]) -> Optional[int]:
+    """An int ceiling, a callable of the lint ctx, or None (unbounded)."""
+    if limit is None:
+        return None
+    if callable(limit):
+        return int(limit(ctx))
+    return int(limit)
+
+
+@dataclass(frozen=True)
+class CollectiveContract:
+    """Per-site ceiling on collective count and per-op payload bytes.
+
+    ``site`` is the ``note_collective`` site name; ``ops`` the collective
+    kinds the site may tally (a site like the wave winner exchange
+    legitimately mixes pmax/pmin/psum).  ``max_count`` bounds tallied
+    calls per traced program, ``max_bytes_per_op`` the mean per-op
+    payload."""
+
+    site: str
+    ops: Tuple[str, ...]
+    max_count: Limit = None
+    max_bytes_per_op: Limit = None
+    declared_in: str = ""
+    note: str = ""
+
+
+_lock = threading.Lock()
+_registry: Dict[str, CollectiveContract] = {}
+
+
+def collective_contract(site: str, ops, *, max_count: Limit = None,
+                        max_bytes_per_op: Limit = None,
+                        note: str = "") -> CollectiveContract:
+    """Declare (or redeclare) the contract for one collective site.
+
+    Call at module scope next to the ``note_collective`` site it
+    constrains; ``declared_in`` records that module for diagnostics."""
+    import inspect
+    frame = inspect.currentframe()
+    declared_in = ""
+    if frame is not None and frame.f_back is not None:
+        declared_in = frame.f_back.f_globals.get("__name__", "")
+    if isinstance(ops, str):
+        ops = (ops,)
+    c = CollectiveContract(site=site, ops=tuple(ops), max_count=max_count,
+                           max_bytes_per_op=max_bytes_per_op,
+                           declared_in=declared_in, note=note)
+    with _lock:
+        _registry[site] = c
+    return c
+
+
+def contract_for(site: str) -> Optional[CollectiveContract]:
+    with _lock:
+        return _registry.get(site)
+
+
+def all_contracts() -> Dict[str, CollectiveContract]:
+    with _lock:
+        return dict(_registry)
+
+
+def remove_collective_contract(site: str) -> None:
+    """Unregister (tests planting temporary contracts clean up here)."""
+    with _lock:
+        _registry.pop(site, None)
+
+
+# ---------------------------------------------------------------------------
+# Donation contracts: jitted entries whose big buffers must alias
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class DonationContract:
+    """A jitted entry point that declares buffer donation.
+
+    The lint verifies the declaration can actually alias: every donated
+    argument's abstract value must match an output's shape+dtype, else
+    XLA silently keeps both buffers live (the score-update class of bug:
+    a dtype drift turns an in-place 4 MB update into an 8 MB copy).
+    ``build_args`` makes small representative arguments for lowering."""
+
+    name: str
+    fn_ref: Callable[[], Any]          # lazy: returns the jitted fn
+    donate_argnums: Tuple[int, ...]
+    build_args: Callable[[], tuple] = field(repr=False, default=tuple)
+    declared_in: str = ""
+
+
+_donations: Dict[str, DonationContract] = {}
+
+
+def donation_contract(name: str, fn_ref: Callable[[], Any],
+                      donate_argnums, build_args) -> DonationContract:
+    import inspect
+    frame = inspect.currentframe()
+    declared_in = ""
+    if frame is not None and frame.f_back is not None:
+        declared_in = frame.f_back.f_globals.get("__name__", "")
+    c = DonationContract(name=name, fn_ref=fn_ref,
+                         donate_argnums=tuple(donate_argnums),
+                         build_args=build_args, declared_in=declared_in)
+    with _lock:
+        _donations[name] = c
+    return c
+
+
+def all_donation_contracts() -> Dict[str, DonationContract]:
+    with _lock:
+        return dict(_donations)
+
+
+def remove_donation_contract(name: str) -> None:
+    with _lock:
+        _donations.pop(name, None)

@@ -1,0 +1,377 @@
+"""``lint-trace``: trace the config matrix, enforce program contracts.
+
+Drives :mod:`.ir` + :mod:`.rules` over one traced (never executed)
+program per supported training/serving shape:
+
+* ``serial``     — the sequential wave grower (no mesh, no collectives);
+* ``wave``       — the wave grower, Pallas kernels (interpret off-TPU);
+* ``dp_scatter`` — 8-shard DP wave, feature-sliced reduce-scatter merge;
+* ``spec_ramp``  — DP wave + speculative ramp (the ceil(log2 W) budget);
+* ``multitrain`` — the vmapped model axis over the wave grower;
+* ``serve``      — the ensemble predictor across the SHAPE_BUCKETS
+  ladder (one program per bucket, hash-stable on re-trace).
+
+Every config is traced TWICE with freshly built same-shape inputs so
+the retrace rule sees real hash probes, and the telemetry collective
+tally is snapshotted around each trace so the collective-budget rule
+can cross-check contracts against both the tally and the jaxpr.
+
+The report is JSON (``trace-lint-v1``) and the CLI exits 1 when any
+violation is found (0 when clean) — CI runs this as a blocking step.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import ir
+from .contracts import all_donation_contracts
+from .rules import DEFAULT_RULES, TraceUnit, Violation, run_rules
+
+__all__ = ["MATRIX_CONFIGS", "build_unit", "run_lint", "main"]
+
+MATRIX_CONFIGS = ("serial", "wave", "dp_scatter", "spec_ramp",
+                  "multitrain", "serve")
+
+# shared small-but-representative shapes (the test-suite geometry: the
+# endgame engages at 13 leaves / wave 4, scatter pads 6 features to 8
+# blocks at k=8)
+_F, _B, _LEAVES, _WAVE = 6, 64, 13, 4
+
+
+def _backend_initialized() -> bool:
+    """True once a jax client exists (then the device count is fixed).
+    Must NOT itself initialize the backend — jax.devices() would."""
+    try:
+        from jax._src import xla_bridge
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return False
+
+
+def _ensure_devices(k: int) -> int:
+    """Best-effort k virtual CPU devices.  Device count can only be set
+    before the first jax client exists; afterwards fall back to
+    whatever is visible (a short mesh still traces every contract, just
+    at a smaller k)."""
+    import os
+
+    import jax
+    if not _backend_initialized():
+        try:
+            jax.config.update("jax_num_cpu_devices", k)
+        except (AttributeError, RuntimeError):
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count={k}"
+                ).strip()
+    try:
+        return min(k, len(jax.devices()))
+    except Exception:
+        return 1
+
+
+def _mk_train_args(seed: int, n: int, quantized: bool = False):
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, _B - 1, (_F, n)).astype(np.uint8)
+    logit = (bins[0].astype(np.float32) / _B - 0.5) * 3
+    y = (logit + rng.randn(n) * 0.7 > 0).astype(np.float32)
+    grad = (0.5 - y).astype(np.float32)
+    hess = np.full(n, 0.25, np.float32)
+    mask = np.ones(n, np.float32)
+    meta = (jnp.full((_F,), _B, jnp.int32), jnp.zeros((_F,), bool),
+            jnp.zeros((_F,), bool), jnp.zeros((_F,), jnp.int32),
+            jnp.zeros((_F,), jnp.float32), jnp.ones((_F,), bool))
+    return (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(mask)) + meta
+
+
+def _mk_wave_grow(strategy, *, quantized: bool, spec: bool):
+    from ..learner.wave import make_wave_grow_fn
+    from ..ops.split import SplitParams
+    sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=0.0,
+                     any_cat=False)
+    return make_wave_grow_fn(
+        num_leaves=_LEAVES, num_features=_F, max_bins=_B, max_depth=0,
+        split_params=sp, hist_impl="pallas", any_cat=False, interpret=None,
+        jit=False, wave_size=_WAVE, quantized=quantized, stochastic=False,
+        spec_ramp=spec, spec_tol=0.02, strategy=strategy)
+
+
+def _serial_entry(grow):
+    def entry(bins, grad, hess, mask, nb, ic, hn, mono, cp, fm):
+        return grow(bins, grad, hess, mask, nb, ic, hn, mono, cp, (), fm)
+    return entry
+
+
+def _dp_entry(grow, mesh, ax):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.data_parallel import DataParallelTreeLearner
+    from ..parallel.mesh import shard_map_compat
+    return jax.jit(shard_map_compat(
+        _serial_entry(grow), mesh=mesh,
+        in_specs=(P(None, ax), P(ax), P(ax), P(ax), P(), P(), P(), P(),
+                  P(), P()),
+        out_specs=DataParallelTreeLearner._tree_specs(ax)))
+
+
+def _trace_with_tally(fn, args) -> Tuple[Any, Dict[str, Dict[str, Any]]]:
+    """make_jaxpr plus the telemetry collective delta the trace fired."""
+    from ..telemetry.train_record import collectives_snapshot
+    before = collectives_snapshot()
+    jaxpr = ir.trace(lambda *a: fn(*a), *args)
+    after = collectives_snapshot()
+    delta: Dict[str, Dict[str, Any]] = {}
+    for site, rec in after.items():
+        base = before.get(site, {"count": 0, "bytes": 0})
+        dc = rec["count"] - base["count"]
+        if dc > 0:
+            delta[site] = {"op": rec["op"], "count": dc,
+                           "bytes": rec["bytes"] - base["bytes"]}
+    return jaxpr, delta
+
+
+def _base_ctx(**kw) -> Dict[str, Any]:
+    ctx: Dict[str, Any] = {
+        "wave_size": _WAVE, "features": _F, "bins": _B, "leaves": _LEAVES,
+        "itemsize": 4, "nshards": 1, "quantized": False,
+        "spec_ramp": False}
+    from ..telemetry import _config as tele_config
+    if not tele_config.enabled():
+        # no tallies to cross-check against the program (the jaxpr-side
+        # rules still run at full strength)
+        ctx["crosscheck_tally"] = False
+    ctx.update(kw)
+    return ctx
+
+
+def _unit_from_traces(name: str, build: Callable[[int], Tuple[Any, tuple]],
+                      ctx: Dict[str, Any]) -> TraceUnit:
+    """Trace a config twice (fresh same-shape args) for the retrace
+    probe; rules run on the first trace's jaxpr + tally."""
+    fn0, args0 = build(0)
+    jaxpr0, tally = _trace_with_tally(fn0, args0)
+    h0 = ir.stable_hash(jaxpr0)
+    fn1, args1 = build(1)
+    jaxpr1, _ = _trace_with_tally(fn1, args1)
+    h1 = ir.stable_hash(jaxpr1)
+    return TraceUnit(name=name, jaxpr=jaxpr0, ctx=ctx,
+                     collectives=tally,
+                     hashes=[("iteration", h0), ("iteration", h1)])
+
+
+def _build_serial(i: int):
+    from ..ops.histogram_pallas import pad_rows
+    grow = _mk_wave_grow(None, quantized=False, spec=False)
+    return _serial_entry(grow), _mk_train_args(i, pad_rows(4000))
+
+
+def _build_wave(i: int):
+    from ..ops.histogram_pallas import pad_rows
+    grow = _mk_wave_grow(None, quantized=True, spec=False)
+    return _serial_entry(grow), _mk_train_args(i, pad_rows(4000), True)
+
+
+def _dp_builder(k: int, spec: bool):
+    from ..parallel.data_parallel import WaveDPStrategy
+    from ..parallel.mesh import get_mesh
+    mesh = get_mesh(k)
+    ax = mesh.axis_names[0]
+
+    def build(i: int):
+        grow = _mk_wave_grow(
+            WaveDPStrategy(ax, nshards=k, hist_scatter=True),
+            quantized=True, spec=spec)
+        return _dp_entry(grow, mesh, ax), _mk_train_args(i, k * 4096, True)
+
+    return build
+
+
+def _build_multitrain(i: int):
+    import jax
+    from ..ops.histogram_pallas import pad_rows
+    grow = _mk_wave_grow(None, quantized=False, spec=False)
+    entry = _serial_entry(grow)
+    # the model axis: per-lane grad/hess/mask over shared bins (the
+    # multitrain/batched.py vm_grow shape, M=3 lanes)
+    vm = jax.vmap(entry,
+                  in_axes=(None, 0, 0, 0) + (None,) * 6)
+    args = _mk_train_args(i, pad_rows(4000))
+    import jax.numpy as jnp
+    stack = lambda a: jnp.stack([a, a * 0.5, a * 0.25])
+    vm_args = (args[0], stack(args[1]), stack(args[2]),
+               jnp.stack([args[3]] * 3)) + args[4:]
+    return vm, vm_args
+
+
+def _mk_serve_ensemble():
+    """A tiny hand-built 2-leaf/3-tree dense ensemble — the serving
+    shape class, no training run needed."""
+    import numpy as np
+    from ..models.tree import Tree, TreeBatch, ensemble_serve_fields
+    trees = []
+    for t in range(3):
+        trees.append(Tree(
+            num_leaves=2,
+            split_feature=np.array([t % _F], np.int32),
+            threshold_bin=np.array([1], np.int32),
+            nan_bin=np.array([-1], np.int32),
+            threshold=np.array([0.5 + t], np.float64),
+            decision_type=np.array([0], np.uint8),
+            left_child=np.array([-1], np.int32),
+            right_child=np.array([-2], np.int32),
+            split_gain=np.array([1.0], np.float32),
+            internal_value=np.array([0.0], np.float64),
+            internal_weight=np.array([1.0], np.float64),
+            internal_count=np.array([2], np.int64),
+            leaf_value=np.array([0.1 * (t + 1), -0.1], np.float64),
+            leaf_weight=np.array([1.0, 1.0], np.float64),
+            leaf_count=np.array([1, 1], np.int64)))
+    kind, fields, lin = ensemble_serve_fields(TreeBatch(trees))
+    return ((fields, lin),), (kind,)
+
+
+def _build_serve_unit(ctx: Dict[str, Any]) -> TraceUnit:
+    import numpy as np
+    from ..models.tree import SHAPE_BUCKETS, predict_raw_ensemble
+    per_class, kinds = _mk_serve_ensemble()
+    hashes: List[Tuple[str, str]] = []
+    jaxpr0 = None
+    tally: Dict[str, Dict[str, Any]] = {}
+    for bucket in SHAPE_BUCKETS:
+        for rep in range(2):
+            X = np.zeros((bucket, _F), np.float32) + rep
+            fn = lambda Xa, pc: predict_raw_ensemble(Xa, pc, kinds)
+            jx, t = _trace_with_tally(fn, (X, per_class))
+            hashes.append((f"bucket{bucket}", ir.stable_hash(jx)))
+            if jaxpr0 is None:
+                jaxpr0, tally = jx, t
+    ctx = dict(ctx)
+    # one compiled program per ladder rung and not one more
+    ctx["max_distinct_programs"] = len(SHAPE_BUCKETS)
+    return TraceUnit(name="serve", jaxpr=jaxpr0, ctx=ctx,
+                     collectives=tally, hashes=hashes)
+
+
+def build_unit(name: str, nshards: int = 8) -> TraceUnit:
+    """Trace one matrix config into a rule-ready :class:`TraceUnit`."""
+    if name == "serial":
+        return _unit_from_traces("serial", _build_serial, _base_ctx())
+    if name == "wave":
+        return _unit_from_traces("wave", _build_wave,
+                                 _base_ctx(quantized=True))
+    if name == "dp_scatter":
+        k = _ensure_devices(nshards)
+        return _unit_from_traces(
+            "dp_scatter", _dp_builder(k, spec=False),
+            _base_ctx(nshards=k, quantized=True))
+    if name == "spec_ramp":
+        k = _ensure_devices(nshards)
+        return _unit_from_traces(
+            "spec_ramp", _dp_builder(k, spec=True),
+            _base_ctx(nshards=k, quantized=True, spec_ramp=True))
+    if name == "multitrain":
+        return _unit_from_traces("multitrain", _build_multitrain,
+                                 _base_ctx(models=3))
+    if name == "serve":
+        return _build_serve_unit(_base_ctx())
+    raise ValueError(f"unknown lint config '{name}' "
+                     f"(matrix: {', '.join(MATRIX_CONFIGS)})")
+
+
+def _donation_unit() -> TraceUnit:
+    """The declared-donation entries (score buffers), checked once."""
+    # importing gbdt registers its donation contracts
+    from ..models import gbdt  # noqa: F401
+    return TraceUnit(name="score_update",
+                     ctx={"donation_contracts":
+                          tuple(all_donation_contracts().values()),
+                          "crosscheck_tally": False})
+
+
+def run_lint(configs: Optional[Sequence[str]] = None,
+             nshards: int = 8) -> Dict[str, Any]:
+    """Trace the matrix, run every rule, return the JSON-ready report."""
+    configs = tuple(configs) if configs else MATRIX_CONFIGS
+    units: List[TraceUnit] = []
+    report_cfgs: Dict[str, Any] = {}
+    for name in configs:
+        t0 = time.perf_counter()
+        unit = build_unit(name, nshards=nshards)
+        units.append(unit)
+        coll = {site: dict(rec) for site, rec in
+                sorted(unit.collectives.items())}
+        report_cfgs[name] = {
+            "jaxpr_hash": ir.stable_hash(unit.jaxpr)
+            if unit.jaxpr is not None else None,
+            "eqns": sum(1 for _ in ir.iter_eqns(unit.jaxpr))
+            if unit.jaxpr is not None else 0,
+            "collectives": coll,
+            "trace_seconds": round(time.perf_counter() - t0, 3),
+        }
+    units.append(_donation_unit())
+    violations = run_rules(units)
+    by_cfg: Dict[str, List[Violation]] = {}
+    for v in violations:
+        by_cfg.setdefault(v.config, []).append(v)
+    for name, entry in report_cfgs.items():
+        entry["ok"] = name not in by_cfg
+        entry["violations"] = [v.to_json() for v in by_cfg.get(name, [])]
+    report_cfgs["score_update"] = {
+        "ok": "score_update" not in by_cfg,
+        "violations": [v.to_json() for v in by_cfg.get("score_update", [])],
+    }
+    from .contracts import all_contracts
+    return {
+        "schema": "trace-lint-v1",
+        "ok": not violations,
+        "num_violations": len(violations),
+        "rules": [r.name for r in DEFAULT_RULES],
+        "contracts": {site: {"ops": list(c.ops),
+                             "declared_in": c.declared_in}
+                      for site, c in sorted(all_contracts().items())},
+        "configs": report_cfgs,
+    }
+
+
+def main(argv: Sequence[str]) -> int:
+    """``python -m lightgbm_tpu lint-trace [configs=a,b] [out=report.json]
+    [devices=8]`` — trace the matrix, print the JSON contract report,
+    exit nonzero on any violation."""
+    import json
+
+    configs: Optional[List[str]] = None
+    out_path = ""
+    nshards = 8
+    for arg in argv:
+        if arg.startswith("--"):
+            arg = arg[2:]
+        if "=" not in arg:
+            continue
+        key, value = arg.split("=", 1)
+        key = key.strip()
+        if key in ("configs", "config"):
+            configs = [c.strip() for c in value.split(",") if c.strip()]
+        elif key in ("out", "json", "json_out"):
+            out_path = value.strip()
+        elif key in ("devices", "nshards"):
+            nshards = int(value)
+    t0 = time.perf_counter()
+    _ensure_devices(nshards)
+    report = run_lint(configs, nshards=nshards)
+    report["elapsed_seconds"] = round(time.perf_counter() - t0, 3)
+    text = json.dumps(report, indent=2, sort_keys=False)
+    print(text)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(text + "\n")
+    if not report["ok"]:
+        from ..utils.log import log_warning
+        log_warning(f"lint-trace: {report['num_violations']} contract "
+                    f"violation(s)")
+    return 0 if report["ok"] else 1

@@ -8,17 +8,14 @@ boosting driver is in-process).
 
 from __future__ import annotations
 
-import copy
-from typing import Any, Dict, List, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .config import Config
 from .dataset import Dataset
 from .models.boosting import create_boosting
-from .models.gbdt import GBDT
-from .objective import create_objective
-from .utils.log import log_info, log_warning
+from .utils.log import log_warning
 
 __all__ = ["Booster"]
 

@@ -289,6 +289,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     if argv and argv[0] == "profile":
         # profiling verb: python -m lightgbm_tpu profile config=train.conf
         return run_profile(argv[1:])
+    if argv and argv[0] in ("lint-trace", "lint_trace"):
+        # static-analysis verb: trace the config matrix (serial / wave /
+        # DP-scatter / spec-ramp / multitrain / serve), enforce the
+        # declared program contracts, print the JSON report, exit
+        # nonzero on violations (the blocking CI step)
+        from .analysis.lint import main as lint_main
+        return lint_main(argv[1:])
     params = parse_cli_args(argv)
     cfg = Config(params)
     task = cfg.task

@@ -27,7 +27,7 @@ import numpy as np
 
 from .binning import BinMapper, bin_matrix, find_bin
 from .config import Config
-from .utils.log import log_info, log_warning
+from .utils.log import log_info
 
 __all__ = ["Dataset", "Metadata"]
 

@@ -24,11 +24,10 @@ elided).  Singleton bundles keep their feature's bins verbatim.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 import numpy as np
 
-from .utils.log import log_info, log_warning
 
 MAX_BUNDLE_BINS = 256    # uint8 device columns
 CONFLICT_RATE = 1e-4     # max conflicting rows per bundle, as fraction of N
@@ -61,7 +60,6 @@ def find_bundles(mappers: Sequence, nondefault: List[np.ndarray], n_rows: int,
     nondefault[f] is a bool mask over the SAMPLED rows where feature f is
     away from its default bin.  Returns bundles as lists of feature ids.
     """
-    F = len(mappers)
     max_conflict = max(0, int(conflict_rate * sample_rows))
     counts = np.array([int(m.sum()) for m in nondefault])
     order = np.argsort(-counts, kind="stable")

@@ -154,7 +154,6 @@ def pick_hist_impl(X_binned: np.ndarray, max_bins: int,
     1.3x hysteresis margin: a wrong flip away from the measured-good
     default costs 5-10x per histogram pass at wave-grower shapes, so the
     probe must beat real noise, not tie with it."""
-    import jax
     import jax.numpy as jnp
     n, f = X_binned.shape
     if candidates is None:

@@ -38,8 +38,6 @@ identical to serial.py — the two growers are cross-checked by tests.
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

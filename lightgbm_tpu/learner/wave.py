@@ -53,6 +53,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..analysis.contracts import collective_contract
 from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
 from ..ops.histogram import build_histogram_leaves, histogram_subtract
 from ..ops.quantize import dequant_scales, quantize_wch
@@ -96,6 +97,79 @@ def _unpack_bits(p: jnp.ndarray) -> jnp.ndarray:
 
 from ..ops.histogram_pallas import LEAF_CHANNELS as WAVE_SIZE  # 25/pass
 from ..ops.histogram_pallas import Q_LEAF_CHANNELS as Q_WAVE_SIZE  # 42/pass
+
+
+# ---------------------------------------------------------------------------
+# Program contracts for the DP-wave collective sites (lint-trace enforced;
+# site names match the WaveDPStrategy note_collective tallies so the
+# contract, the telemetry tally and the collective call cannot drift).
+# ---------------------------------------------------------------------------
+
+# Histogram-MERGE sites per traced wave-tree program: the root pass, the
+# wave-body pass inside the while loop (traced once), and the endgame
+# bank pass.  tests/test_wave_scatter.py asserts this exact count on the
+# scatter path.
+WAVE_MERGE_SITES = 3
+
+
+def _wave_merge_budget(ctx):
+    """Merge collectives per traced tree: the three merge sites, plus —
+    spec ramp on — ceil(log2 W) provisional-pass merges and the
+    verification mega-pass (which replaces the root pass, hence the +1
+    net; the budget tests/test_specramp.py counts on the jaxpr)."""
+    import math
+    if not ctx.get("spec_ramp"):
+        return WAVE_MERGE_SITES
+    w = max(2, int(ctx.get("wave_size", 2)))
+    return WAVE_MERGE_SITES + math.ceil(math.log2(w)) + 1
+
+
+def _hist_batch_bytes(ctx):
+    """Full merged histogram batch: (W, F, B, 3) x itemsize."""
+    return (int(ctx.get("wave_size", WAVE_SIZE)) * int(ctx["features"]) *
+            int(ctx["bins"]) * 3 * int(ctx.get("itemsize", 4)))
+
+
+def _hist_slice_bytes(ctx):
+    """Feature-sliced reduce-scatter payload: each shard RECEIVES only
+    its ceil(F/k) feature block of the merged batch — the 1/k budget
+    the round-8 optimisation claims (PERF.md)."""
+    k = max(1, int(ctx.get("nshards", 1)))
+    f_blk = -(-int(ctx["features"]) // k)
+    return (int(ctx.get("wave_size", WAVE_SIZE)) * f_blk *
+            int(ctx["bins"]) * 3 * int(ctx.get("itemsize", 4)))
+
+
+def _exchange_payload_bytes(ctx):
+    """O(W*k) winner exchange: per scan site a (W,) gain pmax, a (W,)
+    feature pmin and one (W, 8) packed payload psum — never a histogram
+    (the exchange_cap tests/test_wave_scatter.py bounds)."""
+    w = int(ctx.get("wave_size", WAVE_SIZE))
+    return 16 * max(2 * w, int(ctx.get("leaves", 2 * w))) * \
+        int(ctx.get("itemsize", 4))
+
+
+collective_contract(
+    "data_parallel/wave/hist_psum", "psum",
+    max_count=_wave_merge_budget, max_bytes_per_op=_hist_batch_bytes,
+    note="one full-batch histogram psum per merge site")
+collective_contract(
+    "data_parallel/wave/hist_reduce_scatter", "psum_scatter",
+    max_count=_wave_merge_budget, max_bytes_per_op=_hist_slice_bytes,
+    note="one reduce_scatter per merge site, 1/k received payload")
+collective_contract(
+    "data_parallel/wave/winner_exchange", ("pmax", "pmin", "psum"),
+    max_count=lambda ctx: 3 * _wave_merge_budget(ctx),
+    max_bytes_per_op=_exchange_payload_bytes,
+    note="pmax/pmin/psum triple per candidate-scan site, O(W*k) bytes")
+collective_contract(
+    "data_parallel/wave/scalar_sum", "psum",
+    max_count=8, max_bytes_per_op=_exchange_payload_bytes,
+    note="leaf totals / root sums — small vectors only")
+collective_contract(
+    "data_parallel/wave/quant_scale", "pmax",
+    max_count=2, max_bytes_per_op=8,
+    note="global gradient/hessian quantization scales (two scalars)")
 
 
 def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,

@@ -10,7 +10,7 @@ CPU outside the tree-growing loop)."""
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 

@@ -4,7 +4,7 @@ goss.hpp:25 ``GOSS``, dart.hpp ``DART``, rf.hpp:25 ``RF``)."""
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -12,9 +12,9 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
-from ..utils.log import log_info, log_warning
+from ..utils.log import log_warning
 from ..utils.random import host_rng
-from .gbdt import GBDT, _update_score_by_leaf
+from .gbdt import GBDT
 
 
 class GOSS(GBDT):

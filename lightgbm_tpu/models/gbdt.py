@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import contracts as _contracts
 from ..config import Config
 from ..dataset import Dataset
 from ..learner.serial import GrownTree, SerialTreeLearner
@@ -31,7 +32,7 @@ from ..utils.log import log_info, log_warning
 from ..utils.random import host_rng
 from ..utils.timer import FunctionTimer
 from .tree import Tree, TreeBatch, pad_rows, predict_raw
-from ..ops.split import SplitParams, leaf_output as _leaf_output_fn
+from ..ops.split import leaf_output as _leaf_output_fn
 
 EPSILON = 1e-12
 
@@ -129,12 +130,46 @@ def _mappers_equal(a, b) -> bool:
     return True
 
 
-@jax.jit
-def _update_score_by_leaf(score, row_leaf, leaf_value, shrinkage):
+def _update_score_impl(score, row_leaf, leaf_value, shrinkage):
     """score += shrinkage * leaf_value[row_leaf] — training-set score update
     using the grower's final leaf assignment (replaces the reference's
     ScoreUpdater::AddScore tree walk for train data, score_updater.hpp:54)."""
     return score + shrinkage * leaf_value[row_leaf]
+
+
+# Undonated entry: the multitrain driver vmaps this over the model axis
+# (donation annotations do not survive inner-jit batching).
+_update_score_by_leaf = jax.jit(_update_score_impl)
+
+# Standalone boosting path: the incoming (N,)/(N,) column score buffer is
+# dead after the call (``self.score`` is rebound to the result; the
+# multiclass call site passes a fresh slice), so the buffer is donated
+# and XLA updates the score in place instead of allocating a second
+# N-row buffer per tree.  The aliasing contract — donated input aval
+# must exactly match an output aval, or XLA silently copies — is
+# machine-checked by ``lint-trace``'s donation rule via the declaration
+# below.  TPU-only at dispatch: the XLA:CPU runtime in this jax version
+# frees a donated buffer while earlier in-flight consumers of the same
+# score array may still be reading it (observed as a hard runtime abort
+# in the capi update path); on TPU the aliasing is what buys back an
+# N-row HBM buffer per tree.
+SCORE_DONATE_ARGNUMS = (0,)
+_update_score_by_leaf_donated = jax.jit(
+    _update_score_impl, donate_argnums=SCORE_DONATE_ARGNUMS)
+
+
+def _score_update_entry():
+    """The donated entry on TPU, the plain one elsewhere."""
+    from ..utils.backend import default_backend
+    if default_backend() == "tpu":
+        return _update_score_by_leaf_donated
+    return _update_score_by_leaf
+
+_contracts.donation_contract(
+    "gbdt/score_update", lambda: _update_score_by_leaf_donated,
+    SCORE_DONATE_ARGNUMS,
+    lambda: (jnp.zeros((64,), jnp.float32), jnp.zeros((64,), jnp.int32),
+             jnp.zeros((8,), jnp.float32), np.float32(0.1)))
 
 
 # -- host-side per-iteration sampling (pure functions of (config, iter)) ----
@@ -878,7 +913,6 @@ class GBDT:
     def _record_tree(self, grown: GrownTree, class_id: int) -> Optional[Tree]:
         if getattr(self, "_linear", False):
             return self._record_tree_linear(grown, class_id)
-        cfg = self.config
         shrinkage = self._current_shrinkage()
         renewed = None
         defer = self._defer_trees and not (
@@ -909,11 +943,11 @@ class GBDT:
         # update train scores from the grower's leaf assignment
         lv = (grown.leaf_value if renewed is None
               else jnp.asarray(renewed, jnp.float32)) * shrinkage
+        upd = _score_update_entry()
         if self.num_tree_per_iteration == 1:
-            self.score = _update_score_by_leaf(self.score, grown.row_leaf, lv, 1.0)
+            self.score = upd(self.score, grown.row_leaf, lv, 1.0)
         else:
-            col = _update_score_by_leaf(self.score[:, class_id], grown.row_leaf,
-                                        lv, 1.0)
+            col = upd(self.score[:, class_id], grown.row_leaf, lv, 1.0)
             self.score = self.score.at[:, class_id].set(col)
         # update validation scores with a tree walk on their binned matrices
         for vi, (_, vset) in enumerate(self.valid_sets):
@@ -1112,7 +1146,6 @@ class GBDT:
                 return np.asarray(conv)
         batch = self._tree_batch()
         if batch is None:
-            n_iter_trees = 0
             raw = np.zeros((X.shape[0], k), np.float32)
         else:
             t0 = start_iteration * k
@@ -1190,7 +1223,6 @@ class GBDT:
         return np.asarray(out)[:Xi.shape[0]]
 
     def _predict_leaf(self, Xi, start_iteration, num_iteration):
-        from .tree import _walk_raw
         k = self.num_tree_per_iteration
         t0 = start_iteration * k
         t1 = len(self.models) if num_iteration is None else min(

@@ -16,12 +16,11 @@ dataset.h:282)."""
 from __future__ import annotations
 
 import io
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import numpy as np
 
-from ..utils.log import log_warning
-from .tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN, MISSING_ZERO, Tree
+from .tree import CAT_MASK, DEFAULT_LEFT_MASK, Tree
 
 MODEL_VERSION = "v3"
 

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN, Tree
+from .tree import CAT_MASK, DEFAULT_LEFT_MASK, Tree
 
 _CHUNK = 4096
 

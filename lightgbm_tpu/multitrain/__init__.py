@@ -35,9 +35,9 @@ import numpy as np
 from ..config import Config
 from ..dataset import Dataset
 from ..utils.log import log_info, log_warning
-from .batched import BatchTrainer, MultiTrainError, batch_reject_reason
+from .batched import BatchTrainer, MultiTrainError
 from .variants import (HOST_SWEEP, SWEEPABLE, TRACED_SWEEP, group_variants,
-                       normalize_variants, structure_key)
+                       normalize_variants)
 
 __all__ = ["train_many", "ManyBooster", "MultiTrainError",
            "GridSearchCVMany", "TRACED_SWEEP", "HOST_SWEEP", "SWEEPABLE"]

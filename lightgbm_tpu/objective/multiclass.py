@@ -80,7 +80,6 @@ class MulticlassOVA(ObjectiveFunction):
         self.weight = (jnp.asarray(metadata.weight, jnp.float32)
                        if metadata.weight is not None else None)
         self.num_data = num_data
-        import copy
         from ..dataset import Metadata
         for k, b in enumerate(self._binary):
             md = Metadata()

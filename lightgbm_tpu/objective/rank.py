@@ -10,7 +10,6 @@ unnecessary — the VPU computes exact sigmoids faster than a gather."""
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax

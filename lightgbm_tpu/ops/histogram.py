@@ -38,7 +38,6 @@ here because TPU f32 hessian sums are not exact counts.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

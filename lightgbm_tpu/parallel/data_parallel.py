@@ -21,7 +21,6 @@ counts fall out of the psum'd count channel (GetGlobalDataCountInLeaf).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -33,12 +32,49 @@ from ..config import Config
 from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
                               make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
+from ..analysis.contracts import collective_contract
 from ..telemetry.train_record import note_collective
 from .mesh import get_mesh, psum_scatter_compat, shard_map_compat
 
 __all__ = ["DataParallelTreeLearner", "DataParallelStrategy"]
 
 BIG_FEAT = np.int32(2 ** 30)
+
+
+def _masked_scan_budget(ctx):
+    """Masked-grower candidate scans per traced program: bounded by a
+    small multiple of the static leaf budget (the while body traces
+    once; root + two children per commit site)."""
+    return 8 * max(2, int(ctx.get("leaves", 2)))
+
+
+def _masked_hist_block_bytes(ctx):
+    """psum_scatter operand: the full LOCAL (Fp, B, 3) histogram goes in,
+    each shard receives its Fp/k block fully reduced (the reference's
+    per-split ReduceScatter, data_parallel_tree_learner.cpp:155-173)."""
+    k = max(1, int(ctx.get("nshards", 1)))
+    f_pad = -(-int(ctx["features"]) // k) * k
+    return f_pad * int(ctx["bins"]) * 3 * int(ctx.get("itemsize", 4))
+
+
+# Contracts for the MASKED sequential DP grower's sites (the wave-path
+# sites are declared next to their merge logic in learner/wave.py).
+collective_contract("data_parallel/masked/leaf_sum", "psum",
+                    max_count=_masked_scan_budget, max_bytes_per_op=256)
+collective_contract("data_parallel/masked/hist_reduce_scatter",
+                    "psum_scatter", max_count=_masked_scan_budget,
+                    max_bytes_per_op=_masked_hist_block_bytes,
+                    note="one reduce-scatter per candidate scan; "
+                         "operand is the local histogram")
+collective_contract("data_parallel/masked/best_gain", "pmax",
+                    max_count=_masked_scan_budget, max_bytes_per_op=64)
+collective_contract("data_parallel/masked/best_feature", "pmin",
+                    max_count=_masked_scan_budget, max_bytes_per_op=64)
+collective_contract("data_parallel/masked/winner_bcast", "psum",
+                    max_count=lambda ctx: 8 * _masked_scan_budget(ctx),
+                    max_bytes_per_op=lambda ctx: 4 * max(
+                        64, int(ctx["bins"])),
+                    note="winner payload incl. the (B,) cat membership")
 
 
 class DataParallelStrategy(CommStrategy):

@@ -30,12 +30,36 @@ from ..config import Config
 from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
                               make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
+from ..analysis.contracts import collective_contract
 from ..telemetry.train_record import note_collective
 from .mesh import get_mesh, shard_map_compat
 
 __all__ = ["FeatureParallelTreeLearner", "FeatureParallelStrategy"]
 
 BIG_FEAT = np.int32(2 ** 30)
+
+
+def _per_split_budget(ctx):
+    """Candidate-scan collectives trace once per scan SITE, not per
+    executed split (the grower's while body traces once); scan sites are
+    bounded by a small multiple of the static leaf budget."""
+    return 8 * max(2, int(ctx.get("leaves", 2)))
+
+
+# The FP learner's wire profile (SyncUpGlobalBestSplit + owner column
+# broadcast): winner scalars/payloads per scan site plus one (N,)-sized
+# column psum per committed split — never a histogram.
+collective_contract("feature_parallel/best_gain", "pmax",
+                    max_count=_per_split_budget, max_bytes_per_op=64)
+collective_contract("feature_parallel/best_feature", "pmin",
+                    max_count=_per_split_budget, max_bytes_per_op=64)
+collective_contract("feature_parallel/winner_bcast", "psum",
+                    max_count=_per_split_budget, max_bytes_per_op=256,
+                    note="winner payload scalars/vectors (SplitInfo)")
+collective_contract("feature_parallel/column_bcast", "psum",
+                    max_count=_per_split_budget,
+                    note="owner broadcast of the winning bin column; "
+                         "O(N) by design, unbounded bytes")
 
 
 class FeatureParallelStrategy(CommStrategy):

@@ -29,10 +29,37 @@ from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
                               make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
 from ..ops.split import NEG_INF, best_split_per_feature
+from ..analysis.contracts import collective_contract
 from ..telemetry.train_record import note_collective
 from .mesh import get_mesh, shard_map_compat
 
 __all__ = ["VotingParallelTreeLearner", "VotingStrategy"]
+
+
+def _vote_budget(ctx):
+    return 8 * max(2, int(ctx.get("leaves", 2)))
+
+
+def _voted_hist_bytes(ctx):
+    """The PV-Tree refinement (arXiv:1611.01276): only the voted top-2k
+    features' histograms cross the wire — a (2k, B, 3) psum replacing
+    the (F, B, 3) merge; 2k defaults to ctx['top_k']*2 but never exceeds
+    the full feature space."""
+    two_k = min(2 * int(ctx.get("top_k", 10)), int(ctx["features"]))
+    return two_k * int(ctx["bins"]) * 3 * int(ctx.get("itemsize", 4))
+
+
+collective_contract("voting_parallel/leaf_sum", "psum",
+                    max_count=_vote_budget, max_bytes_per_op=256)
+collective_contract("voting_parallel/vote_allgather", "all_gather",
+                    max_count=_vote_budget,
+                    max_bytes_per_op=lambda ctx: 8 * int(
+                        ctx.get("top_k", 10)),
+                    note="local top-k feature ids, O(k) ints")
+collective_contract("voting_parallel/voted_hist_psum", "psum",
+                    max_count=_vote_budget,
+                    max_bytes_per_op=_voted_hist_bytes,
+                    note="top-2k voted feature histograms only")
 
 
 class VotingStrategy(CommStrategy):

@@ -45,7 +45,7 @@ import numpy as np
 
 from ..io_utils import atomic_write_bytes, atomic_write_text
 from ..telemetry.metrics import default_registry
-from ..utils.log import log_info, log_warning
+from ..utils.log import log_warning
 
 __all__ = ["Checkpoint", "CheckpointError", "CheckpointManager",
            "TrainingPreempted", "load_checkpoint", "resolve_checkpoint",

@@ -12,7 +12,6 @@ from .basic import Booster
 from .callback import early_stopping as early_stopping_cb
 from .dataset import Dataset
 from .engine import train as engine_train
-from .utils.log import log_warning
 
 __all__ = ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
 

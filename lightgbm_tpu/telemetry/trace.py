@@ -24,7 +24,7 @@ import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from ..utils.timer import global_timer
 

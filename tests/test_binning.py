@@ -1,9 +1,8 @@
 """BinMapper tests (reference src/io/bin.cpp FindBin semantics)."""
 
 import numpy as np
-import pytest
 
-from lightgbm_tpu.binning import BinMapper, MissingType, bin_matrix, find_bin
+from lightgbm_tpu.binning import MissingType, bin_matrix, find_bin
 
 
 def test_simple_numeric():

@@ -8,7 +8,6 @@ out.  Inside this test process the shim reuses the already-running
 interpreter through PyGILState."""
 
 import ctypes
-import os
 
 import numpy as np
 import pytest

@@ -3,7 +3,6 @@ reference-format multi-category model loading (reference patterns:
 test_engine.py:118-375 categorical semantics)."""
 
 import numpy as np
-import pytest
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu.models.tree import CAT_MASK

@@ -3,7 +3,7 @@ alias table; analog of parts of tests/python_package_test/test_basic.py)."""
 
 import pytest
 
-from lightgbm_tpu.config import Config, parse_config_file, resolve_param_aliases
+from lightgbm_tpu.config import Config, parse_config_file
 
 
 def test_defaults():

@@ -11,7 +11,7 @@ import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
-from lightgbm_tpu.cli import main as cli_main, parse_cli_args
+from lightgbm_tpu.cli import main as cli_main
 from lightgbm_tpu.config import Config, parse_config_file
 from lightgbm_tpu.io_utils import load_data_file, load_sidecar
 

@@ -64,9 +64,9 @@ def test_init_model_with_valid(reg_data):
     ds = lgb.Dataset(X, y)
     first = lgb.train(PARAMS, ds, 15)
     evals = {}
-    cont = lgb.train(PARAMS, lgb.Dataset(X, y), 10, init_model=first,
-                     valid_sets=[lgb.Dataset(X, y)],
-                     callbacks=[lgb.record_evaluation(evals)])
+    lgb.train(PARAMS, lgb.Dataset(X, y), 10, init_model=first,
+              valid_sets=[lgb.Dataset(X, y)],
+              callbacks=[lgb.record_evaluation(evals)])
     l2 = evals["valid_0"]["l2"]
     # validation scores must include the loaded trees: first recorded value
     # already reflects 15+1 trees, so it is far better than a fresh model's

@@ -4,7 +4,6 @@ a wide 99%-sparse synthetic trains with device width ~ bundle count and
 matches unbundled predictions)."""
 
 import numpy as np
-import pytest
 import scipy.sparse as sp
 
 import lightgbm_tpu as lgb

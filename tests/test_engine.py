@@ -131,9 +131,9 @@ def test_eval_result_recording(regression_data):
     ds = lgb.Dataset(X[:400], y[:400])
     vs = ds.create_valid(X[400:], y[400:])
     hist = {}
-    bst = lgb.train({**SMALL, "objective": "regression", "metric": ["l2", "l1"]},
-                    ds, 8, valid_sets=[vs],
-                    callbacks=[lgb.record_evaluation(hist)])
+    lgb.train({**SMALL, "objective": "regression", "metric": ["l2", "l1"]},
+              ds, 8, valid_sets=[vs],
+              callbacks=[lgb.record_evaluation(hist)])
     assert "valid_0" in hist
     assert len(hist["valid_0"]["l2"]) == 8
     assert hist["valid_0"]["l2"][-1] <= hist["valid_0"]["l2"][0]

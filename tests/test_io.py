@@ -1,8 +1,6 @@
 """Text-loader tests (reference src/io/parser.cpp CreateParser detection +
 dataset_loader.cpp two-round loading)."""
 
-import os
-
 import numpy as np
 
 from lightgbm_tpu.io_utils import _detect_format, load_data_file

@@ -2,7 +2,6 @@
 analog of parts of test_engine.py save/load and test_basic.py)."""
 
 import numpy as np
-import pytest
 
 import lightgbm_tpu as lgb
 

@@ -438,7 +438,7 @@ def test_telemetry_counters_and_train_record():
 # -- sklearn sweep ------------------------------------------------------------
 
 def test_grid_search_cv_many_regressor():
-    sklearn = pytest.importorskip("sklearn")
+    pytest.importorskip("sklearn")
     from lightgbm_tpu.multitrain import GridSearchCVMany
     from lightgbm_tpu.sklearn import LGBMRegressor
     X, y = _data(n=800)
@@ -457,8 +457,8 @@ def test_grid_search_cv_many_regressor():
 
 
 def test_grid_search_cv_many_classifier_matches_sequential():
-    sklearn = pytest.importorskip("sklearn")
-    from sklearn.model_selection import GridSearchCV, KFold
+    pytest.importorskip("sklearn")
+    from sklearn.model_selection import KFold
     from lightgbm_tpu.multitrain import GridSearchCVMany
     from lightgbm_tpu.sklearn import LGBMClassifier
     X, y = _data(n=800)

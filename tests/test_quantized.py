@@ -10,7 +10,6 @@ serial and data-parallel wave growers, and exact leaf renewal."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 import lightgbm_tpu as lgb
 

@@ -32,7 +32,6 @@ from lightgbm_tpu import (Checkpoint, CheckpointError, ModelCorruptError,
 from lightgbm_tpu.io_utils import atomic_write_bytes, atomic_write_text
 from lightgbm_tpu.resilience.admission import (DeadlineExceeded,
                                                QueueFullError, ServerClosed)
-from lightgbm_tpu.resilience.checkpoint import CheckpointManager
 from lightgbm_tpu.resilience.faults import InjectedFault, faults
 from lightgbm_tpu.serve import MicroBatcher
 

@@ -12,8 +12,7 @@ import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
-from lightgbm_tpu.serve import (SHAPE_BUCKETS, CompiledPredictor,
-                                MicroBatcher, ModelRegistry,
+from lightgbm_tpu.serve import (MicroBatcher, ModelRegistry,
                                 PredictionServer)
 
 SMALL = {"num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1}

@@ -1,7 +1,6 @@
 """sklearn API tests (analog of reference test_sklearn.py)."""
 
 import numpy as np
-import pytest
 
 from lightgbm_tpu import LGBMClassifier, LGBMRanker, LGBMRegressor
 

@@ -75,7 +75,6 @@ def test_spec_full_subsample_matches_plain_exactly():
 
 def test_spec_quantized_matches_plain():
     bins, grad, hess, mask, y, n = _mk_data(seed=3)
-    qk = jnp.zeros((2,), jnp.uint32)
     t_plain = _call(_grow(False, n, quantized=True), bins, grad, hess, mask)
     t_spec = _call(_grow(True, n, quantized=True), bins, grad, hess, mask)
     assert int(t_spec.num_leaves) == int(t_plain.num_leaves)
@@ -185,7 +184,6 @@ def test_spec_dp_one_psum_per_provisional_pass():
     the traced program: spec-on minus spec-off psum count == provisional
     passes + the verification mega-pass - the root pass it replaces."""
     import math
-    import jax
     from lightgbm_tpu.parallel.data_parallel import WaveDPStrategy
     from lightgbm_tpu.parallel.mesh import get_mesh
     mesh = get_mesh(8)
@@ -197,11 +195,12 @@ def test_spec_dp_one_psum_per_provisional_pass():
             jnp.zeros((6,), jnp.int32), jnp.zeros((6,), jnp.float32),
             jnp.ones((6,), bool))
 
+    from lightgbm_tpu.analysis import ir
+
     def count_psums(spec):
         g = _wrap_dp(_mk_grow_dp(WaveDPStrategy(ax, nshards=8), spec),
                      mesh, ax)
-        txt = str(jax.make_jaxpr(lambda *a: g(*a))(*args))
-        return txt.count("psum")
+        return ir.count_primitive(ir.trace(lambda *a: g(*a), *args), "psum")
 
     w = 4
     extra = count_psums(True) - count_psums(False)

@@ -21,7 +21,6 @@ import pytest
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu import telemetry
-from lightgbm_tpu.telemetry import metrics as tmetrics
 from lightgbm_tpu.telemetry.metrics import (MetricsRegistry, SlidingWindow,
                                             percentile)
 
@@ -299,10 +298,12 @@ def _mk_dp_data(n_raw):
 
 
 def _trace_dp_grow(spec, wave=4):
-    """Trace (don't run) the DP wave grower, mirroring
-    tests/test_specramp.py's jaxpr-based psum count."""
+    """Trace (don't run) the DP wave grower; the psum counting rides the
+    shared analysis.ir walker (tests/test_specramp.py counts the same
+    quantity through the same API)."""
     import jax
     import jax.numpy as jnp
+    from lightgbm_tpu.analysis import ir
     from jax.sharding import PartitionSpec as P
     from lightgbm_tpu.learner.wave import make_wave_grow_fn
     from lightgbm_tpu.ops.split import SplitParams
@@ -334,10 +335,11 @@ def _trace_dp_grow(spec, wave=4):
             jnp.zeros((6,), jnp.float32), jnp.ones((6,), bool))
     before = telemetry.collectives_snapshot().get(
         "data_parallel/wave/hist_psum", {"count": 0})["count"]
-    txt = str(jax.make_jaxpr(lambda *a: wrapped(*a))(*args))
+    n_psum = ir.count_primitive(
+        ir.trace(lambda *a: wrapped(*a), *args), "psum")
     after = telemetry.collectives_snapshot().get(
         "data_parallel/wave/hist_psum", {"count": 0})["count"]
-    return after - before, txt
+    return after - before, n_psum
 
 
 def test_collective_tally_matches_traced_psum_delta():
@@ -346,14 +348,13 @@ def test_collective_tally_matches_traced_psum_delta():
     on the jaxpr: spec-on minus spec-off == ceil(log2(W)) extra
     histogram psums per tree."""
     w = 4
-    tally_off, txt_off = _trace_dp_grow(False, wave=w)
-    tally_on, txt_on = _trace_dp_grow(True, wave=w)
+    tally_off, n_off = _trace_dp_grow(False, wave=w)
+    tally_on, n_on = _trace_dp_grow(True, wave=w)
     assert tally_off >= 1
     assert tally_on - tally_off == math.ceil(math.log2(w))
     # the tally site is the histogram psum: its per-trace count moves
     # exactly with the program's psum op count
-    assert (tally_on - tally_off) == \
-        (txt_on.count("psum") - txt_off.count("psum"))
+    assert (tally_on - tally_off) == (n_on - n_off)
     # and the recorded bytes are the histogram batch operand size
     rec = telemetry.collectives_snapshot()["data_parallel/wave/hist_psum"]
     assert rec["op"] == "psum" and rec["bytes"] > 0

@@ -3,7 +3,6 @@ and agreement between the binned device walk, the raw device walk, and the
 host reference predictor."""
 
 import numpy as np
-import pytest
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu.models.tree import TreeBatch, predict_binned, predict_raw
@@ -84,7 +83,6 @@ def test_dense_walk_matches_sequential_walk():
     """The MXU dense walk (path-matrix formulation) must reproduce the
     sequential gather walk bit-for-bit on numeric trees (incl. NaN
     routing and linear leaves)."""
-    import jax
     import jax.numpy as jnp
     import lightgbm_tpu as lgb
     from lightgbm_tpu.models.tree import TreeBatch, _walk_raw, predict_raw

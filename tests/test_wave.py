@@ -7,7 +7,6 @@ splits / interaction constraints / bynode), incl. EFB, categoricals,
 monotone constraints and GOSS."""
 
 import numpy as np
-import pytest
 import scipy.sparse as sp
 
 import lightgbm_tpu as lgb

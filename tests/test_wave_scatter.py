@@ -137,48 +137,11 @@ def test_scatter_spec_ramp_rides_the_scatter():
 
 # ---------------------------------------------------------------------------
 # Traced-program shape: one reduce_scatter per merge site, zero
-# full-histogram psums, O(W*k) winner exchange
+# full-histogram psums, O(W*k) winner exchange.  The jaxpr traversal is
+# the shared analysis.ir walker (this file's local copy moved there).
 # ---------------------------------------------------------------------------
 
-
-def _subjaxprs(val):
-    """Sub-jaxprs inside an eqn param: raw Jaxpr (shard_map), ClosedJaxpr
-    (pjit/while/cond) or lists of either (cond branches)."""
-    if hasattr(val, "eqns"):
-        yield val
-    elif hasattr(val, "jaxpr"):
-        yield val.jaxpr
-    elif isinstance(val, (list, tuple)):
-        for it in val:
-            yield from _subjaxprs(it)
-
-
-def _walk_eqns(jaxpr):
-    """Yield every (primitive_name, max_operand_elems), descending into
-    while/cond/pjit/shard_map sub-jaxprs."""
-    for eqn in jaxpr.eqns:
-        size = 0
-        for v in eqn.invars:
-            aval = getattr(v, "aval", None)
-            if aval is not None and hasattr(aval, "shape"):
-                s = 1
-                for d in aval.shape:
-                    s *= int(d)
-                size = max(size, s)
-        yield eqn.primitive.name, size
-        for val in eqn.params.values():
-            for sub in _subjaxprs(val):
-                yield from _walk_eqns(sub)
-
-
-def _collectives_of(fn, *args):
-    jx = jax.make_jaxpr(fn)(*args)
-    out = {}
-    for name, size in _walk_eqns(jx.jaxpr):
-        if name in ("psum", "pmax", "pmin") or "reduce_scatter" in name \
-                or "all_reduce" in name:
-            out.setdefault(name, []).append(size)
-    return out
+from lightgbm_tpu.analysis.ir import collect_collectives as _collectives_of
 
 
 def test_scatter_traced_collectives_shape():
