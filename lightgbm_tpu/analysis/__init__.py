@@ -6,40 +6,63 @@ histogram-merge site, zero full-histogram psums on the sliced path,
 ceil(log2 W) spec-ramp collectives, no host syncs or silent f64 in hot
 programs, no giant constant-folded operands, zero retraces across
 boosting iterations and serve buckets, donated score buffers that
-really alias.  This package states those contracts once and machine
-checks them:
+really alias — plus the pod-scale pair this package grew in PR 11:
+per-device HBM / per-kernel VMEM stays under a declared curve at any
+(rows, world_size), and every conditional arm issues the identical
+collective sequence (no static cross-host deadlocks).  This package
+states those contracts once and machine-checks them:
 
 * :mod:`.ir` — the recursive jaxpr walker every check rides
   (supersedes the three test-local walkers of PRs 4-5);
 * :mod:`.contracts` — contract declarations living NEXT TO the code
-  they constrain, keyed by telemetry ``note_collective`` site names;
-* :mod:`.rules` — the rule engine (six checks);
+  they constrain: collective budgets keyed by telemetry
+  ``note_collective`` site names, donation entries, and
+  :class:`~.contracts.MemoryBudget` HBM/VMEM curves;
+* :mod:`.rules` — the rule engine (the six PR-10 checks);
+* :mod:`.spmd` — SPMD-safety rules: collective-order deadlock
+  detection + shard_map sharding consistency, world-size-scaled;
+* :mod:`.memory` — the ``lint-mem`` peak-memory estimator (live-range
+  jaxpr sweep, per-shard sizing, XLA memory_analysis cross-check);
 * :mod:`.lint` — the ``python -m lightgbm_tpu lint-trace`` matrix
   driver (serial / wave / DP-scatter / spec-ramp / multitrain / serve),
   a blocking CI step.
 """
 
-from . import contracts, ir, lint, rules
-from .contracts import (CollectiveContract, DonationContract,
-                        all_contracts, collective_contract,
-                        contract_for, donation_contract)
+from . import contracts, ir, lint, memory, rules, spmd
+from .contracts import (CollectiveContract, DonationContract, MemoryBudget,
+                        all_contracts, all_memory_budgets,
+                        collective_contract, contract_for,
+                        donation_contract, memory_budget,
+                        memory_budget_for, world_size)
 from .ir import (collect_collectives, collectives_of, count_primitive,
                  is_collective, iter_consts, iter_eqns, stable_hash,
                  subjaxprs, trace, walk_eqns)
-from .lint import MATRIX_CONFIGS, build_unit, run_lint
+from .lint import (MATRIX_CONFIGS, Geometry, build_unit, environment_info,
+                   run_lint)
+from .memory import (MemoryBudgetRule, MemoryEstimate, estimate_memory,
+                     run_lint_mem)
 from .rules import (DEFAULT_RULES, CollectiveBudgetRule, ConstantFoldRule,
                     DonationRule, DtypeRule, HostSyncRule, RetraceRule,
                     Rule, TraceUnit, Violation, run_rules)
+from .spmd import (SPMD_RULES, CollectiveOrderRule,
+                   ShardingConsistencyRule, collective_trace)
 
 __all__ = [
-    "ir", "contracts", "rules", "lint",
+    "ir", "contracts", "rules", "lint", "memory", "spmd",
     "collect_collectives", "collectives_of", "count_primitive",
     "is_collective", "iter_consts", "iter_eqns", "stable_hash",
     "subjaxprs", "trace", "walk_eqns",
-    "CollectiveContract", "DonationContract", "all_contracts",
-    "collective_contract", "contract_for", "donation_contract",
-    "MATRIX_CONFIGS", "build_unit", "run_lint",
+    "CollectiveContract", "DonationContract", "MemoryBudget",
+    "all_contracts", "all_memory_budgets", "collective_contract",
+    "contract_for", "donation_contract", "memory_budget",
+    "memory_budget_for", "world_size",
+    "MATRIX_CONFIGS", "Geometry", "build_unit", "environment_info",
+    "run_lint",
+    "MemoryBudgetRule", "MemoryEstimate", "estimate_memory",
+    "run_lint_mem",
     "DEFAULT_RULES", "CollectiveBudgetRule", "ConstantFoldRule",
     "DonationRule", "DtypeRule", "HostSyncRule", "RetraceRule",
     "Rule", "TraceUnit", "Violation", "run_rules",
+    "SPMD_RULES", "CollectiveOrderRule", "ShardingConsistencyRule",
+    "collective_trace",
 ]

@@ -28,7 +28,9 @@ from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 __all__ = ["CollectiveContract", "collective_contract", "contract_for",
            "all_contracts", "resolve_limit", "DonationContract",
-           "donation_contract", "all_donation_contracts"]
+           "donation_contract", "all_donation_contracts", "MemoryBudget",
+           "memory_budget", "memory_budget_for", "all_memory_budgets",
+           "world_size"]
 
 Limit = Union[int, Callable[[Dict[str, Any]], int], None]
 
@@ -40,6 +42,18 @@ def resolve_limit(limit: Limit, ctx: Dict[str, Any]) -> Optional[int]:
     if callable(limit):
         return int(limit(ctx))
     return int(limit)
+
+
+def world_size(ctx: Dict[str, Any]) -> int:
+    """Mesh world size from a lint ctx.
+
+    Every collective/memory contract scales its curve through this one
+    accessor so the same declaration checks a W=4 virtual mesh, the W=8
+    CI mesh and a W=64/256 trace-only pod mesh.  ``world_size`` is the
+    canonical key; ``nshards`` is the historical spelling the W=8 lint
+    matrix has always set — both stay honored so older ctx dicts keep
+    resolving."""
+    return max(1, int(ctx.get("world_size", ctx.get("nshards", 1))))
 
 
 @dataclass(frozen=True)
@@ -149,3 +163,77 @@ def all_donation_contracts() -> Dict[str, DonationContract]:
 def remove_donation_contract(name: str) -> None:
     with _lock:
         _donations.pop(name, None)
+
+
+# ---------------------------------------------------------------------------
+# Memory budgets: static HBM/VMEM curves per traced program family
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class MemoryBudget:
+    """Declared peak-memory curve for one lint-matrix program family.
+
+    ``configs`` names the lint configs the budget binds to (a budget for
+    the wave grower covers both the ``serial`` and ``wave`` configs).
+    ``hbm_per_device`` bounds the per-device peak-live-buffer estimate
+    the memory lint computes from the jaxpr (live-range sweep, per-shard
+    sizing inside shard_map bodies); ``vmem_per_kernel`` bounds the
+    VMEM-resident block bytes of any single ``pallas_call`` in the
+    program (the ~16 MB/core ceiling).  Both are functions of the lint
+    ctx — (rows, features, bins, wave_size, leaves, world_size, models,
+    itemsize) — so ``lint-mem --rows=1e8 --devices=64`` evaluates the
+    same declaration at pod scale no CI host can run."""
+
+    name: str
+    configs: Tuple[str, ...]
+    hbm_per_device: Limit
+    vmem_per_kernel: Limit = None
+    declared_in: str = ""
+    note: str = ""
+
+
+_mem_budgets: Dict[str, MemoryBudget] = {}
+
+
+def memory_budget(name: str, configs, hbm_per_device: Limit, *,
+                  vmem_per_kernel: Limit = None,
+                  note: str = "") -> MemoryBudget:
+    """Declare (or redeclare) the memory curve for one program family.
+
+    Call at module scope next to the code whose footprint it bounds
+    (the wave grower declares its (W,F,B,3) batch + pool curve, the DP
+    strategy its 1/k sliced curve, the predictor the bucket ladder,
+    multitrain the M-stacked state)."""
+    import inspect
+    frame = inspect.currentframe()
+    declared_in = ""
+    if frame is not None and frame.f_back is not None:
+        declared_in = frame.f_back.f_globals.get("__name__", "")
+    if isinstance(configs, str):
+        configs = (configs,)
+    b = MemoryBudget(name=name, configs=tuple(configs),
+                     hbm_per_device=hbm_per_device,
+                     vmem_per_kernel=vmem_per_kernel,
+                     declared_in=declared_in, note=note)
+    with _lock:
+        _mem_budgets[name] = b
+    return b
+
+
+def memory_budget_for(config: str) -> Optional[MemoryBudget]:
+    """The budget whose ``configs`` tuple claims this lint config."""
+    with _lock:
+        for b in _mem_budgets.values():
+            if config in b.configs:
+                return b
+    return None
+
+
+def all_memory_budgets() -> Dict[str, MemoryBudget]:
+    with _lock:
+        return dict(_mem_budgets)
+
+
+def remove_memory_budget(name: str) -> None:
+    with _lock:
+        _mem_budgets.pop(name, None)

@@ -1,11 +1,11 @@
 """``lint-trace``: trace the config matrix, enforce program contracts.
 
-Drives :mod:`.ir` + :mod:`.rules` over one traced (never executed)
-program per supported training/serving shape:
+Drives :mod:`.ir` + :mod:`.rules` + :mod:`.spmd` over one traced (never
+executed) program per supported training/serving shape:
 
 * ``serial``     — the sequential wave grower (no mesh, no collectives);
 * ``wave``       — the wave grower, Pallas kernels (interpret off-TPU);
-* ``dp_scatter`` — 8-shard DP wave, feature-sliced reduce-scatter merge;
+* ``dp_scatter`` — W-shard DP wave, feature-sliced reduce-scatter merge;
 * ``spec_ramp``  — DP wave + speculative ramp (the ceil(log2 W) budget);
 * ``multitrain`` — the vmapped model axis over the wave grower;
 * ``serve``      — the ensemble predictor across the SHAPE_BUCKETS
@@ -16,28 +16,63 @@ the retrace rule sees real hash probes, and the telemetry collective
 tally is snapshotted around each trace so the collective-budget rule
 can cross-check contracts against both the tally and the jaxpr.
 
+**World-size scaling**: the DP configs trace at any ``devices=W``.  Up
+to the attached device count they run on a real submesh; past it the
+trace rides a :class:`jax.sharding.AbstractMesh` (trace-only — shapes
+and collectives are exact, nothing can execute), which is how the W=64
+pod path is machine-checked on a laptop (ROADMAP item 1).
+
 The report is JSON (``trace-lint-v1``) and the CLI exits 1 when any
 violation is found (0 when clean) — CI runs this as a blocking step.
+Each report records the jax/jaxlib version and the device/mesh shape it
+traced under, so an 8-virtual-device run is distinguishable from a
+real-chip run.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, \
+    Sequence, Tuple
 
 from . import ir
 from .contracts import all_donation_contracts
 from .rules import DEFAULT_RULES, TraceUnit, Violation, run_rules
+from .spmd import SPMD_RULES
 
-__all__ = ["MATRIX_CONFIGS", "build_unit", "run_lint", "main"]
+__all__ = ["MATRIX_CONFIGS", "Geometry", "TRACE_GEOMETRY", "MEM_GEOMETRY",
+           "build_unit", "build_callable", "environment_info",
+           "parse_kv_args", "run_lint", "main"]
 
 MATRIX_CONFIGS = ("serial", "wave", "dp_scatter", "spec_ramp",
                   "multitrain", "serve")
 
-# shared small-but-representative shapes (the test-suite geometry: the
-# endgame engages at 13 leaves / wave 4, scatter pads 6 features to 8
-# blocks at k=8)
-_F, _B, _LEAVES, _WAVE = 6, 64, 13, 4
+# every rule the matrix runs: the six PR-10 program-contract rules plus
+# the SPMD-safety pair (collective-order, sharding-consistency)
+ALL_RULES = tuple(DEFAULT_RULES) + tuple(SPMD_RULES)
+
+
+class Geometry(NamedTuple):
+    """Trace shapes for one lint pass.
+
+    ``TRACE_GEOMETRY`` is the small-but-representative test-suite
+    geometry (the endgame engages at 13 leaves / wave 4, scatter pads 6
+    features to 8 blocks at k=8) — fast, used by ``lint-trace``.
+    ``MEM_GEOMETRY`` is larger so the histogram working set dominates
+    the row arrays and a footprint regression (an un-scattered merge, a
+    doubled pool) moves the peak estimate well past curve noise — used
+    by ``lint-mem``."""
+
+    features: int = 6
+    bins: int = 64
+    leaves: int = 13
+    wave: int = 4
+    rows: int = 4096
+
+
+TRACE_GEOMETRY = Geometry()
+MEM_GEOMETRY = Geometry(features=64, bins=255, leaves=17, wave=16,
+                        rows=8192)
 
 
 def _backend_initialized() -> bool:
@@ -53,8 +88,8 @@ def _backend_initialized() -> bool:
 def _ensure_devices(k: int) -> int:
     """Best-effort k virtual CPU devices.  Device count can only be set
     before the first jax client exists; afterwards fall back to
-    whatever is visible (a short mesh still traces every contract, just
-    at a smaller k)."""
+    whatever is visible (a larger requested W then traces over an
+    AbstractMesh — see :func:`_trace_mesh`)."""
     import os
 
     import jax
@@ -73,32 +108,53 @@ def _ensure_devices(k: int) -> int:
         return 1
 
 
-def _mk_train_args(seed: int, n: int, quantized: bool = False):
+def _trace_mesh(k: int, axis_name: str = "workers"):
+    """A k-way 1-D mesh for TRACING: a real submesh when k devices are
+    attached, else an AbstractMesh (trace-only — a program traced over
+    it can never execute, which is exactly what the lint wants).
+    Returns ``(mesh, abstract)``."""
+    avail = _ensure_devices(k)
+    if avail >= k:
+        from ..parallel.mesh import get_mesh
+        return get_mesh(k, axis_name), False
+    try:
+        from jax.sharding import AbstractMesh
+    except ImportError as exc:
+        raise RuntimeError(
+            f"devices={k} exceeds the {avail} attached device(s) and this "
+            f"jax build has no AbstractMesh for trace-only meshes") from exc
+    return AbstractMesh(((axis_name, k),)), True
+
+
+def _mk_train_args(seed: int, n: int, geom: Geometry,
+                   quantized: bool = False):
     import jax.numpy as jnp
     import numpy as np
+    f, b = geom.features, geom.bins
     rng = np.random.RandomState(seed)
-    bins = rng.randint(0, _B - 1, (_F, n)).astype(np.uint8)
-    logit = (bins[0].astype(np.float32) / _B - 0.5) * 3
+    bins = rng.randint(0, b - 1, (f, n)).astype(np.uint8)
+    logit = (bins[0].astype(np.float32) / b - 0.5) * 3
     y = (logit + rng.randn(n) * 0.7 > 0).astype(np.float32)
     grad = (0.5 - y).astype(np.float32)
     hess = np.full(n, 0.25, np.float32)
     mask = np.ones(n, np.float32)
-    meta = (jnp.full((_F,), _B, jnp.int32), jnp.zeros((_F,), bool),
-            jnp.zeros((_F,), bool), jnp.zeros((_F,), jnp.int32),
-            jnp.zeros((_F,), jnp.float32), jnp.ones((_F,), bool))
+    meta = (jnp.full((f,), b, jnp.int32), jnp.zeros((f,), bool),
+            jnp.zeros((f,), bool), jnp.zeros((f,), jnp.int32),
+            jnp.zeros((f,), jnp.float32), jnp.ones((f,), bool))
     return (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
             jnp.asarray(mask)) + meta
 
 
-def _mk_wave_grow(strategy, *, quantized: bool, spec: bool):
+def _mk_wave_grow(strategy, geom: Geometry, *, quantized: bool, spec: bool):
     from ..learner.wave import make_wave_grow_fn
     from ..ops.split import SplitParams
     sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=0.0,
                      any_cat=False)
     return make_wave_grow_fn(
-        num_leaves=_LEAVES, num_features=_F, max_bins=_B, max_depth=0,
-        split_params=sp, hist_impl="pallas", any_cat=False, interpret=None,
-        jit=False, wave_size=_WAVE, quantized=quantized, stochastic=False,
+        num_leaves=geom.leaves, num_features=geom.features,
+        max_bins=geom.bins, max_depth=0, split_params=sp,
+        hist_impl="pallas", any_cat=False, interpret=None, jit=False,
+        wave_size=geom.wave, quantized=quantized, stochastic=False,
         spec_ramp=spec, spec_tol=0.02, strategy=strategy)
 
 
@@ -136,10 +192,11 @@ def _trace_with_tally(fn, args) -> Tuple[Any, Dict[str, Dict[str, Any]]]:
     return jaxpr, delta
 
 
-def _base_ctx(**kw) -> Dict[str, Any]:
+def _base_ctx(geom: Geometry, **kw) -> Dict[str, Any]:
     ctx: Dict[str, Any] = {
-        "wave_size": _WAVE, "features": _F, "bins": _B, "leaves": _LEAVES,
-        "itemsize": 4, "nshards": 1, "quantized": False,
+        "wave_size": geom.wave, "features": geom.features,
+        "bins": geom.bins, "leaves": geom.leaves, "rows": geom.rows,
+        "itemsize": 4, "nshards": 1, "world_size": 1, "quantized": False,
         "spec_ramp": False}
     from ..telemetry import _config as tele_config
     if not tele_config.enabled():
@@ -165,51 +222,53 @@ def _unit_from_traces(name: str, build: Callable[[int], Tuple[Any, tuple]],
                      hashes=[("iteration", h0), ("iteration", h1)])
 
 
-def _build_serial(i: int):
+def _serial_builder(geom: Geometry, quantized: bool):
     from ..ops.histogram_pallas import pad_rows
-    grow = _mk_wave_grow(None, quantized=False, spec=False)
-    return _serial_entry(grow), _mk_train_args(i, pad_rows(4000))
-
-
-def _build_wave(i: int):
-    from ..ops.histogram_pallas import pad_rows
-    grow = _mk_wave_grow(None, quantized=True, spec=False)
-    return _serial_entry(grow), _mk_train_args(i, pad_rows(4000), True)
-
-
-def _dp_builder(k: int, spec: bool):
-    from ..parallel.data_parallel import WaveDPStrategy
-    from ..parallel.mesh import get_mesh
-    mesh = get_mesh(k)
-    ax = mesh.axis_names[0]
 
     def build(i: int):
-        grow = _mk_wave_grow(
-            WaveDPStrategy(ax, nshards=k, hist_scatter=True),
-            quantized=True, spec=spec)
-        return _dp_entry(grow, mesh, ax), _mk_train_args(i, k * 4096, True)
+        grow = _mk_wave_grow(None, geom, quantized=quantized, spec=False)
+        return _serial_entry(grow), _mk_train_args(
+            i, pad_rows(geom.rows), geom, quantized)
 
     return build
 
 
-def _build_multitrain(i: int):
-    import jax
-    from ..ops.histogram_pallas import pad_rows
-    grow = _mk_wave_grow(None, quantized=False, spec=False)
-    entry = _serial_entry(grow)
-    # the model axis: per-lane grad/hess/mask over shared bins (the
-    # multitrain/batched.py vm_grow shape, M=3 lanes)
-    vm = jax.vmap(entry,
-                  in_axes=(None, 0, 0, 0) + (None,) * 6)
-    args = _mk_train_args(i, pad_rows(4000))
-    import jax.numpy as jnp
-    stack = lambda a: jnp.stack([a, a * 0.5, a * 0.25])
-    vm_args = (args[0], stack(args[1]), stack(args[2]),
-               jnp.stack([args[3]] * 3)) + args[4:]
-    return vm, vm_args
+def _dp_builder(k: int, geom: Geometry, spec: bool):
+    from ..parallel.data_parallel import WaveDPStrategy
+    mesh, _abstract = _trace_mesh(k)
+    ax = mesh.axis_names[0]
+
+    def build(i: int):
+        grow = _mk_wave_grow(
+            WaveDPStrategy(ax, nshards=k, hist_scatter=True), geom,
+            quantized=True, spec=spec)
+        return _dp_entry(grow, mesh, ax), _mk_train_args(
+            i, k * 4096, geom, True)
+
+    return build
 
 
-def _mk_serve_ensemble():
+def _multitrain_builder(geom: Geometry):
+    def build(i: int):
+        import jax
+        import jax.numpy as jnp
+        from ..ops.histogram_pallas import pad_rows
+        grow = _mk_wave_grow(None, geom, quantized=False, spec=False)
+        entry = _serial_entry(grow)
+        # the model axis: per-lane grad/hess/mask over shared bins (the
+        # multitrain/batched.py vm_grow shape, M=3 lanes)
+        vm = jax.vmap(entry,
+                      in_axes=(None, 0, 0, 0) + (None,) * 6)
+        args = _mk_train_args(i, pad_rows(geom.rows), geom)
+        stack = lambda a: jnp.stack([a, a * 0.5, a * 0.25])
+        vm_args = (args[0], stack(args[1]), stack(args[2]),
+                   jnp.stack([args[3]] * 3)) + args[4:]
+        return vm, vm_args
+
+    return build
+
+
+def _mk_serve_ensemble(geom: Geometry):
     """A tiny hand-built 2-leaf/3-tree dense ensemble — the serving
     shape class, no training run needed."""
     import numpy as np
@@ -218,7 +277,7 @@ def _mk_serve_ensemble():
     for t in range(3):
         trees.append(Tree(
             num_leaves=2,
-            split_feature=np.array([t % _F], np.int32),
+            split_feature=np.array([t % geom.features], np.int32),
             threshold_bin=np.array([1], np.int32),
             nan_bin=np.array([-1], np.int32),
             threshold=np.array([0.5 + t], np.float64),
@@ -236,52 +295,120 @@ def _mk_serve_ensemble():
     return ((fields, lin),), (kind,)
 
 
-def _build_serve_unit(ctx: Dict[str, Any]) -> TraceUnit:
+def _build_serve_unit(geom: Geometry, ctx: Dict[str, Any]) -> TraceUnit:
     import numpy as np
     from ..models.tree import SHAPE_BUCKETS, predict_raw_ensemble
-    per_class, kinds = _mk_serve_ensemble()
+    per_class, kinds = _mk_serve_ensemble(geom)
     hashes: List[Tuple[str, str]] = []
     jaxpr0 = None
     tally: Dict[str, Dict[str, Any]] = {}
     for bucket in SHAPE_BUCKETS:
         for rep in range(2):
-            X = np.zeros((bucket, _F), np.float32) + rep
+            X = np.zeros((bucket, geom.features), np.float32) + rep
             fn = lambda Xa, pc: predict_raw_ensemble(Xa, pc, kinds)
             jx, t = _trace_with_tally(fn, (X, per_class))
             hashes.append((f"bucket{bucket}", ir.stable_hash(jx)))
-            if jaxpr0 is None:
+            if bucket == max(SHAPE_BUCKETS):
                 jaxpr0, tally = jx, t
     ctx = dict(ctx)
     # one compiled program per ladder rung and not one more
     ctx["max_distinct_programs"] = len(SHAPE_BUCKETS)
+    ctx["bucket"] = max(SHAPE_BUCKETS)
+    ctx["trees"] = 3
     return TraceUnit(name="serve", jaxpr=jaxpr0, ctx=ctx,
                      collectives=tally, hashes=hashes)
 
 
-def build_unit(name: str, nshards: int = 8) -> TraceUnit:
+def build_unit(name: str, nshards: int = 8,
+               geometry: Optional[Geometry] = None) -> TraceUnit:
     """Trace one matrix config into a rule-ready :class:`TraceUnit`."""
+    geom = geometry or TRACE_GEOMETRY
     if name == "serial":
-        return _unit_from_traces("serial", _build_serial, _base_ctx())
+        return _unit_from_traces("serial", _serial_builder(geom, False),
+                                 _base_ctx(geom))
     if name == "wave":
-        return _unit_from_traces("wave", _build_wave,
-                                 _base_ctx(quantized=True))
+        return _unit_from_traces("wave", _serial_builder(geom, True),
+                                 _base_ctx(geom, quantized=True))
     if name == "dp_scatter":
-        k = _ensure_devices(nshards)
         return _unit_from_traces(
-            "dp_scatter", _dp_builder(k, spec=False),
-            _base_ctx(nshards=k, quantized=True))
+            "dp_scatter", _dp_builder(nshards, geom, spec=False),
+            _base_ctx(geom, nshards=nshards, world_size=nshards,
+                      quantized=True, rows=nshards * 4096,
+                      mesh_axes=("workers",)))
     if name == "spec_ramp":
-        k = _ensure_devices(nshards)
         return _unit_from_traces(
-            "spec_ramp", _dp_builder(k, spec=True),
-            _base_ctx(nshards=k, quantized=True, spec_ramp=True))
+            "spec_ramp", _dp_builder(nshards, geom, spec=True),
+            _base_ctx(geom, nshards=nshards, world_size=nshards,
+                      quantized=True, spec_ramp=True,
+                      rows=nshards * 4096, mesh_axes=("workers",)))
     if name == "multitrain":
-        return _unit_from_traces("multitrain", _build_multitrain,
-                                 _base_ctx(models=3))
+        return _unit_from_traces("multitrain", _multitrain_builder(geom),
+                                 _base_ctx(geom, models=3))
     if name == "serve":
-        return _build_serve_unit(_base_ctx())
+        return _build_serve_unit(geom, _base_ctx(geom))
     raise ValueError(f"unknown lint config '{name}' "
                      f"(matrix: {', '.join(MATRIX_CONFIGS)})")
+
+
+def build_callable(name: str, nshards: int = 8,
+                   geometry: Optional[Geometry] = None
+                   ) -> Optional[Tuple[Any, tuple]]:
+    """The (fn, args) a config traces — for callers that need to
+    LOWER/COMPILE it (the lint-mem XLA cross-check).  None for the mesh
+    configs: XLA's ``memory_analysis()`` semantics on SPMD executables
+    depend on the partition count (per-partition vs aggregate differs
+    across backends/partitionings), so the compiler cross-check is
+    restricted to unpartitioned programs — the mesh configs are bounded
+    by their declared curves and the per-shard body sweep instead."""
+    geom = geometry or TRACE_GEOMETRY
+    if name in ("serial", "wave"):
+        return _serial_builder(geom, name == "wave")(0)
+    if name == "multitrain":
+        return _multitrain_builder(geom)(0)
+    if name == "serve":
+        import numpy as np
+        from ..models.tree import SHAPE_BUCKETS, predict_raw_ensemble
+        per_class, kinds = _mk_serve_ensemble(geom)
+        X = np.zeros((max(SHAPE_BUCKETS), geom.features), np.float32)
+        return (lambda Xa, pc: predict_raw_ensemble(Xa, pc, kinds),
+                (X, per_class))
+    return None
+
+
+def environment_info(nshards: int = 0) -> Dict[str, Any]:
+    """The jax/device environment a lint report was produced under —
+    reports from an 8-virtual-device CPU env must be distinguishable
+    from real-chip runs."""
+    import os
+
+    import jax
+    info: Dict[str, Any] = {"jax_version": jax.__version__}
+    try:
+        import jaxlib
+        info["jaxlib_version"] = jaxlib.__version__
+    except Exception:
+        pass
+    try:
+        devs = jax.devices()
+        info["backend"] = devs[0].platform
+        info["device_count"] = len(devs)
+        info["device_kind"] = getattr(devs[0], "device_kind", "")
+        info["process_count"] = jax.process_count()
+    except Exception as exc:
+        info["backend"] = f"unavailable ({exc})"
+        info["device_count"] = 0
+    flags = os.environ.get("XLA_FLAGS", "")
+    forced = "xla_force_host_platform_device_count" in flags
+    try:
+        forced = forced or int(getattr(jax.config, "jax_num_cpu_devices",
+                                       0) or 0) > 1
+    except Exception:
+        pass
+    info["virtual_devices"] = bool(info.get("backend") == "cpu" and forced)
+    if nshards:
+        info["requested_devices"] = nshards
+        info["abstract_mesh"] = nshards > info.get("device_count", 0)
+    return info
 
 
 def _donation_unit() -> TraceUnit:
@@ -315,7 +442,7 @@ def run_lint(configs: Optional[Sequence[str]] = None,
             "trace_seconds": round(time.perf_counter() - t0, 3),
         }
     units.append(_donation_unit())
-    violations = run_rules(units)
+    violations = run_rules(units, rules=ALL_RULES)
     by_cfg: Dict[str, List[Violation]] = {}
     for v in violations:
         by_cfg.setdefault(v.config, []).append(v)
@@ -331,12 +458,30 @@ def run_lint(configs: Optional[Sequence[str]] = None,
         "schema": "trace-lint-v1",
         "ok": not violations,
         "num_violations": len(violations),
-        "rules": [r.name for r in DEFAULT_RULES],
+        "environment": environment_info(nshards),
+        "rules": [r.name for r in ALL_RULES],
         "contracts": {site: {"ops": list(c.ops),
                              "declared_in": c.declared_in}
                       for site, c in sorted(all_contracts().items())},
         "configs": report_cfgs,
     }
+
+
+def parse_kv_args(argv: Sequence[str]) -> Dict[str, str]:
+    """The lint verbs' shared ``key=value`` CLI grammar: optional
+    leading ``--``, ``-`` normalized to ``_`` in keys (``hbm-gb=`` and
+    ``hbm_gb=`` both work), non-``=`` tokens ignored.  One parser for
+    ``lint-trace`` and ``lint-mem`` so flag spelling cannot drift
+    between the verbs."""
+    out: Dict[str, str] = {}
+    for arg in argv:
+        if arg.startswith("--"):
+            arg = arg[2:]
+        if "=" not in arg:
+            continue
+        key, value = arg.split("=", 1)
+        out[key.strip().replace("-", "_")] = value.strip()
+    return out
 
 
 def main(argv: Sequence[str]) -> int:
@@ -348,17 +493,11 @@ def main(argv: Sequence[str]) -> int:
     configs: Optional[List[str]] = None
     out_path = ""
     nshards = 8
-    for arg in argv:
-        if arg.startswith("--"):
-            arg = arg[2:]
-        if "=" not in arg:
-            continue
-        key, value = arg.split("=", 1)
-        key = key.strip()
+    for key, value in parse_kv_args(argv).items():
         if key in ("configs", "config"):
             configs = [c.strip() for c in value.split(",") if c.strip()]
         elif key in ("out", "json", "json_out"):
-            out_path = value.strip()
+            out_path = value
         elif key in ("devices", "nshards"):
             nshards = int(value)
     t0 = time.perf_counter()

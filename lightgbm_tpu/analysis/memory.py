@@ -1,0 +1,540 @@
+"""``lint-mem``: static peak-memory estimation + declared HBM/VMEM curves.
+
+ROADMAP items 1 (pod-scale) and 2 (out-of-core) both stall on a question
+no test answers statically: *will this traced program fit in HBM at 10^8
+rows on W hosts?*  The reference framework answers it by construction —
+its histogram pool and ``pipeline_reader.h`` bound the working set
+(PAPER.md layers 0/3).  Here the same property is recovered by analysis:
+
+* :func:`estimate_memory` runs a **live-range sweep** over a traced
+  jaxpr: walk equations in program order, a buffer becomes live when the
+  eqn that binds it runs and dies after its last use; the peak of the
+  live set (inputs + consts + intermediates) is the HBM estimate.  The
+  sweep descends pjit/scan/while/cond/shard_map sub-jaxprs, counting a
+  nested body's interior peak (beyond its boundary buffers, which alias
+  the call site's operands) as a transient at the call site.
+
+* **Per-device sizing**: a ``shard_map`` body is traced at per-shard
+  block avals — ``P(ax)`` operands arrive as global/k slices, ``P()``
+  operands at full (replicated) size — so the body sweep IS the
+  per-device estimate on mesh programs; device residency is decided
+  inside the body, and the boundary buffers outside it are the same
+  arrays the body counts at their sharded size.  Programs with no mesh
+  report their global sweep.
+
+* ``pallas_call`` equations stay opaque for the HBM sweep (their blocks
+  live in VMEM, not HBM) and instead feed the **VMEM estimate**: the sum
+  of a kernel's VMEM-resident block avals, checked against the ~16
+  MB/core ceiling (pallas guide: HBM -> VMEM -> compute units).
+
+* Where the backend reports one, the estimate is cross-checked against
+  XLA's own ``lower().compile().memory_analysis()`` (argument + output +
+  temp bytes) — the estimator must stay within 2x of the compiler's
+  number or the lint fails, so the static answer cannot silently drift
+  from what XLA actually allocates.
+
+Budgets are :class:`~.contracts.MemoryBudget` curves declared next to
+the code they constrain (``learner/wave.py``, ``parallel/
+data_parallel.py``, ``serve/predictor.py``, ``multitrain/batched.py``)
+as functions of (rows, features, bins, wave_size, leaves, world_size,
+models) — ``lint-mem rows=1e8 devices=64`` evaluates the same
+declarations at pod scale and answers the fit question for meshes no CI
+host can run.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterator, List, NamedTuple, Optional, \
+    Sequence, Tuple
+
+from . import ir
+from .contracts import (all_memory_budgets, memory_budget_for,
+                        resolve_limit, world_size)
+from .rules import Rule, TraceUnit, Violation
+
+__all__ = ["BufferInfo", "MemoryEstimate", "estimate_memory",
+           "kernel_vmem_bytes", "MemoryBudgetRule", "VMEM_BYTES_PER_CORE",
+           "DEFAULT_HBM_GB", "xla_memory_analysis", "run_lint_mem", "main"]
+
+# TPU memory-hierarchy constants (pallas guide "Memory Hierarchy" table:
+# HBM = GBs off-chip, VMEM ~16 MB/core on-chip).  Overridable per ctx
+# ("vmem_limit") and per CLI run (hbm-gb=) for other parts.
+VMEM_BYTES_PER_CORE = 16 * 1024 * 1024
+DEFAULT_HBM_GB = 16.0          # v5e-class part; hbm-gb= overrides
+
+# Primitives whose sub-jaxpr buffers do NOT occupy HBM as jax arrays —
+# pallas kernel bodies run out of VMEM/SMEM blocks and scratch.
+_VMEM_BODY_PRIMS = ("pallas_call",)
+
+
+class BufferInfo(NamedTuple):
+    """One live buffer at the peak instant, for diagnostics."""
+
+    what: str
+    bytes: int
+    aval: str
+    path: Tuple[str, ...]
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"what": self.what, "bytes": self.bytes, "aval": self.aval,
+                "path": "/".join(self.path) or "<top>"}
+
+
+class MemoryEstimate:
+    """Result of one program sweep.
+
+    ``peak_bytes`` — whole-program (global-aval) peak;
+    ``peak_bytes_per_device`` — the per-shard peak: the largest
+    shard_map body sweep on mesh programs (body avals are per-shard
+    block shapes), the global sweep otherwise.  ``top_buffers`` are the
+    largest buffers live at that peak, for site-named diagnostics.
+    ``vmem_kernels`` maps each pallas_call site to the VMEM bytes of
+    its kernel blocks."""
+
+    def __init__(self) -> None:
+        self.peak_bytes = 0
+        self.peak_bytes_per_device = 0
+        self.args_bytes = 0
+        self.consts_bytes = 0
+        self.top_buffers: List[BufferInfo] = []
+        self.vmem_kernels: Dict[str, int] = {}
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "peak_bytes": self.peak_bytes,
+            "peak_bytes_per_device": self.peak_bytes_per_device,
+            "args_bytes": self.args_bytes,
+            "consts_bytes": self.consts_bytes,
+            "top_buffers": [b.to_json() for b in self.top_buffers[:5]],
+            "vmem_kernels": dict(self.vmem_kernels),
+        }
+
+
+def _aval_bytes(var: Any) -> int:
+    aval = getattr(var, "aval", None)
+    if aval is None or not hasattr(aval, "shape"):
+        return 0
+    size = 1
+    for d in aval.shape:
+        size *= int(d)
+    dt = getattr(aval, "dtype", None)
+    return size * int(getattr(dt, "itemsize", 4) or 4)
+
+
+def _aval_str(var: Any) -> str:
+    aval = getattr(var, "aval", None)
+    if aval is None:
+        return "?"
+    return f"{getattr(aval, 'dtype', '?')}{tuple(getattr(aval, 'shape', ()))}"
+
+
+def kernel_vmem_bytes(eqn: Any) -> int:
+    """VMEM-resident bytes of one pallas_call: the sum of kernel-body
+    ref avals placed in VMEM (HBM/ANY-space refs are DMA'd manually by
+    the kernel and excluded; unspecified spaces count, conservatively)."""
+    kjaxpr = eqn.params.get("jaxpr")
+    if kjaxpr is None:
+        return 0
+    total = 0
+    for v in tuple(getattr(kjaxpr, "invars", ())) + \
+            tuple(getattr(kjaxpr, "outvars", ())):
+        aval = getattr(v, "aval", None)
+        space = str(getattr(aval, "memory_space", "") or "").lower()
+        if "hbm" in space or "any" in space:
+            continue
+        inner = getattr(aval, "inner_aval", aval)  # MemRef wraps the array
+        size = 1
+        for d in getattr(inner, "shape", ()):
+            size *= int(d)
+        dt = getattr(inner, "dtype", None)
+        total += size * int(getattr(dt, "itemsize", 4) or 4)
+    return total
+
+
+def _sub_jaxprs_of(eqn: Any) -> Iterator[Any]:
+    for val in eqn.params.values():
+        yield from ir.subjaxprs(val)
+
+
+def _is_literal(var: Any) -> bool:
+    return type(var).__name__ == "Literal"
+
+
+def _sweep(jaxpr_like: Any, path: Tuple[str, ...],
+           est: MemoryEstimate) -> Tuple[int, List[BufferInfo]]:
+    """Live-range sweep of one (sub-)jaxpr.
+
+    Returns ``(peak_bytes, buffers_at_peak)``; peak includes the
+    jaxpr's own inputs + consts (boundary buffers — callers descending
+    a sub-jaxpr subtract them, since they alias the call operands)."""
+    jaxpr = jaxpr_like.jaxpr if hasattr(jaxpr_like, "jaxpr") else jaxpr_like
+
+    live: Dict[int, BufferInfo] = {}
+    live_bytes = 0
+
+    def _add(var: Any, what: str) -> None:
+        nonlocal live_bytes
+        b = _aval_bytes(var)
+        if b <= 0 or id(var) in live:
+            return
+        live[id(var)] = BufferInfo(what, b, _aval_str(var), path)
+        live_bytes += b
+
+    def _drop(var: Any) -> None:
+        nonlocal live_bytes
+        info = live.pop(id(var), None)
+        if info is not None:
+            live_bytes -= info.bytes
+
+    # last-use index per var (jaxpr outvars live to the end)
+    last_use: Dict[int, int] = {}
+    eqns = list(jaxpr.eqns)
+    n_eqns = len(eqns)
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if not _is_literal(v):
+                last_use[id(v)] = i
+    for v in jaxpr.outvars:
+        if not _is_literal(v):
+            last_use[id(v)] = n_eqns
+
+    for v in jaxpr.invars:
+        _add(v, "arg")
+    for cv in jaxpr.constvars:
+        _add(cv, "const")
+    # a var with no last_use entry (unused arg/const) defaults to n_eqns
+    # in the _drop check below, i.e. it stays live to the end
+    peak = live_bytes
+    peak_buffers = list(live.values())
+
+    for i, eqn in enumerate(eqns):
+        prim = eqn.primitive.name
+        sub_path = path + (prim,)
+        transient = 0
+        if prim in _VMEM_BODY_PRIMS:
+            # kernel blocks live in VMEM, not HBM: record for the VMEM
+            # check; the HBM sweep sees only the eqn's in/out HBM avals
+            key = f"{'/'.join(sub_path)}#{len(est.vmem_kernels)}"
+            est.vmem_kernels[key] = kernel_vmem_bytes(eqn)
+        else:
+            for sub in _sub_jaxprs_of(eqn):
+                sub_peak, _ = _sweep(sub, sub_path, est)
+                sj = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                boundary = sum(_aval_bytes(v) for v in sj.invars) + \
+                    sum(_aval_bytes(v) for v in sj.outvars)
+                transient = max(transient, max(0, sub_peak - boundary))
+
+        out_bytes = sum(_aval_bytes(v) for v in eqn.outvars)
+        candidate = live_bytes + out_bytes + transient
+        if candidate > peak:
+            peak = candidate
+            peak_buffers = list(live.values())
+            for v in eqn.outvars:
+                if _aval_bytes(v) > 0:
+                    peak_buffers.append(BufferInfo(
+                        f"out:{prim}", _aval_bytes(v), _aval_str(v),
+                        sub_path))
+            if transient > 0:
+                peak_buffers.append(BufferInfo(
+                    f"transient:{prim}", transient, "(sub-jaxpr interior)",
+                    sub_path))
+        for v in eqn.outvars:
+            _add(v, f"out:{prim}")
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if not _is_literal(v) and last_use.get(id(v), n_eqns) <= i:
+                _drop(v)
+
+    return peak, peak_buffers
+
+
+def estimate_memory(jaxpr_like: Any) -> MemoryEstimate:
+    """Static peak-live-buffer estimate of one traced program."""
+    est = MemoryEstimate()
+    jaxpr = jaxpr_like.jaxpr if hasattr(jaxpr_like, "jaxpr") else jaxpr_like
+    est.args_bytes = sum(_aval_bytes(v) for v in jaxpr.invars)
+    consts = getattr(jaxpr_like, "consts", None) or ()
+    est.consts_bytes = sum(
+        int(getattr(c, "nbytes", 0) or 0) for c in consts)
+    est.peak_bytes, buffers = _sweep(jaxpr_like, (), est)
+    # per-device: the largest shard_map body sweep (per-shard avals) on
+    # mesh programs; the global sweep when no mesh is involved
+    body_peak = 0
+    body_buffers: List[BufferInfo] = []
+    for info in ir.iter_eqns(jaxpr_like):
+        if info.prim == "shard_map":
+            for sub in _sub_jaxprs_of(info.eqn):
+                p, bufs = _sweep(sub, info.path + ("shard_map",),
+                                 MemoryEstimate())
+                if p > body_peak:
+                    body_peak, body_buffers = p, bufs
+    if body_peak > 0:
+        est.peak_bytes_per_device = body_peak
+        est.top_buffers = sorted(body_buffers, key=lambda b: -b.bytes)[:8]
+    else:
+        est.peak_bytes_per_device = est.peak_bytes
+        est.top_buffers = sorted(buffers, key=lambda b: -b.bytes)[:8]
+    return est
+
+
+# ---------------------------------------------------------------------------
+# the rule
+# ---------------------------------------------------------------------------
+
+class MemoryBudgetRule(Rule):
+    """Estimated per-device peak must stay under the declared HBM curve;
+    every pallas kernel's VMEM blocks under the per-core ceiling; the
+    estimate must track XLA's memory_analysis within 2x where reported.
+
+    The unit's ctx carries the geometry the curve is evaluated at (rows,
+    features, bins, wave_size, leaves, world_size, models) — the same
+    dict ``lint-mem rows= devices=`` scales for the fit question.  A
+    config with no declared budget is itself a violation: a new traced
+    program family cannot land without a memory contract."""
+
+    name = "memory-budget"
+
+    def check(self, unit: TraceUnit) -> List[Violation]:
+        if unit.jaxpr is None or not unit.ctx.get("check_memory", False):
+            return []
+        est: MemoryEstimate = unit.ctx.get("memory_estimate") \
+            or estimate_memory(unit.jaxpr)
+        out: List[Violation] = []
+        budget = memory_budget_for(unit.name)
+        if budget is None:
+            out.append(self._v(
+                unit, "<program>",
+                f"config '{unit.name}' has no declared MemoryBudget; "
+                f"declare one with analysis.contracts.memory_budget next "
+                f"to the code that owns this program's footprint"))
+            return out
+        limit = resolve_limit(budget.hbm_per_device, unit.ctx)
+        if limit is not None and est.peak_bytes_per_device > limit:
+            top = ", ".join(
+                f"{b.what} {b.aval} ({b.bytes >> 10} KiB) at "
+                f"{'/'.join(b.path) or '<top>'}"
+                for b in est.top_buffers[:3])
+            out.append(self._v(
+                unit, budget.name,
+                f"estimated per-device peak {est.peak_bytes_per_device} B "
+                f"exceeds the '{budget.name}' HBM budget {limit} B "
+                f"({budget.declared_in}) at "
+                f"rows={unit.ctx.get('rows')}, W={world_size(unit.ctx)}; "
+                f"largest live buffers: {top}"))
+        vmem_limit = resolve_limit(budget.vmem_per_kernel, unit.ctx)
+        if vmem_limit is None:
+            vmem_limit = int(unit.ctx.get("vmem_limit",
+                                          VMEM_BYTES_PER_CORE))
+        for kname, kbytes in est.vmem_kernels.items():
+            if kbytes > vmem_limit:
+                out.append(self._v(
+                    unit, kname,
+                    f"pallas kernel at {kname} holds {kbytes} B of VMEM "
+                    f"blocks (> {vmem_limit} B per-core ceiling); shrink "
+                    f"the block specs or stream via HBM refs + DMA"))
+        xla = unit.ctx.get("xla_memory")
+        if xla:
+            total = int(xla.get("total_bytes", 0))
+            if total > 0:
+                ratio = est.peak_bytes_per_device / total
+                lo, hi = unit.ctx.get("xla_ratio_bounds", (0.5, 2.0))
+                if not (lo <= ratio <= hi):
+                    out.append(self._v(
+                        unit, "<xla-crosscheck>",
+                        f"static estimate {est.peak_bytes_per_device} B is "
+                        f"{ratio:.2f}x XLA memory_analysis() "
+                        f"({total} B = args {xla.get('argument_bytes')} + "
+                        f"out {xla.get('output_bytes')} + temp "
+                        f"{xla.get('temp_bytes')}); the estimator has "
+                        f"drifted outside [{lo}, {hi}]x of the compiler"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# XLA cross-check
+# ---------------------------------------------------------------------------
+
+def xla_memory_analysis(fn: Any, args: tuple) -> Optional[Dict[str, int]]:
+    """Compile ``fn`` (an unpartitioned program — see
+    :func:`..lint.build_callable`) and read the backend's memory
+    analysis, or None when the backend does not report one (some plugin
+    backends)."""
+    import jax
+    try:
+        stats = jax.jit(lambda *a: fn(*a)).lower(*args).compile() \
+            .memory_analysis()
+    except Exception:
+        return None
+    if stats is None:
+        return None
+    try:
+        arg_b = int(stats.argument_size_in_bytes)
+        out_b = int(stats.output_size_in_bytes)
+        tmp_b = int(stats.temp_size_in_bytes)
+        alias_b = int(getattr(stats, "alias_size_in_bytes", 0))
+    except Exception:
+        return None
+    return {"argument_bytes": arg_b, "output_bytes": out_b,
+            "temp_bytes": tmp_b, "alias_bytes": alias_b,
+            "total_bytes": arg_b + out_b + tmp_b}
+
+
+# ---------------------------------------------------------------------------
+# the lint-mem driver
+# ---------------------------------------------------------------------------
+
+def _fit_report(fit_ctx: Dict[str, Any], hbm_gb: float) -> Dict[str, Any]:
+    """Evaluate every declared HBM curve at a scaled ctx — the static
+    answer to "will rows=R fit at W devices?"."""
+    hbm_bytes = int(hbm_gb * (1 << 30))
+    out: Dict[str, Any] = {"ctx": {k: v for k, v in sorted(fit_ctx.items())},
+                           "hbm_gb_per_device": hbm_gb, "budgets": {}}
+    for name, b in sorted(all_memory_budgets().items()):
+        try:
+            need = resolve_limit(b.hbm_per_device, fit_ctx)
+        except Exception as exc:
+            out["budgets"][name] = {"error": str(exc)}
+            continue
+        if need is None:
+            continue
+        out["budgets"][name] = {
+            "hbm_bytes_per_device": need,
+            "fits": bool(need <= hbm_bytes),
+            "fraction_of_hbm": round(need / hbm_bytes, 4),
+            "declared_in": b.declared_in,
+        }
+    # an errored curve was NOT evaluated — it must fail the verdict, not
+    # silently count as fitting (the whole point of the fit question)
+    out["all_fit"] = all(v.get("fits", False)
+                         for v in out["budgets"].values())
+    return out
+
+
+def run_lint_mem(configs: Optional[Sequence[str]] = None, nshards: int = 8,
+                 crosscheck: bool = True,
+                 fit_ctx: Optional[Dict[str, Any]] = None,
+                 hbm_gb: float = DEFAULT_HBM_GB) -> Dict[str, Any]:
+    """Trace the matrix at memory-lint geometry, estimate, check the
+    declared curves, cross-check XLA, and answer the fit question."""
+    from . import lint
+
+    # budgets register at module import; pull in every declaring module
+    # so the check is import-order independent (learner/wave.py and
+    # parallel/data_parallel.py load via the trace builders anyway)
+    from ..learner import wave  # noqa: F401
+    from ..multitrain import batched  # noqa: F401
+    from ..parallel import data_parallel  # noqa: F401
+    from ..serve import predictor  # noqa: F401
+    configs = tuple(configs) if configs else lint.MATRIX_CONFIGS
+    geometry = lint.MEM_GEOMETRY
+    report: Dict[str, Any] = {
+        "schema": "lint-mem-v1",
+        "environment": lint.environment_info(nshards),
+        "configs": {},
+    }
+    violations: List[Violation] = []
+    rule = MemoryBudgetRule()
+    for name in configs:
+        t0 = time.perf_counter()
+        unit = lint.build_unit(name, nshards=nshards, geometry=geometry)
+        est = estimate_memory(unit.jaxpr)
+        unit.ctx["check_memory"] = True
+        unit.ctx["memory_estimate"] = est
+        entry: Dict[str, Any] = {"estimate": est.to_json()}
+        budget = memory_budget_for(name)
+        if budget is not None:
+            entry["budget"] = {
+                "name": budget.name,
+                "hbm_per_device":
+                    resolve_limit(budget.hbm_per_device, unit.ctx),
+                "declared_in": budget.declared_in,
+            }
+        if crosscheck:
+            fn_args = lint.build_callable(name, nshards=nshards,
+                                          geometry=geometry)
+            if fn_args is not None:
+                fn, args = fn_args
+                xla = xla_memory_analysis(fn, args)
+                if xla is not None:
+                    unit.ctx["xla_memory"] = xla
+                    entry["xla_memory"] = xla
+                    entry["estimate_over_xla"] = round(
+                        est.peak_bytes_per_device /
+                        max(1, xla["total_bytes"]), 3)
+        vs = rule.check(unit)
+        violations.extend(vs)
+        entry["ok"] = not vs
+        entry["violations"] = [v.to_json() for v in vs]
+        entry["seconds"] = round(time.perf_counter() - t0, 3)
+        report["configs"][name] = entry
+    if fit_ctx is not None:
+        report["fit"] = _fit_report(fit_ctx, hbm_gb)
+    report["ok"] = not violations
+    report["num_violations"] = len(violations)
+    return report
+
+
+def main(argv: Sequence[str]) -> int:
+    """``python -m lightgbm_tpu lint-mem [configs=a,b] [out=report.json]
+    [devices=8] [rows=1e8] [features=28] [bins=255] [hbm-gb=16]
+    [crosscheck=1]``
+
+    Without ``rows=``, checks the traced matrix against the declared
+    curves (+ XLA cross-check) and exits nonzero on violation.  With
+    ``rows=`` (and usually ``devices=``), additionally evaluates every
+    declared HBM curve at that scale and prints the fit verdict — the
+    static "will 10^8 rows fit at W=64?" answer."""
+    import json
+
+    from .lint import parse_kv_args
+
+    configs: Optional[List[str]] = None
+    out_path = ""
+    nshards = 8
+    crosscheck = True
+    hbm_gb = DEFAULT_HBM_GB
+    fit: Dict[str, int] = {}
+    for key, value in parse_kv_args(argv).items():
+        if key in ("configs", "config"):
+            configs = [c.strip() for c in value.split(",") if c.strip()]
+        elif key in ("out", "json", "json_out"):
+            out_path = value
+        elif key in ("devices", "nshards", "world_size"):
+            nshards = int(float(value))
+        elif key == "crosscheck":
+            crosscheck = value.lower() not in ("0", "false", "no", "off")
+        elif key == "hbm_gb":
+            hbm_gb = float(value)
+        elif key in ("rows", "features", "bins", "leaves", "wave_size",
+                     "models", "itemsize", "bucket"):
+            fit[key] = int(float(value))
+    fit_ctx: Optional[Dict[str, Any]] = None
+    if fit:
+        fit_ctx = {
+            "rows": fit.get("rows", 10 ** 8),
+            "features": fit.get("features", 28),
+            "bins": fit.get("bins", 255),
+            "leaves": fit.get("leaves", 255),
+            "wave_size": fit.get("wave_size", 42),
+            "models": fit.get("models", 64),
+            "itemsize": fit.get("itemsize", 4),
+            "bucket": fit.get("bucket", 4096),
+            "world_size": nshards,
+            "nshards": nshards,
+        }
+    t0 = time.perf_counter()
+    from .lint import _ensure_devices
+    _ensure_devices(nshards)
+    report = run_lint_mem(configs, nshards=nshards, crosscheck=crosscheck,
+                          fit_ctx=fit_ctx, hbm_gb=hbm_gb)
+    report["elapsed_seconds"] = round(time.perf_counter() - t0, 3)
+    text = json.dumps(report, indent=2, sort_keys=False)
+    print(text)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(text + "\n")
+    if not report["ok"]:
+        from ..utils.log import log_warning
+        log_warning(f"lint-mem: {report['num_violations']} memory-contract "
+                    f"violation(s)")
+    return 0 if report["ok"] else 1

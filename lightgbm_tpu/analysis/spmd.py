@@ -1,0 +1,197 @@
+"""SPMD-safety lint: collective-order deadlock detection + sharding rules.
+
+A pod-scale program is SPMD: every shard runs the SAME traced program,
+and every collective is a rendezvous — all ranks must issue the same
+collective sequence (same primitive, same mesh axes, same wire shape) or
+rank 7 hangs forever inside an all-reduce the other ranks never enter.
+The worker-kill chaos test (tests/test_multiprocess.py) catches this
+class dynamically on a 2-process runtime; this module catches it
+statically, on every traced program in the lint matrix:
+
+* :func:`collective_trace` — the ordered collective sequence of a
+  program per mesh axis: ``(primitive, axes, shape, dtype)`` tuples in
+  program order, descending every sub-jaxpr.
+
+* :class:`CollectiveOrderRule` — every conditional arm (``cond``
+  branches, anywhere in the program, including donated/serve branches)
+  must issue an IDENTICAL collective sequence.  A collective inside one
+  arm of a cond is the canonical static deadlock: shards that take the
+  other arm never reach the rendezvous.  (``while`` bodies are exempt:
+  they are shared by all ranks, and their trip counts are data-uniform
+  on the growers — the dynamic half the chaos suite owns.)
+
+* :class:`ShardingConsistencyRule` — every ``shard_map`` must run over
+  the DECLARED mesh axes (``ctx['mesh_axes']``), its in/out specs may
+  reference only those axes, and every collective inside its body must
+  name an axis the enclosing mesh binds.  A spec naming a stale or
+  misspelled axis silently replicates the operand (k-times the memory
+  and wire traffic) before it deadlocks anything.
+
+Both rules ride the PR-10 walker (:mod:`.ir`) and join the lint-trace
+matrix (:mod:`.lint`), so the pod path is machine-checked at W=4, W=8
+and (trace-only, via AbstractMesh) W=64 — per ROADMAP item 1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Tuple
+
+from . import ir
+from .rules import Rule, TraceUnit, Violation
+
+__all__ = ["CollectiveOp", "collective_trace", "branch_signatures",
+           "CollectiveOrderRule", "ShardingConsistencyRule", "SPMD_RULES"]
+
+
+class CollectiveOp(NamedTuple):
+    """(primitive, axes, operand shape, dtype) — one wire rendezvous."""
+
+    prim: str
+    axes: str
+    shape: Tuple[int, ...]
+    dtype: str
+
+    def __str__(self) -> str:
+        return f"{self.prim}[{self.axes}]{self.dtype}{self.shape}"
+
+
+def _eqn_axes(eqn: Any) -> str:
+    """The mesh axes a collective eqn synchronizes over, normalized to a
+    stable string (psum/pmax/pmin carry ``axes``; ppermute/all_gather
+    spell it ``axis_name``)."""
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if isinstance(axes, (list, tuple)):
+        return ",".join(str(a) for a in axes)
+    return str(axes)
+
+
+def _wire_sig(eqn: Any) -> CollectiveOp:
+    shape: Tuple[int, ...] = ()
+    dtype = ""
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "shape"):
+            shape = tuple(int(d) for d in aval.shape)
+            dtype = str(getattr(aval, "dtype", ""))
+            break
+    return CollectiveOp(eqn.primitive.name, _eqn_axes(eqn), shape, dtype)
+
+
+def collective_trace(jaxpr_like: Any) -> List[CollectiveOp]:
+    """Ordered collective sequence of a program (depth-first program
+    order, every sub-jaxpr descended) — the rendezvous schedule all
+    shards must agree on."""
+    return [_wire_sig(info.eqn) for info in ir.iter_eqns(jaxpr_like)
+            if ir.is_collective(info.prim)]
+
+
+def branch_signatures(eqn: Any) -> List[List[CollectiveOp]]:
+    """Per-branch collective sequences of one ``cond`` eqn."""
+    branches = eqn.params.get("branches", ())
+    return [collective_trace(b) for b in branches]
+
+
+class CollectiveOrderRule(Rule):
+    """All arms of every conditional must issue identical collective
+    sequences — the static form of the cross-host deadlock."""
+
+    name = "collective-order"
+
+    def check(self, unit: TraceUnit) -> List[Violation]:
+        if unit.jaxpr is None:
+            return []
+        out: List[Violation] = []
+        for info in ir.iter_eqns(unit.jaxpr):
+            if info.prim != "cond":
+                continue
+            sigs = branch_signatures(info.eqn)
+            if len(sigs) < 2 or all(s == sigs[0] for s in sigs[1:]):
+                continue
+            where = "/".join(info.path + ("cond",))
+            rendered = "; ".join(
+                f"arm {i}: [{', '.join(map(str, s)) or 'none'}]"
+                for i, s in enumerate(sigs))
+            out.append(self._v(
+                unit, where,
+                f"conditional arms at {where} issue DIVERGENT collective "
+                f"sequences ({rendered}): shards taking different arms "
+                f"rendezvous on different schedules — rank-level deadlock "
+                f"on a real mesh; hoist the collective out of the cond or "
+                f"issue it identically in every arm"))
+        return out
+
+
+def _spec_axes(names: Any) -> List[str]:
+    """Mesh axes one shard_map in/out names dict references."""
+    out: List[str] = []
+    if isinstance(names, dict):
+        for axes in names.values():
+            for ax in (axes if isinstance(axes, (list, tuple)) else (axes,)):
+                out.append(str(ax))
+    return out
+
+
+def _mesh_axes(eqn: Any) -> Tuple[str, ...]:
+    mesh = eqn.params.get("mesh")
+    try:
+        return tuple(str(a) for a in mesh.axis_names)
+    except Exception:
+        return ()
+
+
+class ShardingConsistencyRule(Rule):
+    """shard_map meshes/specs must match the declared mesh axes, and
+    body collectives must use axes the mesh binds."""
+
+    name = "sharding-consistency"
+
+    def check(self, unit: TraceUnit) -> List[Violation]:
+        if unit.jaxpr is None:
+            return []
+        declared = tuple(unit.ctx.get("mesh_axes", ()))
+        out: List[Violation] = []
+        for info in ir.iter_eqns(unit.jaxpr):
+            if info.prim != "shard_map":
+                continue
+            where = "/".join(info.path + ("shard_map",)) or "shard_map"
+            mesh_axes = _mesh_axes(info.eqn)
+            if declared and tuple(mesh_axes) != declared:
+                out.append(self._v(
+                    unit, where,
+                    f"shard_map at {where} runs over mesh axes "
+                    f"{mesh_axes} but this config declares "
+                    f"{declared}: a stray mesh axis means the program "
+                    f"is sharded over a mesh the launcher never built"))
+            bound = set(mesh_axes)
+            for kind, all_names in (("in", info.eqn.params.get("in_names",
+                                                               ())),
+                                    ("out", info.eqn.params.get("out_names",
+                                                                ()))):
+                for idx, names in enumerate(all_names):
+                    bad = [a for a in _spec_axes(names) if a not in bound]
+                    if bad:
+                        out.append(self._v(
+                            unit, where,
+                            f"shard_map at {where} {kind}_specs[{idx}] "
+                            f"references axis(es) {bad} the mesh "
+                            f"{mesh_axes} does not bind — the operand "
+                            f"silently replicates instead of sharding"))
+            # body collectives must rendezvous over bound axes
+            body = info.eqn.params.get("jaxpr")
+            if body is not None and bound:
+                for binfo in ir.iter_eqns(body):
+                    if not ir.is_collective(binfo.prim):
+                        continue
+                    axes = [a for a in _eqn_axes(binfo.eqn).split(",") if a]
+                    bad = [a for a in axes if a not in bound]
+                    if bad:
+                        out.append(self._v(
+                            unit, where,
+                            f"collective '{binfo.prim}' inside the "
+                            f"shard_map body at {where} names axis(es) "
+                            f"{bad} outside the mesh {mesh_axes}"))
+        return out
+
+
+SPMD_RULES: Tuple[Rule, ...] = (CollectiveOrderRule(),
+                                ShardingConsistencyRule())

@@ -296,6 +296,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # nonzero on violations (the blocking CI step)
         from .analysis.lint import main as lint_main
         return lint_main(argv[1:])
+    if argv and argv[0] in ("lint-mem", "lint_mem"):
+        # memory-lint verb: trace the same matrix at memory geometry,
+        # estimate per-device peak HBM + per-kernel VMEM, check the
+        # declared MemoryBudget curves (cross-checked against XLA's
+        # memory_analysis where the backend reports one); with rows=/
+        # devices= also answers "will it fit at that scale?" statically
+        from .analysis.memory import main as lint_mem_main
+        return lint_mem_main(argv[1:])
     params = parse_cli_args(argv)
     cfg = Config(params)
     task = cfg.task

@@ -53,7 +53,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..analysis.contracts import collective_contract
+from ..analysis.contracts import collective_contract, memory_budget
 from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
 from ..ops.histogram import build_histogram_leaves, histogram_subtract
 from ..ops.quantize import dequant_scales, quantize_wch
@@ -133,8 +133,11 @@ def _hist_batch_bytes(ctx):
 def _hist_slice_bytes(ctx):
     """Feature-sliced reduce-scatter payload: each shard RECEIVES only
     its ceil(F/k) feature block of the merged batch — the 1/k budget
-    the round-8 optimisation claims (PERF.md)."""
-    k = max(1, int(ctx.get("nshards", 1)))
+    the round-8 optimisation claims (PERF.md).  ``k`` is the mesh world
+    size, so the same declaration checks W=4, W=8 and the trace-only
+    W=64 pod mesh."""
+    from ..analysis.contracts import world_size
+    k = world_size(ctx)
     f_blk = -(-int(ctx["features"]) // k)
     return (int(ctx.get("wave_size", WAVE_SIZE)) * f_blk *
             int(ctx["bins"]) * 3 * int(ctx.get("itemsize", 4)))
@@ -170,6 +173,39 @@ collective_contract(
     "data_parallel/wave/quant_scale", "pmax",
     max_count=2, max_bytes_per_op=8,
     note="global gradient/hessian quantization scales (two scalars)")
+
+
+# ---------------------------------------------------------------------------
+# Memory budget for the wave grower program family (lint-mem enforced).
+# The footprint is histogram-channel dominated: the per-leaf bank
+# (L,F,B,3), the kernel's channel batch (the quantized kernel always
+# builds Q_WAVE_SIZE=42 channels, the f32 one 2*wave trial channels) and
+# the wave loop's subtraction/scan temporaries — measured ~5 channel
+# layers of working set per batch layer at the lint geometry; the curve
+# budgets 6 for headroom.  Row arrays: bins (F,N) uint8 + grad/hess/
+# mask/row_leaf/quantized lanes, ~24 B/row beyond the bin matrix.
+# ---------------------------------------------------------------------------
+
+def wave_grow_hbm_bytes(ctx):
+    """Per-device HBM curve of one wave-grower tree program, as a
+    function of (rows, features, bins, wave_size, leaves, world_size) —
+    the statically answerable half of "will 10^8 rows fit at W=64?"."""
+    from ..analysis.contracts import world_size
+    f = int(ctx["features"])
+    b = int(ctx["bins"])
+    it = int(ctx.get("itemsize", 4))
+    r = -(-int(ctx["rows"]) // world_size(ctx))
+    wave = int(ctx.get("wave_size", WAVE_SIZE))
+    kernel_ch = Q_WAVE_SIZE if ctx.get("quantized") else WAVE_SIZE
+    layers = int(ctx.get("leaves", 2)) + 6 * max(2 * wave, kernel_ch)
+    hist = layers * f * b * 3 * it
+    rows = r * (f + 24)
+    return hist + rows + (1 << 20)
+
+
+memory_budget(
+    "wave/grow", ("serial", "wave"), wave_grow_hbm_bytes,
+    note="per-leaf bank + 6 channel layers of wave batches + row arrays")
 
 
 def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,

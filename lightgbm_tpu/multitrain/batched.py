@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.contracts import memory_budget
 from ..basic import Booster
 from ..callback import CallbackEnv, EarlyStopException, early_stopping
 from ..config import Config
@@ -60,6 +61,28 @@ from ..telemetry.train_record import TrainRecord, set_last_train_record
 from .variants import TRACED_SWEEP
 
 __all__ = ["MultiTrainError", "BatchTrainer", "batch_reject_reason"]
+
+
+def multitrain_hbm_bytes(ctx):
+    """Per-device HBM curve of the M-stacked vmapped grower program
+    (lint-mem enforced): every wave-grower working buffer except the
+    shared bin matrix picks up a leading M axis, so the footprint is
+    ~M x the standalone curve — the reason tpu_multitrain_batch caps a
+    structure group at 256 models and the model axis pmap-shards across
+    devices when M % ndev == 0 (each device then holds M/ndev lanes)."""
+    from ..learner.wave import wave_grow_hbm_bytes
+    m = max(1, int(ctx.get("models", 1)))
+    ndev = max(1, int(ctx.get("model_shards", 1)))
+    lanes = -(-m // ndev)
+    per_model = wave_grow_hbm_bytes(ctx)
+    # 1.15: vmap stacks a few M-wide temporaries the standalone program
+    # frees between dispatches (measured at the lint-mem geometry)
+    return int(1.15 * lanes * per_model)
+
+
+memory_budget("multitrain/stacked_state", ("multitrain",),
+              multitrain_hbm_bytes,
+              note="M/ndev lanes x the wave-grower curve (shared bins)")
 
 
 class MultiTrainError(ValueError):

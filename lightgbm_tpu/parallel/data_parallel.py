@@ -32,7 +32,8 @@ from ..config import Config
 from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
                               make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
-from ..analysis.contracts import collective_contract
+from ..analysis.contracts import (collective_contract, memory_budget,
+                                  world_size)
 from ..telemetry.train_record import note_collective
 from .mesh import get_mesh, psum_scatter_compat, shard_map_compat
 
@@ -51,8 +52,9 @@ def _masked_scan_budget(ctx):
 def _masked_hist_block_bytes(ctx):
     """psum_scatter operand: the full LOCAL (Fp, B, 3) histogram goes in,
     each shard receives its Fp/k block fully reduced (the reference's
-    per-split ReduceScatter, data_parallel_tree_learner.cpp:155-173)."""
-    k = max(1, int(ctx.get("nshards", 1)))
+    per-split ReduceScatter, data_parallel_tree_learner.cpp:155-173).
+    ``k`` is the mesh world size so one declaration covers W=4..W=256."""
+    k = world_size(ctx)
     f_pad = -(-int(ctx["features"]) // k) * k
     return f_pad * int(ctx["bins"]) * 3 * int(ctx.get("itemsize", 4))
 
@@ -75,6 +77,43 @@ collective_contract("data_parallel/masked/winner_bcast", "psum",
                     max_bytes_per_op=lambda ctx: 4 * max(
                         64, int(ctx["bins"])),
                     note="winner payload incl. the (B,) cat membership")
+
+
+# ---------------------------------------------------------------------------
+# Memory budget for the sliced DP-wave program family (lint-mem
+# enforced): the per-device working set on the reduce-scatter path.
+# Two full-F local kernel banks (the pre-merge local histograms the
+# quantized kernel builds at Q_WAVE_SIZE=42 channels) dominate; AFTER
+# the merge everything is a ceil(F/k) feature slice — the per-leaf bank,
+# the scan operands, the winner rescans.  An un-scattered merge (the
+# planted regression class) re-inflates the post-merge terms to full F
+# and blows through this curve.
+# ---------------------------------------------------------------------------
+
+def dp_sliced_hbm_bytes(ctx):
+    """Per-device HBM curve of one sliced DP-wave tree program as a
+    function of (rows, features, bins, wave_size, leaves, world_size)."""
+    from ..learner.wave import Q_WAVE_SIZE, WAVE_SIZE
+    k = world_size(ctx)
+    f = int(ctx["features"])
+    b = int(ctx["bins"])
+    it = int(ctx.get("itemsize", 4))
+    r = -(-int(ctx["rows"]) // k)
+    wave = int(ctx.get("wave_size", WAVE_SIZE))
+    kernel_ch = Q_WAVE_SIZE if ctx.get("quantized", True) else WAVE_SIZE
+    # pre-merge: 2.5 local full-F channel banks in flight (build + merge)
+    local_banks = int(2.5 * max(2 * wave, kernel_ch) * f * b * 3 * it)
+    # post-merge: per-leaf bank + scan/rescan temporaries on the slice
+    f_blk = -(-f // k)
+    sliced = (int(ctx.get("leaves", 2)) + 6 * wave) * f_blk * b * 3 * it
+    rows = r * (f + 24)
+    return local_banks + sliced + rows + (1 << 20)
+
+
+memory_budget(
+    "data_parallel/wave_sliced", ("dp_scatter", "spec_ramp"),
+    dp_sliced_hbm_bytes,
+    note="2.5 local full-F kernel banks + F/k post-merge slice + rows")
 
 
 class DataParallelStrategy(CommStrategy):

@@ -122,13 +122,13 @@ class MicroBatcher:
         deadline = None if timeout_s is None else \
             time.monotonic() + float(timeout_s)
         fut = self.submit(X, raw_score, deadline=deadline)
-        if timeout_s is None:
+        if deadline is None:
             return fut.result()
         try:
             return fut.result(timeout=max(0.0, deadline - time.monotonic()))
         except FutureTimeout:
             exc = DeadlineExceeded(
-                f"request did not complete within {timeout_s:.3f}s")
+                f"request did not complete within {float(timeout_s or 0):.3f}s")
             try:
                 # mark the future failed so the worker neither batches
                 # nor double-counts this request when it dequeues it

@@ -26,11 +26,32 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 
+from ..analysis.contracts import memory_budget
 from ..models.tree import (SHAPE_BUCKETS, bucket_rows, ensemble_serve_fields,
                            pad_rows, predict_raw_ensemble)
 from .stats import ModelStats
 
 __all__ = ["CompiledPredictor", "SHAPE_BUCKETS"]
+
+
+def serve_ladder_hbm_bytes(ctx):
+    """Per-device HBM curve of one serve-bucket program (lint-mem
+    enforced): the padded request block dominates — the walk kernels
+    hold ~3 row-block-sized temporaries (feature gathers, comparisons,
+    per-tree leaf one-hots) next to the input — plus the resident
+    ensemble arrays (~16 B per tree-leaf across the serve fields)."""
+    bucket = int(ctx.get("bucket", max(SHAPE_BUCKETS)))
+    f = int(ctx["features"])
+    it = int(ctx.get("itemsize", 4))
+    trees = int(ctx.get("trees", 1000))
+    leaves = int(ctx.get("leaves", 255))
+    request = 4 * bucket * f * it
+    model = 16 * trees * leaves
+    return request + model + (1 << 20)
+
+
+memory_budget("serve/bucket_ladder", ("serve",), serve_ladder_hbm_bytes,
+              note="4 request-block temporaries + resident ensemble")
 
 # (shape-signature, bucket) pairs that have already been dispatched — the
 # process-wide mirror of XLA's jit cache for predict_raw_ensemble

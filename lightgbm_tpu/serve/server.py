@@ -83,7 +83,10 @@ class PredictionServer:
 
     @property
     def host(self) -> str:
-        return self._httpd.server_address[0]
+        # server_address is typed (str | bytes, int); ours is always str
+        host = self._httpd.server_address[0]
+        return host.decode() if isinstance(host, (bytes, bytearray)) \
+            else str(host)
 
     def _predict(self, name: Optional[str], X: np.ndarray,
                  raw_score: bool,
@@ -308,7 +311,8 @@ def main(argv: List[str]) -> int:
     # it during warmup
     default_backend()
     files = [a for a in argv if "=" not in a]
-    kv = dict(a.split("=", 1) for a in argv if "=" in a)
+    kv = {k: v for k, v in
+          (a.split("=", 1) for a in argv if "=" in a)}
     if kv.get("model"):
         files.append(kv["model"])
     if not files:

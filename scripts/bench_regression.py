@@ -1,0 +1,116 @@
+"""Nightly bench-regression gate (ROADMAP item 5).
+
+Diffs two bench-matrix-v1 artifacts — benchmarks/run.py (iters_per_sec),
+benchmarks/many_models.py (models_per_sec) and benchmarks/hist_kernel.py
+(builds_per_sec) all emit the schema, each row named and git-SHA-stamped
+— and exits nonzero when any matched row regresses past the threshold
+(default 10%), the way trace-lint fails on contract drift.
+
+Usage:
+    python scripts/bench_regression.py --baseline prev.json \
+        --current cur.json [--threshold 0.10] [--out diff.json]
+
+Missing/invalid baseline exits 0 with a "no baseline" note (the first
+nightly run after the gate lands has nothing to diff); rows only in one
+artifact are reported but never fail the gate (configs come and go);
+interpret-mode rungs (correctness proxies, not perf claims) are skipped.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+THROUGHPUT_KEYS = ("iters_per_sec", "models_per_sec", "builds_per_sec")
+
+
+def load_rows(path):
+    """name -> (metric_key, value) for one bench-matrix-v1 artifact."""
+    with open(path) as fh:
+        rec = json.load(fh)
+    if rec.get("schema") != "bench-matrix-v1":
+        raise ValueError(f"{path}: not a bench-matrix-v1 artifact "
+                         f"(schema={rec.get('schema')!r})")
+    rows = {}
+    for row in rec.get("rows", []):
+        if row.get("interpreted"):
+            continue                 # correctness proxy, not a perf claim
+        name = row.get("name")
+        if not name:
+            continue
+        for key in THROUGHPUT_KEYS:
+            if key in row:
+                rows[name] = (key, float(row[key]))
+                break
+    return rec, rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--baseline", required=True)
+    ap.add_argument("--current", required=True)
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="fail on throughput drops beyond this fraction")
+    ap.add_argument("--out", default="",
+                    help="optional JSON diff report path")
+    ns = ap.parse_args(argv)
+
+    if not os.path.exists(ns.baseline):
+        print(json.dumps({"ok": True, "skipped": "no baseline artifact",
+                          "baseline": ns.baseline}))
+        return 0
+    try:
+        base_rec, base = load_rows(ns.baseline)
+    except (ValueError, json.JSONDecodeError, OSError) as exc:
+        print(json.dumps({"ok": True,
+                          "skipped": f"unreadable baseline: {exc}"}))
+        return 0
+    try:
+        cur_rec, cur = load_rows(ns.current)
+    except (ValueError, json.JSONDecodeError, OSError) as exc:
+        # the CI bench smoke writes an {"error": ...} fallback artifact
+        # when the bench itself failed — that failure is already visible
+        # upstream; the gate has nothing to judge and must not add a
+        # crash on top of it
+        print(json.dumps({"ok": True,
+                          "skipped": f"unreadable current artifact: {exc}"}))
+        return 0
+
+    report = {
+        "schema": "bench-regression-v1",
+        "threshold": ns.threshold,
+        "baseline_sha": base_rec.get("git_sha"),
+        "current_sha": cur_rec.get("git_sha"),
+        "rows": [],
+        "regressions": [],
+        "unmatched": sorted(set(base) ^ set(cur)),
+    }
+    for name in sorted(set(base) & set(cur)):
+        key, b = base[name]
+        _, c = cur[name]
+        ratio = c / b if b > 0 else 1.0
+        row = {"name": name, "metric": key, "baseline": b, "current": c,
+               "ratio": round(ratio, 4)}
+        report["rows"].append(row)
+        if ratio < 1.0 - ns.threshold:
+            report["regressions"].append(row)
+    report["ok"] = not report["regressions"]
+
+    if ns.out:
+        with open(ns.out, "w") as fh:
+            json.dump(report, fh, indent=2)
+    print(json.dumps({"ok": report["ok"],
+                      "compared": len(report["rows"]),
+                      "regressions": report["regressions"],
+                      "unmatched": report["unmatched"]}, indent=2))
+    if not report["ok"]:
+        worst = min(report["regressions"], key=lambda r: r["ratio"])
+        print(f"bench regression: {worst['name']} {worst['metric']} "
+              f"{worst['baseline']:.4f} -> {worst['current']:.4f} "
+              f"({(1 - worst['ratio']) * 100:.1f}% drop > "
+              f"{ns.threshold * 100:.0f}% threshold)", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -83,10 +83,46 @@ def collective_bytes_snapshot(n_devices: int) -> dict:
     return out
 
 
+def contract_sweep_per_w(ws=(4, 8, 64)) -> dict:
+    """Re-parameterized contract sweep: run the full rule matrix (the
+    collective budgets + the SPMD-safety pair) over the DP configs at
+    W in ``ws`` — real virtual-device submeshes up to the attached
+    count, trace-only AbstractMesh past it (W=64).  One declaration set
+    covers every W; this sweep proves it per push (ROADMAP item 1's
+    "pod path machine-checked like the single-host one")."""
+    from lightgbm_tpu.analysis import lint
+    from lightgbm_tpu.analysis.lint import ALL_RULES
+    from lightgbm_tpu.analysis.rules import run_rules
+
+    out = {"schema": "contracts-per-w-v1",
+           "environment": lint.environment_info(),
+           "worlds": {}}
+    for w in ws:
+        entry = {}
+        for cfg in ("dp_scatter", "spec_ramp"):
+            t0 = time.perf_counter()
+            unit = lint.build_unit(cfg, nshards=w)
+            vs = run_rules([unit], rules=ALL_RULES)
+            entry[cfg] = {
+                "ok": not vs,
+                "violations": [v.to_json() for v in vs],
+                "collectives": {site: dict(rec) for site, rec in
+                                sorted(unit.collectives.items())},
+                "trace_seconds": round(time.perf_counter() - t0, 2),
+            }
+        out["worlds"][f"W{w}"] = entry
+    out["ok"] = all(c["ok"] for e in out["worlds"].values()
+                    for c in e.values())
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--out", default="multichip.json")
+    ap.add_argument("--per-w-out", default="contracts-per-w.json",
+                    help="per-world-size contract sweep report "
+                         "(W=4/8 virtual devices, W=64 trace-only)")
     ns = ap.parse_args()
 
     rec = {"schema": "multichip-dryrun-v1", "n_devices": ns.devices,
@@ -103,12 +139,26 @@ def main() -> int:
         rec["collectives"] = collective_bytes_snapshot(ns.devices)
     except Exception:  # noqa: BLE001
         rec["collectives_error"] = traceback.format_exc(limit=20)
+    per_w_ok = True
+    try:
+        per_w = contract_sweep_per_w()
+        per_w_ok = per_w["ok"]
+        with open(ns.per_w_out, "w") as fh:
+            json.dump(per_w, fh, indent=2, default=str)
+    except Exception:  # noqa: BLE001
+        per_w_ok = False
+        with open(ns.per_w_out, "w") as fh:
+            json.dump({"schema": "contracts-per-w-v1", "ok": False,
+                       "error": traceback.format_exc(limit=20)}, fh,
+                      indent=2)
+    rec["contracts_per_w_ok"] = per_w_ok
     with open(ns.out, "w") as fh:
         json.dump(rec, fh, indent=2, default=str)
     print(json.dumps({k: rec[k] for k in ("ok", "dryrun_seconds")} |
                      {"ratio": rec.get("collectives", {}).get(
-                         "hist_bytes_ratio_allreduce_over_scatter")}))
-    return 0 if rec["ok"] else 1
+                         "hist_bytes_ratio_allreduce_over_scatter"),
+                      "contracts_per_w_ok": per_w_ok}))
+    return 0 if rec["ok"] and per_w_ok else 1
 
 
 if __name__ == "__main__":

@@ -57,6 +57,66 @@ def test_ir_walks_nested_subjaxprs():
     assert ir.count_primitive(jx, "cond") == 1
 
 
+def test_ir_descends_pallas_call_kernels():
+    """The walker enumerates eqns INSIDE pallas_call kernel jaxprs
+    (claimed since PR 10, pinned here): both on a synthetic kernel and
+    on the real wave grower's traced program."""
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0 + 1.0
+
+    def f(x):
+        return pl.pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=True)(x)
+
+    jx = ir.trace(f, jnp.ones((8, 128), jnp.float32))
+    inside = [info for info in ir.iter_eqns(jx)
+              if "pallas_call" in info.path]
+    assert inside, "no eqns enumerated inside the pallas kernel jaxpr"
+    prims = {info.prim for info in inside}
+    assert "mul" in prims and "add" in prims
+    # the real thing: the wave config's program carries pallas kernels
+    # and the walker sees their interiors too
+    unit = lint.build_unit("wave")
+    in_kernel = [info for info in ir.iter_eqns(unit.jaxpr)
+                 if "pallas_call" in info.path]
+    assert in_kernel, "wave program pallas kernels not descended"
+
+
+def test_ir_descends_custom_jvp_and_vjp_bodies():
+    @jax.custom_jvp
+    def f(x):
+        return jnp.sin(x) * x
+
+    @f.defjvp
+    def f_jvp(primals, tangents):
+        (x,), (t,) = primals, tangents
+        return f(x), (jnp.cos(x) * x + jnp.sin(x)) * t
+
+    jx = ir.trace(lambda x: f(x) + 1.0, jnp.ones((4,)))
+    in_jvp = [info for info in ir.iter_eqns(jx)
+              if any(p.startswith("custom_jvp_call") for p in info.path)]
+    assert in_jvp and "sin" in {i.prim for i in in_jvp}
+
+    @jax.custom_vjp
+    def g(x):
+        return jnp.tanh(x) * 3.0
+
+    def g_fwd(x):
+        return g(x), x
+
+    def g_bwd(res, ct):
+        return (ct * (1 - jnp.tanh(res) ** 2) * 3.0,)
+
+    g.defvjp(g_fwd, g_bwd)
+    jxg = ir.trace(lambda x: g(x) * 2.0, jnp.ones((4,)))
+    in_vjp = [info for info in ir.iter_eqns(jxg)
+              if any(p.startswith("custom_vjp_call") for p in info.path)]
+    assert in_vjp and "tanh" in {i.prim for i in in_vjp}
+
+
 def test_ir_stable_hash_and_consts():
     jx1 = ir.trace(_nested_program, jnp.ones((4,)))
     jx2 = ir.trace(_nested_program, jnp.ones((4,)))
@@ -326,7 +386,12 @@ def test_donated_score_update_bit_identical():
     rl = jnp.asarray(rng.randint(0, 7, 257).astype(np.int32))
     lv = jnp.asarray(rng.randn(7).astype(np.float32))
     want = np.asarray(_update_score_by_leaf(score, rl, lv, 1.0))
-    got = np.asarray(_update_score_by_leaf_donated(score, rl, lv, 1.0))
+    # donate a fresh, settled copy: the XLA:CPU runtime frees donated
+    # buffers under in-flight readers (the reason gbdt gates the donated
+    # dispatch TPU-only), so the shared `score` must not be the donated
+    # operand and nothing may be pending when the donation dispatches
+    score_d = jax.block_until_ready(jnp.array(score, copy=True))
+    got = np.asarray(_update_score_by_leaf_donated(score_d, rl, lv, 1.0))
     np.testing.assert_array_equal(got, want)
 
 
