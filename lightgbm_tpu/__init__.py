@@ -27,13 +27,14 @@ if "cpu" in _os.environ.get("JAX_PLATFORMS", ""):
         pass  # a backend already initialized; too late to switch
 
 
-from . import analysis, distributed, resilience, telemetry
+from . import analysis, distributed, ingest, resilience, telemetry
 from .basic import Booster
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        print_evaluation, record_evaluation, reset_parameter)
 from .config import Config
 from .dataset import Dataset
 from .engine import CVBooster, cv, train
+from .ingest import StreamedDataset, train_streamed
 from .models.model_text import ModelCorruptError
 from .multitrain import ManyBooster, MultiTrainError, train_many
 from .resilience import (Checkpoint, CheckpointError, TrainingPreempted,
@@ -57,7 +58,7 @@ __all__ = ["Dataset", "Booster", "Config", "train", "cv", "CVBooster",
            "early_stopping", "print_evaluation", "log_evaluation",
            "record_evaluation", "reset_parameter", "EarlyStopException",
            "register_log_callback", "set_verbosity", "analysis",
-           "distributed",
+           "distributed", "ingest", "StreamedDataset", "train_streamed",
            "telemetry", "resilience", "Checkpoint", "CheckpointError",
            "TrainingPreempted", "load_checkpoint", "ModelCorruptError",
            "plot_importance", "plot_metric", "plot_tree",

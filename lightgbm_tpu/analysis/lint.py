@@ -45,7 +45,7 @@ __all__ = ["MATRIX_CONFIGS", "Geometry", "TRACE_GEOMETRY", "MEM_GEOMETRY",
            "parse_kv_args", "run_lint", "main"]
 
 MATRIX_CONFIGS = ("serial", "wave", "dp_scatter", "spec_ramp",
-                  "multitrain", "serve")
+                  "multitrain", "serve", "ingest")
 
 # every rule the matrix runs: the six PR-10 program-contract rules plus
 # the SPMD-safety pair (collective-order, sharding-consistency)
@@ -248,6 +248,57 @@ def _dp_builder(k: int, geom: Geometry, spec: bool):
     return build
 
 
+def _mk_ingest_chunk(geom: Geometry):
+    """(fn, args) for the chunked-ingest per-chunk program: the fused
+    row-update + histogram-accumulate step (ingest/grower.py) at one
+    chunk of ``geom.rows`` rows.  This is the program whose footprint
+    the ``ingest/chunk_pipeline`` MemoryBudget bounds — shapes are
+    functions of (chunk_rows, features, bins, wave) only, which is the
+    rows-independence the budget's no-rows-term contract states."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ..ingest.grower import ChunkedWaveGrower
+    from ..ops.split import SplitParams
+
+    def build(i: int):
+        sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=0.0,
+                         any_cat=False)
+        gr = ChunkedWaveGrower(
+            num_leaves=geom.leaves, num_features=geom.features,
+            max_bins=geom.bins, max_depth=0, split_params=sp,
+            num_bins=np.full(geom.features, geom.bins, np.int32),
+            has_nan=np.zeros(geom.features, bool), hist_impl="segment",
+            quantized=True, wave_size=geom.wave)
+        W, F, B = gr.W, gr.F, gr.B
+        c = geom.rows                       # one chunk's rows
+        rng = np.random.RandomState(i)
+        bins = jnp.asarray(rng.randint(0, B - 1, (c, F)).astype(np.uint8))
+        rl = jnp.zeros((c,), jnp.uint8)
+        grad = jnp.asarray(rng.randn(c).astype(np.float32))
+        hess = jnp.full((c,), 0.25, jnp.float32)
+        mask = jnp.ones((c,), jnp.float32)
+        acc = jnp.zeros((W, F, B, 3), jnp.int32)
+        zi = jnp.zeros((W,), jnp.int32)
+        head = {"vals": jnp.ones((W,), jnp.float32),
+                "sel_leaves": zi, "sel": jnp.ones((W,), jnp.bool_),
+                "feat": zi, "thr": zi + 1, "dleft": jnp.zeros((W,),
+                                                             jnp.bool_),
+                "lsum": jnp.zeros((W, 3), jnp.float32),
+                "rsum": jnp.ones((W, 3), jnp.float32),
+                "member": jnp.zeros((W, B), jnp.bool_),
+                "psum": jnp.ones((W, 3), jnp.float32),
+                "new_ids": zi + 1, "node_ids": zi,
+                "left_smaller": jnp.ones((W,), jnp.bool_),
+                "fnan": jnp.zeros((W,), jnp.bool_),
+                "f_nan_bin": zi - 1,
+                "total_new": jnp.asarray(1, jnp.int32)}
+        scales = (jnp.float32(0.1), jnp.float32(0.1))
+        fn = lambda *a: gr._chunk_step(*a)
+        return fn, (acc, bins, rl, grad, hess, mask, head, scales)
+
+    return build
+
+
 def _multitrain_builder(geom: Geometry):
     def build(i: int):
         import jax
@@ -346,6 +397,10 @@ def build_unit(name: str, nshards: int = 8,
                                  _base_ctx(geom, models=3))
     if name == "serve":
         return _build_serve_unit(geom, _base_ctx(geom))
+    if name == "ingest":
+        return _unit_from_traces(
+            "ingest", _mk_ingest_chunk(geom),
+            _base_ctx(geom, quantized=True, chunk_rows=geom.rows))
     raise ValueError(f"unknown lint config '{name}' "
                      f"(matrix: {', '.join(MATRIX_CONFIGS)})")
 
@@ -365,6 +420,8 @@ def build_callable(name: str, nshards: int = 8,
         return _serial_builder(geom, name == "wave")(0)
     if name == "multitrain":
         return _multitrain_builder(geom)(0)
+    if name == "ingest":
+        return _mk_ingest_chunk(geom)(0)
     if name == "serve":
         import numpy as np
         from ..models.tree import SHAPE_BUCKETS, predict_raw_ensemble

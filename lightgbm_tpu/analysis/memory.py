@@ -421,6 +421,7 @@ def run_lint_mem(configs: Optional[Sequence[str]] = None, nshards: int = 8,
     # budgets register at module import; pull in every declaring module
     # so the check is import-order independent (learner/wave.py and
     # parallel/data_parallel.py load via the trace builders anyway)
+    from ..ingest import stream  # noqa: F401
     from ..learner import wave  # noqa: F401
     from ..multitrain import batched  # noqa: F401
     from ..parallel import data_parallel  # noqa: F401

@@ -25,7 +25,9 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["MissingType", "BinMapper", "find_bin", "bin_matrix"]
+__all__ = ["MissingType", "BinMapper", "find_bin", "bin_matrix",
+           "ColumnSummary", "summarize_column", "merge_column_summaries",
+           "find_bin_from_summary"]
 
 # reference include/LightGBM/bin.h:29 kZeroThreshold
 ZERO_THRESHOLD = 1e-35
@@ -111,17 +113,30 @@ def _distinct_with_zero(vals_sorted: np.ndarray, zero_cnt: int):
     bin.cpp:355-383: the sample carries only |v| > kZeroThreshold values;
     everything else is the zero block).  One-ULP-adjacent values merge,
     keeping the larger value."""
-    n = len(vals_sorted)
+    return _distinct_with_zero_counts(
+        vals_sorted, np.ones(len(vals_sorted), np.int64), zero_cnt)
+
+
+def _distinct_with_zero_counts(dv: np.ndarray, cv: np.ndarray,
+                               zero_cnt: int):
+    """Counts-based core of :func:`_distinct_with_zero`: ``dv`` are sorted
+    values (duplicates allowed — exact-duplicate runs are 0 ULP apart and
+    merge into one group anyway), ``cv`` their multiplicities.  Operating
+    on (value, count) pairs makes the construction *mergeable*: chunk
+    summaries built by :func:`summarize_column` merge exactly and
+    finalize through this same code, so streamed sketch binning is
+    bit-identical to the one-shot path."""
+    n = len(dv)
     if n == 0:
         return [0.0], [int(zero_cnt)]
     new_grp = np.empty(n, bool)
     new_grp[0] = True
     if n > 1:
-        new_grp[1:] = vals_sorted[1:] > np.nextafter(vals_sorted[:-1], np.inf)
+        new_grp[1:] = dv[1:] > np.nextafter(dv[:-1], np.inf)
     starts = np.flatnonzero(new_grp)
     ends = np.append(starts[1:], n) - 1
-    dl = vals_sorted[ends].tolist()
-    cl = (np.append(starts[1:], n) - starts).tolist()
+    dl = np.asarray(dv)[ends].tolist()
+    cl = np.add.reduceat(np.asarray(cv, np.int64), starts).tolist()
     out_d: List[float] = []
     out_c: List[int] = []
     if dl[0] > 0.0 and zero_cnt > 0:
@@ -311,6 +326,70 @@ class BinMapper:
         return float(v)
 
 
+@dataclass
+class ColumnSummary:
+    """Mergeable one-pass summary of one feature's sampled values.
+
+    The streamed-sketch form of the reference's per-feature sample
+    (dataset_loader.cpp:966): exact distinct nonzero finite values (or
+    category ids) with multiplicities, plus NaN/total counters.  Two
+    summaries over disjoint row sets merge *exactly*
+    (:func:`merge_column_summaries`), and :func:`find_bin_from_summary`
+    produces the same BinMapper a one-shot :func:`find_bin` over the
+    concatenated sample would — the property the out-of-core ingest
+    subsystem (lightgbm_tpu/ingest/) builds on.  Memory is bounded by the
+    number of distinct sampled values, never by the dataset row count.
+    """
+
+    distinct: np.ndarray          # sorted distinct values / category ids
+    counts: np.ndarray            # int64 multiplicities
+    na_cnt: int = 0
+    total_cnt: int = 0            # rows summarized (zeros + NaNs included)
+    is_categorical: bool = False
+
+
+def summarize_column(values: np.ndarray,
+                     is_categorical: bool = False) -> ColumnSummary:
+    """Summarize one chunk of one feature's values (NaN allowed)."""
+    values = np.asarray(values, dtype=np.float64).ravel()
+    na_cnt = int(np.isnan(values).sum())
+    finite = values[~np.isnan(values)]
+    if is_categorical:
+        ivals = finite.astype(np.int64)
+        if len(ivals) and ivals.min() < 0:
+            raise ValueError(
+                "categorical features must be non-negative integers")
+        cats, counts = (np.unique(ivals, return_counts=True) if len(ivals)
+                        else (np.array([], np.int64), np.array([], np.int64)))
+        return ColumnSummary(distinct=cats.astype(np.float64),
+                             counts=counts.astype(np.int64), na_cnt=na_cnt,
+                             total_cnt=len(values), is_categorical=True)
+    # only |v| > kZeroThreshold values are kept; zeros are implicit
+    # (total - nonzero - na), exactly like the reference's sample
+    vals = finite[np.abs(finite) > ZERO_THRESHOLD]
+    distinct, counts = (np.unique(vals, return_counts=True) if len(vals)
+                        else (np.array([], np.float64),
+                              np.array([], np.int64)))
+    return ColumnSummary(distinct=distinct, counts=counts.astype(np.int64),
+                         na_cnt=na_cnt, total_cnt=len(values))
+
+
+def merge_column_summaries(a: ColumnSummary,
+                           b: ColumnSummary) -> ColumnSummary:
+    """Exact merge of two disjoint-row summaries (order-insensitive)."""
+    if a.is_categorical != b.is_categorical:
+        raise ValueError("cannot merge categorical and numerical summaries")
+    d = np.concatenate([a.distinct, b.distinct])
+    c = np.concatenate([a.counts, b.counts]).astype(np.int64)
+    ud, inv = np.unique(d, return_inverse=True)
+    uc = np.zeros(len(ud), np.int64)
+    np.add.at(uc, inv, c)
+    return ColumnSummary(distinct=ud, counts=uc,
+                         na_cnt=a.na_cnt + b.na_cnt,
+                         total_cnt=a.total_cnt + b.total_cnt,
+                         is_categorical=a.is_categorical)
+
+
 def find_bin(sample_values: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
              *, total_cnt: Optional[int] = None, is_categorical: bool = False,
              use_missing: bool = True, zero_as_missing: bool = False,
@@ -326,16 +405,37 @@ def find_bin(sample_values: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
     ``DatasetLoader::GetForcedBins`` + bin.cpp FindBin forced_upper_bounds):
     they always appear as boundaries; the greedy boundaries fill the
     remaining budget.
-    """
-    sample_values = np.asarray(sample_values, dtype=np.float64).ravel()
-    n_sample = len(sample_values)
-    if total_cnt is None:
-        total_cnt = n_sample
-    na_cnt = int(np.isnan(sample_values).sum())
-    finite = sample_values[~np.isnan(sample_values)]
 
-    if is_categorical:
-        return _find_bin_categorical(finite, max_bin, na_cnt, use_missing)
+    One thin wrapper over :func:`summarize_column` +
+    :func:`find_bin_from_summary` — the SAME code path streamed sketch
+    binning (lightgbm_tpu/ingest/sketch.py) and distributed summary-merge
+    binning (dataset.py pre_partition) take, so all three produce
+    identical mappers from identical samples.
+    """
+    summary = summarize_column(sample_values, is_categorical=is_categorical)
+    return find_bin_from_summary(
+        summary, max_bin, min_data_in_bin, total_cnt=total_cnt,
+        use_missing=use_missing, zero_as_missing=zero_as_missing,
+        forced_bounds=forced_bounds, pre_filter_cnt=pre_filter_cnt)
+
+
+def find_bin_from_summary(summary: ColumnSummary, max_bin: int,
+                          min_data_in_bin: int = 3, *,
+                          total_cnt: Optional[int] = None,
+                          use_missing: bool = True,
+                          zero_as_missing: bool = False,
+                          forced_bounds: Optional[Sequence[float]] = None,
+                          pre_filter_cnt: int = 1) -> BinMapper:
+    """BinMapper from a (possibly merged) :class:`ColumnSummary`."""
+    if total_cnt is None:
+        total_cnt = summary.total_cnt
+    na_cnt = int(summary.na_cnt)
+
+    if summary.is_categorical:
+        return _find_bin_categorical_counts(
+            summary.distinct.astype(np.int64),
+            np.asarray(summary.counts, np.int64), max_bin, na_cnt,
+            use_missing)
 
     if zero_as_missing:
         missing_type = MissingType.ZERO
@@ -348,11 +448,11 @@ def find_bin(sample_values: np.ndarray, max_bin: int, min_data_in_bin: int = 3,
     # The reference's per-feature sample holds only |v| > kZeroThreshold
     # values (dataset_loader.cpp:966); everything else is the implicit
     # zero block of size total - sample - na.
-    vals = finite[np.abs(finite) > ZERO_THRESHOLD]
+    nonzero_cnt = int(np.asarray(summary.counts, np.int64).sum())
     na_eff = na_cnt if missing_type == MissingType.NAN else 0
-    zero_cnt = int(total_cnt - len(vals) - na_eff)
-    vals = np.sort(vals, kind="stable")
-    distinct, counts = _distinct_with_zero(vals, zero_cnt)
+    zero_cnt = int(total_cnt - nonzero_cnt - na_eff)
+    distinct, counts = _distinct_with_zero_counts(
+        summary.distinct, summary.counts, zero_cnt)
 
     if missing_type == MissingType.NAN:
         mb, tot = max_bin - 1, int(total_cnt) - na_eff
@@ -425,6 +525,16 @@ def _find_bin_categorical(finite: np.ndarray, max_bin: int, na_cnt: int,
         raise ValueError("categorical features must be non-negative integers")
     cats, counts = (np.unique(ivals, return_counts=True) if len(ivals)
                     else (np.array([], np.int64), np.array([], np.int64)))
+    return _find_bin_categorical_counts(cats, counts, max_bin, na_cnt,
+                                        use_missing)
+
+
+def _find_bin_categorical_counts(cats: np.ndarray, counts: np.ndarray,
+                                 max_bin: int, na_cnt: int,
+                                 use_missing: bool) -> BinMapper:
+    """Counts-based core (``cats`` ascending-sorted distinct ids): the
+    mergeable-summary form of the categorical FindBin, shared by the
+    one-shot and streamed-sketch paths."""
     order = np.argsort(-counts, kind="stable")
     cats, counts = cats[order], counts[order]
     # keep categories covering 99% of samples, capped at max_bin

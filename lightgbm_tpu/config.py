@@ -287,6 +287,15 @@ _PARAMS: List[ParamSpec] = [
     # grower) when the batch width divides the device count — every
     # chip grows M/k models concurrently; False = single-device vmap
     _p("tpu_multitrain_shard", bool, True),
+    # out-of-core ingest (lightgbm_tpu/ingest/): how a StreamedDataset
+    # trains.  "hbm" = upload the streamed binned cache to HBM once and
+    # run the normal growers (bit-identical to in-core training on every
+    # path); "chunked" = chunk-accumulated wave histograms with a
+    # rows-independent HBM budget (the 10^8-10^9-row regime; envelope
+    # checked by ingest/train.py).  An execution-strategy directive like
+    # resume/checkpoint_dir: it never changes the model (quantized path)
+    # and is excluded from the model-text params dump.
+    _p("tpu_ingest_mode", str, "hbm"),
 ]
 
 PARAM_SCHEMA: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}
@@ -434,6 +443,8 @@ class Config:
              "packed4"),
             (self.tpu_pallas_pipeline in ("auto", "dma", "blockspec"),
              "tpu_pallas_pipeline must be auto|dma|blockspec"),
+            (self.tpu_ingest_mode in ("hbm", "chunked"),
+             "tpu_ingest_mode must be hbm|chunked"),
         ]
         for ok, msg in checks:
             if not ok:

@@ -25,11 +25,24 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
-from .binning import BinMapper, bin_matrix, find_bin
+from .binning import (BinMapper, bin_matrix, find_bin,
+                      find_bin_from_summary)
 from .config import Config
 from .utils.log import log_info
 
-__all__ = ["Dataset", "Metadata"]
+__all__ = ["Dataset", "Metadata", "DatasetCorruptError"]
+
+
+class DatasetCorruptError(ValueError):
+    """A binary dataset file could not be read or failed validation
+    (truncated/garbage payload, missing fields, or a fingerprint that
+    does not match the stored binned matrix) — the Dataset analog of
+    ``ModelCorruptError``."""
+
+    def __init__(self, source: str, detail: str) -> None:
+        super().__init__(f"{source}: {detail}")
+        self.source = source
+        self.detail = detail
 
 _ArrayLike = Union[np.ndarray, Sequence[float], "Any"]
 
@@ -162,9 +175,13 @@ class Dataset:
                                     _dist.process_count()))
         else:
             sample_cnt = min(n, int(cfg.bin_construct_sample_cnt))
-        sample_idx = (np.sort(rng.choice(n, size=sample_cnt, replace=False))
-                      if sample_cnt < n else np.arange(n))
-        sample_rows_global = None
+        # one code path with the streamed sketch pass (the bit-identity
+        # root); the shared generator keeps the sparse path's remaining
+        # stream identical
+        from .ingest.sketch import sample_row_indices
+        sample_idx = sample_row_indices(n, sample_cnt,
+                                        cfg.data_random_seed, rng=rng)
+        dist_sketch = None
         dist_sparse_cols = None
         n_total = n
         if dist_rows:
@@ -214,8 +231,16 @@ class Dataset:
                     dist_sparse_cols.append(
                         np.concatenate([vals, np.zeros(nz)]))
             else:
-                sample_rows_global = _dist.allgather_host(
-                    np.asarray(raw[sample_idx], np.float64))
+                # dense: per-rank per-feature SUMMARIES allgathered and
+                # merged in rank order — the streamed sketch's wire form
+                # (ingest/sketch.py), one code path with single-process
+                # and streamed binning (both finalize through
+                # binning.find_bin_from_summary), and never more bytes
+                # than the raw sample-row gather it replaces
+                from .ingest.sketch import BinningSketch
+                dist_sketch = BinningSketch(f, cat_indices)
+                dist_sketch.update(np.asarray(raw[sample_idx], np.float64))
+                dist_sketch.allgather_merge()
 
         if self.reference is not None:
             ref = self.reference
@@ -229,17 +254,25 @@ class Dataset:
         else:
             # sample rows for bin finding (dataset_loader.cpp:902
             # SampleTextDataFromFile — here rows are already in memory)
-            # forced bin boundaries (dataset_loader.cpp:641 GetForcedBins:
-            # JSON list of {"feature": i, "bin_upper_bound": [...]})
-            forced_bins: Dict[int, list] = {}
-            if getattr(cfg, "forcedbins_filename", ""):
-                import json as _json
-                with open(cfg.forcedbins_filename) as fh:
-                    for ent in _json.load(fh):
-                        forced_bins[int(ent["feature"])] = \
-                            list(ent["bin_upper_bound"])
+            forced_bins = self._load_forced_bins(cfg)
             self.bin_mappers = []
             for j in range(f):
+                if dist_sketch is not None:
+                    # distributed dense: finalize the merged summaries
+                    # through the shared sketch machinery
+                    summary = dist_sketch.summary(j)
+                    filt = max(1, int(cfg.min_data_in_leaf *
+                                      summary.total_cnt /
+                                      max(1, n_total))) \
+                        if cfg.feature_pre_filter else 0
+                    self.bin_mappers.append(find_bin_from_summary(
+                        summary, cfg.max_bin,
+                        min_data_in_bin=cfg.min_data_in_bin,
+                        use_missing=cfg.use_missing,
+                        zero_as_missing=cfg.zero_as_missing,
+                        forced_bounds=forced_bins.get(j),
+                        pre_filter_cnt=filt))
+                    continue
                 if dist_sparse_cols is not None:
                     col_sample = dist_sparse_cols[j]
                 elif sparse:
@@ -255,8 +288,6 @@ class Dataset:
                         if zfrac < 1.0 else sample_cnt
                     nz = min(nz, sample_cnt)
                     col_sample = np.concatenate([vals, np.zeros(nz)])
-                elif sample_rows_global is not None:
-                    col_sample = sample_rows_global[:, j]
                 else:
                     col_sample = raw[sample_idx, j]
                 # the reference's pre-filter threshold scales
@@ -276,17 +307,7 @@ class Dataset:
                     zero_as_missing=cfg.zero_as_missing,
                     forced_bounds=forced_bins.get(j),
                     pre_filter_cnt=filt))
-            # pre-filter trivial features (config.h feature_pre_filter)
-            used = [j for j, m in enumerate(self.bin_mappers) if not m.is_trivial]
-            if len(used) == 0:
-                raise ValueError("cannot construct Dataset: all features are trivial "
-                                 "(constant); nothing to split on")
-            if len(used) < f:
-                log_info(f"Dataset: filtered {f - len(used)} trivial features, "
-                         f"{len(used)} remain")
-            self.used_feature_map = np.asarray(used, dtype=np.int32)
-            self.num_bins_per_feature = np.asarray(
-                [self.bin_mappers[j].num_bin for j in used], dtype=np.int32)
+            self._finalize_used_features(f)
 
         used = self.used_feature_map
         mappers = [self.bin_mappers[j] for j in used]
@@ -335,6 +356,37 @@ class Dataset:
         if self.free_raw_data:
             self.data = None
         return self
+
+    @staticmethod
+    def _load_forced_bins(cfg) -> Dict[int, list]:
+        """forcedbins_filename JSON -> {feature: [upper bounds]}
+        (dataset_loader.cpp:641 GetForcedBins) — shared with the
+        streamed construct (ingest/stream.py)."""
+        forced_bins: Dict[int, list] = {}
+        if getattr(cfg, "forcedbins_filename", ""):
+            import json as _json
+            with open(cfg.forcedbins_filename) as fh:
+                for ent in _json.load(fh):
+                    forced_bins[int(ent["feature"])] = \
+                        list(ent["bin_upper_bound"])
+        return forced_bins
+
+    def _finalize_used_features(self, f: int) -> None:
+        """Trivial-feature pre-filter (config.h feature_pre_filter) ->
+        used_feature_map / num_bins_per_feature — shared with the
+        streamed construct so the filter policy cannot drift between
+        the in-core and streamed mapper sets."""
+        used = [j for j, m in enumerate(self.bin_mappers)
+                if not m.is_trivial]
+        if len(used) == 0:
+            raise ValueError("cannot construct Dataset: all features are "
+                             "trivial (constant); nothing to split on")
+        if len(used) < f:
+            log_info(f"Dataset: filtered {f - len(used)} trivial features, "
+                     f"{len(used)} remain")
+        self.used_feature_map = np.asarray(used, dtype=np.int32)
+        self.num_bins_per_feature = np.asarray(
+            [self.bin_mappers[j].num_bin for j in used], dtype=np.int32)
 
     def _finalize_distributed_rows(self, n_local: int) -> int:
         """Pad the LOCAL binned shard to the mesh row quantum and
@@ -566,8 +618,18 @@ class Dataset:
         fp = self._device_cache.get("_fingerprint")
         if fp is not None:
             return fp
-        import hashlib
         import zlib
+        crc = zlib.crc32(np.ascontiguousarray(self.X_binned).tobytes())
+        fp = self._fingerprint_with_crc(crc)
+        self._device_cache["_fingerprint"] = fp
+        return fp
+
+    def _fingerprint_with_crc(self, crc: int) -> Dict[str, Any]:
+        """Fingerprint dict from a precomputed binned-codes crc — the
+        mapper sha and field layout single-sourced here so the streamed
+        subclass (which streams the crc over chunks) cannot drift from
+        the in-core fingerprint it must equal bit for bit."""
+        import hashlib
         h = hashlib.sha256()
         for j in self.used_feature_map:
             m = self.bin_mappers[j]
@@ -578,16 +640,13 @@ class Dataset:
                     m.bin_upper_bound, np.float64).tobytes())
             if m.cat_to_bin:
                 h.update(repr(sorted(m.cat_to_bin.items())).encode())
-        crc = zlib.crc32(np.ascontiguousarray(self.X_binned).tobytes())
-        fp = {
+        return {
             "num_data": int(self.num_data()),
             "binned_shape": [int(v) for v in self.X_binned.shape],
             "num_features": int(self.num_feature()),
             "binning_sha256": h.hexdigest(),
             "data_crc32": int(crc),
         }
-        self._device_cache["_fingerprint"] = fp
-        return fp
 
     @property
     def feature_names(self) -> List[str]:
@@ -629,11 +688,17 @@ class Dataset:
     # -- binary serialization (reference Dataset::SaveBinaryFile /
     #    DatasetLoader::LoadFromBinFile) -------------------------------------
     def save_binary(self, filename: str) -> "Dataset":
+        """Crash-safe binary save: the payload lands via
+        ``io_utils.atomic_write_bytes`` (temp + fsync + rename — the same
+        path Booster.save_model takes), and carries the dataset
+        :meth:`fingerprint` so ``load_binary`` can validate the stored
+        binned matrix against its recorded identity."""
         self._check_constructed()
         import pickle
+        from .io_utils import atomic_write_bytes
         payload = {
             "format": "lightgbm_tpu.dataset.v1",
-            "X_binned": self.X_binned,
+            "X_binned": np.asarray(self.X_binned),
             "bin_mappers": self.bin_mappers,
             "used_feature_map": self.used_feature_map,
             "num_bins_per_feature": self.num_bins_per_feature,
@@ -643,18 +708,42 @@ class Dataset:
             "weight": self.metadata.weight,
             "group": self.metadata.group,
             "init_score": self.metadata.init_score,
+            "fingerprint": self.fingerprint(),
         }
-        with open(filename, "wb") as fh:
-            pickle.dump(payload, fh, protocol=4)
+        atomic_write_bytes(filename, pickle.dumps(payload, protocol=4))
         return self
+
+    _BINARY_REQUIRED = ("X_binned", "bin_mappers", "used_feature_map",
+                        "num_bins_per_feature", "feature_names", "label",
+                        "weight", "group", "init_score")
 
     @staticmethod
     def load_binary(filename: str, params: Optional[Dict[str, Any]] = None) -> "Dataset":
+        """Load a :meth:`save_binary` file.  Truncated/garbage payloads
+        raise a typed :class:`DatasetCorruptError` (never a raw pickle
+        exception), and the stored :meth:`fingerprint` is recomputed and
+        compared — a binned matrix that no longer matches its recorded
+        identity fails loudly."""
         import pickle
-        with open(filename, "rb") as fh:
-            payload = pickle.load(fh)
-        if payload.get("format") != "lightgbm_tpu.dataset.v1":
-            raise ValueError(f"{filename} is not a lightgbm_tpu binary dataset")
+        try:
+            with open(filename, "rb") as fh:
+                payload = pickle.load(fh)
+        except OSError:
+            raise
+        except Exception as exc:
+            raise DatasetCorruptError(
+                str(filename), f"not a readable binary dataset "
+                f"({type(exc).__name__}: {exc})") from exc
+        if not isinstance(payload, dict) or \
+                payload.get("format") != "lightgbm_tpu.dataset.v1":
+            raise DatasetCorruptError(
+                str(filename), "not a lightgbm_tpu binary dataset "
+                "(missing/unknown format marker)")
+        missing = [k for k in Dataset._BINARY_REQUIRED if k not in payload]
+        if missing:
+            raise DatasetCorruptError(
+                str(filename),
+                f"binary dataset is missing fields: {', '.join(missing)}")
         ds = Dataset(None, params=params)
         ds.X_binned = payload["X_binned"]
         ds.bin_mappers = payload["bin_mappers"]
@@ -669,6 +758,22 @@ class Dataset:
         ds.metadata.set_group(payload["group"])
         ds.metadata.set_init_score(payload["init_score"])
         ds.constructed = True
+        stored = payload.get("fingerprint")
+        if stored:  # absent in pre-fingerprint files: accept
+            try:
+                got = ds.fingerprint()
+            except Exception as exc:
+                raise DatasetCorruptError(
+                    str(filename), f"stored arrays are inconsistent "
+                    f"({type(exc).__name__}: {exc})") from exc
+            diffs = [k for k in stored if k in got and got[k] != stored[k]]
+            if diffs:
+                raise DatasetCorruptError(
+                    str(filename),
+                    "stored binned matrix does not match its recorded "
+                    "fingerprint (" + ", ".join(
+                        f"{k}: stored={stored[k]!r} got={got[k]!r}"
+                        for k in diffs) + ")")
         return ds
 
     def _check_constructed(self) -> None:

@@ -171,6 +171,31 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if fobj is not None:
         params["objective"] = "none"
 
+    # out-of-core route: a StreamedDataset with tpu_ingest_mode=chunked
+    # trains via chunk-accumulated wave histograms (ingest/train.py) —
+    # HBM bounded by the chunk budget, not by rows.  The default "hbm"
+    # mode falls through: the streamed binned cache uploads once and
+    # every normal learner path runs unchanged (bit-identical to
+    # in-core training).
+    if getattr(train_set, "is_streamed", False) and \
+            str(cfg.tpu_ingest_mode) == "chunked":
+        from .ingest.train import train_streamed
+        unsupported = [nm for nm, v in (
+            ("valid_sets", valid_sets), ("fobj", fobj), ("feval", feval),
+            ("init_model", init_model), ("callbacks", callbacks)) if v]
+        if unsupported:
+            raise ValueError(
+                "tpu_ingest_mode=chunked training does not support "
+                + ", ".join(unsupported) +
+                " yet; drop them or use tpu_ingest_mode=hbm")
+        if isinstance(resume_from, Checkpoint):
+            raise ValueError("tpu_ingest_mode=chunked resume takes a "
+                             "bundle/directory path, not a loaded "
+                             "Checkpoint object")
+        return train_streamed(params, train_set, num_boost_round,
+                              resume_from=(str(resume_from)
+                                           if resume_from else None))
+
     booster = Booster(params=params, train_set=train_set)
     if init_model is not None:
         # Continued training keeps the loaded trees in the model (the

@@ -1,0 +1,32 @@
+"""Out-of-core ingestion subsystem: streaming sketch binning + chunked
+host->HBM training toward 10^9 rows (ROADMAP item 2; reference
+``pipeline_reader.h`` streaming ingest + sampled bin finding, PAPER.md
+layers 0/3; XGBoost external-memory + gradient-based sampling,
+arXiv:1806.11248).
+
+Layers:
+
+* :mod:`.source` — ``ChunkSource`` row-block iterators (mmap ``.npy``,
+  CSV/TSV, optional Arrow/parquet, deterministic synthetic);
+* :mod:`.sketch` — one-pass mergeable per-feature summaries producing
+  BinMappers bit-identical to in-core construction;
+* :mod:`.stream` — ``StreamedDataset``: two streaming passes into an
+  on-disk binned cache, full Dataset API on top;
+* :mod:`.grower` / :mod:`.train` — chunk-accumulated wave training with
+  a rows-independent HBM budget (``tpu_ingest_mode=chunked``).
+"""
+
+from .source import (ArraySource, ArrowSource, Chunk, ChunkSource,
+                     CSVSource, DEFAULT_CHUNK_ROWS, NumpyMmapSource,
+                     SyntheticSource)
+from .sketch import BinningSketch, sample_row_indices
+from .stream import StreamedDataset
+from .grower import ChunkedWaveGrower, StreamedEnvelopeError
+from .train import train_streamed
+
+__all__ = [
+    "ArraySource", "ArrowSource", "Chunk", "ChunkSource", "CSVSource",
+    "DEFAULT_CHUNK_ROWS", "NumpyMmapSource", "SyntheticSource",
+    "BinningSketch", "sample_row_indices", "StreamedDataset",
+    "ChunkedWaveGrower", "StreamedEnvelopeError", "train_streamed",
+]

@@ -1,0 +1,385 @@
+"""Chunked streamed training driver: boosting over a StreamedDataset.
+
+Drives :class:`..ingest.grower.ChunkedWaveGrower` through the boosting
+loop with every per-row array host-resident (score, gradients, bag mask,
+per-chunk ``row_leaf``) — HBM holds only the bounded chunk ring plus the
+wave state, so total rows are limited by disk + host RAM at ~20 B/row,
+not by accelerator memory (ROADMAP item 2's 10^8-10^9-row regime).
+
+Envelope (checked, typed errors): numeric features, objective ``regression``
+or ``binary``, boosting ``gbdt``/``goss``, single class, no monotone/
+interaction/forced-split/CEGB/linear-tree extras; ``stochastic_rounding``
+and ``quant_train_renew_leaf`` are forced off (both need full-row device
+passes).  Everything else — including bagging, ``feature_fraction``,
+quantized gradients and boost-from-average — matches the in-core
+trainer's host-side sampling streams exactly.  With
+``use_quantized_grad=true`` the produced model text is bit-identical to
+an in-core ``engine.train`` run of the same configuration
+(tests/test_ingest_train.py).
+
+GOSS (arXiv:1806.11248's gradient-based sampling recipe for the
+out-of-core tail): with ``boosting=goss`` the per-tree bag keeps the
+top-``top_rate`` rows by |grad*hess| plus a Bernoulli ``other_rate``
+sample of the rest (amplified by (1-a)/b), computed host-side over the
+streamed gradient array — the thinned rows then skip every chunk's
+histogram work for that tree.
+
+Checkpoint/resume rides the PR-6 bundle format
+(:mod:`..resilience.checkpoint`): the bundle's dataset fingerprint is the
+StreamedDataset's streamed crc, so a resume against re-streamed chunks
+validates end-to-end, and the continuation is bit-identical on the
+quantized path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..basic import Booster
+from ..config import Config
+from ..learner.serial import (resolve_hist_impl, split_params_from_config)
+from ..models.gbdt import (EPSILON, GBDT, _grown_to_tree, bagging_mask_np,
+                           feature_mask_np)
+from ..objective import create_objective
+from ..objective.binary import BinaryLogloss
+from ..objective.regression import RegressionL2
+from ..ops.quantize import quant_levels
+from ..resilience.checkpoint import (CKPT_SOFT_KEYS, CKPT_STRUCTURAL_KEYS,
+                                     Checkpoint, CheckpointManager,
+                                     load_checkpoint)
+from ..telemetry.trace import span
+from ..utils.log import log_info, log_warning
+from ..utils.random import host_rng, rng_checkpoint_state
+from .grower import ChunkedWaveGrower, StreamedEnvelopeError
+from .stream import StreamedDataset
+
+__all__ = ["train_streamed", "StreamedEnvelopeError"]
+
+
+def _check_envelope(cfg: Config) -> None:
+    bad = []
+    if cfg.num_class > 1:
+        bad.append("num_class>1")
+    if cfg.boosting not in ("gbdt", "goss"):
+        bad.append(f"boosting={cfg.boosting}")
+    if cfg.linear_tree:
+        bad.append("linear_tree")
+    if cfg.monotone_constraints and \
+            any(int(v) != 0 for v in cfg.monotone_constraints):
+        bad.append("monotone_constraints")
+    if cfg.interaction_constraints:
+        bad.append("interaction_constraints")
+    if cfg.forcedsplits_filename:
+        bad.append("forcedsplits_filename")
+    if cfg.cegb_penalty_split > 0 or cfg.cegb_penalty_feature_coupled or \
+            cfg.cegb_penalty_feature_lazy:
+        bad.append("cegb penalties")
+    if cfg.feature_fraction_bynode < 1.0:
+        bad.append("feature_fraction_bynode")
+    if cfg.extra_trees:
+        bad.append("extra_trees")
+    if cfg.path_smooth > 0:
+        bad.append("path_smooth")
+    if bad:
+        raise StreamedEnvelopeError(
+            "chunked streamed training (tpu_ingest_mode=chunked) does not "
+            "support: " + ", ".join(bad) + "; train with "
+            "tpu_ingest_mode=hbm (in-core from the streamed binned cache) "
+            "instead")
+
+
+def _host_objective(cfg: Config, label: Optional[np.ndarray],
+                    weight: Optional[np.ndarray], n: int):
+    """Objective with HOST-resident label/weight (no O(N) device copy).
+    Mirrors ``ObjectiveFunction.init`` minus the device upload; the
+    gradient formulas themselves run per chunk."""
+    obj = create_objective(cfg.objective, cfg)
+    ok = (type(obj) is BinaryLogloss or
+          (type(obj) is RegressionL2 and not obj.sqrt))
+    if not ok:
+        raise StreamedEnvelopeError(
+            f"chunked streamed training supports objective=regression|"
+            f"binary (got {cfg.objective}); use tpu_ingest_mode=hbm")
+    if label is None:
+        raise ValueError(f"objective {obj.name} requires labels")
+    label = np.asarray(label, np.float32)
+    obj.check_label(label)
+    obj.label = label
+    obj.weight = None if weight is None else np.asarray(weight, np.float32)
+    obj.num_data = n
+    if type(obj) is BinaryLogloss:
+        # the class-weight computation of BinaryLogloss.init, host-side
+        cnt_pos = float((label > 0).sum())
+        cnt_neg = float((label <= 0).sum())
+        w0 = w1 = 1.0
+        if obj.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
+            if cnt_pos > cnt_neg:
+                w0 = cnt_pos / cnt_neg
+            else:
+                w1 = cnt_neg / cnt_pos
+        w1 *= obj.scale_pos_weight
+        obj.label_weight = (w0, w1)
+    return obj
+
+
+def _chunk_gradients(obj, score_c: np.ndarray, label_c: np.ndarray,
+                     weight_c: Optional[np.ndarray]):
+    """One chunk's gradients through the objective's own formula —
+    elementwise per row, so per-chunk evaluation is bit-identical to the
+    in-core full-array call."""
+    import jax.numpy as jnp
+    saved = (obj.label, obj.weight)
+    try:
+        obj.label = jnp.asarray(label_c, jnp.float32)
+        obj.weight = None if weight_c is None else \
+            jnp.asarray(weight_c, jnp.float32)
+        g, h = obj.get_gradients(jnp.asarray(score_c, jnp.float32))
+        return np.asarray(g), np.asarray(h)
+    finally:
+        obj.label, obj.weight = saved
+
+
+def _goss_mult_np(grad: np.ndarray, hess: np.ndarray, top_rate: float,
+                  other_rate: float, seed: int, iteration: int):
+    """Host GOSS draw (goss.hpp:103-152 semantics, mirroring the in-core
+    device GOSS in models/boosting.py): the rest rows sample at
+    ``b/(1-a)`` so ~``b*n`` of them survive, and the ``(1-a)/b``
+    amplification keeps their expected gradient mass unbiased.  Returns
+    (mask, multiplier) or None when sampling keeps everything."""
+    n = len(grad)
+    a, b = float(top_rate), float(other_rate)
+    if a + b >= 1.0:
+        return None
+    score = np.abs(grad * hess)
+    k = max(1, int(n * a))
+    thr = np.partition(score, n - k)[n - k]
+    top = score >= thr
+    rng = host_rng(seed, iteration)
+    rest_p = b / max(1.0 - a, 1e-12)
+    keep_rest = (~top) & (rng.random(n) < rest_p)
+    amp = (1.0 - a) / max(b, 1e-12)
+    mask = (top | keep_rest).astype(np.float32)
+    mult = np.where(keep_rest, np.float32(amp),
+                    np.float32(1.0)).astype(np.float32)
+    return mask, mult
+
+
+def _glue_gbdt(cfg: Config, train_set: StreamedDataset, obj,
+               trees: List[Any]) -> GBDT:
+    """A host-only GBDT shell carrying the streamed-trained model (for
+    model_to_string / Booster surfaces; no device state)."""
+    g = GBDT(cfg, None, objective=obj)
+    g.train_set = train_set
+    g.num_data = train_set.num_data()
+    g.num_features = train_set.num_feature()
+    g.num_tree_per_iteration = 1
+    g.models = list(trees)
+    g.iter_ = len(trees)
+    return g
+
+
+def train_streamed(params: Dict[str, Any], train_set: StreamedDataset,
+                   num_boost_round: int = 100,
+                   resume_from: Optional[str] = None) -> Booster:
+    """Boost ``num_boost_round`` trees over a StreamedDataset with
+    chunk-accumulated histograms; returns a Booster."""
+    cfg = Config(dict(params))
+    _check_envelope(cfg)
+    if cfg.use_quantized_grad and cfg.stochastic_rounding:
+        log_warning("chunked streamed training forces "
+                    "stochastic_rounding=false (the per-row rounding "
+                    "stream is not chunk-sliceable)")
+        cfg.stochastic_rounding = False
+    if cfg.use_quantized_grad and cfg.quant_train_renew_leaf:
+        log_warning("chunked streamed training forces "
+                    "quant_train_renew_leaf=false")
+        cfg.quant_train_renew_leaf = False
+    train_set.construct(cfg)
+    n = train_set.num_data()
+    f_used = train_set.num_feature()
+    mappers = [train_set.bin_mappers[j] for j in train_set.used_feature_map]
+    from ..binning import MissingType
+    num_bins = np.array([m.num_bin for m in mappers], np.int32)
+    is_cat = np.array([m.is_categorical for m in mappers], bool)
+    has_nan = np.array([m.missing_type == MissingType.NAN for m in mappers],
+                       bool)
+    if np.any(is_cat):
+        raise StreamedEnvelopeError(
+            "chunked streamed training supports numeric features only; "
+            "use tpu_ingest_mode=hbm for categorical data")
+    max_bins = int(num_bins.max())
+    if cfg.use_quantized_grad:
+        # the int32 channel-sum exactness bound GBDT._init_train warns
+        # about (single shard here): past it the quantized accumulator
+        # can wrap and the chunked==in-core contract is void
+        _gq = max(quant_levels(int(cfg.num_grad_quant_bins)))
+        if n > (1 << 31) // _gq:
+            log_warning(
+                f"num_data={n} exceeds the quantized histogram's int32 "
+                f"channel-sum exactness bound (2^31/{_gq} rows at "
+                f"num_grad_quant_bins={cfg.num_grad_quant_bins}); lower "
+                f"num_grad_quant_bins or shard rows across more devices")
+    elif n > (1 << 24):
+        log_warning(f"num_data={n} exceeds the f32 histogram count "
+                    "channel's 16.7M-row exactness range; set "
+                    "use_quantized_grad=true for exact int32 counts (and "
+                    "the chunked bit-identity contract) at this scale")
+    impl = resolve_hist_impl(cfg, wave=True, max_bins=max_bins)
+    if impl == "packed4":
+        impl = "segment"   # no leaf-channel form (ops/histogram.py)
+    if impl == "pallas":
+        from ..ops.histogram_pallas import DEFAULT_ROW_BLOCK
+        if train_set.chunk_rows % DEFAULT_ROW_BLOCK:
+            log_warning(f"chunk_rows={train_set.chunk_rows} is not a "
+                        f"multiple of the Pallas row block "
+                        f"({DEFAULT_ROW_BLOCK}); using the XLA onehot "
+                        f"histogram path")
+            impl = "onehot"
+    sp = split_params_from_config(cfg, num_bins, is_cat)
+    gq_max, hq_max = quant_levels(int(cfg.num_grad_quant_bins))
+    grower = ChunkedWaveGrower(
+        num_leaves=int(cfg.num_leaves), num_features=f_used,
+        max_bins=max_bins, max_depth=int(cfg.max_depth), split_params=sp,
+        num_bins=num_bins, has_nan=has_nan, hist_impl=impl,
+        quantized=bool(cfg.use_quantized_grad), gq_max=gq_max,
+        hq_max=hq_max, wave_size=int(cfg.tpu_wave_size),
+        interpret=None, pipeline=(None if cfg.tpu_pallas_pipeline == "auto"
+                                  else str(cfg.tpu_pallas_pipeline)))
+
+    md = train_set.metadata
+    obj = _host_objective(cfg, md.label, md.weight, n)
+    label32 = obj.label
+    weight32 = obj.weight
+
+    # ---- initial scores (GBDT._init_train's score0 logic) -----------------
+    score = np.zeros(n, np.float32)
+    pending_bias = 0.0
+    if md.init_score is not None:
+        score += md.init_score.reshape(n).astype(np.float32)
+    elif cfg.boost_from_average:
+        pending_bias = obj.boost_from_score(0)
+        if abs(pending_bias) > EPSILON:
+            log_info(f"Start training from score {pending_bias:.6f}")
+        score += np.float32(pending_bias)
+
+    # ---- checkpoint / resume ----------------------------------------------
+    ckpt_dir = str(cfg.checkpoint_dir or "")
+    if not ckpt_dir and int(cfg.snapshot_freq) > 0:
+        ckpt_dir = f"{cfg.output_model}.ckpt"
+    manager = CheckpointManager(ckpt_dir, int(cfg.checkpoint_keep)) \
+        if ckpt_dir else None
+    freq = int(cfg.snapshot_freq) if int(cfg.snapshot_freq) > 0 else \
+        max(1, num_boost_round // 100)
+    if resume_from is None and str(cfg.resume).strip():
+        want = str(cfg.resume).strip()
+        if want in ("latest", "auto"):
+            resume_from = manager.latest_path() if manager else None
+            if resume_from is None and not manager:
+                raise ValueError("resume=latest needs snapshot_freq>0 or "
+                                 "checkpoint_dir")
+        else:
+            resume_from = want
+    trees: List[Any] = []
+    start_iter = 0
+    if resume_from:
+        ckpt = load_checkpoint(str(resume_from))
+        ckpt.validate_dataset(train_set)
+        ckpt.validate_config(cfg)
+        from ..models.model_text import string_to_model
+        loaded = string_to_model(ckpt.model_text, cfg)
+        trees = list(loaded.models)
+        start_iter = int(ckpt.iteration)
+        score = np.asarray(ckpt.score, np.float32).reshape(n).copy()
+        log_info(f"train_streamed: resumed at iteration {start_iter} "
+                 f"from {resume_from}")
+
+    def _save_ckpt(it: int) -> None:
+        if manager is None:
+            return
+        text = _glue_gbdt(cfg, train_set, obj, trees) \
+            .save_model_to_string()
+        manager.save(Checkpoint(
+            iteration=it, model_text=text, score=score.copy(),
+            rng_state=rng_checkpoint_state(cfg),
+            fingerprint=train_set.fingerprint(),
+            params={k: getattr(cfg, k)
+                    for k in CKPT_STRUCTURAL_KEYS + CKPT_SOFT_KEYS}))
+
+    # ---- boosting loop -----------------------------------------------------
+    shrinkage = float(cfg.learning_rate)
+    goss = cfg.boosting == "goss"
+    if goss and cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+        # in-core GOSS ignores bagging too (models/boosting.py GOSS)
+        log_warning("cannot use bagging in GOSS (ignored)")
+    warmup = int(1.0 / max(float(cfg.learning_rate), 1e-12))
+    grad = np.empty(n, np.float32)
+    hess = np.empty(n, np.float32)
+    completed = start_iter
+    for it in range(start_iter, num_boost_round):
+        with span("ingest/train/iteration"):
+            for i in range(train_set.num_chunks()):
+                lo, hi = train_set.chunk_bounds(i)
+                g, h = _chunk_gradients(
+                    obj, score[lo:hi], label32[lo:hi],
+                    None if weight32 is None else weight32[lo:hi])
+                grad[lo:hi] = g
+                hess[lo:hi] = h
+            if goss:
+                # GOSS replaces bagging (in-core GOSS overrides
+                # _prepare_iter_sampling and never draws a bag)
+                mask = np.ones(n, np.float32)
+                if it >= warmup:
+                    gm = _goss_mult_np(grad, hess, float(cfg.top_rate),
+                                       float(cfg.other_rate),
+                                       int(cfg.bagging_seed), it)
+                    if gm is not None:
+                        mask, mult = gm
+                        grad = grad * mult
+                        hess = hess * mult
+            else:
+                mask = bagging_mask_np(
+                    cfg, n, it,
+                    label=(np.asarray(label32) if cfg.objective == "binary"
+                           else None))
+                mask = np.ones(n, np.float32) if mask is None else mask
+            fmask = feature_mask_np(cfg, f_used, it)
+            grown, rl_chunks = grower.grow(train_set, grad, hess, mask,
+                                           feature_mask=fmask)
+            nl = int(grown.num_leaves)
+            if nl <= 1 and trees:
+                log_warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                break
+            tree = _grown_to_tree(grown, shrinkage, train_set)
+            bias = pending_bias if it == start_iter and not trees else 0.0
+            if abs(bias) > EPSILON:
+                tree.add_bias(bias)
+            trees.append(tree)
+            # score update: the in-core _update_score_impl's
+            # score + lv[row_leaf], per chunk, host f32 (same IEEE ops)
+            lv = (np.asarray(grown.leaf_value, np.float32) *
+                  np.float32(shrinkage))
+            for i, rl_c in enumerate(rl_chunks):
+                lo, hi = train_set.chunk_bounds(i)
+                score[lo:hi] = score[lo:hi] + lv[rl_c.astype(np.int64)]
+            completed = it + 1
+            if nl <= 1:
+                log_warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                break
+            if manager is not None and completed % freq == 0:
+                _save_ckpt(completed)
+    if manager is not None:
+        _save_ckpt(completed)
+
+    gbdt = _glue_gbdt(cfg, train_set, obj, trees)
+    bst = Booster.__new__(Booster)
+    bst.params = dict(params)
+    bst.best_iteration = -1
+    bst.best_score = {}
+    bst._train_data_name = "training"
+    bst.config = cfg
+    bst._gbdt = gbdt
+    return bst

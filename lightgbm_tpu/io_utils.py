@@ -18,6 +18,21 @@ import numpy as np
 # interleaved bytes this module exists to prevent
 _tmp_seq = itertools.count()
 
+# missing-value tokens every CSV path coerces to NaN (genfromtxt-ish
+# tolerance) — single-sourced so the in-core loader below and the
+# streaming CSVSource (ingest/source.py) cannot drift
+CSV_NA_VALUES = ("", "NA", "nan", "NULL", "null", "?", "N/A", "na")
+
+
+def parse_label_column(params: Dict[str, Any]) -> int:
+    """The reference CLI ``label_column`` convention: column 0 unless
+    ``label_column``/``label`` names ``column_<i>`` or a bare index —
+    shared by :func:`load_data_file` and the streaming CSVSource."""
+    lc = str(params.get("label_column", "") or params.get("label", ""))
+    if lc.startswith("column_") or lc.isdigit():
+        return int(lc.replace("column_", "") or 0)
+    return 0
+
 
 def atomic_write_bytes(path: str, data: Optional[bytes] = None,
                        writer: Optional[Callable] = None) -> None:
@@ -103,10 +118,7 @@ def load_data_file(path: str, params: Optional[Dict[str, Any]] = None
     """
     params = params or {}
     header = str(params.get("header", "false")).lower() in ("true", "1")
-    label_col = 0
-    lc = str(params.get("label_column", "") or params.get("label", ""))
-    if lc.startswith("column_") or lc.isdigit():
-        label_col = int(lc.replace("column_", "") or 0)
+    label_col = parse_label_column(params)
 
     with open(path) as fh:
         first = fh.readline()
@@ -164,7 +176,7 @@ def _load_dense(path: str, delim: str, skip: int,
     # and ANY unparseable token coerced to NaN rather than raising (the
     # slow coerce path only runs when the fast typed parse fails)
     kw = dict(sep=delim, header=None, skiprows=skip, comment="#",
-              na_values=["", "NA", "nan", "NULL", "null", "?", "N/A", "na"])
+              na_values=list(CSV_NA_VALUES))
 
     def _to_f64(df):
         """Clean numeric columns are already float64 after type inference

@@ -63,7 +63,19 @@ from .endgame import patch_child_pointers, write_split_records
 from .serial import CommStrategy, GrownTree, local_best_candidate
 
 __all__ = ["make_wave_grow_fn", "WAVE_SIZE", "Q_WAVE_SIZE",
-           "lazy_bitmap_init", "LAZY_PACK"]
+           "lazy_bitmap_init", "LAZY_PACK", "wave_taper_k"]
+
+
+def wave_taper_k(budget, W: int):
+    """Endgame-taper wave width: commit min(W, budget) splits while the
+    budget is ample, halve the wave once budget < 2W (with a W//4 floor
+    capping the halving cascade) so freshly-created children get to
+    compete near exhaustion.  Shared by the traced in-core wave body and
+    the chunked streamed grower (ingest/grower.py), which must select
+    identically for the streamed-vs-in-core bit-identity contract."""
+    taper = jnp.maximum(budget // 2, jnp.minimum(W // 4, budget))
+    return jnp.minimum(W, jnp.maximum(
+        1, jnp.where(budget >= 2 * W, budget, taper)))
 
 # Lazy-CEGB persistent bitmap layout: one bit per (feature, row), packed
 # LSB-first into uint8 bytes — 8x less HBM than the former bool layout
@@ -1125,9 +1137,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 # at ~2-3 extra waves (each wave is a full-data histogram
                 # pass — a log2(W)-deep taper costs more wall time than
                 # its last few splits are worth).
-                taper = jnp.maximum(budget // 2, jnp.minimum(W // 4, budget))
-                k_eff = jnp.minimum(W, jnp.maximum(
-                    1, jnp.where(budget >= 2 * W, budget, taper)))
+                k_eff = wave_taper_k(budget, W)
                 vals, sel_leaves = jax.lax.top_k(s["cand_gain"], W)
                 sel = (vals > 0) & (jarange < k_eff)
                 feat = s["cand_feat"][sel_leaves]          # (W,)

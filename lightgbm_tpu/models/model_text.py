@@ -191,11 +191,13 @@ def model_to_string(gbdt, start_iteration: int = 0,
         tail.write(f"{name}={int(val)}\n")
     tail.write("\nparameters:\n")
     for key, value in sorted(gbdt.config.to_dict().items()):
-        if key in ("resume", "checkpoint_dir", "checkpoint_keep"):
+        if key in ("resume", "checkpoint_dir", "checkpoint_keep",
+                   "tpu_ingest_mode"):
             # transient run directives, not training config: a preempted-
             # and-resumed run must produce byte-identical model text to
-            # the run that never stopped, and a shipped model must not
-            # embed machine-local checkpoint paths
+            # the run that never stopped, a shipped model must not embed
+            # machine-local checkpoint paths, and a model trained
+            # streamed-chunked must match its in-core twin byte for byte
             continue
         if isinstance(value, list):
             value = ",".join(str(v) for v in value)

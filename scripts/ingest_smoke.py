@@ -1,0 +1,93 @@
+"""Ingest footprint smoke: stream a synthetic source far beyond what
+in-core construction could hold and ASSERT the host working set stays
+flat (bounded by the chunk budget, not by rows).
+
+    JAX_PLATFORMS=cpu python scripts/ingest_smoke.py \
+        rows=1e7 features=8 chunk_rows=1048576 rss_cap_mb=900 train_rounds=1
+
+Measures peak RSS (ru_maxrss) across StreamedDataset construct (sketch
+pass + bin/spill pass) and an optional short chunked-training run, and
+exits nonzero when the peak exceeds ``rss_cap_mb`` — a cap chosen far
+below the raw matrix's ``rows * features * 8`` bytes, so an accidental
+materialization (the regression class this smoke exists to catch) fails
+the build immediately.  The in-core equivalent at the default geometry
+would need ~6x the cap for the raw f64 matrix alone.
+
+CI runs this in the static-analysis job next to lint-mem: lint-mem
+checks the DECLARED rows-independent HBM curve statically; this smoke
+checks the HOST side empirically.
+"""
+
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _rss_mb() -> float:
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # ru_maxrss is KiB on Linux, bytes on macOS
+    return peak / (1 << 20) if sys.platform == "darwin" else peak / 1024.0
+
+
+def main(argv):
+    kv = {}
+    for a in argv:
+        if "=" in a:
+            k, v = a.lstrip("-").split("=", 1)
+            kv[k.replace("-", "_")] = v
+    rows = int(float(kv.get("rows", 1e7)))
+    features = int(kv.get("features", 8))
+    chunk_rows = int(float(kv.get("chunk_rows", 1 << 20)))
+    rss_cap_mb = float(kv.get("rss_cap_mb", 900))
+    train_rounds = int(kv.get("train_rounds", 1))
+    out_path = kv.get("out", "")
+
+    from lightgbm_tpu.ingest import StreamedDataset, SyntheticSource, \
+        train_streamed
+
+    params = {"objective": "binary", "verbosity": -1, "max_bin": 63,
+              "num_leaves": 31, "enable_bundle": False,
+              "use_quantized_grad": True, "stochastic_rounding": False,
+              "tree_grow_mode": "wave", "tpu_exact_endgame": False,
+              "tpu_speculative_ramp": False,
+              "bin_construct_sample_cnt": 200000}
+    raw_gb = rows * features * 8 / 1e9
+    rss0 = _rss_mb()
+    report = {"rows": rows, "features": features, "chunk_rows": chunk_rows,
+              "rss_cap_mb": rss_cap_mb, "raw_matrix_gb": round(raw_gb, 3),
+              "rss_baseline_mb": round(rss0, 1)}
+    src = SyntheticSource(rows, features, chunk_rows=chunk_rows, seed=1)
+    t0 = time.perf_counter()
+    sd = StreamedDataset(src, params=params).construct()
+    report["construct_seconds"] = round(time.perf_counter() - t0, 1)
+    report["construct_rows_per_sec"] = round(
+        rows / max(1e-9, time.perf_counter() - t0), 1)
+    report["rss_after_construct_mb"] = round(_rss_mb(), 1)
+    report["spill_bytes"] = os.path.getsize(sd._spill_path)
+
+    if train_rounds > 0:
+        t0 = time.perf_counter()
+        bst = train_streamed(params, sd, num_boost_round=train_rounds)
+        report["train_seconds"] = round(time.perf_counter() - t0, 1)
+        report["trees"] = len(bst._gbdt.models)
+    report["rss_peak_mb"] = round(_rss_mb(), 1)
+    report["ok"] = report["rss_peak_mb"] <= rss_cap_mb
+    print(json.dumps(report, indent=2))
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(report, fh, indent=2)
+    if not report["ok"]:
+        print(f"FAIL: peak RSS {report['rss_peak_mb']} MB exceeds the "
+              f"{rss_cap_mb} MB chunk-budget cap (raw matrix would be "
+              f"{raw_gb:.1f} GB — something materialized O(rows) state)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,302 @@
+"""Out-of-core ingestion subsystem: sources, streaming sketch binning,
+StreamedDataset, and the streamed-vs-in-core identity contract on the
+engine.train (hbm) route."""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.binning import (find_bin, find_bin_from_summary,
+                                  merge_column_summaries, summarize_column)
+from lightgbm_tpu.ingest import (ArraySource, BinningSketch, CSVSource,
+                                 NumpyMmapSource, StreamedDataset,
+                                 SyntheticSource, sample_row_indices)
+
+
+def _data(n=3001, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    if f > 2:
+        X[:, 2] = np.where(rng.rand(n) < 0.3, 0.0, X[:, 2])
+    if f > 3:
+        X[:, 3] = np.where(rng.rand(n) < 0.1, np.nan, X[:, 3])
+    if f > 4:
+        X[:, 4] = rng.randint(0, 9, n)
+    y = (X[:, 0] + np.nan_to_num(X[:, 1]) * 0.5 +
+         rng.randn(n) * 0.5 > 0).astype(np.float64)
+    return X, y
+
+
+def _mappers_equal(a, b):
+    assert len(a) == len(b)
+    for j, (ma, mb) in enumerate(zip(a, b)):
+        assert ma.num_bin == mb.num_bin, j
+        assert ma.is_categorical == mb.is_categorical, j
+        assert ma.missing_type == mb.missing_type, j
+        assert ma.default_bin == mb.default_bin, j
+        assert ma.most_freq_bin == mb.most_freq_bin, j
+        assert ma.forced_trivial == mb.forced_trivial, j
+        if ma.bin_upper_bound is not None or mb.bin_upper_bound is not None:
+            assert np.array_equal(ma.bin_upper_bound, mb.bin_upper_bound), j
+        assert ma.cat_to_bin == mb.cat_to_bin, j
+
+
+# ---------------------------------------------------------------------------
+# summaries / sketch
+# ---------------------------------------------------------------------------
+
+def test_summary_merge_matches_one_shot():
+    rng = np.random.RandomState(1)
+    vals = np.concatenate([rng.randn(500), np.zeros(100),
+                           np.full(30, np.nan), rng.randn(200) * 1e-3])
+    rng.shuffle(vals)
+    one = find_bin(vals, max_bin=63)
+    parts = [summarize_column(vals[i::7]) for i in range(7)]
+    merged = parts[0]
+    for p in parts[1:]:
+        merged = merge_column_summaries(merged, p)
+    two = find_bin_from_summary(merged, 63)
+    _mappers_equal([one], [two])
+
+
+def test_summary_merge_categorical():
+    rng = np.random.RandomState(2)
+    vals = rng.randint(0, 40, 2000).astype(np.float64)
+    one = find_bin(vals, max_bin=16, is_categorical=True)
+    a = summarize_column(vals[:777], is_categorical=True)
+    b = summarize_column(vals[777:], is_categorical=True)
+    two = find_bin_from_summary(merge_column_summaries(a, b), 16)
+    _mappers_equal([one], [two])
+
+
+def test_sketch_serialize_roundtrip():
+    X, _ = _data()
+    sk = BinningSketch(X.shape[1], cat_indices=[4])
+    sk.update(X[:1500])
+    sk.update(X[1500:])
+    flat, layout = sk.serialize()
+    sk2 = BinningSketch.deserialize(flat, layout, cat_indices=[4])
+    for j in range(X.shape[1]):
+        a, b = sk.summary(j), sk2.summary(j)
+        assert np.array_equal(a.distinct, b.distinct)
+        assert np.array_equal(a.counts, b.counts)
+        assert (a.na_cnt, a.total_cnt) == (b.na_cnt, b.total_cnt)
+
+
+def test_sample_row_indices_matches_incore_draw():
+    n, cnt, seed = 5000, 1200, 17
+    rng = np.random.RandomState(seed)
+    expect = np.sort(rng.choice(n, size=cnt, replace=False))
+    assert np.array_equal(sample_row_indices(n, cnt, seed), expect)
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+
+def test_chunk_rows_quantum_validated():
+    with pytest.raises(ValueError, match="multiple"):
+        ArraySource(np.zeros((10, 2)), chunk_rows=100)
+
+
+def test_numpy_mmap_source(tmp_path):
+    X, y = _data(1500, 4, seed=3)
+    xp = tmp_path / "x.npy"
+    yp = tmp_path / "y.npy"
+    np.save(xp, X)
+    np.save(yp, y)
+    src = NumpyMmapSource(str(xp), str(yp), chunk_rows=512)
+    assert src.num_rows() == 1500 and src.num_features() == 4
+    got = np.concatenate([c.X for c in src.chunks()])
+    lab = np.concatenate([c.label for c in src.chunks()])
+    assert np.array_equal(np.nan_to_num(got), np.nan_to_num(X))
+    assert np.array_equal(lab, y)
+
+
+def test_csv_source(tmp_path):
+    rng = np.random.RandomState(4)
+    X = rng.randn(700, 3)
+    y = (X[:, 0] > 0).astype(np.float64)
+    path = tmp_path / "d.csv"
+    with open(path, "w") as fh:
+        for i in range(700):
+            fh.write(",".join(f"{v:.9g}" for v in [y[i]] + list(X[i])) + "\n")
+    src = CSVSource(str(path), chunk_rows=256)
+    assert src.num_rows() == 700 and src.num_features() == 3
+    got = np.concatenate([c.X for c in src.chunks()])
+    lab = np.concatenate([c.label for c in src.chunks()])
+    assert np.allclose(got, X, atol=1e-7)
+    assert np.array_equal(lab, y)
+
+
+def test_csv_source_comments_and_header(tmp_path):
+    """Leading '#' comment lines and a header: num_rows() must agree
+    with what chunks() yields (a mismatch crashes the spill memmap)."""
+    rng = np.random.RandomState(8)
+    X = rng.randn(300, 2)
+    y = (X[:, 0] > 0).astype(np.float64)
+    path = tmp_path / "c.csv"
+    with open(path, "w") as fh:
+        fh.write("# a comment before the header\n")
+        fh.write("target,a,b\n")
+        fh.write("# and one after\n")
+        for i in range(300):
+            fh.write(f"{y[i]:g},{X[i,0]:.9g},{X[i,1]:.9g}\n")
+    src = CSVSource(str(path), params={"header": "true"}, chunk_rows=256)
+    assert src.num_rows() == 300 and src.num_features() == 2
+    assert src.feature_names() == ["a", "b"]
+    got = np.concatenate([c.X for c in src.chunks()])
+    assert got.shape == (300, 2)
+    assert np.allclose(got, X, atol=1e-7)
+    sd = StreamedDataset(src, params={"verbosity": -1}).construct()
+    assert sd.num_data() == 300
+
+
+def test_arrow_source(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+    rng = np.random.RandomState(6)
+    X = rng.randn(900, 3)
+    y = (X[:, 0] > 0).astype(np.float64)
+    tbl = pa.table({"f0": X[:, 0], "f1": X[:, 1], "f2": X[:, 2],
+                    "target": y})
+    path = str(tmp_path / "d.parquet")
+    pq.write_table(tbl, path, row_group_size=256)
+    from lightgbm_tpu.ingest import ArrowSource
+    src = ArrowSource(path, label="target", chunk_rows=256)
+    assert src.num_rows() == 900 and src.num_features() == 3
+    assert src.feature_names() == ["f0", "f1", "f2"]
+    got = np.concatenate([c.X for c in src.chunks()])
+    lab = np.concatenate([c.label for c in src.chunks()])
+    assert np.allclose(got, X)
+    assert np.array_equal(lab, y)
+    params = {"verbosity": -1, "enable_bundle": False}
+    sd = StreamedDataset(src, params=params).construct()
+    ds = lgb.Dataset(X.copy(), label=y.copy(), params=params).construct()
+    assert np.array_equal(np.asarray(ds.X_binned), np.asarray(sd.X_binned))
+
+
+def test_synthetic_source_reiterates_identically():
+    src = SyntheticSource(2000, 5, chunk_rows=512, seed=9)
+    a = [c.X.copy() for c in src.chunks()]
+    b = [c.X.copy() for c in src.chunks()]
+    for xa, xb in zip(a, b):
+        assert np.array_equal(xa, xb)
+    assert sum(len(x) for x in a) == 2000
+
+
+# ---------------------------------------------------------------------------
+# StreamedDataset: construct identity with in-core
+# ---------------------------------------------------------------------------
+
+def test_streamed_dataset_matches_incore():
+    X, y = _data()
+    params = {"verbosity": -1, "enable_bundle": False,
+              "bin_construct_sample_cnt": 1200}
+    ds = lgb.Dataset(X.copy(), label=y.copy(), params=params,
+                     categorical_feature=[4]).construct()
+    sd = StreamedDataset(ArraySource(X, y, chunk_rows=512), params=params,
+                         categorical_feature=[4]).construct()
+    _mappers_equal(ds.bin_mappers, sd.bin_mappers)
+    assert np.array_equal(ds.used_feature_map, sd.used_feature_map)
+    assert np.array_equal(np.asarray(ds.X_binned), np.asarray(sd.X_binned))
+    assert ds.fingerprint() == sd.fingerprint()
+    assert np.array_equal(ds.metadata.label, sd.metadata.label)
+
+
+@pytest.mark.parametrize("n", [2048, 2049])
+def test_streamed_dataset_chunk_boundaries(n):
+    X, y = _data(n, 5, seed=11)
+    params = {"verbosity": -1, "enable_bundle": False}
+    ds = lgb.Dataset(X.copy(), label=y.copy(), params=params).construct()
+    sd = StreamedDataset(ArraySource(X, y, chunk_rows=512),
+                         params=params).construct()
+    assert np.array_equal(np.asarray(ds.X_binned), np.asarray(sd.X_binned))
+    assert ds.fingerprint() == sd.fingerprint()
+
+
+def test_streamed_dataset_spill_is_on_disk(tmp_path):
+    X, y = _data(2048, 4, seed=5)
+    sd = StreamedDataset(ArraySource(X, y, chunk_rows=512),
+                         params={"verbosity": -1},
+                         spill_dir=str(tmp_path)).construct()
+    assert isinstance(sd.X_binned, np.memmap)
+    assert os.path.getsize(os.path.join(str(tmp_path), "binned.dat")) == \
+        sd.X_binned.shape[0] * sd.X_binned.shape[1]
+    # caller-provided spill dirs survive close() (reusable caches)...
+    sd.close()
+    assert os.path.exists(os.path.join(str(tmp_path), "binned.dat"))
+    # ...self-created temp spills are deleted (no /tmp accumulation
+    # across CV sweeps / bench ladders)
+    sd2 = StreamedDataset(ArraySource(X, y, chunk_rows=512),
+                          params={"verbosity": -1}).construct()
+    own = sd2.spill_dir
+    assert own and os.path.exists(own)
+    sd2.close()
+    assert not os.path.exists(own)
+
+
+# ---------------------------------------------------------------------------
+# engine.train (hbm route): streamed-vs-in-core bit-identity matrix
+# ---------------------------------------------------------------------------
+
+_BASE = {"objective": "binary", "verbosity": -1, "num_leaves": 13,
+         "learning_rate": 0.2, "max_bin": 63, "min_data_in_leaf": 5,
+         "enable_bundle": False, "seed": 3}
+
+
+@pytest.mark.parametrize("name,extra", [
+    ("serial", {}),
+    ("wave", {"tree_grow_mode": "wave", "tpu_wave_size": 4}),
+    ("quantized", {"tree_grow_mode": "wave", "use_quantized_grad": True}),
+    ("dp_scatter", {"tree_learner": "data", "num_machines": 8,
+                    "num_devices": 8, "use_quantized_grad": True,
+                    "tpu_dp_hist_scatter": True}),
+])
+def test_hbm_route_bit_identity(name, extra):
+    import jax
+    if name == "dp_scatter" and jax.device_count() < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    X, y = _data(3001, 6, seed=7)
+    X = np.nan_to_num(X)
+    p = dict(_BASE)
+    p.update(extra)
+    ds = lgb.Dataset(X.copy(), label=y.copy())
+    t1 = lgb.train(p, ds, num_boost_round=5).model_to_string()
+    sd = StreamedDataset(ArraySource(X, y, chunk_rows=512), params=p)
+    bst2 = lgb.train(p, sd, num_boost_round=5)
+    assert t1 == bst2.model_to_string(), \
+        f"streamed {name} training diverged from in-core"
+
+
+# ---------------------------------------------------------------------------
+# memory budget: no rows term
+# ---------------------------------------------------------------------------
+
+def test_ingest_memory_budget_flat_in_rows():
+    from lightgbm_tpu.analysis.contracts import memory_budget_for, \
+        resolve_limit
+    from lightgbm_tpu.ingest import stream as _stream  # noqa: F401
+    b = memory_budget_for("ingest")
+    assert b is not None and b.name == "ingest/chunk_pipeline"
+    ctx = {"features": 28, "bins": 255, "wave_size": 25, "leaves": 255,
+           "chunk_rows": 1 << 20, "itemsize": 4, "quantized": True}
+    small = resolve_limit(b.hbm_per_device, dict(ctx, rows=10 ** 3))
+    huge = resolve_limit(b.hbm_per_device, dict(ctx, rows=10 ** 12))
+    assert small == huge, "ingest budget must not depend on total rows"
+    # but it must scale with the chunk budget
+    bigger = resolve_limit(b.hbm_per_device,
+                           dict(ctx, chunk_rows=1 << 24, rows=10 ** 3))
+    assert bigger > small
+
+
+def test_ingest_lint_config_clean():
+    from lightgbm_tpu.analysis.lint import build_unit
+    from lightgbm_tpu.analysis.rules import run_rules, DEFAULT_RULES
+    unit = build_unit("ingest")
+    assert unit.jaxpr is not None
+    assert not unit.collectives
+    vs = run_rules([unit], rules=DEFAULT_RULES)
+    assert not vs, [v.to_json() for v in vs]

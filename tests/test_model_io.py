@@ -2,6 +2,7 @@
 analog of parts of test_engine.py save/load and test_basic.py)."""
 
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
 
@@ -116,6 +117,65 @@ def test_save_binary_dataset(tmp_path, binary_data):
     # trainable
     bst = lgb.train({**SMALL, "objective": "binary"}, ds2, 3)
     assert bst.num_trees() == 3
+
+
+def test_save_binary_is_atomic_and_validated(tmp_path, binary_data):
+    """save_binary writes through atomic_write_bytes (no partial file on
+    crash) and load_binary rejects truncated/garbage payloads with a
+    typed DatasetCorruptError validated against fingerprint() fields."""
+    import os
+
+    from lightgbm_tpu.dataset import DatasetCorruptError
+    X, y = binary_data
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    path = str(tmp_path / "data.bin")
+    ds.save_binary(path)
+    # no temp litter from the atomic write
+    assert [f for f in os.listdir(tmp_path) if f.startswith(".")] == []
+
+    # truncated payload -> typed error, not a raw pickle exception
+    raw = open(path, "rb").read()
+    with open(str(tmp_path / "trunc.bin"), "wb") as fh:
+        fh.write(raw[:len(raw) // 2])
+    with pytest.raises(DatasetCorruptError):
+        lgb.Dataset.load_binary(str(tmp_path / "trunc.bin"))
+
+    # garbage bytes -> typed error
+    with open(str(tmp_path / "junk.bin"), "wb") as fh:
+        fh.write(b"not a dataset at all")
+    with pytest.raises(DatasetCorruptError):
+        lgb.Dataset.load_binary(str(tmp_path / "junk.bin"))
+
+    # a wrong-format pickle -> typed error naming the format marker
+    import pickle
+    with open(str(tmp_path / "fmt.bin"), "wb") as fh:
+        pickle.dump({"format": "something.else"}, fh)
+    with pytest.raises(DatasetCorruptError, match="format"):
+        lgb.Dataset.load_binary(str(tmp_path / "fmt.bin"))
+
+    # a missing required field -> typed error naming it
+    import pickle as _p
+    payload = _p.loads(raw)
+    del payload["bin_mappers"]
+    with open(str(tmp_path / "miss.bin"), "wb") as fh:
+        _p.dump(payload, fh)
+    with pytest.raises(DatasetCorruptError, match="bin_mappers"):
+        lgb.Dataset.load_binary(str(tmp_path / "miss.bin"))
+
+    # binned codes flipped after save -> fingerprint mismatch
+    payload = _p.loads(raw)
+    Xb = np.array(payload["X_binned"], copy=True)
+    Xb[0, 0] = (Xb[0, 0] + 1) % 4
+    payload["X_binned"] = Xb
+    with open(str(tmp_path / "flip.bin"), "wb") as fh:
+        _p.dump(payload, fh)
+    with pytest.raises(DatasetCorruptError, match="fingerprint"):
+        lgb.Dataset.load_binary(str(tmp_path / "flip.bin"))
+
+    # DatasetCorruptError is a ValueError (back-compat with callers
+    # catching the old raw ValueError)
+    assert issubclass(DatasetCorruptError, ValueError)
 
 
 def test_num_iteration_predict(binary_data):
