@@ -9,7 +9,11 @@ executed) program per supported training/serving shape:
 * ``spec_ramp``  — DP wave + speculative ramp (the ceil(log2 W) budget);
 * ``multitrain`` — the vmapped model axis over the wave grower;
 * ``serve``      — the ensemble predictor across the SHAPE_BUCKETS
-  ladder (one program per bucket, hash-stable on re-trace).
+  ladder (one program per bucket, hash-stable on re-trace);
+* ``serve_dense`` — the inference compiler's fused dense program
+  (serve/compiler.py): bucket-ladder retrace probes plus the
+  tree-sharded top-bucket program whose single score psum and
+  per-shard memory are contract-checked.
 
 Every config is traced TWICE with freshly built same-shape inputs so
 the retrace rule sees real hash probes, and the telemetry collective
@@ -45,7 +49,7 @@ __all__ = ["MATRIX_CONFIGS", "Geometry", "TRACE_GEOMETRY", "MEM_GEOMETRY",
            "parse_kv_args", "run_lint", "main"]
 
 MATRIX_CONFIGS = ("serial", "wave", "dp_scatter", "spec_ramp",
-                  "multitrain", "serve", "ingest")
+                  "multitrain", "serve", "serve_dense", "ingest")
 
 # every rule the matrix runs: the six PR-10 program-contract rules plus
 # the SPMD-safety pair (collective-order, sharding-consistency)
@@ -346,6 +350,100 @@ def _mk_serve_ensemble(geom: Geometry):
     return ((fields, lin),), (kind,)
 
 
+def _mk_serve_dense_ensemble(geom: Geometry):
+    """A tiny hand-built mixed ensemble for the dense serving compiler:
+    two numeric trees (one with a missing-nan/default-left node) plus a
+    categorical tree whose bitset spans TWO uint32 words — the shape
+    class of the fused dense program, no training run needed."""
+    import numpy as np
+    from ..models.tree import Tree
+
+    def _tree(nl, sf, thr, dt, lc, rc, leaves, **kw):
+        n = nl - 1
+        return Tree(
+            num_leaves=nl,
+            split_feature=np.asarray(sf, np.int32),
+            threshold_bin=np.zeros(n, np.int32),
+            nan_bin=np.full(n, -1, np.int32),
+            threshold=np.asarray(thr, np.float64),
+            decision_type=np.asarray(dt, np.uint8),
+            left_child=np.asarray(lc, np.int32),
+            right_child=np.asarray(rc, np.int32),
+            split_gain=np.ones(n, np.float32),
+            internal_value=np.zeros(n, np.float64),
+            internal_weight=np.ones(n, np.float64),
+            internal_count=np.full(n, 2, np.int64),
+            leaf_value=np.asarray(leaves, np.float64),
+            leaf_weight=np.ones(nl, np.float64),
+            leaf_count=np.ones(nl, np.int64), **kw)
+
+    trees = [
+        # numeric, 3 leaves, node1 missing-nan + default-left (dt 8|2)
+        _tree(3, [0, 1], [0.5, -0.2], [0, 10], [1, -2], [-1, -3],
+              [0.1, -0.2, 0.3]),
+        # categorical on feature 2: rank-0 bitset over 2 words (cats
+        # 1, 3 and 32 in the LEFT set)
+        _tree(2, [2], [0.0], [1], [-1], [-2], [0.4, -0.4],
+              cat_boundaries=np.asarray([0, 2], np.int32),
+              cat_threshold=np.asarray([0b1010, 0b1], np.uint32)),
+        _tree(2, [1], [1.5], [0], [-1], [-2], [-0.1, 0.2]),
+        _tree(2, [0], [-0.5], [0], [-1], [-2], [0.05, -0.05]),
+    ]
+    return trees
+
+
+def _build_serve_dense_unit(geom: Geometry, ctx: Dict[str, Any],
+                            nshards: int) -> TraceUnit:
+    """The fused dense serving compiler's lint unit: retrace-stability
+    probes over the whole bucket ladder (unsharded) plus the
+    tree-sharded program at the top bucket as the MAIN jaxpr, so the
+    one-psum collective contract and the per-shard memory sweep are
+    machine-checked."""
+    import numpy as np
+    from ..models.dense_predict import (dense_predict_raw, lower_ensemble,
+                                        make_sharded_predict)
+    from ..models.tree import SHAPE_BUCKETS
+    # importing the compiler registers the serve/dense_predict
+    # collective contract + memory budget
+    from ..serve import compiler as _compiler  # noqa: F401
+    trees = _mk_serve_dense_ensemble(geom)
+    arrays, meta = lower_ensemble(trees, 1, geom.features)
+    hashes: List[Tuple[str, str]] = []
+    for bucket in SHAPE_BUCKETS:
+        for rep in range(2):
+            X = np.zeros((bucket, geom.features), np.float32) + rep
+            jx = ir.trace(
+                lambda Xa, A: dense_predict_raw(Xa, A, meta), X, arrays)
+            hashes.append((f"bucket{bucket}", ir.stable_hash(jx)))
+    k = max(2, min(nshards, 4))
+    mesh, _abstract = _trace_mesh(k, "trees")
+    sh_arrays, sh_meta = lower_ensemble(trees, 1, geom.features, shard=k)
+    fn = make_sharded_predict(sh_arrays, sh_meta, mesh)
+    Xtop = np.zeros((max(SHAPE_BUCKETS), geom.features), np.float32)
+    jaxpr0, tally = _trace_with_tally(lambda Xa, A: fn(Xa, A),
+                                      (Xtop, sh_arrays))
+    jx1, _ = _trace_with_tally(lambda Xa, A: fn(Xa, A),
+                               (Xtop + 1.0, sh_arrays))
+    hashes.append(("sharded_top", ir.stable_hash(jaxpr0)))
+    hashes.append(("sharded_top", ir.stable_hash(jx1)))
+    ctx = dict(ctx)
+    # one program per ladder rung plus the sharded top-bucket program
+    ctx["max_distinct_programs"] = len(SHAPE_BUCKETS) + 1
+    ctx["bucket"] = max(SHAPE_BUCKETS)
+    ctx["trees"] = sh_arrays.path_dir.shape[0]
+    ctx["leaves"] = sh_arrays.path_dir.shape[2]
+    ctx["num_class"] = 1
+    ctx["cat_cols"] = (0 if sh_arrays.cat_table is None
+                      else sh_arrays.cat_table.shape[0])
+    ctx["cat_nodes"] = (0 if sh_arrays.cat_table is None
+                       else sh_arrays.cat_table.shape[1])
+    ctx["nshards"] = k
+    ctx["world_size"] = k
+    ctx["mesh_axes"] = ("trees",)
+    return TraceUnit(name="serve_dense", jaxpr=jaxpr0, ctx=ctx,
+                     collectives=tally, hashes=hashes)
+
+
 def _build_serve_unit(geom: Geometry, ctx: Dict[str, Any]) -> TraceUnit:
     import numpy as np
     from ..models.tree import SHAPE_BUCKETS, predict_raw_ensemble
@@ -397,6 +495,8 @@ def build_unit(name: str, nshards: int = 8,
                                  _base_ctx(geom, models=3))
     if name == "serve":
         return _build_serve_unit(geom, _base_ctx(geom))
+    if name == "serve_dense":
+        return _build_serve_dense_unit(geom, _base_ctx(geom), nshards)
     if name == "ingest":
         return _unit_from_traces(
             "ingest", _mk_ingest_chunk(geom),
@@ -429,6 +529,14 @@ def build_callable(name: str, nshards: int = 8,
         X = np.zeros((max(SHAPE_BUCKETS), geom.features), np.float32)
         return (lambda Xa, pc: predict_raw_ensemble(Xa, pc, kinds),
                 (X, per_class))
+    if name == "serve_dense":
+        import numpy as np
+        from ..models.dense_predict import dense_predict_raw, lower_ensemble
+        from ..models.tree import SHAPE_BUCKETS
+        trees = _mk_serve_dense_ensemble(geom)
+        arrays, meta = lower_ensemble(trees, 1, geom.features)
+        X = np.zeros((max(SHAPE_BUCKETS), geom.features), np.float32)
+        return (lambda Xa, A: dense_predict_raw(Xa, A, meta), (X, arrays))
     return None
 
 

@@ -296,6 +296,23 @@ _PARAMS: List[ParamSpec] = [
     # resume/checkpoint_dir: it never changes the model (quantized path)
     # and is excluded from the model-text params dump.
     _p("tpu_ingest_mode", str, "hbm"),
+    # --- inference compiler (lightgbm_tpu/serve/compiler.py) ---
+    # dense = force the fused dense MXU program (one loop-free jitted
+    # program per row bucket: one-hot threshold compares, categorical
+    # bitset-membership contraction, quantized leaf tables); walk = the
+    # sequential per-tree walk; auto = dense whenever the ensemble
+    # lowers AND the backend profits (always on TPU; on CPU a host cost
+    # model keeps the walk where it measures faster and RECORDS the
+    # fallback reason in the serve_compiler_fallback counter).
+    _p("tpu_predict_compiler", str, "auto"),
+    # leaf-table quantization for the dense program: 0 = exact f32
+    # leaves, 8/16 = i8/i16 leaf codes + per-tree f32 scale dequantized
+    # in the final contraction (abs error <= sum of per-tree scales / 2)
+    _p("tpu_predict_leaf_bits", int, 0),
+    # pjit-shard the dense program's tree axis over this many devices
+    # (0/1 = single device); partial scores merge in ONE psum per
+    # request (collective contract serve/dense_predict/score_psum)
+    _p("tpu_predict_shard", int, 0, check=">=0"),
 ]
 
 PARAM_SCHEMA: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}
@@ -445,6 +462,10 @@ class Config:
              "tpu_pallas_pipeline must be auto|dma|blockspec"),
             (self.tpu_ingest_mode in ("hbm", "chunked"),
              "tpu_ingest_mode must be hbm|chunked"),
+            (self.tpu_predict_compiler in ("auto", "dense", "walk"),
+             "tpu_predict_compiler must be auto|dense|walk"),
+            (self.tpu_predict_leaf_bits in (0, 8, 16),
+             "tpu_predict_leaf_bits must be 0|8|16"),
         ]
         for ok, msg in checks:
             if not ok:

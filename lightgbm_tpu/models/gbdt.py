@@ -1086,6 +1086,31 @@ class GBDT:
             cache[key] = batch
         return batch
 
+    def _dense_program(self, t0: int, t1: int, num_features: int):
+        """The serving compiler's fused program for trees [t0, t1), or
+        None when the walk serves this call (mode/cost-model/lowering —
+        the reason is recorded by serve/compiler.py, never silent).
+        Memoized in the per-call ``_predict_cache`` so chunked predicts
+        lower once."""
+        cache = getattr(self, "_predict_cache", None)
+        ck = ("dense", t0, t1)
+        if cache is not None and ck in cache:
+            return cache[ck]
+        from ..serve.compiler import compile_ensemble
+        cfg = self.config
+        k = self.num_tree_per_iteration
+        full = t0 == 0 and t1 == len(self.models)
+        dense, _reason = compile_ensemble(
+            self.models[t0:t1], k, num_features,
+            class_ids=[t % k for t in range(t0, t1)],
+            mode=getattr(cfg, "tpu_predict_compiler", "auto"),
+            leaf_bits=int(getattr(cfg, "tpu_predict_leaf_bits", 0)),
+            shard=int(getattr(cfg, "tpu_predict_shard", 0)),
+            batch=self._tree_batch() if full else None)
+        if cache is not None:
+            cache[ck] = dense
+        return dense
+
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 start_iteration: int = 0,
                 num_iteration: Optional[int] = None,
@@ -1096,6 +1121,26 @@ class GBDT:
         X = np.asarray(X, np.float32)
         if X.ndim == 1:
             X = X.reshape(1, -1)
+        # per-call memo for TreeBatch/dense-program builds: ONE build per
+        # predict() call whether the call chunks or not (the dense route
+        # consults the TreeBatch twice — cost model + lowering — so an
+        # unmemoized call would build it twice)
+        own_cache = getattr(self, "_predict_cache", None) is None
+        if own_cache:
+            self._predict_cache = {}
+        try:
+            return self._predict_cached(
+                X, raw_score, start_iteration, num_iteration, pred_leaf,
+                pred_contrib, pred_early_stop, pred_early_stop_freq,
+                pred_early_stop_margin)
+        finally:
+            if own_cache:
+                self._predict_cache = None
+
+    def _predict_cached(self, X, raw_score, start_iteration, num_iteration,
+                        pred_leaf, pred_contrib, pred_early_stop,
+                        pred_early_stop_freq, pred_early_stop_margin
+                        ) -> np.ndarray:
         # bound the device working set: very large batches walk in row
         # chunks (the reference predicts row blocks too,
         # gbdt_prediction.cpp).  The dense walk's temporaries scale with
@@ -1104,29 +1149,18 @@ class GBDT:
         chunk = min(1 << 20,
                     max(1 << 14, (1 << 28) //
                         max(int(self.config.num_leaves), 256)))
+        k = self.num_tree_per_iteration
         if X.shape[0] > chunk:
-            own_cache = getattr(self, "_predict_cache", None) is None
-            if own_cache:
-                self._predict_cache = {}
-            try:
-                parts = [self.predict(
-                    X[lo:lo + chunk], raw_score=raw_score,
-                    start_iteration=start_iteration,
-                    num_iteration=num_iteration, pred_leaf=pred_leaf,
-                    pred_contrib=pred_contrib,
-                    pred_early_stop=pred_early_stop,
-                    pred_early_stop_freq=pred_early_stop_freq,
-                    pred_early_stop_margin=pred_early_stop_margin)
-                    for lo in range(0, X.shape[0], chunk)]
-            finally:
-                if own_cache:
-                    self._predict_cache = None
+            parts = [self._predict_cached(
+                X[lo:lo + chunk], raw_score, start_iteration,
+                num_iteration, pred_leaf, pred_contrib, pred_early_stop,
+                pred_early_stop_freq, pred_early_stop_margin)
+                for lo in range(0, X.shape[0], chunk)]
             return np.concatenate(parts, axis=0)
         # map raw columns to inner (used) features
         used = self.train_set.used_feature_map if self.train_set is not None \
             else np.arange(X.shape[1])
         Xi = X[:, used]
-        k = self.num_tree_per_iteration
         if pred_leaf:
             return self._predict_leaf(Xi, start_iteration, num_iteration)
         if pred_contrib:
@@ -1157,7 +1191,12 @@ class GBDT:
             # and cannot perturb real rows: every walk reduces per row)
             n_rows = Xi.shape[0]
             Xd = jnp.asarray(pad_rows(Xi))
-            if k == 1:
+            dense = self._dense_program(t0, t1, Xi.shape[1])
+            if dense is not None:
+                # the inference compiler's fused loop-free program:
+                # every class's trees in one dense contraction set
+                raw = np.asarray(dense.predict_raw(Xd))[:n_rows]
+            elif k == 1:
                 raw = np.asarray(
                     predict_raw(batch, Xd, t0, t1 - t0))[:n_rows, None]
             else:
@@ -1228,6 +1267,12 @@ class GBDT:
         t1 = len(self.models) if num_iteration is None else min(
             len(self.models), (start_iteration + num_iteration) * k)
         Xd = jnp.asarray(pad_rows(np.asarray(Xi)))
+        if t1 > t0:
+            # pred-leaf rides the same compiled dense program (the hit
+            # one-hot's argmax IS the leaf index)
+            dense = self._dense_program(t0, t1, Xi.shape[1])
+            if dense is not None:
+                return np.asarray(dense.predict_leaf(Xd))[:Xi.shape[0]]
         leaves = []
         for t in range(t0, t1):
             tree = self.models[t]

@@ -17,10 +17,14 @@ per-request Python dispatch costs or fresh XLA traces:
 """
 
 from .batcher import MicroBatcher
+from .compiler import DenseExecutable, DenseLoweringError, \
+    compile_ensemble, fallback_counts
 from .predictor import SHAPE_BUCKETS, CompiledPredictor
 from .registry import ModelRegistry
 from .server import PredictionServer
 from .stats import ModelStats
 
 __all__ = ["CompiledPredictor", "MicroBatcher", "ModelRegistry",
-           "PredictionServer", "ModelStats", "SHAPE_BUCKETS"]
+           "PredictionServer", "ModelStats", "SHAPE_BUCKETS",
+           "DenseExecutable", "DenseLoweringError", "compile_ensemble",
+           "fallback_counts"]

@@ -1,0 +1,261 @@
+"""Inference compiler: lower a trained ensemble into one fused dense
+MXU program for serving.
+
+The compile step (:func:`compile_ensemble`) takes the model's trees and
+produces a :class:`DenseExecutable` — device-resident lowered tables
+(:mod:`..models.dense_predict`) plus jitted loop-free prediction
+entries, optionally pjit-sharded over the tree axis for ensembles too
+wide for one device.  ``CompiledPredictor`` and ``Booster.predict``
+both route through it behind ``tpu_predict_compiler=dense|walk|auto``:
+
+* ``dense`` — force the fused program; raise if the ensemble cannot
+  lower (a table budget would blow);
+* ``walk``  — keep the sequential per-tree walk;
+* ``auto``  — dense whenever the ensemble lowers AND the backend
+  profits.  On the MXU the dense formulation is the measured ~70x
+  serving win (PERF.md round 4: 26 ms/tree/1M rows vs ~1.8 s for the
+  gather walk); on CPU/interpret backends gathers are cheap and matmuls
+  are not, so a host cost model keeps the walk where it measures faster
+  — and RECORDS WHY (the ``serve_compiler_fallback`` telemetry counter
+  + ``CompiledPredictor.info()``), fixing the silent categorical
+  fallback this compiler exists to kill.
+
+Program contracts (machine-checked by the ``serve_dense`` lint config):
+the ``serve/dense_predict`` MemoryBudget bounds the per-device peak of
+one bucket program, and the ``serve/dense_predict/score_psum``
+collective contract pins the sharded program to exactly one psum of the
+(bucket, num_class) partial scores.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..analysis.contracts import collective_contract, memory_budget, \
+    world_size
+from ..models.dense_predict import (DenseArrays, DenseLoweringError,
+                                    DenseMeta, dense_predict_leaf,
+                                    dense_predict_raw, dense_table_bytes,
+                                    lower_ensemble, make_sharded_predict)
+from ..models.tree import SHAPE_BUCKETS, TreeBatch
+from ..telemetry.metrics import default_registry
+from ..utils.backend import default_backend
+
+__all__ = ["DenseExecutable", "compile_ensemble", "DenseLoweringError",
+           "dense_cost_model", "fallback_counts", "FALLBACK_COUNTER"]
+
+# ---------------------------------------------------------------------------
+# program contracts — declared next to the code they constrain
+# ---------------------------------------------------------------------------
+
+collective_contract(
+    "serve/dense_predict/score_psum", "psum",
+    max_count=1,
+    max_bytes_per_op=lambda ctx: 4 * int(ctx.get("bucket", 4096)) *
+    max(1, int(ctx.get("num_class", 1))),
+    note="ONE psum of the per-shard (bucket, num_class) partial scores "
+         "— the whole collective cost of tree-sharded dense serving")
+
+
+def dense_predict_hbm_bytes(ctx):
+    """Per-device HBM curve of one fused dense bucket program.
+
+    Dominated by the (bucket, T/W * Nn) condition matrix and the
+    (T/W, bucket, L) count/hit blocks (two resident at the peak), plus
+    the lowered model tables (path matrices, bitset table, linear
+    tables) and the request block."""
+    n = int(ctx.get("bucket", max(SHAPE_BUCKETS)))
+    t = -(-int(ctx.get("trees", 64)) // world_size(ctx))
+    leaves = int(ctx.get("leaves", 64))
+    nn = max(leaves - 1, 1)
+    f = int(ctx.get("features", 32))
+    cat_cols = int(ctx.get("cat_cols", 0))        # Fc * C
+    cat_nodes = int(ctx.get("cat_nodes", 0))
+    lin = 2 * 4 * t * leaves * f if ctx.get("has_linear") else 0
+    rows = n * (3 * 4 * t * nn            # P / isn / dec condition blocks
+                + 3 * 4 * t * leaves      # S + hit + value blocks
+                + 4 * f + 4 * cat_cols + 4 * (cat_nodes + 1))
+    tables = t * nn * (leaves + 16) + 4 * cat_cols * max(cat_nodes, 1) + lin
+    return rows + tables + (8 << 20)
+
+
+memory_budget("serve/dense_predict", ("serve_dense",),
+              dense_predict_hbm_bytes,
+              note="condition matrix + count/hit blocks + lowered tables")
+
+
+# ---------------------------------------------------------------------------
+# fallback telemetry: never again a silent 70x-slower path
+# ---------------------------------------------------------------------------
+
+FALLBACK_COUNTER = "serve_compiler_fallback"
+_fb_lock = threading.Lock()
+_fb_counts: Dict[str, int] = {}
+
+
+def _note_fallback(reason: str, model: str = "") -> None:
+    with _fb_lock:
+        _fb_counts[reason] = _fb_counts.get(reason, 0) + 1
+    default_registry().counter(
+        FALLBACK_COUNTER,
+        "auto-mode dense-compiler fallbacks to the sequential walk, "
+        "by reason", labels=("reason", "model")).inc(
+        reason=reason, model=model or "-")
+
+
+def fallback_counts() -> Dict[str, int]:
+    """Process-wide auto-fallback tally by reason (mirrors the labeled
+    ``serve_compiler_fallback`` counter series)."""
+    with _fb_lock:
+        return dict(_fb_counts)
+
+
+# ---------------------------------------------------------------------------
+# backend cost model for auto mode
+# ---------------------------------------------------------------------------
+
+def dense_cost_model(num_trees: int, max_leaves: int, max_depth: int,
+                     backend: Optional[str] = None) -> bool:
+    """True when the fused dense program should beat the sequential
+    walk on this backend.
+
+    On TPU the answer is always yes (per-row gathers are the slow
+    primitive; PERF.md round 4 measured the 70x).  On CPU/interpret the
+    walk's gathers run near memory speed while the dense program pays
+    O(T * Nn * L) matmul work per row, so dense only wins when the
+    per-row dense work is small next to the walk's sequential
+    depth-loop cost (measured on the 1-core CI env, PERF.md round 13)."""
+    backend = backend if backend is not None else default_backend()
+    if backend == "tpu":
+        return True
+    nn = max(max_leaves - 1, 1)
+    dense_units = num_trees * nn * (2 + max_leaves)
+    walk_units = num_trees * (max_depth + 1) * 24
+    return dense_units < walk_units
+
+
+def _max_depth(batch: TreeBatch) -> int:
+    """Deepest real leaf across the ensemble (host-side, from the
+    path-length matrices TreeBatch already built)."""
+    pt = np.asarray(batch.plen_total)
+    real = pt < 1e8
+    return int(pt[real].max()) if real.any() else 0
+
+
+# ---------------------------------------------------------------------------
+# the executable
+# ---------------------------------------------------------------------------
+
+class DenseExecutable:
+    """One compiled-dense model version: device-resident lowered tables
+    plus the jitted (optionally tree-sharded) prediction entries.
+
+    Immutable once built — hot-swap replaces the whole object, so there
+    is no window where path matrices and leaf tables disagree."""
+
+    def __init__(self, arrays: DenseArrays, meta: DenseMeta,
+                 shard: int = 0) -> None:
+        self.meta = meta
+        self.shard = 0
+        self._sharded_fn: Optional[Any] = None
+        if shard and shard > 1:
+            ndev = len(jax.devices())
+            k = min(shard, ndev)
+            if k > 1 and arrays.path_dir.shape[0] % k == 0:
+                from ..parallel.mesh import get_mesh
+                self.shard = k
+                self._mesh = get_mesh(k, "trees")
+                self._sharded_fn = make_sharded_predict(
+                    arrays, meta, self._mesh)
+        # ONE device_put pins every table; requests then ship only rows.
+        # The sharded program's tables commit with the SAME sharding its
+        # in_specs demand, so no per-request redistribution happens.
+        if self.shard:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..models.dense_predict import _shard_specs
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self._mesh, s),
+                _shard_specs(arrays, "trees"),
+                is_leaf=lambda x: isinstance(x, PartitionSpec))
+            self.arrays = jax.device_put(arrays, shardings)
+        else:
+            self.arrays = jax.device_put(arrays)
+        self.table_bytes = dense_table_bytes(arrays)
+
+    @property
+    def signature(self) -> tuple:
+        """Shape/dtype signature — what XLA's jit cache keys on besides
+        the row bucket (drives the /stats recompile counter)."""
+        leaves = jax.tree_util.tree_leaves(self.arrays)
+        return ("dense", self.meta, self.shard,
+                tuple((a.shape, str(a.dtype)) for a in leaves))
+
+    def predict_raw(self, Xp) -> Any:
+        """(N, num_class) raw scores for a bucket-padded row block."""
+        if self._sharded_fn is not None:
+            return self._sharded_fn(Xp, self.arrays)
+        return dense_predict_raw(Xp, self.arrays, self.meta)
+
+    def predict_leaf(self, Xp) -> Any:
+        """(N, num_trees) leaf indices (shard-padding trees sliced)."""
+        out = dense_predict_leaf(Xp, self.arrays, self.meta)
+        return out[:, :self.meta.num_trees]
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "mode": "dense",
+            "num_trees": self.meta.num_trees,
+            "num_class": self.meta.num_class,
+            "has_cat": self.meta.has_cat,
+            "has_linear": self.meta.has_linear,
+            "leaf_bits": self.meta.leaf_bits,
+            "mxu": self.meta.mxu,
+            "shard": self.shard,
+            "table_bytes": self.table_bytes,
+        }
+
+
+def compile_ensemble(trees: List[Any], num_class: int, num_features: int,
+                     class_ids: Optional[List[int]] = None, *,
+                     mode: str = "auto", leaf_bits: int = 0,
+                     shard: int = 0, batch: Optional[TreeBatch] = None,
+                     model_label: str = ""
+                     ) -> Tuple[Optional[DenseExecutable], Optional[str]]:
+    """Compile ``trees`` into a :class:`DenseExecutable`, or decide the
+    walk and say why.
+
+    Returns ``(executable, None)`` on a dense lowering and
+    ``(None, reason)`` on the walk path.  ``mode='dense'`` raises
+    :class:`DenseLoweringError` instead of falling back; auto-mode
+    fallbacks bump the ``serve_compiler_fallback{reason}`` counter."""
+    if mode not in ("auto", "dense", "walk"):
+        raise ValueError(f"tpu_predict_compiler must be auto|dense|walk, "
+                         f"got '{mode}'")
+    if mode == "walk":
+        return None, "forced_walk"
+    if not trees:
+        if mode == "dense":
+            raise DenseLoweringError("no_trees")
+        _note_fallback("no_trees", model_label)
+        return None, "no_trees"
+    b = batch if batch is not None else TreeBatch(trees)
+    backend = default_backend()
+    if mode == "auto" and not dense_cost_model(
+            b.num_trees, b.max_leaves, _max_depth(b), backend):
+        _note_fallback("cpu_cost_model", model_label)
+        return None, "cpu_cost_model"
+    try:
+        arrays, meta = lower_ensemble(
+            trees, num_class, num_features, class_ids,
+            leaf_bits=leaf_bits, mxu=(backend == "tpu"),
+            shard=max(1, shard), batch=b)
+    except DenseLoweringError as exc:
+        if mode == "dense":
+            raise
+        _note_fallback(exc.reason, model_label)
+        return None, exc.reason
+    return DenseExecutable(arrays, meta, shard=shard), None

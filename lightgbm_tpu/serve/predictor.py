@@ -29,6 +29,7 @@ import numpy as np
 from ..analysis.contracts import memory_budget
 from ..models.tree import (SHAPE_BUCKETS, bucket_rows, ensemble_serve_fields,
                            pad_rows, predict_raw_ensemble)
+from .compiler import DenseExecutable, compile_ensemble
 from .stats import ModelStats
 
 __all__ = ["CompiledPredictor", "SHAPE_BUCKETS"]
@@ -95,7 +96,10 @@ class CompiledPredictor:
 
     def __init__(self, source, num_iteration: Optional[int] = None,
                  buckets: Tuple[int, ...] = SHAPE_BUCKETS,
-                 stats: Optional[ModelStats] = None) -> None:
+                 stats: Optional[ModelStats] = None,
+                 compiler: Optional[str] = None,
+                 leaf_bits: Optional[int] = None,
+                 shard: Optional[int] = None) -> None:
         gbdt = _resolve_gbdt(source)
         self.buckets = tuple(sorted(buckets))
         self.stats = stats if stats is not None else ModelStats()
@@ -113,14 +117,42 @@ class CompiledPredictor:
         ts = gbdt.train_set
         self._used = (np.asarray(ts.used_feature_map)
                       if ts is not None else None)
+        # inference-compiler routing: explicit kwargs win, then the
+        # model's params, then the defaults (auto / exact / unsharded)
+        cfg = getattr(gbdt, "config", None)
+        self._compiler_mode = compiler if compiler is not None else \
+            getattr(cfg, "tpu_predict_compiler", "auto")
+        self._leaf_bits = leaf_bits if leaf_bits is not None else \
+            int(getattr(cfg, "tpu_predict_leaf_bits", 0))
+        self._shard = shard if shard is not None else \
+            int(getattr(cfg, "tpu_predict_shard", 0))
+        self._dense: Optional[DenseExecutable] = None
+        self._fallback_reason: Optional[str] = None
+        self._kinds: tuple = ()
+        self._sig: tuple = ()
+        self._per_class = None
         from ..models.tree import TreeBatch
+        sel = [models[t] for t in range(self.num_trees)]
+        if not sel or self.num_trees < k:
+            raise ValueError("predictor needs at least one tree per class")
+        # the dense program fuses every class's trees into ONE loop-free
+        # jitted program per bucket (serve/compiler.py); the walk keeps
+        # the historical per-class scan kernels
+        self._dense, self._fallback_reason = compile_ensemble(
+            sel, k, len(self._used) if self._used is not None
+            else self.num_features,
+            mode=self._compiler_mode, leaf_bits=self._leaf_bits,
+            shard=self._shard,
+            model_label=getattr(self.stats, "model", "") or "")
+        if self._dense is not None:
+            self._kinds = ("dense_compiled",)
+            self._sig = self._dense.signature
+            return
         per_class = []
         kinds = []
         for c in range(k):
-            sel = [models[t] for t in range(self.num_trees) if t % k == c]
-            if not sel:
-                raise ValueError("predictor needs at least one tree per class")
-            kind, fields, lin = ensemble_serve_fields(TreeBatch(sel))
+            selc = [models[t] for t in range(self.num_trees) if t % k == c]
+            kind, fields, lin = ensemble_serve_fields(TreeBatch(selc))
             kinds.append(kind)
             per_class.append((fields, lin))
         # one device_put pins every array; requests then ship only rows
@@ -150,8 +182,11 @@ class CompiledPredictor:
         Xp = pad_rows(Xi, self.buckets)
         new = _note_dispatch((self._sig, nb))
         t0 = time.perf_counter()
-        out = np.asarray(predict_raw_ensemble(Xp, self._per_class,
-                                              self._kinds))[:n]
+        if self._dense is not None:
+            out = np.asarray(self._dense.predict_raw(Xp))[:n]
+        else:
+            out = np.asarray(predict_raw_ensemble(Xp, self._per_class,
+                                                  self._kinds))[:n]
         self.stats.record_batch(n, nb, (time.perf_counter() - t0) * 1e3,
                                 recompiled=new)
         if self._avg_div != 1:
@@ -178,10 +213,18 @@ class CompiledPredictor:
         return self.stats.snapshot()["recompiles"] - before
 
     def info(self) -> dict:
-        return {
+        out = {
             "num_trees": self.num_trees,
             "num_class": self.num_class,
             "num_features": self.num_features,
             "kinds": list(self._kinds),
             "buckets": list(self.buckets),
+            # the compiler decision, never silent: which program serves
+            # this model and (on the walk path) exactly why
+            "compiler": "dense" if self._dense is not None else "walk",
+            "compiler_mode": self._compiler_mode,
+            "fallback_reason": self._fallback_reason,
         }
+        if self._dense is not None:
+            out["dense"] = self._dense.info()
+        return out

@@ -95,10 +95,26 @@ def test_categorical_predictor_parity():
     ds = lgb.Dataset(Xc, y, categorical_feature=[3], params=p)
     bst = lgb.train(p, ds, 10)
     pred = bst.to_predictor()
-    assert "seq" in pred.info()["kinds"]
+    info = pred.info()
+    # the inference compiler routes categorical ensembles too (the
+    # bitset-membership contraction) — and when it decides the walk it
+    # must say why, never silently
+    assert info["compiler"] in ("dense", "walk")
+    if info["compiler"] == "dense":
+        assert info["dense"]["has_cat"]
+    else:
+        assert info["fallback_reason"]
     Xq = rng.randn(9, 6)
     Xq[:, 3] = rng.randint(0, 14, 9)  # incl. unseen category 12/13
     assert np.array_equal(pred.predict(Xq), bst.predict(Xq))
+    # the forced-walk path stays available and bitwise-consistent with
+    # the sequential kernels
+    pw = bst.to_predictor(compiler="walk")
+    assert pw.info()["compiler"] == "walk"
+    assert pw.info()["fallback_reason"] == "forced_walk"
+    assert "seq" in pw.info()["kinds"]
+    assert np.allclose(pw.predict(Xq), pred.predict(Xq), rtol=1e-6,
+                       atol=1e-7)
 
 
 def test_linear_tree_predictor_parity(regression_data):
@@ -106,7 +122,11 @@ def test_linear_tree_predictor_parity(regression_data):
     p = {**SMALL, "objective": "regression", "linear_tree": True}
     bst = lgb.train(p, lgb.Dataset(X, y, params=p), 8)
     pred = bst.to_predictor()
-    assert pred.info()["kinds"] == ["dense_lin"]
+    info = pred.info()
+    if info["compiler"] == "dense":
+        assert info["dense"]["has_linear"]
+    else:
+        assert info["kinds"] == ["dense_lin"]
     rng = np.random.RandomState(6)
     Xq = rng.randn(9, 6)
     Xq[3, 0] = np.nan  # linear leaves fall back to plain output on NaN
@@ -223,6 +243,52 @@ def test_registry_hot_swap_atomic(binary_data):
         t.join()
     assert not bad, "hot-swap produced mixed-version outputs"
     assert reg.info()["m"]["version"] == 7
+
+
+def test_registry_hot_swap_dense_atomic(binary_data):
+    """Hot-swapping a DENSE-compiled model must rebuild the whole
+    compiled program atomically: readers racing the rollout see exactly
+    one version's output (path matrices and leaf tables can never come
+    from different versions), and stats carry over the swap."""
+    X, y = binary_data
+    p = {**SMALL, "objective": "binary"}
+    b1 = lgb.train(p, lgb.Dataset(X, y, params=p), 5)
+    b2 = lgb.train(p, lgb.Dataset(X, y, params=p), 9)
+    rng = np.random.RandomState(12)
+    Xq = rng.randn(9, 6)
+    reg = ModelRegistry()
+    reg.load("m", b1, warmup=False, compiler="dense")
+    assert reg.get("m").info()["compiler"] == "dense"
+    ref1 = reg.get("m").predict(Xq)
+    reg.load("m", b2, warmup=False, compiler="dense")
+    ref2 = reg.get("m").predict(Xq)
+    assert not np.array_equal(ref1, ref2)
+    reg.get("m").predict(Xq)
+    batches_before = reg.stats()["m"]["batches"]
+    bad = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            out = reg.get("m").predict(Xq)
+            if not (np.array_equal(out, ref1) or np.array_equal(out, ref2)):
+                bad.append(out)
+
+    readers = [threading.Thread(target=reader) for _ in range(4)]
+    for t in readers:
+        t.start()
+    for i in range(6):
+        reg.load("m", b1 if i % 2 == 0 else b2, warmup=False,
+                 compiler="dense")
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not bad, "dense hot-swap produced mixed-version outputs"
+    # stats survive the swaps (the counters track the NAME, and the new
+    # executable was fully built before the one-assignment swap)
+    assert reg.stats()["m"]["batches"] > batches_before
+    assert reg.info()["m"]["version"] == 8
+    assert reg.info()["m"]["compiler"] == "dense"
 
 
 def test_registry_swap_keeps_stats(booster):
