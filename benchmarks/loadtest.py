@@ -1,0 +1,322 @@
+"""Synthetic-load SLO harness: drive the real HTTP serving tier, judge
+it from its own telemetry.
+
+Closes the observability loop the SLO engine opens: train a small
+model, start the REAL :class:`PredictionServer` (sockets, JSON, micro
+batcher, admission control), drive a ladder of synthetic load rungs
+through ``serve/loadgen.py`` (open loop at target QPS or closed loop at
+the ceiling, request shapes mixed over the SHAPE_BUCKETS ladder), and
+render a pass/breach verdict computed SOLELY from ``/metrics`` and
+``/slo`` scrapes — the client-side numbers ride along for context but
+never decide anything, so the harness proves the telemetry an operator
+would actually page on.
+
+Artifacts: an ``slo-report.json`` (verdict + the /slo payload + the
+slowest-request exemplars + per-bucket p50/p99/queue/device split) and
+a bench-matrix-v1 record (rows_per_sec / qps / p99_ms rows) that
+``scripts/bench_regression.py`` diffs across nightly rounds exactly
+like iters/s.
+
+    python benchmarks/loadtest.py [--json out.json] \
+        [--slo-report slo-report.json]
+
+Env knobs: LOAD_LADDER ("closed" and/or comma QPS list, e.g.
+"10,25,closed"), LOAD_DURATION (s/rung), LOAD_WORKERS, LOAD_FEATURES,
+LOAD_TREES, LOAD_LEAVES, LOAD_BUCKETS ("4096:0.9,512:0.1" rows:weight
+mix), LOAD_ARRIVAL (uniform|poisson), LOAD_TARGET_ROWS_S (pass floor,
+default 1e5), LOAD_P99_MS (re-declares the serve/latency_p99 threshold
+for this env), LOAD_MAX_QUEUE_ROWS (admission bound; 0 = unbounded).
+
+Exit code: 0 on pass, 1 on breach/underrun — CI runs this blocking,
+next to the chaos step.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _git_sha():
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=10).stdout.strip() or None
+    except Exception:
+        return None
+
+
+def _train_model(trees: int, leaves: int, features: int, tmp: str) -> str:
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, features).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.randn(2000) > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": leaves, "verbosity": -1}
+    bst = lgb.train(p, lgb.Dataset(X, y, params=p), trees)
+    path = os.path.join(tmp, "loadtest_model.txt")
+    bst.save_model(path)
+    return path
+
+
+def _parse_bucket_mix(spec: str):
+    mix = {}
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if ":" in tok:
+            rows, w = tok.split(":", 1)
+            mix[int(rows)] = float(w)
+        else:
+            mix[int(tok)] = 1.0
+    return mix or {4096: 1.0}
+
+
+def _bucket_latency(parsed, model: str):
+    """Per-bucket p50/p99 + queue/device split from one /metrics parse."""
+    from lightgbm_tpu.serve.loadgen import metric_sum
+    out = {}
+    for lbl, val in parsed.get("lgbm_tpu_serve_request_latency_ms_p99", ()):
+        if lbl.get("model") != model:
+            continue
+        b = lbl.get("bucket", "?")
+        out[b] = {
+            "p99_ms": val,
+            "p50_ms": metric_sum(
+                parsed, "lgbm_tpu_serve_request_latency_ms_p50",
+                model=model, bucket=b),
+            "queue_wait_p50_ms": metric_sum(
+                parsed, "lgbm_tpu_serve_queue_wait_ms_p50",
+                model=model, bucket=b),
+            "device_p50_ms": metric_sum(
+                parsed, "lgbm_tpu_serve_device_ms_p50",
+                model=model, bucket=b),
+            "requests": metric_sum(
+                parsed, "lgbm_tpu_serve_request_latency_ms_count",
+                model=model, bucket=b),
+        }
+    return out
+
+
+def run_loadtest(ladder=("closed",), duration_s: float = 5.0,
+                 workers: int = 3, features: int = 4, trees: int = 20,
+                 leaves: int = 15, bucket_mix=None, arrival: str = "uniform",
+                 target_rows_per_s: float = 1e5,
+                 p99_threshold_ms: float = 0.0,
+                 max_queue_rows: int = 0,
+                 scrape_interval_s: float = 1.0):
+    """Run the ladder against a fresh in-process server; return the
+    verdict report.  Every pass/breach number is read back from the
+    server's own /metrics and /slo endpoints."""
+    from lightgbm_tpu.serve.loadgen import (LoadGenerator, LoadSpec,
+                                            metric_sum, parse_prometheus,
+                                            scrape_json, scrape_metrics)
+    from lightgbm_tpu.serve.registry import ModelRegistry
+    from lightgbm_tpu.serve.server import PredictionServer
+    from lightgbm_tpu.telemetry.slo import set_latency_threshold
+    from lightgbm_tpu.utils.backend import default_backend
+    from lightgbm_tpu.utils.log import set_verbosity
+
+    backend = default_backend()
+    set_verbosity(-1)
+    bucket_mix = dict(bucket_mix or {4096: 1.0})
+    if p99_threshold_ms and p99_threshold_ms > 0:
+        set_latency_threshold("serve/latency_p99", p99_threshold_ms)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        model_file = _train_model(trees, leaves, features, tmp)
+        registry = ModelRegistry()
+        # a fresh engine: the harness judges THIS run's burn, not
+        # whatever the process-wide engine sampled before it
+        from lightgbm_tpu.telemetry.slo import SloEngine
+        srv = PredictionServer(registry, port=0,
+                               max_queue_rows=int(max_queue_rows),
+                               slo_engine=SloEngine()).start()
+        host, port = srv.host, srv.port
+        rungs = []
+        try:
+            for rung in ladder:
+                qps = 0.0 if str(rung).strip() == "closed" else float(rung)
+                label = "closed" if qps <= 0 else f"qps{qps:g}"
+                # one registry name per rung: the latency windows are
+                # cumulative per (model, bucket) series, so a shared
+                # name would contaminate each rung's p99 with the
+                # previous rungs' samples
+                model_name = f"loadtest-{label}"
+                registry.load(model_name, model_file, warmup=True)
+                spec = LoadSpec(duration_s=duration_s, target_qps=qps,
+                                workers=workers, features=features,
+                                bucket_mix=bucket_mix, arrival=arrival,
+                                model=model_name)
+                gen = LoadGenerator(host, port, spec)
+
+                # periodic /slo evaluations while the load flows, so the
+                # burn windows sample DURING the rung, not just after it
+                stop = threading.Event()
+
+                def scraper():
+                    while not stop.wait(scrape_interval_s):
+                        try:
+                            scrape_json(host, port, "/slo")
+                        except Exception:
+                            pass
+
+                before = parse_prometheus(scrape_metrics(host, port))
+                t0 = time.perf_counter()
+                sc = threading.Thread(target=scraper, daemon=True)
+                sc.start()
+                client = gen.run()
+                stop.set()
+                sc.join(2.0)
+                after = parse_prometheus(scrape_metrics(host, port))
+                elapsed = time.perf_counter() - t0
+                slo_rep = scrape_json(host, port, "/slo")
+
+                def delta(name, **labels):
+                    return metric_sum(after, name, **labels) - \
+                        metric_sum(before, name, **labels)
+
+                rows_served = delta("lgbm_tpu_serve_rows_total",
+                                    model=model_name)
+                reqs = delta("lgbm_tpu_serve_requests_total",
+                             model=model_name)
+                resp_total = delta(
+                    "lgbm_tpu_serve_predict_responses_total")
+                resp_5xx = sum(
+                    delta("lgbm_tpu_serve_predict_responses_total", code=c)
+                    for c in ("500", "503", "504"))
+                rungs.append({
+                    "label": label,
+                    "config": {"target_qps": qps, "duration_s": duration_s,
+                               "workers": workers, "features": features,
+                               "bucket_mix": {str(k): v for k, v in
+                                              sorted(bucket_mix.items())},
+                               "arrival": arrival, "backend": backend,
+                               "max_queue_rows": int(max_queue_rows)},
+                    # server-side truth (the verdict inputs).
+                    # Availability reads the /predict-only response
+                    # counter — the harness's own /slo+/metrics scrape
+                    # 200s must not dilute a shed's severity
+                    "rows_per_sec": round(rows_served / elapsed, 1),
+                    "qps": round(reqs / elapsed, 2),
+                    "availability": round(
+                        1.0 - (resp_5xx / resp_total if resp_total
+                               else 0.0), 6),
+                    "shed": delta("lgbm_tpu_requests_shed_total",
+                                  model=model_name),
+                    "per_bucket": _bucket_latency(after, model_name),
+                    "slo": slo_rep,
+                    # client-side context (never judged)
+                    "client": client.summary(),
+                })
+        finally:
+            srv.shutdown()
+
+    best = max(rungs, key=lambda r: r["rows_per_sec"]) if rungs else None
+    slo_ok = all(r["slo"].get("ok", False) for r in rungs)
+    rows_ok = best is not None and \
+        best["rows_per_sec"] >= float(target_rows_per_s)
+    return {
+        "schema": "loadtest-slo-report-v1",
+        "git_sha": _git_sha(),
+        "backend": backend,
+        "verdict": "pass" if (slo_ok and rows_ok) else "breach",
+        "slo_ok": slo_ok,
+        "rows_ok": rows_ok,
+        "target_rows_per_s": float(target_rows_per_s),
+        "peak_rows_per_sec": best["rows_per_sec"] if best else 0.0,
+        "verdict_source": "/metrics + /slo scrapes only",
+        "rungs": rungs,
+    }
+
+
+def to_bench_matrix(report) -> dict:
+    """bench-matrix-v1 record for the nightly regression gate: per rung
+    one rows/s row and one qps row (each metric on its own row — the
+    gate compares one key per row, so sharing a row would leave qps
+    unjudged), one latency row per (rung, bucket), one SLO verdict
+    row."""
+    rows = []
+    for r in report["rungs"]:
+        rows.append({"name": f"loadtest_{r['label']}",
+                     "config": r["config"],
+                     "rows_per_sec": r["rows_per_sec"],
+                     "availability": r["availability"],
+                     "interpreted": False})
+        rows.append({"name": f"loadtest_{r['label']}_qps",
+                     "config": r["config"],
+                     "qps": r["qps"],
+                     "interpreted": False})
+        for b, lat in sorted(r["per_bucket"].items()):
+            rows.append({"name": f"loadtest_{r['label']}_p99_b{b}",
+                         "config": {"bucket": b, **r["config"]},
+                         "p99_ms": lat["p99_ms"],
+                         "queue_wait_p50_ms": lat["queue_wait_p50_ms"],
+                         "device_p50_ms": lat["device_p50_ms"],
+                         "interpreted": False})
+    rows.append({"name": "loadtest_slo",
+                 "slo_ok": bool(report["slo_ok"]),
+                 "verdict": report["verdict"]})
+    return {
+        "schema": "bench-matrix-v1",
+        "bench": "loadtest",
+        "git_sha": report["git_sha"],
+        "backend": report["backend"],
+        "rows": rows,
+    }
+
+
+def main(argv) -> int:
+    json_path = slo_path = ""
+    if "--json" in argv:
+        json_path = argv[argv.index("--json") + 1]
+    if "--slo-report" in argv:
+        slo_path = argv[argv.index("--slo-report") + 1]
+
+    ladder = [tok.strip() for tok in
+              os.environ.get("LOAD_LADDER", "closed").split(",")
+              if tok.strip()]
+    report = run_loadtest(
+        ladder=ladder,
+        duration_s=float(os.environ.get("LOAD_DURATION", 5.0)),
+        workers=int(os.environ.get("LOAD_WORKERS", 3)),
+        features=int(os.environ.get("LOAD_FEATURES", 4)),
+        trees=int(os.environ.get("LOAD_TREES", 20)),
+        leaves=int(os.environ.get("LOAD_LEAVES", 15)),
+        bucket_mix=_parse_bucket_mix(
+            os.environ.get("LOAD_BUCKETS", "4096")),
+        arrival=os.environ.get("LOAD_ARRIVAL", "uniform"),
+        target_rows_per_s=float(os.environ.get("LOAD_TARGET_ROWS_S", 1e5)),
+        p99_threshold_ms=float(os.environ.get("LOAD_P99_MS", 0.0)),
+        max_queue_rows=int(os.environ.get("LOAD_MAX_QUEUE_ROWS", 0)))
+
+    for r in report["rungs"]:
+        print(json.dumps({
+            "rung": r["label"], "rows_per_sec": r["rows_per_sec"],
+            "qps": r["qps"], "availability": r["availability"],
+            "slo_ok": r["slo"].get("ok")}), flush=True)
+    print(json.dumps({
+        "verdict": report["verdict"], "slo_ok": report["slo_ok"],
+        "rows_ok": report["rows_ok"],
+        "peak_rows_per_sec": report["peak_rows_per_sec"],
+        "target_rows_per_s": report["target_rows_per_s"]}), flush=True)
+
+    if slo_path:
+        with open(slo_path, "w") as fh:
+            json.dump(report, fh, indent=2, default=str)
+    if json_path:
+        with open(json_path, "w") as fh:
+            json.dump(to_bench_matrix(report), fh, indent=2, default=str)
+    return 0 if report["verdict"] == "pass" else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

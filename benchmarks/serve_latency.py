@@ -77,6 +77,29 @@ def _measure(pred, Xq, reqs):
     return _pct(lat, 50.0), _pct(lat, 99.0)
 
 
+def _measure_split(pred, Xq, reqs, bucket):
+    """Queue-wait vs device-compute split through the real micro-batcher
+    (the per-request tracing path the serving tier runs): p50 of each
+    component from the (model, bucket)-labeled timing histograms.  A
+    small fixed sample suffices for a p50 split — the un-batched p50/p99
+    measurement above already paid the full request count, so this must
+    not double the ladder's wall time."""
+    from lightgbm_tpu.serve.batcher import MicroBatcher
+    from lightgbm_tpu.telemetry.metrics import percentile as _pct
+    mb = MicroBatcher(pred.predict, stats=pred.stats, buckets=pred.buckets)
+    try:
+        for _ in range(min(int(reqs), 12)):
+            mb.predict(Xq)
+    finally:
+        mb.close()
+    t = pred.stats.bucket_timing(bucket)
+    return {
+        "request_p50_ms": round(_pct(t["request_latency_ms"], 50.0), 4),
+        "queue_wait_p50_ms": round(_pct(t["queue_wait_ms"], 50.0), 4),
+        "device_p50_ms": round(_pct(t["device_ms"], 50.0), 4),
+    }
+
+
 def main(argv) -> None:
     json_path = ""
     if "--json" in argv:
@@ -121,6 +144,7 @@ def main(argv) -> None:
                     pred.predict(Xq)  # warm this bucket (unmeasured)
                     r0 = pred.stats.snapshot()["recompiles"]
                     p50, p99 = _measure(pred, Xq, reqs)
+                    split = _measure_split(pred, Xq, reqs, bucket)
                     key = (trees, leaves, cat, bucket)
                     if path == "walk":
                         walk_p50[key] = p50
@@ -134,6 +158,9 @@ def main(argv) -> None:
                         "p50_ms": round(p50, 4),
                         "p99_ms": round(p99, 4),
                         "rows_per_sec": round(bucket / (p50 / 1e3), 1),
+                        # the per-request tracing split through the real
+                        # micro-batcher path (queue wait vs device call)
+                        **split,
                         "recompiles_after_warm": pred.stats.snapshot()[
                             "recompiles"] - r0,
                         "interpreted": False,

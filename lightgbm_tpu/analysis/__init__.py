@@ -23,12 +23,15 @@ states those contracts once and machine-checks them:
   detection + shard_map sharding consistency, world-size-scaled;
 * :mod:`.memory` — the ``lint-mem`` peak-memory estimator (live-range
   jaxpr sweep, per-shard sizing, XLA memory_analysis cross-check);
+* :mod:`.slo_cover` — SLO-coverage check: every declared
+  service-level objective (telemetry/slo.py) must key to a registered
+  metric series (the ``note_collective``-contract coverage pattern);
 * :mod:`.lint` — the ``python -m lightgbm_tpu lint-trace`` matrix
-  driver (serial / wave / DP-scatter / spec-ramp / multitrain / serve),
-  a blocking CI step.
+  driver (serial / wave / DP-scatter / spec-ramp / multitrain / serve
+  plus the SLO-coverage section), a blocking CI step.
 """
 
-from . import contracts, ir, lint, memory, rules, spmd
+from . import contracts, ir, lint, memory, rules, slo_cover, spmd
 from .contracts import (CollectiveContract, DonationContract, MemoryBudget,
                         all_contracts, all_memory_budgets,
                         collective_contract, contract_for,
@@ -44,11 +47,13 @@ from .memory import (MemoryBudgetRule, MemoryEstimate, estimate_memory,
 from .rules import (DEFAULT_RULES, CollectiveBudgetRule, ConstantFoldRule,
                     DonationRule, DtypeRule, HostSyncRule, RetraceRule,
                     Rule, TraceUnit, Violation, run_rules)
+from .slo_cover import check_slo_coverage, slo_coverage_report
 from .spmd import (SPMD_RULES, CollectiveOrderRule,
                    ShardingConsistencyRule, collective_trace)
 
 __all__ = [
-    "ir", "contracts", "rules", "lint", "memory", "spmd",
+    "ir", "contracts", "rules", "lint", "memory", "slo_cover", "spmd",
+    "check_slo_coverage", "slo_coverage_report",
     "collect_collectives", "collectives_of", "count_primitive",
     "is_collective", "iter_consts", "iter_eqns", "stable_hash",
     "subjaxprs", "trace", "walk_eqns",

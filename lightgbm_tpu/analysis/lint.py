@@ -608,6 +608,13 @@ def run_lint(configs: Optional[Sequence[str]] = None,
         }
     units.append(_donation_unit())
     violations = run_rules(units, rules=ALL_RULES)
+    # SLO-coverage check (slo_cover.py): declared objectives must key to
+    # registered metric series — the note_collective-contract coverage
+    # pattern applied to the SLO layer
+    from .slo_cover import check_slo_coverage, slo_coverage_report
+    slo_violations = check_slo_coverage()
+    slo_section = slo_coverage_report(violations=slo_violations)
+    violations.extend(slo_violations)
     by_cfg: Dict[str, List[Violation]] = {}
     for v in violations:
         by_cfg.setdefault(v.config, []).append(v)
@@ -618,6 +625,7 @@ def run_lint(configs: Optional[Sequence[str]] = None,
         "ok": "score_update" not in by_cfg,
         "violations": [v.to_json() for v in by_cfg.get("score_update", [])],
     }
+    report_cfgs["slo_coverage"] = slo_section
     from .contracts import all_contracts
     return {
         "schema": "trace-lint-v1",

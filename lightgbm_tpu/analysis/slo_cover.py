@@ -1,0 +1,108 @@
+"""SLO-coverage lint: every declared objective keys to a real series.
+
+The ``note_collective``-contract coverage pattern applied to the SLO
+layer: an SLO declared against a metric nobody registers would simply
+never burn — the objective silently stops objecting.  This check
+imports the SLO-declaring modules (serving stats / HTTP server /
+admission / inference compiler), runs every registered *metric ensurer*
+(each subsystem materializes its metric families into a registry with
+no traffic needed), and then validates for each declared SLO that
+
+  * ``metric`` (and ``total_metric`` for ratio SLOs) names a registered
+    metric;
+  * the metric's kind fits the SLO kind (latency objectives need a
+    windowed histogram, ratio objectives counters);
+  * every label key the SLO selects on exists in the metric's label
+    schema (a selector on a label the series never carries matches
+    nothing, forever).
+
+Wired into ``lint-trace`` (``analysis/lint.py``) as the
+``slo_coverage`` report section, so CI blocks on a dangling SLO the
+same way it blocks on an undeclared collective site.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .rules import Violation
+from ..telemetry.metrics import (Counter, MetricsRegistry,
+                                 WindowedHistogram)
+
+__all__ = ["check_slo_coverage", "slo_coverage_report"]
+
+RULE = "slo-coverage"
+
+
+def _import_declaring_modules() -> None:
+    """Import every module that declares SLOs / registers ensurers (the
+    declarations live next to the code they bound, so importing the
+    subsystems collects them)."""
+    from ..resilience import admission  # noqa: F401
+    from ..serve import compiler, server, stats  # noqa: F401
+
+
+def check_slo_coverage(registry: Optional[MetricsRegistry] = None
+                       ) -> List[Violation]:
+    from ..telemetry.slo import all_slos, ensure_metrics
+    _import_declaring_modules()
+    registry = registry if registry is not None else MetricsRegistry()
+    ensure_metrics(registry)
+    out: List[Violation] = []
+
+    def v(site: str, message: str) -> None:
+        out.append(Violation(RULE, "slo_coverage", site, message))
+
+    for name, s in sorted(all_slos().items()):
+        metrics = [("metric", s.metric)]
+        if s.kind == "ratio":
+            if not s.total_metric:
+                v(name, "ratio SLO needs a total_metric denominator")
+            else:
+                metrics.append(("total_metric", s.total_metric))
+        for role, mname in metrics:
+            m = registry.get(mname)
+            if m is None:
+                v(name, f"{role} '{mname}' names no registered series "
+                        f"(declared in {s.declared_in or '?'}); an SLO "
+                        f"keyed to a metric nobody emits never burns")
+                continue
+            if s.kind == "latency" and role == "metric" and \
+                    not isinstance(m, WindowedHistogram):
+                v(name, f"latency SLO needs a windowed histogram but "
+                        f"'{mname}' is a {m.kind}")
+            if s.kind == "ratio" and not isinstance(m, Counter):
+                v(name, f"ratio SLO needs counters but '{mname}' is a "
+                        f"{m.kind}")
+            selectors = dict(s.labels)
+            if role == "metric":
+                selectors.update(s.bad_labels)
+            unknown = sorted(set(selectors) - set(m.label_names))
+            if unknown:
+                v(name, f"selector label(s) {unknown} not in "
+                        f"'{mname}' label schema {list(m.label_names)}")
+        if not (0.0 < s.target < 1.0):
+            v(name, f"target must be in (0, 1), got {s.target}")
+        if s.kind == "latency" and s.threshold_ms <= 0:
+            v(name, f"latency SLO needs threshold_ms > 0, "
+                    f"got {s.threshold_ms}")
+    return out
+
+
+def slo_coverage_report(registry: Optional[MetricsRegistry] = None,
+                        violations: Optional[List[Violation]] = None
+                        ) -> Dict[str, Any]:
+    """JSON-ready section for the ``lint-trace`` report.  Pass
+    ``violations`` when the check already ran (run_lint does) to avoid
+    a second pass over the registry."""
+    from ..telemetry.slo import all_slos
+    if violations is None:
+        violations = check_slo_coverage(registry)
+    return {
+        "ok": not violations,
+        "violations": [x.to_json() for x in violations],
+        "slos": {name: {"metric": s.metric, "kind": s.kind,
+                        "target": s.target,
+                        "declared_in": s.declared_in}
+                 for name, s in sorted(all_slos().items())},
+    }

@@ -132,6 +132,15 @@ _PARAMS: List[ParamSpec] = [
     _p("checkpoint_dir", str, "", ("checkpoint_directory",)),
     _p("checkpoint_keep", int, 3, ("checkpoint_ring",), check=">0"),
     _p("resume", str, "", ("resume_from",)),
+    # training flight recorder (telemetry/flight.py): bounded ring of
+    # per-iteration structured events, dumped to JSONL by the
+    # PreemptionGuard/crash path (into flight_dir, defaulting to the
+    # checkpoint dir).  Observation-only run directives like resume/
+    # checkpoint_dir: excluded from the model-text params dump so
+    # recorder-on and recorder-off models match byte for byte.
+    _p("flight_recorder", bool, True),
+    _p("flight_events", int, 1024, check=">0"),
+    _p("flight_dir", str, ""),
     _p("max_bin", int, 255, check="1<v<=65535"),
     _p("min_data_in_bin", int, 3, check=">0"),
     _p("bin_construct_sample_cnt", int, 200000, ("subsample_for_bin",), check=">0"),

@@ -281,51 +281,95 @@ def train(params: Dict[str, Any], train_set: Dataset,
             log_info(f"final checkpoint flushed to {path}")
         return path
 
+    def _flight_dump(reason: str) -> Optional[str]:
+        """Dump the flight-recorder tape next to the checkpoints (or to
+        an explicit flight_dir) — the crash/preemption post-mortem.
+        Called AFTER the final checkpoint flush, so the tape's last
+        event and the checkpoint land on the same iteration boundary."""
+        import os
+        fr = getattr(booster._gbdt, "flight", None)
+        if fr is None or not fr.enabled or len(fr) == 0:
+            return None
+        out_dir = str(cfg2.flight_dir) or ckpt_dir
+        if not out_dir:
+            return None
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            path = fr.dump(os.path.join(out_dir, "flight.jsonl"),
+                           reason=reason)
+        except OSError as exc:
+            log_warning(f"flight recorder dump failed: {exc}")
+            return None
+        log_info(f"flight recorder: {len(fr)} events dumped to {path} "
+                 f"({reason})")
+        return path
+
     # The guard turns a SIGTERM/SIGINT (TPU preemption notice) into a
     # drain-and-flush exit; installed only while checkpointing is active
     # so a plain Ctrl-C on an uncheckpointed run stays KeyboardInterrupt.
-    with PreemptionGuard(enabled=manager is not None) as guard:
-        for it in range(start_iter, num_boost_round):
-            faults.check_train_iter(it)  # chaos layer (resilience/faults.py)
-            for cb in callbacks_before:
-                cb(CallbackEnv(booster, params, it, 0, num_boost_round, None))
-            if booster.update(fobj=fobj):
-                # no leaf met the split requirements — stop like the reference
-                # CLI train loop (gbdt.cpp:264-283)
-                break
-            if cfg2.snapshot_freq > 0 and \
-                    (it + 1) % cfg2.snapshot_freq == 0:
-                # reference-compatible model-text snapshot (gbdt.cpp:277-281
-                # Train + snapshot_freq/save_period), atomically written
-                booster.save_model(
-                    f"{cfg2.output_model}.snapshot_iter_{it + 1}")
-
-            evaluation_result_list = []
-            if booster._gbdt.train_metrics or booster._gbdt.valid_sets or feval:
-                evaluation_result_list = booster.eval_train(feval) + \
-                    booster.eval_valid(feval)
-            if manager is not None:
-                for data_name, eval_name, value, _ in evaluation_result_list:
-                    run_history.setdefault(
-                        data_name, {}).setdefault(eval_name, []).append(value)
-            try:
-                for cb in callbacks_after:
+    try:
+        with PreemptionGuard(enabled=manager is not None) as guard:
+            for it in range(start_iter, num_boost_round):
+                faults.check_train_iter(it)  # chaos layer (resilience/)
+                for cb in callbacks_before:
                     cb(CallbackEnv(booster, params, it, 0, num_boost_round,
-                                   evaluation_result_list))
-            except EarlyStopException as e:
-                booster.best_iteration = e.best_iteration + 1
-                for ds_name, eval_name, score, _ in e.best_score:
-                    booster.best_score.setdefault(ds_name, {})[eval_name] = score
-                _flush()
-                break
-            # the full-state bundle flushes AFTER the iteration's eval
-            # callbacks so eval history / early-stop bookkeeping restore
-            # to the exact same boundary
-            if manager is not None and (it + 1) % snap_freq == 0:
-                _flush()
-            if guard.fired is not None:
-                raise TrainingPreempted(guard.fired, booster=booster,
-                                        checkpoint=_flush(final=True))
+                                   None))
+                if booster.update(fobj=fobj):
+                    # no leaf met the split requirements — stop like the
+                    # reference CLI train loop (gbdt.cpp:264-283)
+                    break
+                if cfg2.snapshot_freq > 0 and \
+                        (it + 1) % cfg2.snapshot_freq == 0:
+                    # reference-compatible model-text snapshot
+                    # (gbdt.cpp:277-281 Train + snapshot_freq/save_period),
+                    # atomically written
+                    booster.save_model(
+                        f"{cfg2.output_model}.snapshot_iter_{it + 1}")
+
+                evaluation_result_list = []
+                if booster._gbdt.train_metrics or booster._gbdt.valid_sets \
+                        or feval:
+                    evaluation_result_list = booster.eval_train(feval) + \
+                        booster.eval_valid(feval)
+                booster._gbdt.flight.note_eval(it + 1,
+                                               evaluation_result_list)
+                if manager is not None:
+                    for data_name, eval_name, value, _ in \
+                            evaluation_result_list:
+                        run_history.setdefault(data_name, {}).setdefault(
+                            eval_name, []).append(value)
+                try:
+                    for cb in callbacks_after:
+                        cb(CallbackEnv(booster, params, it, 0,
+                                       num_boost_round,
+                                       evaluation_result_list))
+                except EarlyStopException as e:
+                    booster.best_iteration = e.best_iteration + 1
+                    for ds_name, eval_name, score, _ in e.best_score:
+                        booster.best_score.setdefault(
+                            ds_name, {})[eval_name] = score
+                    _flush()
+                    break
+                # the full-state bundle flushes AFTER the iteration's eval
+                # callbacks so eval history / early-stop bookkeeping
+                # restore to the exact same boundary
+                if manager is not None and (it + 1) % snap_freq == 0:
+                    _flush()
+                if guard.fired is not None:
+                    final_path = _flush(final=True)
+                    _flight_dump("preempted")
+                    raise TrainingPreempted(guard.fired, booster=booster,
+                                            checkpoint=final_path)
+    except TrainingPreempted:
+        raise                      # tape already dumped above
+    except (Exception, KeyboardInterrupt):
+        # uncaught training error (including injected chaos faults):
+        # leave the post-mortem tape next to the checkpoints
+        _flight_dump("crash")
+        raise
+    if str(cfg2.flight_dir):
+        # an explicit flight_dir asks for the tape even on success
+        _flight_dump("completed")
     return booster
 
 

@@ -33,6 +33,7 @@ quantized path.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -307,6 +308,26 @@ def train_streamed(params: Dict[str, Any], train_set: StreamedDataset,
             params={k: getattr(cfg, k)
                     for k in CKPT_STRUCTURAL_KEYS + CKPT_SOFT_KEYS}))
 
+    # ---- flight recorder (telemetry/flight.py) ----------------------------
+    # the chunked path is the one where the per-event h2d byte counter
+    # actually moves; the tape dumps next to the checkpoints on a crash
+    from ..telemetry.flight import FlightRecorder
+    flight = FlightRecorder(
+        capacity=int(cfg.flight_events), enabled=bool(cfg.flight_recorder),
+        meta={"boosting": str(cfg.boosting), "objective": str(cfg.objective),
+              "num_data": int(n), "ingest_mode": "chunked"})
+
+    def _flight_dump(reason: str) -> None:
+        out_dir = str(cfg.flight_dir) or ckpt_dir
+        if not flight.enabled or len(flight) == 0 or not out_dir:
+            return
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            flight.dump(os.path.join(out_dir, "flight.jsonl"),
+                        reason=reason)
+        except OSError as exc:
+            log_warning(f"flight recorder dump failed: {exc}")
+
     # ---- boosting loop -----------------------------------------------------
     shrinkage = float(cfg.learning_rate)
     goss = cfg.boosting == "goss"
@@ -317,62 +338,78 @@ def train_streamed(params: Dict[str, Any], train_set: StreamedDataset,
     grad = np.empty(n, np.float32)
     hess = np.empty(n, np.float32)
     completed = start_iter
-    for it in range(start_iter, num_boost_round):
-        with span("ingest/train/iteration"):
-            for i in range(train_set.num_chunks()):
-                lo, hi = train_set.chunk_bounds(i)
-                g, h = _chunk_gradients(
-                    obj, score[lo:hi], label32[lo:hi],
-                    None if weight32 is None else weight32[lo:hi])
-                grad[lo:hi] = g
-                hess[lo:hi] = h
-            if goss:
-                # GOSS replaces bagging (in-core GOSS overrides
-                # _prepare_iter_sampling and never draws a bag)
-                mask = np.ones(n, np.float32)
-                if it >= warmup:
-                    gm = _goss_mult_np(grad, hess, float(cfg.top_rate),
-                                       float(cfg.other_rate),
-                                       int(cfg.bagging_seed), it)
-                    if gm is not None:
-                        mask, mult = gm
-                        grad = grad * mult
-                        hess = hess * mult
-            else:
-                mask = bagging_mask_np(
-                    cfg, n, it,
-                    label=(np.asarray(label32) if cfg.objective == "binary"
-                           else None))
-                mask = np.ones(n, np.float32) if mask is None else mask
-            fmask = feature_mask_np(cfg, f_used, it)
-            grown, rl_chunks = grower.grow(train_set, grad, hess, mask,
-                                           feature_mask=fmask)
-            nl = int(grown.num_leaves)
-            if nl <= 1 and trees:
-                log_warning("Stopped training because there are no more "
-                            "leaves that meet the split requirements")
-                break
-            tree = _grown_to_tree(grown, shrinkage, train_set)
-            bias = pending_bias if it == start_iter and not trees else 0.0
-            if abs(bias) > EPSILON:
-                tree.add_bias(bias)
-            trees.append(tree)
-            # score update: the in-core _update_score_impl's
-            # score + lv[row_leaf], per chunk, host f32 (same IEEE ops)
-            lv = (np.asarray(grown.leaf_value, np.float32) *
-                  np.float32(shrinkage))
-            for i, rl_c in enumerate(rl_chunks):
-                lo, hi = train_set.chunk_bounds(i)
-                score[lo:hi] = score[lo:hi] + lv[rl_c.astype(np.int64)]
-            completed = it + 1
-            if nl <= 1:
-                log_warning("Stopped training because there are no more "
-                            "leaves that meet the split requirements")
-                break
-            if manager is not None and completed % freq == 0:
-                _save_ckpt(completed)
+
+    def _one_iter(it: int) -> bool:
+        """One streamed boosting iteration; True = stop (no more
+        splittable leaves)."""
+        nonlocal completed, grad, hess
+        for i in range(train_set.num_chunks()):
+            lo, hi = train_set.chunk_bounds(i)
+            g, h = _chunk_gradients(
+                obj, score[lo:hi], label32[lo:hi],
+                None if weight32 is None else weight32[lo:hi])
+            grad[lo:hi] = g
+            hess[lo:hi] = h
+        if goss:
+            # GOSS replaces bagging (in-core GOSS overrides
+            # _prepare_iter_sampling and never draws a bag)
+            mask = np.ones(n, np.float32)
+            if it >= warmup:
+                gm = _goss_mult_np(grad, hess, float(cfg.top_rate),
+                                   float(cfg.other_rate),
+                                   int(cfg.bagging_seed), it)
+                if gm is not None:
+                    mask, mult = gm
+                    grad = grad * mult
+                    hess = hess * mult
+        else:
+            mask = bagging_mask_np(
+                cfg, n, it,
+                label=(np.asarray(label32) if cfg.objective == "binary"
+                       else None))
+            mask = np.ones(n, np.float32) if mask is None else mask
+        fmask = feature_mask_np(cfg, f_used, it)
+        grown, rl_chunks = grower.grow(train_set, grad, hess, mask,
+                                       feature_mask=fmask)
+        nl = int(grown.num_leaves)
+        if nl <= 1 and trees:
+            log_warning("Stopped training because there are no more "
+                        "leaves that meet the split requirements")
+            return True
+        tree = _grown_to_tree(grown, shrinkage, train_set)
+        bias = pending_bias if it == start_iter and not trees else 0.0
+        if abs(bias) > EPSILON:
+            tree.add_bias(bias)
+        trees.append(tree)
+        # score update: the in-core _update_score_impl's
+        # score + lv[row_leaf], per chunk, host f32 (same IEEE ops)
+        lv = (np.asarray(grown.leaf_value, np.float32) *
+              np.float32(shrinkage))
+        for i, rl_c in enumerate(rl_chunks):
+            lo, hi = train_set.chunk_bounds(i)
+            score[lo:hi] = score[lo:hi] + lv[rl_c.astype(np.int64)]
+        completed = it + 1
+        flight.note_iter(completed, num_leaves=nl)
+        if nl <= 1:
+            log_warning("Stopped training because there are no more "
+                        "leaves that meet the split requirements")
+            return True
+        if manager is not None and completed % freq == 0:
+            _save_ckpt(completed)
+        return False
+
+    try:
+        for it in range(start_iter, num_boost_round):
+            with span("ingest/train/iteration"):
+                if _one_iter(it):
+                    break
+    except (Exception, KeyboardInterrupt):
+        _flight_dump("crash")
+        raise
     if manager is not None:
         _save_ckpt(completed)
+    if str(cfg.flight_dir):
+        _flight_dump("completed")
 
     gbdt = _glue_gbdt(cfg, train_set, obj, trees)
     bst = Booster.__new__(Booster)

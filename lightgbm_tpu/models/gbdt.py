@@ -288,8 +288,11 @@ class GBDT:
         self.best_iteration = -1
         # loaded (train-set-less) models keep an inert record so the
         # eval/snapshot surfaces never need a None check; _init_train
-        # replaces it with the published per-run record
+        # replaces it with the published per-run record (same deal for
+        # the flight recorder: inert/disabled until a training run)
         self.train_record = TrainRecord(meta={"boosting": self.name})
+        from ..telemetry.flight import FlightRecorder
+        self.flight = FlightRecorder(capacity=1, enabled=False)
         if train_set is not None:
             self._init_train(train_set)
 
@@ -497,6 +500,15 @@ class GBDT:
             "num_features": int(self.num_features),
         })
         set_last_train_record(self.train_record)
+        # flight recorder: bounded per-iteration event ring for crash/
+        # preemption post-mortems (telemetry/flight.py).  Observation
+        # only — recorder-on training is bit-identical to recorder-off.
+        from ..telemetry.flight import FlightRecorder
+        self.flight = FlightRecorder(
+            capacity=int(cfg.flight_events),
+            enabled=bool(cfg.flight_recorder),
+            meta={"boosting": self.name, "objective": str(cfg.objective),
+                  "num_data": int(self.num_data)})
 
     def _inner_monotone(self) -> Optional[np.ndarray]:
         """Map config.monotone_constraints (original column indexing, may be
@@ -761,6 +773,7 @@ class GBDT:
                 return True
 
             finished = True
+            fl_leaves = fl_gain = None  # flight-event fields (last class)
             fmask = self._feature_mask()
             grad, hess, mask = self._prepare_iter_sampling(grad, hess)
             if getattr(self, "_row_valid", None) is not None:
@@ -804,6 +817,13 @@ class GBDT:
                 self.last_hist_passes = grown.hist_passes
                 rec.add_tree(self.iter_, cid, grown.hist_passes,
                              grown.num_leaves)
+                if self.flight.enabled:
+                    # last grown tree's fields for this iteration's
+                    # flight event (device scalars, pulled lazily on
+                    # dump; the max over split gains is one tiny
+                    # device reduce)
+                    fl_leaves = grown.num_leaves
+                    fl_gain = jnp.max(grown.split_gain)
                 with rec.phase("record"):
                     tree = self._record_tree(grown, cid)
                 if tree is not None and self._cegb_coupled is not None:
@@ -825,6 +845,10 @@ class GBDT:
                 if hasattr(x, "copy_to_host_async"):
                     x.copy_to_host_async()
             self.iter_ += 1
+            if self.flight.enabled:
+                self.flight.note_iter(
+                    self.iter_, hist_passes=self.last_hist_passes,
+                    num_leaves=fl_leaves, best_gain=fl_gain)
             if self.iter_ % 16 == 1:
                 # periodic device-memory watermark sample (cheap local
                 # PJRT query; None on backends without memory_stats)

@@ -192,12 +192,15 @@ def model_to_string(gbdt, start_iteration: int = 0,
     tail.write("\nparameters:\n")
     for key, value in sorted(gbdt.config.to_dict().items()):
         if key in ("resume", "checkpoint_dir", "checkpoint_keep",
-                   "tpu_ingest_mode"):
+                   "tpu_ingest_mode", "flight_recorder", "flight_events",
+                   "flight_dir"):
             # transient run directives, not training config: a preempted-
             # and-resumed run must produce byte-identical model text to
             # the run that never stopped, a shipped model must not embed
-            # machine-local checkpoint paths, and a model trained
-            # streamed-chunked must match its in-core twin byte for byte
+            # machine-local checkpoint paths, a model trained
+            # streamed-chunked must match its in-core twin byte for byte,
+            # and the flight recorder (observation only) must not fork
+            # the model text between recorder-on and recorder-off runs
             continue
         if isinstance(value, list):
             value = ",".join(str(v) for v in value)

@@ -24,10 +24,20 @@ through ``GET /metrics`` and consulted by the degraded-mode ``/healthz``.
 
 from __future__ import annotations
 
-from ..telemetry.metrics import Counter, default_registry
+from ..telemetry.metrics import Counter, MetricsRegistry, default_registry
+from ..telemetry.slo import register_metric_ensurer, slo
 
 __all__ = ["QueueFullError", "DeadlineExceeded", "ServerClosed",
            "shed_counter", "deadline_counter"]
+
+# Shed-budget objective, declared next to the counter it reads: at most
+# 1% of client predict calls may be refused by admission control.  A
+# sustained higher shed rate means the tier is undersized for its
+# traffic, not protecting itself from a blip.
+slo("serve/shed_rate", metric="requests_shed_total",
+    total_metric="serve_requests_total", kind="ratio", target=0.99,
+    min_events=50,
+    note="load-shed (503) budget over client predict calls")
 
 
 class QueueFullError(RuntimeError):
@@ -65,3 +75,15 @@ def deadline_counter() -> Counter:
         "deadline_exceeded_total",
         "requests failed by per-request deadline (504)",
         labels=("model",))
+
+
+@register_metric_ensurer
+def _ensure_admission_metrics(reg: MetricsRegistry) -> None:
+    """SLO-coverage ensurer: the admission counter families exist in a
+    registry before any traffic (or shed) does."""
+    reg.counter("requests_shed_total",
+                "requests rejected by admission control (503 load shed)",
+                labels=("model",))
+    reg.counter("deadline_exceeded_total",
+                "requests failed by per-request deadline (504)",
+                labels=("model",))

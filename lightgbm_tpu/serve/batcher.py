@@ -25,10 +25,21 @@ Admission control (resilience/admission.py semantics):
   * ``close()`` drains the queue and fails every pending future with
     :class:`ServerClosed` — a shutdown never leaves a caller blocked
     until its own client timeout.
+
+Observability (fleet-observability tentpole):
+
+  * ``serve_queue_rows{model}`` / ``serve_inflight_requests{model}``
+    gauges track saturation building, not just requests dying — a load
+    test watches the backlog grow BEFORE the shed counter moves;
+  * each request's ``X-Request-Id`` rides the queue item; ``stats``
+    (a :class:`ModelStats`) receives the per-request queue-wait vs
+    device-compute split, and the ids propagate into the predictor when
+    its ``predict`` accepts ``request_ids`` (recompile attribution).
 """
 
 from __future__ import annotations
 
+import inspect
 import queue
 import threading
 import time
@@ -41,10 +52,14 @@ import numpy as np
 from ..resilience.admission import (DeadlineExceeded, QueueFullError,
                                     ServerClosed, deadline_counter,
                                     shed_counter)
+from ..telemetry.metrics import default_registry
 
 __all__ = ["MicroBatcher"]
 
 _CLOSE = object()
+
+# queue item slots: (X, raw_score, future, deadline, request_id, t_submit)
+_X, _RAW, _FUT, _DEADLINE, _RID, _TSUB = range(6)
 
 
 class MicroBatcher:
@@ -53,19 +68,30 @@ class MicroBatcher:
     ``predict_fn(X, raw_score) -> np.ndarray`` must be row-aligned:
     output row i corresponds to input row i (true for every predictor
     path).  ``submit`` returns a Future; ``predict`` blocks on it.
-    ``name`` labels the shed/deadline telemetry counters.
+    ``name`` labels the shed/deadline counters and saturation gauges;
+    ``stats`` (optional :class:`ModelStats`) receives each request's
+    queue-wait vs device-compute timing split.
     """
 
-    def __init__(self, predict_fn: Callable[[np.ndarray, bool], np.ndarray],
+    def __init__(self, predict_fn: Callable[..., np.ndarray],
                  max_batch_rows: int = 4096,
                  max_wait_ms: float = 2.0,
                  max_queue_rows: int = 0,
-                 name: str = "default") -> None:
+                 name: str = "default",
+                 stats=None,
+                 buckets: Optional[tuple] = None) -> None:
         self._predict_fn = predict_fn
         self._max_rows = int(max_batch_rows)
         self._max_wait = max(0.0, float(max_wait_ms)) / 1e3
         self._max_queue_rows = max(0, int(max_queue_rows))  # 0 = unbounded
         self.name = str(name)
+        self.stats = stats
+        self._buckets = tuple(buckets) if buckets is not None else None
+        try:
+            self._fn_takes_rids = "request_ids" in \
+                inspect.signature(predict_fn).parameters
+        except (TypeError, ValueError):
+            self._fn_takes_rids = False
         self._q: "queue.Queue" = queue.Queue()
         self._closed = False
         self._backlog_rows = 0  # rows admitted but not yet dispatched
@@ -73,6 +99,21 @@ class MicroBatcher:
         self._state_lock = threading.Lock()  # serializes submit vs close
         self._shed = shed_counter()
         self._deadline = deadline_counter()
+        # the saturation gauges live NEXT TO the stats' series (a server
+        # with a private metrics registry keeps its saturation private
+        # too); without stats they land in the process-wide registry.
+        # No zero-init: a gauge series appears on the first submit, so
+        # constructing a second batcher can never clobber a live one's
+        # reading under the same model label.
+        reg = stats.registry if stats is not None and \
+            hasattr(stats, "registry") else default_registry()
+        self._queue_gauge = reg.gauge(
+            "serve_queue_rows",
+            "rows admitted to the micro-batcher but not yet dispatched",
+            labels=("model",))
+        self._inflight_gauge = reg.gauge(
+            "serve_inflight_requests",
+            "requests admitted and not yet completed", labels=("model",))
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="lgb-tpu-microbatcher")
         self._thread.start()
@@ -82,11 +123,17 @@ class MicroBatcher:
     def backlog_rows(self) -> int:
         return self._backlog_rows
 
+    def inflight_requests(self) -> int:
+        return int(self._inflight_gauge.value(model=self.name))
+
     def submit(self, X: np.ndarray, raw_score: bool = False,
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               request_id: Optional[str] = None) -> Future:
         """Queue one request.  ``deadline`` is an absolute
         ``time.monotonic()`` instant after which the request is failed
-        with :class:`DeadlineExceeded` rather than dispatched."""
+        with :class:`DeadlineExceeded` rather than dispatched;
+        ``request_id`` tags the request's telemetry trail (exemplars,
+        recompile attribution)."""
         X = np.asarray(X, np.float32)
         if X.ndim == 1:
             X = X.reshape(1, -1)
@@ -105,7 +152,15 @@ class MicroBatcher:
                 raise QueueFullError(self._backlog_rows,
                                      self._max_queue_rows, retry)
             self._backlog_rows += rows
-            self._q.put((X, bool(raw_score), fut, deadline))
+            self._queue_gauge.set(self._backlog_rows, model=self.name)
+            self._inflight_gauge.add(1, model=self.name)
+            # the done-callback fires exactly once whichever path settles
+            # the future (dispatch, deadline expiry, shutdown drain), so
+            # the gauge can never leak under the racy failure paths
+            fut.add_done_callback(
+                lambda _f: self._inflight_gauge.add(-1, model=self.name))
+            self._q.put((X, bool(raw_score), fut, deadline, request_id,
+                         time.monotonic()))
         return fut
 
     def _retry_after_locked(self) -> float:
@@ -115,13 +170,15 @@ class MicroBatcher:
         return max(0.05, batches * self._ewma_batch_s + self._max_wait)
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
-                timeout_s: Optional[float] = None) -> np.ndarray:
+                timeout_s: Optional[float] = None,
+                request_id: Optional[str] = None) -> np.ndarray:
         """Blocking submit; with ``timeout_s`` the call raises
         :class:`DeadlineExceeded` at the deadline instead of hanging the
         calling (handler) thread on a future that is still queued."""
         deadline = None if timeout_s is None else \
             time.monotonic() + float(timeout_s)
-        fut = self.submit(X, raw_score, deadline=deadline)
+        fut = self.submit(X, raw_score, deadline=deadline,
+                          request_id=request_id)
         if deadline is None:
             return fut.result()
         try:
@@ -146,15 +203,22 @@ class MicroBatcher:
             self._q.put(_CLOSE)
         self._thread.join(timeout)
         # drain: fail anything the worker left behind rather than leaving
-        # its caller blocked until a client-side timeout
+        # its caller blocked until a client-side timeout — and release
+        # the queue-gauge accounting, or the process-wide registry keeps
+        # reporting phantom queued rows for a batcher that no longer
+        # exists
         while True:
             try:
                 item = self._q.get_nowait()
             except queue.Empty:
                 break
             if item is not _CLOSE:
+                with self._state_lock:
+                    self._backlog_rows -= int(item[_X].shape[0])
+                    self._queue_gauge.set(self._backlog_rows,
+                                          model=self.name)
                 try:
-                    item[2].set_exception(ServerClosed(
+                    item[_FUT].set_exception(ServerClosed(
                         "batcher closed while the request was queued"))
                 except InvalidStateError:
                     pass  # its waiter expired it in the race window
@@ -164,11 +228,13 @@ class MicroBatcher:
         """Account one dequeued request; expire it instead of batching it
         when its deadline already passed."""
         with self._state_lock:
-            self._backlog_rows -= int(item[0].shape[0])
-        if item[3] is not None and time.monotonic() > item[3]:
-            if not item[2].done():
+            self._backlog_rows -= int(item[_X].shape[0])
+            self._queue_gauge.set(self._backlog_rows, model=self.name)
+        if item[_DEADLINE] is not None and \
+                time.monotonic() > item[_DEADLINE]:
+            if not item[_FUT].done():
                 self._deadline.inc(1, model=self.name)
-                item[2].set_exception(DeadlineExceeded(
+                item[_FUT].set_exception(DeadlineExceeded(
                     "request expired while queued"))
             return False
         return True
@@ -181,7 +247,7 @@ class MicroBatcher:
             if not self._take(first):
                 continue
             batch = [first]
-            rows = first[0].shape[0]
+            rows = first[_X].shape[0]
             deadline = time.monotonic() + self._max_wait
             stop = False
             while rows < self._max_rows:
@@ -206,36 +272,67 @@ class MicroBatcher:
                     break
                 if self._take(nxt):
                     batch.append(nxt)
-                    rows += nxt[0].shape[0]
+                    rows += nxt[_X].shape[0]
             self._run(batch)
             if stop:
                 return
 
+    def _record_timing(self, group, t_dispatch: float, device_s: float,
+                       t_done: float) -> None:
+        """Per-request split for one dispatched group: queue wait is the
+        time from submit to dispatch, device compute is the group's
+        batched call (shared — every co-batched request rode the same
+        dispatch)."""
+        if self.stats is None:
+            return
+        from ..models.tree import SHAPE_BUCKETS, bucket_rows
+        total_rows = sum(g[_X].shape[0] for g in group)
+        # label with the PREDICTOR's ladder when given (a custom-bucket
+        # predictor must not report timings under phantom global-ladder
+        # buckets it never dispatches)
+        bucket = bucket_rows(total_rows, self._buckets
+                             if self._buckets is not None else SHAPE_BUCKETS)
+        for g in group:
+            self.stats.record_request_timing(
+                int(g[_X].shape[0]), bucket,
+                queue_ms=(t_dispatch - g[_TSUB]) * 1e3,
+                device_ms=device_s * 1e3,
+                total_ms=(t_done - g[_TSUB]) * 1e3,
+                request_id=g[_RID])
+
     def _run(self, batch) -> None:
         groups: dict = {}
         for item in batch:
-            groups.setdefault((item[1], item[0].shape[1]), []).append(item)
+            groups.setdefault((item[_RAW], item[_X].shape[1]),
+                              []).append(item)
         for (raw, _cols), group in groups.items():
             t0 = time.monotonic()
             try:
-                X = (group[0][0] if len(group) == 1 else
-                     np.concatenate([g[0] for g in group], axis=0))
-                out = self._predict_fn(X, raw)
+                X = (group[0][_X] if len(group) == 1 else
+                     np.concatenate([g[_X] for g in group], axis=0))
+                if self._fn_takes_rids:
+                    out = self._predict_fn(
+                        X, raw, request_ids=tuple(
+                            g[_RID] for g in group if g[_RID]))
+                else:
+                    out = self._predict_fn(X, raw)
+                t1 = time.monotonic()
                 ofs = 0
                 for g in group:
-                    n = g[0].shape[0]
+                    n = g[_X].shape[0]
                     try:
-                        g[2].set_result(out[ofs:ofs + n])
+                        g[_FUT].set_result(out[ofs:ofs + n])
                     except InvalidStateError:
                         pass  # its waiter expired it in the race window
                     ofs += n
+                self._record_timing(group, t0, t1 - t0, time.monotonic())
                 # retry-after estimates ride this (reads are unlocked —
                 # a slightly stale float is fine)
                 self._ewma_batch_s = 0.8 * self._ewma_batch_s + \
-                    0.2 * (time.monotonic() - t0)
+                    0.2 * (t1 - t0)
             except Exception as exc:  # propagate to every waiter in group
                 for g in group:
                     try:
-                        g[2].set_exception(exc)
+                        g[_FUT].set_exception(exc)
                     except InvalidStateError:
                         pass  # its waiter expired it in the race window

@@ -96,6 +96,45 @@ FALLBACK_COUNTER = "serve_compiler_fallback"
 _fb_lock = threading.Lock()
 _fb_counts: Dict[str, int] = {}
 
+# Fallback-budget objective, declared next to the counters it reads.
+# ``serve_compiler_fallback`` fires once per COMPILE, so a ratio over
+# request traffic would be inert; the SLO instead reads
+# ``serve_fallback_batches_total`` — bumped by the predictor on every
+# device call served by a fallback-built walk — against total batches.
+# The bad set is the ``*_budget`` reasons (a table blowing its budget
+# is the silent-70x-regression class); ``cpu_cost_model`` and
+# ``forced_walk`` are policy, not regressions, and stay outside it.
+from ..telemetry.slo import register_metric_ensurer, slo as _slo  # noqa: E402
+
+FALLBACK_BATCHES = "serve_fallback_batches_total"
+
+_slo("serve/compiler_fallback_rate", metric=FALLBACK_BATCHES,
+     total_metric="serve_batches_total", kind="ratio", target=0.99,
+     bad_labels={"reason": "*_budget"}, min_events=50,
+     note="share of device batches served by budget-blown walk "
+          "fallbacks")
+
+
+def note_fallback_batch(reason: str, model: str) -> None:
+    """One dispatched batch served by a fallback-built walk predictor
+    (serve/predictor.py calls this per device call, so the fallback
+    rate is measured in traffic, not in compiles)."""
+    default_registry().counter(
+        FALLBACK_BATCHES,
+        "device batches served by a dense-compiler fallback, by reason",
+        labels=("reason", "model")).inc(1, reason=reason,
+                                        model=model or "-")
+
+
+@register_metric_ensurer
+def _ensure_fallback_metric(reg) -> None:
+    reg.counter(FALLBACK_COUNTER,
+                "auto-mode dense-compiler fallbacks to the sequential "
+                "walk, by reason", labels=("reason", "model"))
+    reg.counter(FALLBACK_BATCHES,
+                "device batches served by a dense-compiler fallback, "
+                "by reason", labels=("reason", "model"))
+
 
 def _note_fallback(reason: str, model: str = "") -> None:
     with _fb_lock:

@@ -165,10 +165,18 @@ class CompiledPredictor:
                      tuple((a.shape, str(a.dtype)) for a in leaves))
 
     # -- core ---------------------------------------------------------------
-    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+    def predict_raw(self, X: np.ndarray,
+                    request_ids: tuple = ()) -> np.ndarray:
         """Bucketed raw-score prediction: (N,) for single-class models,
         (N, k) for multiclass.  Bitwise identical to ``Booster.predict``
-        (both pad up the same ladder and run the same walk kernels)."""
+        (both pad up the same ladder and run the same walk kernels).
+
+        ``request_ids`` is the per-request trace propagated from the
+        HTTP layer through the micro-batcher: the device call runs
+        under a ``serve/predict`` span and a recompile is attributed to
+        the requests that triggered it (they show up flagged in the
+        slowest-request exemplar ring)."""
+        from ..telemetry.trace import span
         X = np.asarray(X, np.float32)
         if X.ndim == 1:
             X = X.reshape(1, -1)
@@ -182,22 +190,31 @@ class CompiledPredictor:
         Xp = pad_rows(Xi, self.buckets)
         new = _note_dispatch((self._sig, nb))
         t0 = time.perf_counter()
-        if self._dense is not None:
-            out = np.asarray(self._dense.predict_raw(Xp))[:n]
-        else:
-            out = np.asarray(predict_raw_ensemble(Xp, self._per_class,
-                                                  self._kinds))[:n]
+        with span(f"serve/predict/b{nb}"):
+            if self._dense is not None:
+                out = np.asarray(self._dense.predict_raw(Xp))[:n]
+            else:
+                out = np.asarray(predict_raw_ensemble(Xp, self._per_class,
+                                                      self._kinds))[:n]
         self.stats.record_batch(n, nb, (time.perf_counter() - t0) * 1e3,
-                                recompiled=new)
+                                recompiled=new, request_ids=request_ids)
+        if self._dense is None and self._fallback_reason:
+            # fallback rate measured in TRAFFIC: every batch this
+            # fallback-built walk serves counts against the
+            # serve/compiler_fallback_rate budget (compiler.py)
+            from .compiler import note_fallback_batch
+            note_fallback_batch(self._fallback_reason,
+                                getattr(self.stats, "model", "") or "")
         if self._avg_div != 1:
             out = out / self._avg_div
         return out[:, 0] if self.num_class == 1 else out
 
-    def predict(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                request_ids: tuple = ()) -> np.ndarray:
         """Prediction with the model objective's output transform (same
         contract as ``Booster.predict`` without the special modes)."""
         import jax.numpy as jnp
-        raw = self.predict_raw(X)
+        raw = self.predict_raw(X, request_ids=request_ids)
         if raw_score or self.objective is None:
             return raw
         return np.asarray(self.objective.convert_output(jnp.asarray(raw)))

@@ -3,17 +3,28 @@
 Endpoints:
   GET  /healthz  -> {"status": "ok"|"degraded", "models": [...]} —
                     degraded (with "reasons") while serving on the CPU
-                    fallback backend or while admission control shed
-                    requests in the last minute; still 200
+                    fallback backend, while admission control shed
+                    requests in the last minute, or while an SLO's fast
+                    burn window has run hot for several consecutive
+                    evaluations; still 200
   GET  /models   -> per-model info (trees, classes, buckets, version)
   GET  /stats    -> per-model counters (requests/rows/batches/recompiles/
-                    bucket histogram/p50/p99 latency)
+                    bucket histogram/p50/p99 latency + queue-wait vs
+                    device-compute split) plus live batcher saturation
+                    (queue rows, in-flight requests)
   GET  /metrics  -> Prometheus text format: the process-wide telemetry
-                    registry (serving counters, time tags) plus the last
-                    training run's TrainRecord
+                    registry (serving counters, time tags, SLO burn-rate
+                    gauges) plus the last training run's TrainRecord
+  GET  /slo      -> declared-SLO verdicts: multi-window burn rates per
+                    objective, breach flags, and — whenever something is
+                    burning — the slowest-request exemplar ring
   POST /predict  -> {"rows": [[...], ...]} or {"row": [...]}, optional
                     "model" (required only with >1 loaded), "raw_score";
-                    returns {"model", "num_rows", "predictions"}
+                    returns {"model", "num_rows", "predictions",
+                    "request_id"}.  An ``X-Request-Id`` header is
+                    propagated through the micro-batcher into the
+                    predictor (and echoed back); absent one, the server
+                    assigns one
   POST /models   -> {"name": ..., "file": ...} loads or atomically
                     hot-swaps a model from a model_text file
 
@@ -25,6 +36,7 @@ verb ``python -m lightgbm_tpu serve model.txt [key=value ...]``.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -36,8 +48,12 @@ import numpy as np
 
 from .batcher import MicroBatcher
 from .registry import ModelRegistry
+from .stats import request_exemplars
 from ..resilience.admission import (DeadlineExceeded, QueueFullError,
                                     ServerClosed)
+from ..telemetry.metrics import default_registry
+from ..telemetry.slo import (SloEngine, default_engine,
+                             register_metric_ensurer, slo)
 from ..utils.log import log_debug, log_info
 
 __all__ = ["PredictionServer", "main"]
@@ -45,6 +61,55 @@ __all__ = ["PredictionServer", "main"]
 # /healthz reports "degraded" while sheds happened inside this window —
 # the tier is up but actively refusing some traffic
 SHED_DEGRADED_WINDOW_S = 60.0
+
+# Availability objective, declared next to the handler that serves the
+# responses it counts: at most 0.1% of /predict responses may be 5xx
+# (sheds, deadline expiries and server errors all land there).  Keyed
+# to the PREDICT-only counter, not the all-endpoints one — a tier
+# scraped every second by probes/Prometheus would otherwise pad the
+# denominator with its own monitoring 200s and hide a total /predict
+# outage inside the diluted ratio.
+slo("serve/availability", metric="serve_predict_responses_total",
+    kind="ratio", target=0.999,
+    total_metric="serve_predict_responses_total",
+    bad_labels={"code": "5*"}, min_events=50,
+    note="non-5xx response ratio over /predict traffic")
+
+# monotonically unique server-assigned request ids (requests that arrive
+# without an X-Request-Id header still get a trace handle)
+_REQ_SEQ = itertools.count(1)
+_REQ_PREFIX = f"srv-{os.getpid():x}"
+
+
+def _gen_request_id() -> str:
+    return f"{_REQ_PREFIX}-{next(_REQ_SEQ):x}"
+
+
+def _http_response_counter():
+    return default_registry().counter(
+        "serve_http_responses_total", "HTTP responses by status code",
+        labels=("code",))
+
+
+def _predict_response_counter():
+    return default_registry().counter(
+        "serve_predict_responses_total",
+        "/predict responses by status code (the availability SLO's "
+        "series — monitoring-endpoint traffic excluded)",
+        labels=("code",))
+
+
+@register_metric_ensurer
+def _ensure_http_metrics(reg) -> None:
+    """SLO-coverage ensurer for the counters the availability SLO above
+    reads — declared here, next to the handler that bumps them, so the
+    lint validates the REAL schema and not a copy that could drift."""
+    reg.counter("serve_http_responses_total",
+                "HTTP responses by status code", labels=("code",))
+    reg.counter("serve_predict_responses_total",
+                "/predict responses by status code (the availability "
+                "SLO's series — monitoring-endpoint traffic excluded)",
+                labels=("code",))
 
 
 class PredictionServer:
@@ -57,13 +122,16 @@ class PredictionServer:
     thread.  Both ride the micro-batcher queue and are inert with
     ``batching=False`` (the direct-dispatch debug path has no queue to
     bound or expire).  ``/healthz`` reports ``degraded`` while traffic
-    is served on the CPU fallback backend or sheds happened recently."""
+    is served on the CPU fallback backend, sheds happened recently, or
+    an SLO fast-burn has been sustained (``slo_engine.sustain``
+    consecutive hot evaluations)."""
 
     def __init__(self, registry: ModelRegistry, host: str = "127.0.0.1",
                  port: int = 8080, max_batch_rows: int = 4096,
                  max_wait_ms: float = 2.0, batching: bool = True,
                  max_queue_rows: int = 0,
-                 deadline_ms: float = 0.0) -> None:
+                 deadline_ms: float = 0.0,
+                 slo_engine: Optional[SloEngine] = None) -> None:
         self.registry = registry
         self._batching = batching
         self._batch_opts = (max_batch_rows, max_wait_ms)
@@ -72,6 +140,10 @@ class PredictionServer:
         self._batchers: Dict[str, MicroBatcher] = {}
         self._batchers_lock = threading.Lock()
         self._last_shed_t = 0.0
+        self.slo_engine = slo_engine if slo_engine is not None \
+            else default_engine()
+        self._responses = _http_response_counter()
+        self._predict_responses = _predict_response_counter()
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
@@ -90,7 +162,8 @@ class PredictionServer:
 
     def _predict(self, name: Optional[str], X: np.ndarray,
                  raw_score: bool,
-                 deadline_ms: Optional[float] = None) -> np.ndarray:
+                 deadline_ms: Optional[float] = None,
+                 request_id: Optional[str] = None) -> np.ndarray:
         pred = self.registry.get(name)  # resolves None -> the single model
         pred.stats.record_request(X.shape[0])
         if deadline_ms is None:
@@ -98,28 +171,49 @@ class PredictionServer:
         timeout_s = float(deadline_ms) / 1e3 if deadline_ms and \
             deadline_ms > 0 else None
         if not self._batching:
-            return pred.predict(X, raw_score=raw_score)
-        key = name if name is not None else "\0default"
+            # direct-dispatch path: no queue, so the split is all device
+            t0 = time.monotonic()
+            out = pred.predict(X, raw_score=raw_score,
+                               request_ids=(request_id,) if request_id
+                               else ())
+            dt_ms = (time.monotonic() - t0) * 1e3
+            from ..models.tree import bucket_rows
+            pred.stats.record_request_timing(
+                int(X.shape[0]), bucket_rows(int(X.shape[0]), pred.buckets),
+                queue_ms=0.0, device_ms=dt_ms, total_ms=dt_ms,
+                request_id=request_id)
+            return out
+        # key by the RESOLVED model name: a nameless request to a
+        # single-model server and an explicit-name request must share
+        # one batcher (two batchers under one name would clobber each
+        # other's saturation gauges and split the coalescing window)
+        key = pred.stats.model
         with self._batchers_lock:
             batcher = self._batchers.get(key)
             if batcher is None:
-                # the closure re-resolves the registry per batch, so a
-                # hot-swap redirects batched traffic without a restart
+                # the closure re-resolves the registry per batch (by the
+                # RESOLVED name, so loading a second model later never
+                # breaks this batcher's dispatch) and a hot-swap
+                # redirects batched traffic without a restart
                 batcher = MicroBatcher(
-                    lambda Xb, raw, _n=name: self.registry.get(_n).predict(
-                        Xb, raw_score=raw),
+                    lambda Xb, raw, request_ids=(), _n=key:
+                        self.registry.get(_n).predict(
+                            Xb, raw_score=raw, request_ids=request_ids),
                     max_batch_rows=self._batch_opts[0],
                     max_wait_ms=self._batch_opts[1],
                     max_queue_rows=self._max_queue_rows,
-                    name=name if name is not None else "default")
+                    name=key, stats=pred.stats, buckets=pred.buckets)
                 self._batchers[key] = batcher
-        return batcher.predict(X, raw_score=raw_score, timeout_s=timeout_s)
+        return batcher.predict(X, raw_score=raw_score, timeout_s=timeout_s,
+                               request_id=request_id)
 
     def health(self) -> dict:
         """``/healthz`` payload: ``ok``, or ``degraded`` with reasons
-        while traffic runs on the CPU fallback backend or admission
-        control shed requests in the last minute — still 200 (the tier
-        answers), but a reason for an operator to look."""
+        while traffic runs on the CPU fallback backend, admission
+        control shed requests in the last minute, or an SLO's fast burn
+        window has run hot for ``slo_engine.sustain`` consecutive
+        evaluations — still 200 (the tier answers), but a reason for an
+        operator to look."""
         from ..utils.backend import fallback_reason
         reasons = []
         fb = fallback_reason()
@@ -129,10 +223,42 @@ class PredictionServer:
                 time.monotonic() - self._last_shed_t < SHED_DEGRADED_WINDOW_S:
             reasons.append("shedding: request queue hit its limit in the "
                            f"last {int(SHED_DEGRADED_WINDOW_S)}s")
+        report = self.slo_engine.evaluate()
+        for name in report["degraded"]:
+            v = next((s for s in report["slos"] if s["name"] == name), None)
+            burn = v["burn"]["fast"] if v else 0.0
+            reasons.append(f"slo_fast_burn: {name} has burned at "
+                           f"{burn:.1f}x budget for "
+                           f"{self.slo_engine.sustain}+ evaluations")
         out = {"status": "degraded" if reasons else "ok",
                "models": self.registry.names()}
         if reasons:
             out["reasons"] = reasons
+        return out
+
+    def slo_report(self) -> dict:
+        """``/slo`` payload: verdicts per declared objective; breaches
+        and fast burns carry the slowest-request exemplar ring so a tail
+        regression arrives with the offending requests attached."""
+        report = self.slo_engine.evaluate()
+        if report["breached"] or report["fast_burning"]:
+            report["exemplars"] = request_exemplars().snapshot()
+        return report
+
+    def stats_payload(self) -> dict:
+        """``/stats`` payload: per-model counters plus live batcher
+        saturation — a load test can watch the backlog build, not just
+        requests die."""
+        out = self.registry.stats()
+        with self._batchers_lock:
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            entry = out.setdefault(b.name, {})
+            entry["saturation"] = {
+                "queue_rows": int(b.backlog_rows),
+                "inflight_requests": b.inflight_requests(),
+                "max_queue_rows": self._max_queue_rows,
+            }
         return out
 
     # -- lifecycle ----------------------------------------------------------
@@ -167,6 +293,7 @@ def _make_handler(server: PredictionServer):
         def _reply(self, code: int, payload: dict,
                    extra_headers: Optional[Dict[str, str]] = None) -> None:
             body = json.dumps(payload).encode()
+            server._responses.inc(1, code=str(int(code)))
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
@@ -187,7 +314,9 @@ def _make_handler(server: PredictionServer):
             elif self.path == "/models":
                 self._reply(200, server.registry.info())
             elif self.path == "/stats":
-                self._reply(200, server.registry.stats())
+                self._reply(200, server.stats_payload())
+            elif self.path == "/slo":
+                self._reply(200, server.slo_report())
             elif self.path == "/metrics":
                 # Prometheus text: serving counters (registry-managed
                 # models label themselves into the default metrics
@@ -195,6 +324,7 @@ def _make_handler(server: PredictionServer):
                 from ..telemetry.export import (PROMETHEUS_CONTENT_TYPE,
                                                 render_prometheus)
                 body = render_prometheus().encode()
+                server._responses.inc(1, code="200")
                 self.send_response(200)
                 self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
                 self.send_header("Content-Length", str(len(body)))
@@ -217,20 +347,32 @@ def _make_handler(server: PredictionServer):
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
         def _predict(self, req: dict) -> None:
+            # per-request trace handle: propagate the caller's id (or
+            # assign one) server -> batcher -> predictor, echo it back
+            rid = self.headers.get("X-Request-Id") or _gen_request_id()
+            rid_hdr = {"X-Request-Id": rid}
+
+            def reply(code: int, payload: dict,
+                      headers: Optional[Dict[str, str]] = None) -> None:
+                # the availability SLO's series: /predict responses
+                # only, so monitoring scrapes never pad the denominator
+                server._predict_responses.inc(1, code=str(int(code)))
+                self._reply(code, payload, headers or rid_hdr)
+
             name = req.get("model")
             rows = req.get("rows")
             if rows is None and "row" in req:
                 rows = [req["row"]]
             if not isinstance(rows, list) or not rows:
-                self._reply(400, {"error": "body needs 'rows' (list of "
-                                           "feature lists) or 'row'"})
+                reply(400, {"error": "body needs 'rows' (list of "
+                                     "feature lists) or 'row'"})
                 return
             deadline_ms = req.get("deadline_ms")
             if deadline_ms is not None:
                 if isinstance(deadline_ms, bool) or \
                         not isinstance(deadline_ms, (int, float)):
-                    self._reply(400, {"error": "deadline_ms must be a "
-                                               "number of milliseconds"})
+                    reply(400, {"error": "deadline_ms must be a "
+                                         "number of milliseconds"})
                     return
                 deadline_ms = float(deadline_ms)
             try:
@@ -238,34 +380,37 @@ def _make_handler(server: PredictionServer):
                 if X.ndim != 2:
                     raise ValueError(f"rows must be 2-D, got shape {X.shape}")
                 out = server._predict(name, X, bool(req.get("raw_score")),
-                                      deadline_ms=deadline_ms)
+                                      deadline_ms=deadline_ms,
+                                      request_id=rid)
             except KeyError as exc:
-                self._reply(404, {"error": str(exc.args[0])})
+                reply(404, {"error": str(exc.args[0])})
                 return
             except QueueFullError as exc:
                 # load shed: admission control refused the request; tell
                 # the client when the backlog should have drained
                 server._last_shed_t = time.monotonic()
-                self._reply(503, {"error": str(exc),
-                                  "retry_after_s": exc.retry_after},
-                            {"Retry-After":
-                             str(max(1, int(-(-exc.retry_after // 1))))})
+                reply(503, {"error": str(exc),
+                            "retry_after_s": exc.retry_after},
+                      {"Retry-After":
+                       str(max(1, int(-(-exc.retry_after // 1)))),
+                       **rid_hdr})
                 return
             except DeadlineExceeded as exc:
-                self._reply(504, {"error": str(exc)})
+                reply(504, {"error": str(exc)})
                 return
             except ServerClosed as exc:
-                self._reply(503, {"error": str(exc)})
+                reply(503, {"error": str(exc)})
                 return
             except Exception as exc:
                 try:
                     server.registry.get(name).stats.record_error()
                 except KeyError:
                     pass
-                self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+                reply(400, {"error": f"{type(exc).__name__}: {exc}"})
                 return
-            self._reply(200, {"model": name, "num_rows": int(X.shape[0]),
-                              "predictions": np.asarray(out).tolist()})
+            reply(200, {"model": name, "num_rows": int(X.shape[0]),
+                        "predictions": np.asarray(out).tolist(),
+                        "request_id": rid})
 
         def _load_model(self, req: dict) -> None:
             name, path = req.get("name"), req.get("file")
@@ -301,8 +446,9 @@ def main(argv: List[str]) -> int:
     name), warmup (1), batching (1), max_batch (4096), max_wait_ms (2.0),
     max_queue_rows (0 = unbounded; over-limit requests are shed with 503
     + Retry-After), deadline_ms (0 = none; slow requests fail with 504),
-    num_iteration (-1: all).  Multiple model files register under their
-    basenames.
+    slo_latency_ms (re-declares the serve/latency_p99 threshold for this
+    deployment), num_iteration (-1: all).  Multiple model files register
+    under their basenames.
     """
     from ..utils.backend import default_backend
     from ..utils.log import log_fatal
@@ -318,6 +464,10 @@ def main(argv: List[str]) -> int:
     if not files:
         log_fatal("serve needs at least one model file: "
                   "python -m lightgbm_tpu serve model.txt [port=8080 ...]")
+    if kv.get("slo_latency_ms"):
+        from ..telemetry.slo import set_latency_threshold
+        set_latency_threshold("serve/latency_p99",
+                              float(kv["slo_latency_ms"]))
     registry = ModelRegistry()
     n_iter = int(kv.get("num_iteration", -1))
     seen = set()

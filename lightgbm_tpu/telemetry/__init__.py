@@ -16,6 +16,11 @@ Four pieces (see the module docstrings for depth):
   * :mod:`.export` — Prometheus text / JSON renderers; the serve HTTP
     server mounts ``GET /metrics``; ``python -m lightgbm_tpu profile``
     wraps a run in a ``jax.profiler.trace`` capture plus a dump.
+  * :mod:`.slo` — declarative service-level objectives keyed to
+    registry series, evaluated with multi-window burn-rate math
+    (``GET /slo``, SLO-aware ``/healthz``, slowest-request exemplars).
+  * :mod:`.flight` — the training flight recorder: a bounded ring of
+    per-iteration events dumped to JSONL on crash/SIGTERM.
 
 Master switch: ``enabled()`` / ``enable()`` / ``disable()`` (env
 ``LGBM_TPU_TELEMETRY=0`` to opt out).  Telemetry-on and telemetry-off
@@ -32,6 +37,8 @@ from .train_record import (TrainRecord, collectives_reset,
                            set_last_train_record)
 from .export import (PROMETHEUS_CONTENT_TYPE, render_json,
                      render_prometheus, write_snapshot)
+from .slo import (SLO, SloEngine, all_slos, default_engine, slo)
+from .flight import FlightRecorder
 
 __all__ = [
     "enable", "disable", "enabled",
@@ -43,4 +50,6 @@ __all__ = [
     "set_last_train_record",
     "PROMETHEUS_CONTENT_TYPE", "render_json", "render_prometheus",
     "write_snapshot",
+    "SLO", "SloEngine", "all_slos", "default_engine", "slo",
+    "FlightRecorder",
 ]

@@ -178,6 +178,24 @@ class Gauge(_Metric):
             return float(self._series.get(key, 0.0))
 
 
+class _SeriesHandle:
+    """A label-resolved histogram series: ``observe`` skips the per-call
+    label validation/key-building of the dict path (the serving tier
+    records three windows per request — the handle keeps that at one
+    lock + one ring append each).  The handle shares the metric's lock,
+    so snapshots stay tear-free."""
+
+    __slots__ = ("_lock", "_win")
+
+    def __init__(self, lock, win: SlidingWindow) -> None:
+        self._lock = lock
+        self._win = win
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._win.add(value)
+
+
 class WindowedHistogram(_Metric):
     kind = "histogram"
 
@@ -194,6 +212,12 @@ class WindowedHistogram(_Metric):
     def observe(self, value: float, **labels) -> None:
         with self._lock:
             self._get(labels).add(value)
+
+    def handle(self, **labels) -> _SeriesHandle:
+        """Pre-resolve one label set into a hot-path observe handle
+        (validates the labels once, here)."""
+        with self._lock:
+            return _SeriesHandle(self._lock, self._get(labels))
 
     def window_of(self, **labels) -> SlidingWindow:
         """The underlying ring for one label set (callers who need the

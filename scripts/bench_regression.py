@@ -1,10 +1,18 @@
 """Nightly bench-regression gate (ROADMAP item 5).
 
 Diffs two bench-matrix-v1 artifacts — benchmarks/run.py (iters_per_sec),
-benchmarks/many_models.py (models_per_sec) and benchmarks/hist_kernel.py
-(builds_per_sec) all emit the schema, each row named and git-SHA-stamped
-— and exits nonzero when any matched row regresses past the threshold
-(default 10%), the way trace-lint fails on contract drift.
+benchmarks/many_models.py (models_per_sec), benchmarks/hist_kernel.py
+(builds_per_sec) and benchmarks/loadtest.py (rows_per_sec / qps /
+p99_ms / slo_ok) all emit the schema, each row named and
+git-SHA-stamped — and exits nonzero when any matched row regresses past
+the threshold (default 10%), the way trace-lint fails on contract
+drift.  Three row classes:
+
+  * throughput rows (higher is better): fail on drops > threshold;
+  * latency rows (``p99_ms``/``p50_ms`` with no throughput key, the
+    loadtest per-bucket tail rows): fail on INCREASES > threshold;
+  * SLO verdict rows (``slo_ok``): fail when a previously-met objective
+    is now breached (no envelope — a breach is binary).
 
 Usage:
     python scripts/bench_regression.py --baseline prev.json \
@@ -21,11 +29,14 @@ import json
 import os
 import sys
 
-THROUGHPUT_KEYS = ("iters_per_sec", "models_per_sec", "builds_per_sec")
+THROUGHPUT_KEYS = ("iters_per_sec", "models_per_sec", "builds_per_sec",
+                   "rows_per_sec", "qps")
+LATENCY_KEYS = ("p99_ms", "p50_ms")
 
 
 def load_rows(path):
-    """name -> (metric_key, value) for one bench-matrix-v1 artifact."""
+    """name -> (metric_key, value, direction) for one bench-matrix-v1
+    artifact.  direction: "higher" | "lower" | "bool"."""
     with open(path) as fh:
         rec = json.load(fh)
     if rec.get("schema") != "bench-matrix-v1":
@@ -40,8 +51,16 @@ def load_rows(path):
             continue
         for key in THROUGHPUT_KEYS:
             if key in row:
-                rows[name] = (key, float(row[key]))
+                rows[name] = (key, float(row[key]), "higher")
                 break
+        else:
+            if "slo_ok" in row:
+                rows[name] = ("slo_ok", bool(row["slo_ok"]), "bool")
+                continue
+            for key in LATENCY_KEYS:
+                if key in row:
+                    rows[name] = (key, float(row[key]), "lower")
+                    break
     return rec, rows
 
 
@@ -50,7 +69,8 @@ def main(argv=None) -> int:
     ap.add_argument("--baseline", required=True)
     ap.add_argument("--current", required=True)
     ap.add_argument("--threshold", type=float, default=0.10,
-                    help="fail on throughput drops beyond this fraction")
+                    help="fail on throughput drops / latency rises "
+                         "beyond this fraction")
     ap.add_argument("--out", default="",
                     help="optional JSON diff report path")
     ns = ap.parse_args(argv)
@@ -86,13 +106,22 @@ def main(argv=None) -> int:
         "unmatched": sorted(set(base) ^ set(cur)),
     }
     for name in sorted(set(base) & set(cur)):
-        key, b = base[name]
-        _, c = cur[name]
+        key, b, direction = base[name]
+        _, c, _ = cur[name]
+        if direction == "bool":
+            row = {"name": name, "metric": key, "baseline": bool(b),
+                   "current": bool(c), "direction": direction}
+            report["rows"].append(row)
+            if b and not c:          # a met objective is now breached
+                report["regressions"].append(row)
+            continue
         ratio = c / b if b > 0 else 1.0
         row = {"name": name, "metric": key, "baseline": b, "current": c,
-               "ratio": round(ratio, 4)}
+               "ratio": round(ratio, 4), "direction": direction}
         report["rows"].append(row)
-        if ratio < 1.0 - ns.threshold:
+        if direction == "higher" and ratio < 1.0 - ns.threshold:
+            report["regressions"].append(row)
+        elif direction == "lower" and ratio > 1.0 + ns.threshold:
             report["regressions"].append(row)
     report["ok"] = not report["regressions"]
 
@@ -104,11 +133,17 @@ def main(argv=None) -> int:
                       "regressions": report["regressions"],
                       "unmatched": report["unmatched"]}, indent=2))
     if not report["ok"]:
-        worst = min(report["regressions"], key=lambda r: r["ratio"])
-        print(f"bench regression: {worst['name']} {worst['metric']} "
-              f"{worst['baseline']:.4f} -> {worst['current']:.4f} "
-              f"({(1 - worst['ratio']) * 100:.1f}% drop > "
-              f"{ns.threshold * 100:.0f}% threshold)", file=sys.stderr)
+        worst = report["regressions"][0]
+        if worst.get("direction") == "bool":
+            print(f"bench regression: {worst['name']} SLO verdict "
+                  f"flipped met -> breached", file=sys.stderr)
+        else:
+            print(f"bench regression: {worst['name']} {worst['metric']} "
+                  f"{worst['baseline']:.4f} -> {worst['current']:.4f} "
+                  f"({abs(1 - worst['ratio']) * 100:.1f}% "
+                  f"{'drop' if worst['direction'] == 'higher' else 'rise'}"
+                  f" > {ns.threshold * 100:.0f}% threshold)",
+                  file=sys.stderr)
     return 0 if report["ok"] else 1
 
 

@@ -21,6 +21,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="recovery-telemetry.json")
+    ap.add_argument("--flight-out", default="",
+                    help="copy the crash's flight-recorder JSONL tape "
+                         "here (CI artifact)")
     args = ap.parse_args()
 
     import numpy as np
@@ -39,6 +42,7 @@ def main() -> int:
     t0 = time.time()
     full = lgb.train(params, lgb.Dataset(X, y), rounds)
 
+    flight_events = 0
     with tempfile.TemporaryDirectory() as tmp:
         ck = os.path.join(tmp, "ck")
         faults.configure(f"crash_at_iter={crash_at}")
@@ -49,6 +53,15 @@ def main() -> int:
         except InjectedFault:
             crashed = True
         faults.clear()
+        # the crash path dumps the flight-recorder tape next to the
+        # checkpoints; ship it out as the post-mortem artifact
+        tape = os.path.join(ck, "flight.jsonl")
+        if os.path.exists(tape):
+            with open(tape) as fh:
+                flight_events = max(0, sum(1 for _ in fh) - 1)  # - header
+            if args.flight_out:
+                import shutil
+                shutil.copyfile(tape, args.flight_out)
         resumed = lgb.train({**params, "checkpoint_dir": ck,
                              "resume": "latest"}, lgb.Dataset(X, y), rounds)
 
@@ -66,6 +79,7 @@ def main() -> int:
         "rounds": rounds,
         "resume_bit_identical_model_text": bit_identical,
         "resume_predictions_equal": preds_equal,
+        "flight_recorder_events": flight_events,
         "wall_seconds": round(time.time() - t0, 2),
         "metrics": {k: snap[k] for k in keep if k in snap},
     }

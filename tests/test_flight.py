@@ -1,0 +1,141 @@
+"""Training flight recorder (telemetry/flight.py).
+
+The PR-3 telemetry invariant extended: recorder-on training is
+bit-identical to recorder-off (model text + predictions), the event
+ring is bounded, anomaly detection flags NaN/spiking losses, and the
+resilience path leaves a JSONL post-mortem whose last event matches the
+checkpoint iteration on a SIGTERM (preemption) or an injected crash.
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry.flight import FlightRecorder
+
+
+def _data(n=600, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.4 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "metric": "binary_logloss", "seed": 3}
+
+
+def test_flight_recorder_bit_identical_on_off():
+    X, y = _data()
+    on = lgb.train(PARAMS, lgb.Dataset(X, y), 10)
+    off = lgb.train({**PARAMS, "flight_recorder": False},
+                    lgb.Dataset(X, y), 10)
+    assert on.model_to_string() == off.model_to_string()
+    assert np.array_equal(on.predict(X), off.predict(X))
+    assert len(on._gbdt.flight) == 10
+    assert len(off._gbdt.flight) == 0 and not off._gbdt.flight.enabled
+
+
+def test_flight_ring_is_bounded_and_events_structured():
+    X, y = _data()
+    bst = lgb.train({**PARAMS, "flight_events": 6}, lgb.Dataset(X, y),
+                    15, valid_sets=[lgb.Dataset(X, y)])
+    fr = bst._gbdt.flight
+    assert len(fr) == 6                      # ring kept the tail only
+    evs = fr.events()
+    assert [e["iteration"] for e in evs] == list(range(10, 16))
+    last = evs[-1]
+    assert last["num_leaves"] >= 1 and isinstance(last["num_leaves"], int)
+    assert last["best_gain"] is None or \
+        isinstance(last["best_gain"], float)
+    assert "valid_0 binary_logloss" in last["evals"]
+    assert last["loss"] == pytest.approx(
+        last["evals"]["valid_0 binary_logloss"])
+    assert last["anomaly"] is None
+
+
+def test_flight_anomaly_detection_nan_and_spike():
+    from lightgbm_tpu.telemetry.metrics import default_registry
+    fr = FlightRecorder(capacity=64, min_history=2)
+    c = default_registry().get("flight_anomalies_total")
+    base_nan = c.value(kind="nan_loss")
+    base_spike = c.value(kind="loss_spike")
+    for i in range(1, 6):
+        fr.note_iter(i)
+        fr.note_eval(i, [("train", "l2", 0.5, False)])
+    fr.note_iter(6)
+    fr.note_eval(6, [("train", "l2", 50.0, False)])     # 100x the EWMA
+    fr.note_iter(7)
+    fr.note_eval(7, [("train", "l2", float("nan"), False)])
+    kinds = [a["kind"] for a in fr.anomalies]
+    assert kinds == ["loss_spike", "nan_loss"]
+    assert c.value(kind="nan_loss") == base_nan + 1
+    assert c.value(kind="loss_spike") == base_spike + 1
+    evs = fr.events()
+    assert evs[-2]["anomaly"] == "loss_spike"
+    assert evs[-1]["anomaly"] == "nan_loss"
+
+
+def _read_tape(path):
+    with open(path) as fh:
+        lines = [json.loads(ln) for ln in fh]
+    assert lines[0]["schema"] == "flight-record-v1"
+    return lines[0], lines[1:]
+
+
+@pytest.mark.chaos
+def test_sigterm_flight_dump_matches_checkpoint_iteration(tmp_path):
+    """The acceptance invariant: a chaos-style interrupted run (SIGTERM
+    mid-train) leaves a flight JSONL whose last event iteration equals
+    the final checkpoint's iteration — same drained boundary."""
+    from lightgbm_tpu.resilience.checkpoint import (TrainingPreempted,
+                                                    load_checkpoint,
+                                                    resolve_checkpoint)
+    X, y = _data()
+    ck = str(tmp_path / "ck")
+
+    def killer(env):
+        if env.iteration == 5:
+            os.kill(os.getpid(), signal.SIGTERM)
+    killer.before_iteration = True
+
+    with pytest.raises(TrainingPreempted):
+        lgb.train({**PARAMS, "checkpoint_dir": ck}, lgb.Dataset(X, y), 40,
+                  valid_sets=[lgb.Dataset(X, y)], callbacks=[killer])
+    header, events = _read_tape(os.path.join(ck, "flight.jsonl"))
+    assert header["reason"] == "preempted"
+    ckpt = load_checkpoint(resolve_checkpoint(ck))
+    assert events[-1]["iteration"] == ckpt.iteration
+    # the tape carries the observability payload, not bare iteration ids
+    assert "evals" in events[-1] and "collective_bytes" in events[-1]
+
+
+@pytest.mark.chaos
+def test_injected_crash_dumps_flight_tape(tmp_path):
+    from lightgbm_tpu.resilience.faults import InjectedFault, faults
+    X, y = _data()
+    ck = str(tmp_path / "ck")
+    faults.configure("crash_at_iter=4")
+    try:
+        with pytest.raises(InjectedFault):
+            lgb.train({**PARAMS, "checkpoint_dir": ck},
+                      lgb.Dataset(X, y), 20)
+    finally:
+        faults.clear()
+    header, events = _read_tape(os.path.join(ck, "flight.jsonl"))
+    assert header["reason"] == "crash"
+    assert events[-1]["iteration"] == 4   # iterations completed pre-crash
+
+
+def test_explicit_flight_dir_dumps_on_success(tmp_path):
+    X, y = _data()
+    fd = str(tmp_path / "tape")
+    os.makedirs(fd)
+    lgb.train({**PARAMS, "flight_dir": fd}, lgb.Dataset(X, y), 6)
+    header, events = _read_tape(os.path.join(fd, "flight.jsonl"))
+    assert header["reason"] == "completed"
+    assert events[-1]["iteration"] == 6

@@ -1,0 +1,231 @@
+"""Synthetic load harness + per-request tracing satellites.
+
+Covers: the Prometheus text parser the verdict path rides, the
+micro-batcher saturation gauges (queue depth / in-flight), the
+queue-wait vs device-compute split recording, the end-to-end load test
+(real HTTP server, verdict computed solely from /metrics + /slo
+scrapes), and the tracing overhead guard (< 5% of p50 at the smallest
+bucket).  The full 10^5 rows/s acceptance rung is slow-marked (CI runs
+it as the blocking loadtest step; tier-1 runs the reduced-rate e2e).
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve.loadgen import metric_sum, parse_prometheus
+
+
+# ---------------------------------------------------------------------------
+# scrape parsing (the verdict path)
+# ---------------------------------------------------------------------------
+
+def test_parse_prometheus_text():
+    text = "\n".join([
+        "# HELP lgbm_tpu_serve_rows_total data rows",
+        "# TYPE lgbm_tpu_serve_rows_total counter",
+        'lgbm_tpu_serve_rows_total{model="m"} 1234',
+        'lgbm_tpu_serve_rows_total{model="n"} 6',
+        'lgbm_tpu_serve_request_latency_ms_p99{bucket="4096",model="m"} 7.5',
+        "lgbm_tpu_up 1",
+        "garbage line without value",
+    ])
+    parsed = parse_prometheus(text)
+    assert metric_sum(parsed, "lgbm_tpu_serve_rows_total") == 1240
+    assert metric_sum(parsed, "lgbm_tpu_serve_rows_total", model="m") == 1234
+    assert metric_sum(parsed, "lgbm_tpu_serve_request_latency_ms_p99",
+                      model="m", bucket="4096") == 7.5
+    assert metric_sum(parsed, "lgbm_tpu_up") == 1.0
+    assert "garbage" not in parsed
+
+
+# ---------------------------------------------------------------------------
+# batcher saturation gauges + timing split
+# ---------------------------------------------------------------------------
+
+def test_queue_and_inflight_gauges():
+    from lightgbm_tpu.serve.batcher import MicroBatcher
+    from lightgbm_tpu.telemetry.metrics import default_registry
+    release = threading.Event()
+
+    def slow_fn(X, raw):
+        release.wait(10.0)
+        return np.zeros(X.shape[0], np.float32)
+
+    mb = MicroBatcher(slow_fn, max_batch_rows=4, name="t_gauges")
+    qg = default_registry().get("serve_queue_rows")
+    ig = default_registry().get("serve_inflight_requests")
+    try:
+        futs = [mb.submit(np.zeros((2, 3), np.float32))]
+        time.sleep(0.1)          # worker picks it up, blocks in slow_fn
+        futs.append(mb.submit(np.zeros((3, 3), np.float32)))
+        time.sleep(0.05)
+        # one request is being served, one is queued: the gauges show
+        # saturation building while nothing has been shed yet
+        assert qg.value(model="t_gauges") == 3.0
+        assert ig.value(model="t_gauges") == 2.0
+        assert mb.backlog_rows == 3 and mb.inflight_requests() == 2
+        release.set()
+        for f in futs:
+            f.result(timeout=10.0)
+        time.sleep(0.1)
+        assert qg.value(model="t_gauges") == 0.0
+        assert ig.value(model="t_gauges") == 0.0
+    finally:
+        release.set()
+        mb.close()
+
+
+def test_request_timing_split_recorded():
+    from lightgbm_tpu.serve.batcher import MicroBatcher
+    from lightgbm_tpu.serve.stats import ModelStats
+
+    def fn(X, raw):
+        time.sleep(0.01)
+        return np.zeros(X.shape[0], np.float32)
+
+    stats = ModelStats(model="t_split")     # private registry
+    mb = MicroBatcher(fn, stats=stats, name="t_split")
+    try:
+        for _ in range(5):
+            mb.predict(np.zeros((3, 4), np.float32))
+    finally:
+        mb.close()
+    t = stats.bucket_timing(8)              # 3 rows -> bucket 8
+    assert len(t["request_latency_ms"]) == 5
+    assert len(t["queue_wait_ms"]) == 5 and len(t["device_ms"]) == 5
+    for total, q, d in zip(sorted(t["request_latency_ms"]),
+                           sorted(t["queue_wait_ms"]),
+                           sorted(t["device_ms"])):
+        assert d >= 10.0                    # the sleep is device time
+        assert total + 1e-6 >= d            # split components bound total
+    snap = stats.snapshot()
+    assert snap["request_latency_ms"]["window"] == 5
+    assert snap["device_ms"]["p50"] >= 10.0
+
+
+def test_request_ids_propagate_to_predictor_and_exemplars():
+    from lightgbm_tpu.serve.batcher import MicroBatcher
+    from lightgbm_tpu.serve.stats import ModelStats, request_exemplars
+    seen = []
+
+    def fn(X, raw, request_ids=()):
+        seen.extend(request_ids)
+        return np.zeros(X.shape[0], np.float32)
+
+    stats = ModelStats(model="t_rids")
+    # the ring keeps the process-wide slowest N: drop earlier tests'
+    # entries so these near-instant requests qualify
+    request_exemplars().clear()
+    mb = MicroBatcher(fn, stats=stats, name="t_rids")
+    try:
+        mb.predict(np.zeros((2, 3), np.float32), request_id="rid-a")
+        mb.predict(np.zeros((2, 3), np.float32), request_id="rid-b")
+    finally:
+        mb.close()
+    assert seen == ["rid-a", "rid-b"]
+    ids = {e["request_id"] for e in request_exemplars().snapshot()}
+    assert {"rid-a", "rid-b"} <= ids
+
+
+# ---------------------------------------------------------------------------
+# end-to-end harness (reduced rate in tier-1; full rate slow-marked)
+# ---------------------------------------------------------------------------
+
+def _run_loadtest(**kw):
+    bench_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks")
+    sys.path.insert(0, bench_dir)
+    try:
+        import loadtest
+        return loadtest.run_loadtest(**kw)
+    finally:
+        sys.path.remove(bench_dir)
+
+
+def test_loadtest_e2e_verdict_from_scrapes():
+    report = _run_loadtest(ladder=("5", "closed"), duration_s=1.5,
+                           workers=2, trees=5, leaves=7,
+                           bucket_mix={512: 0.5, 64: 0.5},
+                           target_rows_per_s=1000.0,
+                           p99_threshold_ms=5000.0,
+                           scrape_interval_s=0.3)
+    assert report["schema"] == "loadtest-slo-report-v1"
+    assert report["verdict"] == "pass", report
+    assert report["verdict_source"] == "/metrics + /slo scrapes only"
+    assert len(report["rungs"]) == 2
+    open_rung, closed_rung = report["rungs"]
+    assert open_rung["label"] == "qps5" and closed_rung["label"] == "closed"
+    for rung in report["rungs"]:
+        # the verdict inputs all came from the server's own telemetry
+        assert rung["rows_per_sec"] > 0 and rung["qps"] > 0
+        assert rung["availability"] == 1.0
+        assert rung["slo"]["schema"] == "slo-report-v1"
+        assert rung["per_bucket"], rung
+        for b, lat in rung["per_bucket"].items():
+            assert lat["p99_ms"] > 0
+            assert lat["device_p50_ms"] > 0
+    # bench-matrix-v1 record rows (the nightly regression gate's diet)
+    import loadtest as lt
+    rec = lt.to_bench_matrix(report)
+    names = [r["name"] for r in rec["rows"]]
+    assert rec["schema"] == "bench-matrix-v1"
+    assert "loadtest_closed" in names and "loadtest_slo" in names
+    assert "loadtest_closed_qps" in names   # qps judged on its own row
+    assert any(n.startswith("loadtest_closed_p99_b") for n in names)
+
+
+@pytest.mark.slow
+def test_loadtest_sustains_1e5_rows_per_sec():
+    """ROADMAP item 3 acceptance: >= 10^5 synthetic rows/s through the
+    real HTTP serving tier on this env, judged from /metrics scrapes
+    (the CI loadtest step runs the same harness blocking)."""
+    report = _run_loadtest(ladder=("closed",), duration_s=6.0, workers=3,
+                           target_rows_per_s=1e5,
+                           p99_threshold_ms=2000.0)
+    assert report["verdict"] == "pass", report
+    assert report["peak_rows_per_sec"] >= 1e5
+
+
+# ---------------------------------------------------------------------------
+# tracing overhead guard
+# ---------------------------------------------------------------------------
+
+def test_per_request_tracing_overhead_under_5pct_p50():
+    """The per-request tracing add-on (three histogram observes + an
+    exemplar offer) must cost < 5% of the p50 request latency at the
+    SMALLEST bucket.  Both sides take the MIN over repeated rounds —
+    the minimum of a wall-time measurement is robust to the scheduler
+    jitter / GC pauses a shared 1-core CI runner injects, where a
+    single-round mean is not."""
+    from lightgbm_tpu.telemetry.metrics import percentile
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    bst = lgb.train(p, lgb.Dataset(X, y, params=p), 5)
+    pred = bst.to_predictor(warmup=False)
+    x1 = X[:1]
+    for _ in range(20):
+        pred.predict(x1)                       # warm bucket 1
+    lats = []
+    for _ in range(200):
+        t0 = time.perf_counter()
+        pred.predict(x1)
+        lats.append(time.perf_counter() - t0)
+    p50_s = percentile(sorted(lats), 50.0)
+
+    n = 2000
+    per_record_s = float("inf")
+    for r in range(5):
+        t0 = time.perf_counter()
+        for i in range(n):
+            pred.stats.record_request_timing(1, 1, 0.01, 0.2, 0.25,
+                                             request_id=f"ovh-{r}-{i}")
+        per_record_s = min(per_record_s, (time.perf_counter() - t0) / n)
+    assert per_record_s < 0.05 * p50_s, (per_record_s, p50_s)

@@ -1,0 +1,352 @@
+"""SLO engine + coverage lint + serving endpoints (fleet observability).
+
+Covers: declarative SLO registration and the burn-rate math (ratio and
+latency kinds, multi-window breach semantics, sustained-fast-burn
+degradation), the slowest-request exemplar ring, the analysis/
+SLO-coverage check (clean at head; planted dangling-metric and
+bad-selector SLOs fail with site-named diagnostics, the
+note_collective-contract coverage pattern), and the HTTP surface:
+``GET /slo``, SLO-aware ``/healthz``, ``X-Request-Id`` propagation and
+the batcher saturation gauges on ``/stats``.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry.metrics import MetricsRegistry
+from lightgbm_tpu.telemetry.slo import (ExemplarRing, SloEngine, all_slos,
+                                        remove_slo, slo)
+
+
+# ---------------------------------------------------------------------------
+# engine math
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def test_ratio_slo_burn_math():
+    reg = MetricsRegistry()
+    bad = reg.counter("t_bad_total", labels=())
+    total = reg.counter("t_total", labels=())
+    slo("test/ratio", metric="t_bad_total", total_metric="t_total",
+        kind="ratio", target=0.99, window_fast_s=60, window_slow_s=600,
+        burn_fast=10.0, burn_slow=5.0)
+    try:
+        clk = _Clock()
+        eng = SloEngine(registry=reg, sustain=2, clock=clk)
+        total.inc(100)
+        r = eng.evaluate()
+        v = next(s for s in r["slos"] if s["name"] == "test/ratio")
+        assert v["ok"] and v["burn"]["fast"] == 0.0
+
+        # burn: 20% errors against a 1% budget = 20x in both windows
+        clk.t = 10.0
+        bad.inc(25)
+        total.inc(125)
+        r = eng.evaluate()
+        v = next(s for s in r["slos"] if s["name"] == "test/ratio")
+        assert v["error_ratio"]["fast"] == pytest.approx(0.2)
+        assert v["burn"]["fast"] == pytest.approx(20.0)
+        assert v["breached"] and not v["ok"]
+        assert "test/ratio" in r["breached"]
+
+        # sustained fast burn flips the engine's degraded list
+        clk.t = 20.0
+        bad.inc(25)
+        total.inc(125)
+        r = eng.evaluate()
+        assert "test/ratio" in r["degraded"]
+        assert "test/ratio" in eng.degraded()
+
+        # recovery: clean traffic dilutes the fast window below threshold
+        clk.t = 90.0               # the hot samples age out of fast (60s)
+        total.inc(10000)
+        r = eng.evaluate()
+        v = next(s for s in r["slos"] if s["name"] == "test/ratio")
+        assert v["burn"]["fast"] < 10.0
+        assert not v["fast_burning"]
+        assert eng.degraded() == []
+    finally:
+        remove_slo("test/ratio")
+
+
+def test_ratio_slo_idle_service_does_not_burn():
+    reg = MetricsRegistry()
+    reg.counter("t2_bad_total")
+    reg.counter("t2_total")
+    slo("test/idle", metric="t2_bad_total", total_metric="t2_total",
+        kind="ratio", target=0.999)
+    try:
+        eng = SloEngine(registry=reg, clock=_Clock())
+        for _ in range(3):
+            r = eng.evaluate()
+        v = next(s for s in r["slos"] if s["name"] == "test/idle")
+        assert v["ok"] and v["burn"]["fast"] == 0.0
+    finally:
+        remove_slo("test/idle")
+
+
+def test_latency_slo_per_bucket_series():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_ms", labels=("model", "bucket"))
+    for _ in range(50):
+        h.observe(5.0, model="m", bucket="64")     # fast bucket
+        h.observe(80.0, model="m", bucket="4096")  # slow bucket
+    slo("test/lat", metric="t_lat_ms", kind="latency", target=0.9,
+        threshold_ms=50.0, burn_fast=5.0, burn_slow=3.0)
+    try:
+        clk = _Clock()
+        eng = SloEngine(registry=reg, clock=clk)
+        r = eng.evaluate()
+        v = next(s for s in r["slos"] if s["name"] == "test/lat")
+        series = {tuple(sorted(s["labels"].items())): s
+                  for s in v["detail"]["series"]}
+        fast = series[(("bucket", "64"), ("model", "m"))]
+        slow = series[(("bucket", "4096"), ("model", "m"))]
+        assert fast["frac_over"] == 0.0 and slow["frac_over"] == 1.0
+        assert slow["p99_ms"] == pytest.approx(80.0)
+        # worst series drives the burn: 100% over vs 10% budget = 10x
+        assert v["burn"]["fast"] == pytest.approx(10.0)
+        assert v["breached"]
+        # burn-rate gauges landed back in the registry (Prometheus path)
+        g = reg.get("slo_burn_rate")
+        assert g is not None and any(
+            lbl == {"slo": "test/lat", "window": "fast"} and val > 0
+            for lbl, val in g.series())
+
+        # recovery without traffic: the count-bounded histogram window
+        # stays hot forever, but idle evaluations must contribute zero
+        # burn (stale window != live burst) so the breach clears once
+        # the burst ages out of the fast window
+        for clk.t in (60.0, 120.0, 180.0, 240.0, 330.0, 400.0):
+            r = eng.evaluate()
+        v = next(s for s in r["slos"] if s["name"] == "test/lat")
+        assert v["burn"]["fast"] < 5.0 and not v["breached"], v
+    finally:
+        remove_slo("test/lat")
+
+
+def test_exemplar_ring_keeps_worst_n():
+    ring = ExemplarRing(capacity=4)
+    for i in range(100):
+        ring.offer(float(i), {"request_id": f"r{i}"})
+    snap = ring.snapshot()
+    assert [e["score"] for e in snap] == [99.0, 98.0, 97.0, 96.0]
+    assert snap[0]["request_id"] == "r99"
+    assert len(ring) == 4
+
+
+# ---------------------------------------------------------------------------
+# coverage lint (analysis/slo_cover.py)
+# ---------------------------------------------------------------------------
+
+def test_slo_coverage_clean_at_head():
+    from lightgbm_tpu.analysis.slo_cover import check_slo_coverage
+    assert check_slo_coverage() == []
+    # the shipped objectives are all declared
+    names = set(all_slos())
+    assert {"serve/latency_p99", "serve/availability", "serve/shed_rate",
+            "serve/compiler_fallback_rate"} <= names
+
+
+def test_planted_dangling_metric_fails_coverage():
+    from lightgbm_tpu.analysis.slo_cover import check_slo_coverage
+    slo("test/dangling", metric="no_such_series_total",
+        total_metric="serve_requests_total", kind="ratio", target=0.99)
+    try:
+        vs = check_slo_coverage()
+        assert any(v.site == "test/dangling" and
+                   "no_such_series_total" in v.message for v in vs)
+    finally:
+        remove_slo("test/dangling")
+    assert check_slo_coverage() == []
+
+
+def test_planted_bad_selector_and_kind_fail_coverage():
+    from lightgbm_tpu.analysis.slo_cover import check_slo_coverage
+    # selector on a label the series never carries
+    slo("test/bad_label", metric="serve_http_responses_total",
+        total_metric="serve_http_responses_total", kind="ratio",
+        target=0.999, bad_labels={"status_klasse": "5*"})
+    # latency SLO pointed at a counter
+    slo("test/bad_kind", metric="serve_requests_total", kind="latency",
+        target=0.99, threshold_ms=10.0)
+    try:
+        sites = {v.site for v in check_slo_coverage()}
+        assert {"test/bad_label", "test/bad_kind"} <= sites
+    finally:
+        remove_slo("test/bad_label")
+        remove_slo("test/bad_kind")
+
+
+def test_lint_trace_report_carries_slo_section():
+    from lightgbm_tpu.analysis.slo_cover import slo_coverage_report
+    rep = slo_coverage_report()
+    assert rep["ok"] and "serve/latency_p99" in rep["slos"]
+    assert rep["slos"]["serve/latency_p99"]["metric"] == \
+        "serve_request_latency_ms"
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface e2e
+# ---------------------------------------------------------------------------
+
+def _mk_server(tmp_path, **kw):
+    from lightgbm_tpu.serve.registry import ModelRegistry
+    from lightgbm_tpu.serve.server import PredictionServer
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    bst = lgb.train(p, lgb.Dataset(X, y, params=p), 5)
+    mf = os.path.join(str(tmp_path), "m.txt")
+    bst.save_model(mf)
+    reg = ModelRegistry()
+    reg.load("m", mf, warmup=False)
+    return PredictionServer(reg, port=0, **kw).start(), X
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_server_slo_endpoint_and_request_id(tmp_path):
+    srv, X = _mk_server(tmp_path)
+    try:
+        body = json.dumps({"rows": X[:3].tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/predict", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "e2e-42"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            assert r.headers.get("X-Request-Id") == "e2e-42"
+            out = json.loads(r.read().decode())
+        assert out["request_id"] == "e2e-42"
+        # a request without the header gets a server-assigned id
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req2, timeout=30) as r:
+            out2 = json.loads(r.read().decode())
+        assert out2["request_id"].startswith("srv-")
+
+        code, rep = _get(srv.port, "/slo")
+        assert code == 200 and rep["schema"] == "slo-report-v1"
+        names = {s["name"] for s in rep["slos"]}
+        assert "serve/latency_p99" in names and \
+            "serve/availability" in names
+
+        code, health = _get(srv.port, "/healthz")
+        assert code == 200 and health["status"] in ("ok", "degraded")
+
+        # a request naming the model explicitly must share the nameless
+        # requests' batcher (one saturation entry, no "default" alias
+        # clobbering the gauges)
+        req3 = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/predict",
+            data=json.dumps({"rows": X[:2].tolist(),
+                             "model": "m"}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req3, timeout=30).read()
+
+        code, stats = _get(srv.port, "/stats")
+        assert code == 200
+        assert list(stats) == ["m"]
+        assert "saturation" in stats["m"]
+        assert stats["m"]["saturation"]["inflight_requests"] == 0
+        # the per-request timing split made it to /stats
+        assert stats["m"]["request_latency_ms"]["window"] >= 2
+        assert stats["m"]["queue_wait_ms"]["window"] >= 2
+    finally:
+        srv.shutdown()
+
+
+def test_healthz_degrades_on_sustained_fast_burn(tmp_path):
+    from lightgbm_tpu.telemetry.slo import SloEngine
+    from lightgbm_tpu.serve.stats import request_exemplars
+    # a private engine wired into the server, with a planted objective
+    # reading the DEFAULT registry's request-latency series (the server
+    # records into the default registry through ModelStats)
+    slo("test/hot", metric="serve_request_latency_ms", kind="latency",
+        target=0.99, threshold_ms=1e-6, burn_fast=1.0, burn_slow=1.0)
+    try:
+        eng = SloEngine(sustain=2)  # default registry
+        # the ring keeps the process-wide slowest N: drop whatever
+        # earlier tests parked there so this test's requests qualify
+        request_exemplars().clear()
+        srv, X = _mk_server(tmp_path, slo_engine=eng)
+        try:
+            body = json.dumps({"rows": X[:3].tolist()}).encode()
+            for i in range(3):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/predict", data=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Request-Id": f"hot-{i}"})
+                urllib.request.urlopen(req, timeout=30).read()
+            # every request is over the absurd threshold -> sustained
+            # fast burn after two evaluations
+            _get(srv.port, "/slo")
+            code, health = _get(srv.port, "/healthz")
+            assert health["status"] == "degraded"
+            assert any("slo_fast_burn: test/hot" in r
+                       for r in health.get("reasons", []))
+            # the /slo payload attaches the exemplar ring on a burn
+            code, rep = _get(srv.port, "/slo")
+            assert "exemplars" in rep and rep["exemplars"]
+            ids = {e["request_id"] for e in rep["exemplars"]}
+            assert any(i.startswith("hot-") for i in ids)
+        finally:
+            srv.shutdown()
+    finally:
+        remove_slo("test/hot")
+    assert request_exemplars().snapshot() is not None
+
+
+def test_fallback_batches_counter_measures_traffic():
+    """The fallback SLO's numerator moves per SERVED BATCH, not per
+    compile — a fallback-built predictor's traffic is what burns."""
+    from lightgbm_tpu.serve.compiler import FALLBACK_BATCHES
+    from lightgbm_tpu.telemetry.metrics import default_registry
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    bst = lgb.train(p, lgb.Dataset(X, y, params=p), 5)
+    pred = bst.to_predictor(warmup=False, compiler="walk")
+    c = default_registry().counter(FALLBACK_BATCHES,
+                                   labels=("reason", "model"))
+    before = c.value(reason="forced_walk", model="default")
+    pred.predict(X[:3])
+    pred.predict(X[:3])
+    assert c.value(reason="forced_walk", model="default") == before + 2
+
+
+def test_availability_counter_counts_5xx(tmp_path):
+    from lightgbm_tpu.telemetry.metrics import default_registry
+    srv, X = _mk_server(tmp_path)
+    try:
+        c = default_registry().get("serve_http_responses_total")
+        before = c.value(code="404")
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=30)
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        assert c.value(code="404") == before + 1
+    finally:
+        srv.shutdown()
