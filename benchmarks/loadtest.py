@@ -27,8 +27,17 @@ mix), LOAD_ARRIVAL (uniform|poisson), LOAD_TARGET_ROWS_S (pass floor,
 default 1e5), LOAD_P99_MS (re-declares the serve/latency_p99 threshold
 for this env), LOAD_MAX_QUEUE_ROWS (admission bound; 0 = unbounded).
 
-Exit code: 0 on pass, 1 on breach/underrun — CI runs this blocking,
-next to the chaos step.
+``--fleet-chaos`` switches to the fleet-resilience rung: a multi-worker
+``FleetSupervisor`` serves open-loop loadgen traffic while the chaos
+layer's ``serve_crash_after_n`` hard-kills one worker mid-run; the
+verdict — worker crashed AND the fleet recovered to full strength AND
+the availability SLO is met after the recovery window AND every client
+request reached a terminal outcome — is computed solely from the fleet
+``/metrics`` + ``/slo`` scrapes (env knobs: FLEET_WORKERS,
+FLEET_DURATION, FLEET_QPS, FLEET_CRASH_AFTER, FLEET_RECOVERY_S).
+
+Exit code: 0 on pass, 1 on breach/underrun — CI runs both modes
+blocking, next to the chaos step.
 """
 
 import json
@@ -238,6 +247,153 @@ def run_loadtest(ladder=("closed",), duration_s: float = 5.0,
     }
 
 
+def run_fleet_chaos(workers: int = 2, duration_s: float = 8.0,
+                    qps: float = 30.0, crash_after: int = 40,
+                    recovery_window_s: float = 10.0,
+                    features: int = 4, trees: int = 20,
+                    leaves: int = 15, bucket_rows: int = 8,
+                    scrape_interval_s: float = 0.5):
+    """Fleet chaos-under-load smoke: start a supervised worker fleet,
+    arm worker 0 with ``serve_crash_after_n`` (its FIRST incarnation
+    hard-kills itself after N /predict requests — the replacement boots
+    clean), drive open-loop traffic through the dispatcher, then judge
+    recovery exclusively from fleet ``/metrics`` + ``/slo`` scrapes."""
+    from lightgbm_tpu.serve.fleet import FleetSupervisor
+    from lightgbm_tpu.serve.loadgen import (LoadGenerator, LoadSpec,
+                                            metric_sum, parse_prometheus,
+                                            scrape_json, scrape_metrics)
+    from lightgbm_tpu.utils.backend import default_backend
+    from lightgbm_tpu.utils.log import set_verbosity
+
+    backend = default_backend()
+    set_verbosity(-1)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        model_file = _train_model(trees, leaves, features, tmp)
+        fleet = FleetSupervisor(
+            [model_file], workers=int(workers),
+            worker_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo},
+            worker_args={"warmup": "0", "max_wait_ms": "0.5"},
+            first_spawn_env={0: {"LGBM_TPU_FAULTS":
+                                 f"serve_crash_after_n={crash_after}"}},
+            probe_interval_s=0.25, backoff_base_s=0.2,
+            backoff_max_s=1.0, breaker_halfopen_s=1.0,
+            startup_timeout_s=300.0,
+            run_dir=os.path.join(tmp, "fleet"))
+        fleet.start()
+        host, port = fleet.host, fleet.port
+        try:
+            spec = LoadSpec(duration_s=duration_s, target_qps=qps,
+                            workers=2, features=features,
+                            bucket_mix={int(bucket_rows): 1.0}, seed=1,
+                            timeout_s=10.0)
+            gen = LoadGenerator(host, port, spec)
+
+            stop = threading.Event()
+
+            def scraper():
+                # burn windows sample DURING the chaos, not just after
+                while not stop.wait(scrape_interval_s):
+                    try:
+                        scrape_json(host, port, "/slo")
+                    except Exception:
+                        pass
+
+            sc = threading.Thread(target=scraper, daemon=True)
+            sc.start()
+            client = gen.run()
+            stop.set()
+            sc.join(2.0)
+
+            # recovery window: the supervisor restores full strength
+            recovered = False
+            deadline = time.perf_counter() + recovery_window_s
+            while time.perf_counter() < deadline:
+                parsed = parse_prometheus(scrape_metrics(host, port))
+                if metric_sum(parsed,
+                              "lgbm_tpu_fleet_workers_alive") == workers:
+                    recovered = True
+                    break
+                time.sleep(0.25)
+
+            parsed = parse_prometheus(scrape_metrics(host, port))
+            slo_rep = scrape_json(host, port, "/slo")
+            restarts = metric_sum(parsed, "lgbm_tpu_fleet_restarts_total")
+            retries = metric_sum(parsed, "lgbm_tpu_fleet_retries_total")
+            quarantined = metric_sum(parsed,
+                                     "lgbm_tpu_fleet_workers_quarantined")
+            total = metric_sum(parsed,
+                               "lgbm_tpu_serve_predict_responses_total")
+            bad = sum(metric_sum(parsed,
+                                 "lgbm_tpu_serve_predict_responses_total",
+                                 code=c)
+                      for c in ("500", "502", "503", "504"))
+        finally:
+            fleet.shutdown()
+
+    availability = 1.0 - (bad / total) if total else 0.0
+    # terminality must be FALSIFIABLE: the sent-vs-outcome ledger
+    # balances by construction of the generator loop, so the real
+    # assertion is the wall clock — a hung request blocks its
+    # generator thread past the per-connection socket timeout, so a
+    # run whose elapsed time blows duration + timeout + slack had a
+    # request with no terminal outcome inside the client's patience
+    ledger_ok = (sum(client.by_code.values()) + client.connect_errors
+                 == client.requests_sent)
+    no_hang = client.elapsed_s <= duration_s + spec.timeout_s + 5.0
+    all_terminal = ledger_ok and no_hang
+    crashed = restarts >= 1
+    slo_ok = bool(slo_rep.get("ok"))
+    verdict = "pass" if (crashed and recovered and slo_ok and
+                         all_terminal and total > 0) else "breach"
+    return {
+        "schema": "fleet-chaos-report-v1",
+        "git_sha": _git_sha(),
+        "backend": backend,
+        "verdict": verdict,
+        "verdict_source": "fleet /metrics + /slo scrapes only",
+        "config": {"workers": int(workers), "duration_s": duration_s,
+                   "target_qps": qps, "crash_after": int(crash_after),
+                   "recovery_window_s": recovery_window_s,
+                   "bucket_rows": int(bucket_rows)},
+        "crashed": crashed,
+        "recovered": recovered,
+        "slo_ok": slo_ok,
+        "all_requests_terminal": all_terminal,
+        "availability": round(availability, 6),
+        "fleet_restarts_total": restarts,
+        "fleet_retries_total": retries,
+        "fleet_workers_quarantined": quarantined,
+        "qps": round(client.achieved_qps, 2),
+        "slo": slo_rep,
+        "client": client.summary(),
+    }
+
+
+def fleet_chaos_to_bench_matrix(report) -> dict:
+    """bench-matrix-v1 rows for the nightly regression gate: one qps
+    row (throughput direction) and one SLO verdict row (a recovery that
+    stops meeting the availability SLO flips met -> breached and fails
+    the gate)."""
+    return {
+        "schema": "bench-matrix-v1",
+        "bench": "fleet-chaos",
+        "git_sha": report["git_sha"],
+        "backend": report["backend"],
+        "rows": [
+            {"name": "fleet_chaos", "config": report["config"],
+             "qps": report["qps"],
+             "availability": report["availability"],
+             "interpreted": False},
+            {"name": "fleet_chaos_slo",
+             "slo_ok": bool(report["slo_ok"] and report["recovered"]
+                            and report["crashed"]),
+             "verdict": report["verdict"]},
+        ],
+    }
+
+
 def to_bench_matrix(report) -> dict:
     """bench-matrix-v1 record for the nightly regression gate: per rung
     one rows/s row and one qps row (each metric on its own row — the
@@ -280,6 +436,33 @@ def main(argv) -> int:
         json_path = argv[argv.index("--json") + 1]
     if "--slo-report" in argv:
         slo_path = argv[argv.index("--slo-report") + 1]
+
+    if "--fleet-chaos" in argv:
+        report = run_fleet_chaos(
+            workers=int(os.environ.get("FLEET_WORKERS", 2)),
+            duration_s=float(os.environ.get("FLEET_DURATION", 8.0)),
+            qps=float(os.environ.get("FLEET_QPS", 30.0)),
+            crash_after=int(os.environ.get("FLEET_CRASH_AFTER", 40)),
+            recovery_window_s=float(
+                os.environ.get("FLEET_RECOVERY_S", 10.0)))
+        print(json.dumps({
+            "verdict": report["verdict"],
+            "crashed": report["crashed"],
+            "recovered": report["recovered"],
+            "slo_ok": report["slo_ok"],
+            "all_requests_terminal": report["all_requests_terminal"],
+            "availability": report["availability"],
+            "fleet_restarts_total": report["fleet_restarts_total"],
+            "fleet_retries_total": report["fleet_retries_total"]},
+            indent=2), flush=True)
+        if slo_path:
+            with open(slo_path, "w") as fh:
+                json.dump(report, fh, indent=2, default=str)
+        if json_path:
+            with open(json_path, "w") as fh:
+                json.dump(fleet_chaos_to_bench_matrix(report), fh,
+                          indent=2, default=str)
+        return 0 if report["verdict"] == "pass" else 1
 
     ladder = [tok.strip() for tok in
               os.environ.get("LOAD_LADDER", "closed").split(",")

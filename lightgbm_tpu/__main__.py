@@ -1,7 +1,9 @@
 """``python -m lightgbm_tpu`` — the CLI entry point (reference
 src/main.cpp:11).  Tasks: train / predict / refit / convert_model via
 ``key=value`` args, plus the serving verb
-``python -m lightgbm_tpu serve model.txt [port=8080 ...]``, the
+``python -m lightgbm_tpu serve model.txt [port=8080 ...]``, the fleet
+verb ``python -m lightgbm_tpu serve-fleet model.txt [workers=4 ...]``
+(N supervised worker processes behind a crash-tolerant dispatcher), the
 profiling verb ``python -m lightgbm_tpu profile config=train.conf``
 (jax.profiler capture + telemetry dump) and the trace-lint verb
 ``python -m lightgbm_tpu lint-trace [configs=...] [out=report.json]``

@@ -26,7 +26,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 from .rules import Violation
-from ..telemetry.metrics import (Counter, MetricsRegistry,
+from ..telemetry.metrics import (Counter, Gauge, MetricsRegistry,
                                  WindowedHistogram)
 
 __all__ = ["check_slo_coverage", "slo_coverage_report"]
@@ -39,7 +39,7 @@ def _import_declaring_modules() -> None:
     declarations live next to the code they bound, so importing the
     subsystems collects them)."""
     from ..resilience import admission  # noqa: F401
-    from ..serve import compiler, server, stats  # noqa: F401
+    from ..serve import compiler, fleet, server, stats  # noqa: F401
 
 
 def check_slo_coverage(registry: Optional[MetricsRegistry] = None
@@ -74,6 +74,9 @@ def check_slo_coverage(registry: Optional[MetricsRegistry] = None
             if s.kind == "ratio" and not isinstance(m, Counter):
                 v(name, f"ratio SLO needs counters but '{mname}' is a "
                         f"{m.kind}")
+            if s.kind == "gauge_floor" and not isinstance(m, Gauge):
+                v(name, f"gauge_floor SLO needs a gauge but '{mname}' "
+                        f"is a {m.kind}")
             selectors = dict(s.labels)
             if role == "metric":
                 selectors.update(s.bad_labels)
@@ -86,6 +89,8 @@ def check_slo_coverage(registry: Optional[MetricsRegistry] = None
         if s.kind == "latency" and s.threshold_ms <= 0:
             v(name, f"latency SLO needs threshold_ms > 0, "
                     f"got {s.threshold_ms}")
+        if s.kind == "gauge_floor" and s.floor <= 0:
+            v(name, f"gauge_floor SLO needs floor > 0, got {s.floor}")
     return out
 
 

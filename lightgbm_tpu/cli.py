@@ -286,6 +286,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         # serving verb: python -m lightgbm_tpu serve model.txt [key=value]
         from .serve.server import main as serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] in ("serve-fleet", "serve_fleet"):
+        # fleet verb: N supervised worker processes behind a dispatcher
+        # with crash-restart, a crash-loop breaker and rolling deploys
+        from .serve.fleet import main as fleet_main
+        return fleet_main(argv[1:])
     if argv and argv[0] == "profile":
         # profiling verb: python -m lightgbm_tpu profile config=train.conf
         return run_profile(argv[1:])

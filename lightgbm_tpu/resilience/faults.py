@@ -19,9 +19,26 @@ arm a child) or programmatically via ``faults.configure(...)``:
                      (``utils/backend.default_backend``) report the
                      device as lost, driving the CPU-fallback path
 
+Serve-side chaos (the fleet-resilience suite kills and wedges worker
+processes deterministically WHILE the load generator drives traffic;
+``serve/server.py`` calls :meth:`FaultPlan.check_serve_request` at the
+top of every HTTP handler):
+
+  serve_crash_after_n=N  hard-kill the worker (``os._exit(137)``) on the
+                     first ``/predict`` request AFTER N have been
+                     admitted — the in-flight client sees a connection
+                     reset, the supervisor sees a dead process
+  serve_hang_ms=T    sleep T ms in EVERY handler (including
+                     ``/healthz`` — a wedged process wedges its health
+                     probe too, which is exactly what the fleet
+                     watchdog keys on)
+  serve_drop_conn=K  sever every K-th ``/predict`` connection without a
+                     response (simulates a mid-request network reset;
+                     the dispatcher's bounded retry path)
+
 Every trigger increments ``faults_injected_total{fault=...}`` in the
-telemetry registry (kill_at_iter necessarily excepted — the process is
-gone before any export).
+telemetry registry (kill_at_iter / serve_crash_after_n necessarily
+excepted — the process is gone before any export).
 """
 
 from __future__ import annotations
@@ -62,6 +79,7 @@ class FaultPlan:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._plan: Dict[str, int] = {}
+        self._serve_predicts = 0  # /predict requests seen (serve chaos)
         self._counter = default_registry().counter(
             "faults_injected_total", "chaos-layer faults triggered",
             labels=("fault",))
@@ -83,6 +101,7 @@ class FaultPlan:
     def clear(self) -> None:
         with self._lock:
             self._plan = {}
+            self._serve_predicts = 0
 
     def get(self, key: str) -> Optional[int]:
         with self._lock:
@@ -118,6 +137,44 @@ class FaultPlan:
             return int(jax.process_index()) == rank
         except Exception:
             return rank == 0
+
+    def check_serve_request(self, path: str) -> Optional[str]:
+        """Called by the HTTP serving layer at the top of every handler.
+
+        Returns ``"drop"`` when the armed plan wants this connection
+        severed without a response (the handler closes the socket), or
+        ``None`` to proceed.  ``serve_crash_after_n`` never returns —
+        the process is gone.
+        """
+        # production fast path: with nothing armed this is one
+        # unlocked dict-emptiness read per request, not four lock
+        # acquisitions (faults are armed before traffic starts; a
+        # racy read here only delays an injection by one request)
+        if not self._plan:
+            return None
+        hang_ms = self.get("serve_hang_ms")
+        if hang_ms:
+            # wedge, don't die: EVERY handler (healthz probes included)
+            # stalls, which is what distinguishes a hung worker from a
+            # crashed one to the supervisor's watchdog
+            self.fire("serve_hang_ms")
+            import time
+            time.sleep(hang_ms / 1e3)
+        if path != "/predict":
+            return None
+        with self._lock:
+            self._serve_predicts += 1
+            n_seen = self._serve_predicts
+        crash_after = self.get("serve_crash_after_n")
+        if crash_after is not None and n_seen > crash_after:
+            log_warning(f"fault injection: hard-killing the serving "
+                        f"process after {crash_after} /predict requests")
+            os._exit(137)
+        drop_every = self.get("serve_drop_conn")
+        if drop_every and n_seen % drop_every == 0:
+            self.fire("serve_drop_conn")
+            return "drop"
+        return None
 
     def check_device_probe(self) -> None:
         """Called by the backend probe; an armed ``device_loss`` makes it

@@ -13,12 +13,17 @@ per-request Python dispatch costs or fresh XLA traces:
 - :class:`PredictionServer` — dependency-free ``http.server`` JSON
   endpoint (``/predict``, ``/models``, ``/healthz``, ``/stats``),
   exposed as the ``python -m lightgbm_tpu serve`` CLI verb;
-- :class:`ModelStats` — per-model serving counters behind ``/stats``.
+- :class:`ModelStats` — per-model serving counters behind ``/stats``;
+- :class:`FleetSupervisor` — N worker processes behind one dispatcher
+  with crash-restart, a crash-loop circuit breaker, rolling drain and
+  zero-downtime rolling deploys (``python -m lightgbm_tpu
+  serve-fleet``).
 """
 
 from .batcher import MicroBatcher
 from .compiler import DenseExecutable, DenseLoweringError, \
     compile_ensemble, fallback_counts
+from .fleet import FleetSupervisor
 from .predictor import SHAPE_BUCKETS, CompiledPredictor
 from .registry import ModelRegistry
 from .server import PredictionServer
@@ -27,4 +32,4 @@ from .stats import ModelStats
 __all__ = ["CompiledPredictor", "MicroBatcher", "ModelRegistry",
            "PredictionServer", "ModelStats", "SHAPE_BUCKETS",
            "DenseExecutable", "DenseLoweringError", "compile_ensemble",
-           "fallback_counts"]
+           "fallback_counts", "FleetSupervisor"]

@@ -56,6 +56,7 @@ class LoadSpec:
     model: Optional[str] = None        # /predict "model" field
     deadline_ms: float = 0.0           # per-request deadline (0 = none)
     seed: int = 0
+    timeout_s: float = 30.0            # per-connection connect/read timeout
 
 
 @dataclass
@@ -67,6 +68,10 @@ class LoadResult:
     rows_sent: int = 0
     by_code: Dict[int, int] = field(default_factory=dict)
     errors: int = 0
+    connect_errors: int = 0            # connection-level failures (a
+    #                                    worker restart mid-request);
+    #                                    counted as failed requests,
+    #                                    never abort a worker thread
     elapsed_s: float = 0.0
     latencies_ms: List[float] = field(default_factory=list)
     late_departures: int = 0           # open loop: schedule slips
@@ -89,6 +94,7 @@ class LoadResult:
             "achieved_rows_per_s": round(self.achieved_rows_per_s, 1),
             "by_code": {str(k): v for k, v in sorted(self.by_code.items())},
             "errors": self.errors,
+            "connect_errors": self.connect_errors,
             "late_departures": self.late_departures,
             "client_p50_ms": round(percentile(lat, 50.0), 3),
             "client_p99_ms": round(percentile(lat, 99.0), 3),
@@ -142,10 +148,13 @@ class LoadGenerator:
             int(max(64, s.duration_s * 2000))
         sizes = self._rng.choice(self._sizes, size=draw_n, p=self._weights)
 
+        def new_conn() -> http.client.HTTPConnection:
+            return http.client.HTTPConnection(self.host, self.port,
+                                              timeout=s.timeout_s)
+
         def worker(wid: int) -> None:
-            conn = http.client.HTTPConnection(self.host, self.port,
-                                              timeout=30)
-            sent = rows = errors = late = 0
+            conn = new_conn()
+            sent = rows = errors = conn_errors = late = 0
             codes: Dict[int, int] = {}
             lats: List[float] = []
             while True:
@@ -174,22 +183,33 @@ class LoadGenerator:
                 body = self._bodies[nrows]
                 rid = f"load-{wid}-{sent}"
                 t_req = time.perf_counter()
-                try:
-                    conn.request("POST", "/predict", body, {
-                        "Content-Type": "application/json",
-                        "Content-Length": str(len(body)),
-                        "X-Request-Id": rid})
-                    r = conn.getresponse()
-                    r.read()
-                    code = r.status
-                except Exception:
-                    errors += 1
+                code: Optional[int] = None
+                # one bounded reconnect: a worker restart mid-request
+                # severs the keep-alive connection; the SECOND attempt
+                # runs on a fresh socket, and a second failure counts
+                # as a failed request (connect_errors) rather than
+                # aborting the generator thread or burning the slot in
+                # a reconnect storm
+                for attempt in (0, 1):
                     try:
-                        conn.close()
+                        conn.request("POST", "/predict", body, {
+                            "Content-Type": "application/json",
+                            "Content-Length": str(len(body)),
+                            "X-Request-Id": rid})
+                        r = conn.getresponse()
+                        r.read()
+                        code = r.status
+                        break
                     except Exception:
-                        pass
-                    conn = http.client.HTTPConnection(
-                        self.host, self.port, timeout=30)
+                        try:
+                            conn.close()
+                        except Exception:
+                            pass
+                        conn = new_conn()
+                if code is None:
+                    errors += 1
+                    conn_errors += 1
+                    sent += 1       # a failed request, not a non-event
                     continue
                 lats.append((time.perf_counter() - t_req) * 1e3)
                 codes[code] = codes.get(code, 0) + 1
@@ -204,6 +224,7 @@ class LoadGenerator:
                 res.requests_sent += sent
                 res.rows_sent += rows
                 res.errors += errors
+                res.connect_errors += conn_errors
                 res.late_departures += late
                 res.latencies_ms.extend(lats)
                 for c, k in codes.items():

@@ -42,6 +42,9 @@ class ModelRegistry:
         # same name share one instance
         self._stats: Dict[str, ModelStats] = {}
         self._versions: Dict[str, int] = {}
+        # file path behind each loaded name (None for in-memory sources)
+        # — a rolling deploy reads it back to roll a regressed swap back
+        self._sources: Dict[str, Optional[str]] = {}
         self._max_models = max_models
         # registry-managed models report into the process-wide metrics
         # registry (labeled model=<name>) so /metrics covers them
@@ -52,19 +55,42 @@ class ModelRegistry:
              **predictor_kwargs) -> CompiledPredictor:
         """Load or hot-swap ``name``.  The predictor is built and warmed
         before the swap, so in-flight traffic never waits on a compile;
-        the swap itself is one dict assignment under the lock."""
+        the swap itself is one dict assignment under the lock.  A build
+        or warmup failure (corrupt file -> :class:`ModelCorruptError`,
+        bad params, ...) therefore leaves the OLD entry serving
+        untouched — same version, same stats, never torn or evicted —
+        and surfaces the typed error to the caller."""
         with self._lock:
             stats = self._stats.get(name)
-            if stats is None:
+            created_stats = stats is None
+            if created_stats:
+                # priming deferred: a failed first load must not leave
+                # phantom model=<name> series in the shared metrics
+                # registry either (only registry-private bookkeeping is
+                # rolled back below)
                 stats = self._stats[name] = ModelStats(
-                    model=name, registry=self._metrics)
-        pred = CompiledPredictor(source, stats=stats, **predictor_kwargs)
-        if warmup:
-            pred.warmup()
+                    model=name, registry=self._metrics, prime=False)
+        try:
+            pred = CompiledPredictor(source, stats=stats,
+                                     **predictor_kwargs)
+            if warmup:
+                pred.warmup()
+        except Exception:
+            with self._lock:
+                # a failed FIRST load must not leave a phantom stats
+                # entry for a name that never served (hot-swap failures
+                # keep theirs: the old version is still live)
+                if created_stats and name not in self._models:
+                    self._stats.pop(name, None)
+            raise
+        if created_stats:
+            stats.prime_series()
         with self._lock:
             swapped = name in self._models
             self._models[name] = pred
             self._versions[name] = self._versions.get(name, 0) + 1
+            self._sources[name] = source if isinstance(source, str) \
+                else None
             if self._max_models is not None and \
                     len(self._models) > self._max_models:
                 # evict the oldest OTHER entry (insertion order)
@@ -72,6 +98,7 @@ class ModelRegistry:
                     if victim != name:
                         del self._models[victim]
                         self._stats.pop(victim, None)
+                        self._sources.pop(victim, None)
                         break
         log_info(f"serve: {'hot-swapped' if swapped else 'loaded'} model "
                  f"'{name}' (v{self._versions[name]}, "
@@ -98,8 +125,15 @@ class ModelRegistry:
                 return False
             del self._models[name]
             self._stats.pop(name, None)
+            self._sources.pop(name, None)
             log_info(f"serve: evicted model '{name}'")
             return True
+
+    def source_of(self, name: str) -> Optional[str]:
+        """File path serving under ``name`` (None when loaded from an
+        in-memory object) — the rollback source for a rolling deploy."""
+        with self._lock:
+            return self._sources.get(name)
 
     def names(self) -> List[str]:
         with self._lock:
@@ -109,7 +143,9 @@ class ModelRegistry:
         with self._lock:
             items = list(self._models.items())
             versions = dict(self._versions)
-        return {name: {**pred.info(), "version": versions.get(name, 1)}
+            sources = dict(self._sources)
+        return {name: {**pred.info(), "version": versions.get(name, 1),
+                       "source": sources.get(name)}
                 for name, pred in items}
 
     def stats(self) -> Dict[str, dict]:

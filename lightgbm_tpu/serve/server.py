@@ -32,6 +32,17 @@ Each HTTP request runs on its own thread (ThreadingHTTPServer); /predict
 routes through a per-model :class:`MicroBatcher`, so concurrent small
 requests coalesce into one bucketed device call.  Started by the CLI
 verb ``python -m lightgbm_tpu serve model.txt [key=value ...]``.
+
+Lifecycle: the CLI installs SIGTERM/SIGINT handlers that run the same
+drain discipline training's ``PreemptionGuard`` gives checkpoints —
+stop accepting, fail queued batcher futures with :class:`ServerClosed`,
+let in-flight requests finish writing their responses, exit
+``128+signum`` (a repeat signal aborts immediately).  ``port_file=``
+announces the bound port to a supervisor (``serve/fleet.py``) via an
+atomic write, so ``port=0`` workers are discoverable without stdout
+parsing.  The chaos layer's serve-side fault points
+(``serve_crash_after_n`` / ``serve_hang_ms`` / ``serve_drop_conn``,
+``resilience/faults.py``) hook the top of every handler.
 """
 
 from __future__ import annotations
@@ -39,6 +50,8 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import signal
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -51,10 +64,11 @@ from .registry import ModelRegistry
 from .stats import request_exemplars
 from ..resilience.admission import (DeadlineExceeded, QueueFullError,
                                     ServerClosed)
+from ..resilience.faults import faults
 from ..telemetry.metrics import default_registry
 from ..telemetry.slo import (SloEngine, default_engine,
                              register_metric_ensurer, slo)
-from ..utils.log import log_debug, log_info
+from ..utils.log import log_debug, log_info, log_warning
 
 __all__ = ["PredictionServer", "main"]
 
@@ -144,6 +158,12 @@ class PredictionServer:
             else default_engine()
         self._responses = _http_response_counter()
         self._predict_responses = _predict_response_counter()
+        # drain bookkeeping: in-flight /predict handlers are counted so
+        # a graceful shutdown can wait for their responses to be written
+        self._active_cv = threading.Condition()
+        self._active_predicts = 0
+        self._draining = False
+        self.signal_received: Optional[int] = None
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
@@ -272,15 +292,74 @@ class PredictionServer:
     def serve_forever(self) -> None:
         self._httpd.serve_forever()
 
-    def shutdown(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+    def _enter_predict(self) -> bool:
+        """Admit one /predict handler; False while draining (the caller
+        replies 503 instead of racing the batcher teardown)."""
+        with self._active_cv:
+            if self._draining:
+                return False
+            self._active_predicts += 1
+            return True
+
+    def _exit_predict(self) -> None:
+        with self._active_cv:
+            self._active_predicts -= 1
+            if self._active_predicts <= 0:
+                self._active_cv.notify_all()
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Graceful shutdown in the strict order a rolling restart
+        needs: (1) stop accepting — new /predict requests get an
+        immediate 503 and the accept loop stops; (2) drain the
+        micro-batchers — queued futures fail with
+        :class:`ServerClosed`, the in-flight device batch completes and
+        settles its futures; (3) wait for in-flight handler threads to
+        write their responses; (4) close the sockets.  Every admitted
+        request therefore gets exactly one terminal response — a result
+        or a typed 5xx — never a hang."""
+        with self._active_cv:
+            self._draining = True
+        self._httpd.shutdown()   # no-op if serve_forever already returned
         with self._batchers_lock:
             batchers, self._batchers = dict(self._batchers), {}
         for b in batchers.values():
             b.close()
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._active_cv:
+            while self._active_predicts > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    log_warning(f"serve: drain timed out with "
+                                f"{self._active_predicts} request(s) "
+                                f"still in flight")
+                    break
+                self._active_cv.wait(remaining)
+        self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(5.0)
+
+    def shutdown(self) -> None:
+        self.drain(timeout=5.0)
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> drain-and-exit, the serving twin of
+        training's ``PreemptionGuard``: the handler only flags the
+        signal and stops the accept loop (from a helper thread —
+        ``shutdown()`` called inside the handler would deadlock the
+        main-thread ``serve_forever``); ``main`` then drains and exits
+        ``128+signum``.  A repeat signal aborts immediately instead of
+        waiting out the drain.  Main-thread only (``signal.signal``'s
+        constraint); embedded servers use :meth:`drain` directly."""
+        def _on_signal(signum: int, frame) -> None:
+            if self.signal_received is not None:
+                os._exit(128 + int(signum))
+            self.signal_received = int(signum)
+            log_warning(f"serve: received signal {signum}; draining "
+                        f"in-flight requests (repeat to abort)")
+            threading.Thread(target=self._httpd.shutdown,
+                             daemon=True).start()
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
 
 
 def _make_handler(server: PredictionServer):
@@ -308,7 +387,21 @@ def _make_handler(server: PredictionServer):
                 return {}
             return json.loads(self.rfile.read(length).decode())
 
+        def _chaos(self) -> bool:
+            """Armed serve-side fault points fire here (top of every
+            handler).  True = the connection was severed; stop."""
+            if faults.check_serve_request(self.path) == "drop":
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self.close_connection = True
+                return True
+            return False
+
         def do_GET(self):
+            if self._chaos():
+                return
             if self.path == "/healthz":
                 self._reply(200, server.health())
             elif self.path == "/models":
@@ -334,6 +427,8 @@ def _make_handler(server: PredictionServer):
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self):
+            if self._chaos():
+                return
             try:
                 req = self._read_json()
             except (ValueError, UnicodeDecodeError) as exc:
@@ -359,6 +454,19 @@ def _make_handler(server: PredictionServer):
                 server._predict_responses.inc(1, code=str(int(code)))
                 self._reply(code, payload, headers or rid_hdr)
 
+            # drain gate + in-flight accounting: an admitted request is
+            # guaranteed a written response before sockets close
+            if not server._enter_predict():
+                reply(503, {"error": "server is draining"},
+                      {"Retry-After": "1", **rid_hdr})
+                return
+            try:
+                self._predict_admitted(req, reply, rid)
+            finally:
+                server._exit_predict()
+
+        def _predict_admitted(self, req: dict, reply, rid: str) -> None:
+            rid_hdr = {"X-Request-Id": rid}
             name = req.get("model")
             rows = req.get("rows")
             if rows is None and "row" in req:
@@ -447,8 +555,14 @@ def main(argv: List[str]) -> int:
     max_queue_rows (0 = unbounded; over-limit requests are shed with 503
     + Retry-After), deadline_ms (0 = none; slow requests fail with 504),
     slo_latency_ms (re-declares the serve/latency_p99 threshold for this
-    deployment), num_iteration (-1: all).  Multiple model files register
-    under their basenames.
+    deployment), num_iteration (-1: all), port_file (announce the bound
+    port by atomic write — the fleet supervisor's discovery channel for
+    port=0 workers).  Multiple model files register under their
+    basenames.
+
+    SIGTERM/SIGINT drain the server (stop accepting, fail queued
+    futures with ServerClosed, finish in-flight requests) and exit
+    ``128+signum``; a repeat signal aborts immediately.
     """
     from ..utils.backend import default_backend
     from ..utils.log import log_fatal
@@ -490,6 +604,15 @@ def main(argv: List[str]) -> int:
         batching=_parse_bool(kv.get("batching"), True),
         max_queue_rows=int(kv.get("max_queue_rows", 0)),
         deadline_ms=float(kv.get("deadline_ms", 0.0)))
+    if kv.get("port_file"):
+        # atomic announce AFTER the bind: a supervisor polling this file
+        # can only ever read a complete, live port
+        from ..io_utils import atomic_write_bytes
+        atomic_write_bytes(kv["port_file"], f"{srv.port}\n".encode())
+    try:
+        srv.install_signal_handlers()
+    except ValueError:
+        pass  # not the main thread (embedded run); signals stay default
     log_info(f"serve: listening on http://{srv.host}:{srv.port} "
              f"(models: {', '.join(registry.names())})")
     try:
@@ -497,4 +620,10 @@ def main(argv: List[str]) -> int:
     except KeyboardInterrupt:
         log_info("serve: shutting down")
         srv.shutdown()
+        return 0
+    if srv.signal_received is not None:
+        # accept loop already stopped by the handler; finish the drain
+        srv.drain()
+        log_info(f"serve: drained after signal {srv.signal_received}")
+        return 128 + int(srv.signal_received)
     return 0

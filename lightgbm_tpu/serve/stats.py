@@ -64,7 +64,8 @@ class ModelStats:
     WINDOW = 4096  # batch latencies kept for percentile estimates
 
     def __init__(self, model: Optional[str] = None,
-                 registry: Optional[MetricsRegistry] = None) -> None:
+                 registry: Optional[MetricsRegistry] = None,
+                 prime: bool = True) -> None:
         self.model = model if model is not None else "default"
         self._reg = registry if registry is not None else MetricsRegistry()
         fam = _metric_family(self._reg)
@@ -78,15 +79,21 @@ class ModelStats:
         self._req_latency = fam.req_latency
         self._queue_wait = fam.queue_wait
         self._device = fam.device
-        # touch this model's series so a fresh model scrapes as 0 rather
-        # than being absent until its first request
-        for c in (self._requests, self._rows, self._batches,
-                  self._recompiles, self._errors):
-            c.inc(0, model=self.model)
+        if prime:
+            self.prime_series()
         self.last_recompile_requests: tuple = ()
         # per-bucket hot-path handles for the three timing windows
         # (label resolution once per bucket, not once per request)
         self._timing_handles: Dict[str, tuple] = {}
+
+    def prime_series(self) -> None:
+        """Touch this model's series so it scrapes as 0 rather than
+        being absent until its first request.  ``ModelRegistry`` defers
+        this until a first load succeeds, so a failed load never leaves
+        phantom ``model=<name>`` series in the shared registry."""
+        for c in (self._requests, self._rows, self._batches,
+                  self._recompiles, self._errors):
+            c.inc(0, model=self.model)
 
     @property
     def registry(self) -> MetricsRegistry:

@@ -38,8 +38,8 @@ import time
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
-from .metrics import (MetricsRegistry, WindowedHistogram, default_registry,
-                      percentile)
+from .metrics import (Gauge, MetricsRegistry, WindowedHistogram,
+                      default_registry, percentile)
 
 __all__ = ["SLO", "slo", "slo_for", "all_slos", "remove_slo",
            "register_metric_ensurer", "ensure_metrics", "SloEngine",
@@ -52,20 +52,29 @@ class SLO:
 
     ``metric`` is the registry series the SLO is keyed to (the coverage
     lint validates it exists); ``kind`` is ``"ratio"`` (bad events over
-    a total, both counters) or ``"latency"`` (a windowed histogram whose
-    observations must stay under ``threshold_ms``).  ``target`` is the
-    good fraction (0.999 availability = 0.1% error budget).  For ratio
-    SLOs ``bad_labels`` selects the bad series of ``metric`` (label
-    values may be fnmatch patterns: ``{"code": "5*"}``) and
-    ``total_metric`` names the denominator counter.  For latency SLOs
-    every label combination of the histogram (e.g. each shape bucket)
-    is evaluated independently — one declaration covers the ladder."""
+    a total, both counters), ``"latency"`` (a windowed histogram whose
+    observations must stay under ``threshold_ms``), or ``"gauge_floor"``
+    (a gauge that must stay at or above ``floor`` — the fleet
+    supervision kind: every evaluation with ANY matching series below
+    the floor spends budget, so "no worker alive" burns exactly like
+    "every request 5xx").  ``target`` is the good fraction (0.999
+    availability = 0.1% error budget).  For ratio SLOs ``bad_labels``
+    selects the bad series of ``metric`` (label values may be fnmatch
+    patterns: ``{"code": "5*"}``) and ``total_metric`` names the
+    denominator counter.  For latency SLOs every label combination of
+    the histogram (e.g. each shape bucket) is evaluated independently —
+    one declaration covers the ladder.  Gauge-floor SLOs have a per
+    scrape error of 0 or 1, so declare them with a wide budget and low
+    burn thresholds (e.g. ``target=0.5, burn_fast=1.9``: a breach means
+    essentially EVERY fast-window scrape saw the gauge under its
+    floor)."""
 
     name: str
     metric: str
-    kind: str                        # "ratio" | "latency"
+    kind: str                        # "ratio" | "latency" | "gauge_floor"
     target: float
     threshold_ms: float = 0.0        # latency kind only
+    floor: float = 0.0               # gauge_floor kind only
     total_metric: str = ""           # ratio kind denominator
     bad_labels: Mapping[str, str] = field(default_factory=dict)
     labels: Mapping[str, str] = field(default_factory=dict)
@@ -90,7 +99,8 @@ _slos: Dict[str, SLO] = {}
 
 
 def slo(name: str, *, metric: str, kind: str, target: float,
-        threshold_ms: float = 0.0, total_metric: str = "",
+        threshold_ms: float = 0.0, floor: float = 0.0,
+        total_metric: str = "",
         bad_labels: Optional[Mapping[str, str]] = None,
         labels: Optional[Mapping[str, str]] = None,
         window_fast_s: float = 300.0, window_slow_s: float = 3600.0,
@@ -104,10 +114,12 @@ def slo(name: str, *, metric: str, kind: str, target: float,
     declared_in = ""
     if frame is not None and frame.f_back is not None:
         declared_in = frame.f_back.f_globals.get("__name__", "")
-    if kind not in ("ratio", "latency"):
-        raise ValueError(f"SLO kind must be ratio|latency, got {kind!r}")
+    if kind not in ("ratio", "latency", "gauge_floor"):
+        raise ValueError(f"SLO kind must be ratio|latency|gauge_floor, "
+                         f"got {kind!r}")
     s = SLO(name=name, metric=metric, kind=kind, target=float(target),
-            threshold_ms=float(threshold_ms), total_metric=total_metric,
+            threshold_ms=float(threshold_ms), floor=float(floor),
+            total_metric=total_metric,
             bad_labels=dict(bad_labels or {}), labels=dict(labels or {}),
             window_fast_s=float(window_fast_s),
             window_slow_s=float(window_slow_s),
@@ -397,6 +409,31 @@ class SloEngine:
                            "observations": pooled_n,
                            "series": per_series}}
 
+    def _eval_gauge_floor(self, s: SLO, now: float) -> Dict[str, Any]:
+        """Per-scrape binary error: 1.0 while any matching gauge series
+        sits below the declared floor, 0.0 otherwise.  No series yet ->
+        no data -> no burn (the tier hasn't reported; a fleet booting
+        must not page before its first supervision tick), exactly the
+        ratio kinds' idle rule."""
+        m = self.registry.get(s.metric)
+        values: List[float] = []
+        if isinstance(m, Gauge):
+            for lbl, val in m.series():
+                if _labels_match(lbl, s.labels) and \
+                        isinstance(val, (int, float)):
+                    values.append(float(val))
+        frac = 1.0 if values and min(values) < s.floor else 0.0
+        ring = self._samples.setdefault(s.name, [])
+        ring.append((now, frac))
+        self._trim(ring, now, s.window_slow_s * 1.25)
+        rf = self._latency_over(ring, now, s.window_fast_s)
+        rs = self._latency_over(ring, now, s.window_slow_s)
+        return {"error_ratio": {"fast": rf, "slow": rs},
+                "burn": {"fast": rf / s.budget, "slow": rs / s.budget},
+                "detail": {"floor": s.floor,
+                           "value": min(values) if values else None,
+                           "series": len(values)}}
+
     def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
         now = self._clock() if now is None else float(now)
         burn_g = self.registry.gauge(
@@ -409,8 +446,12 @@ class SloEngine:
         breached, fast_burning, degraded = [], [], []
         with self._lock:
             for name, s in sorted(all_slos().items()):
-                ev = (self._eval_ratio(s, now) if s.kind == "ratio"
-                      else self._eval_latency(s, now))
+                if s.kind == "ratio":
+                    ev = self._eval_ratio(s, now)
+                elif s.kind == "gauge_floor":
+                    ev = self._eval_gauge_floor(s, now)
+                else:
+                    ev = self._eval_latency(s, now)
                 bf, bs = ev["burn"]["fast"], ev["burn"]["slow"]
                 is_fast = bf >= s.burn_fast
                 is_breach = is_fast and bs >= s.burn_slow

@@ -5,6 +5,12 @@ uninterrupted run, and dump the resilience counters
 plus the outcome as JSON — uploaded as the CI ``chaos`` step's artifact so
 the recovery path is machine-tracked per push.
 
+The artifact also ships a serve-side ``fleet`` block: a 2-worker
+``FleetSupervisor`` cycle where ``serve_crash_after_n`` kills one worker
+mid-traffic, snapshotting the fleet restart/retry counters and the
+per-worker breaker table after recovery (informational — the BLOCKING
+fleet gate is the ``--fleet-chaos`` loadtest step; ``--fleet 0`` skips).
+
 Usage: python scripts/chaos_snapshot.py [--out recovery-telemetry.json]
 """
 
@@ -18,12 +24,88 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _fleet_chaos_block(repo: str) -> dict:
+    """One worker-kill/recover cycle on a 2-worker stub-model fleet;
+    returns the fleet restart/breaker telemetry for the artifact."""
+    import http.client
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve.fleet import FleetSupervisor
+    from lightgbm_tpu.serve.loadgen import metric_sum, parse_prometheus, \
+        scrape_metrics
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rng = np.random.RandomState(0)
+        X = rng.randn(400, 4).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float64)
+        p = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+        bst = lgb.train(p, lgb.Dataset(X, y, params=p), 5)
+        model_file = os.path.join(tmp, "fleet_model.txt")
+        bst.save_model(model_file)
+        fleet = FleetSupervisor(
+            [model_file], workers=2,
+            worker_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo},
+            worker_args={"warmup": "0", "max_wait_ms": "0.5"},
+            first_spawn_env={0: {"LGBM_TPU_FAULTS":
+                                 "serve_crash_after_n=8"}},
+            probe_interval_s=0.25, backoff_base_s=0.2,
+            backoff_max_s=1.0, startup_timeout_s=300.0,
+            run_dir=os.path.join(tmp, "fleet"))
+        fleet.start()
+        try:
+            body = json.dumps({"rows": X[:4].tolist()}).encode()
+            codes = {}
+            for _ in range(30):
+                conn = http.client.HTTPConnection(
+                    fleet.host, fleet.port, timeout=60)
+                try:
+                    conn.request("POST", "/predict", body, {
+                        "Content-Type": "application/json",
+                        "Content-Length": str(len(body))})
+                    code = conn.getresponse().status
+                    codes[code] = codes.get(code, 0) + 1
+                finally:
+                    conn.close()
+            deadline = time.time() + 20.0
+            recovered = False
+            while time.time() < deadline:
+                parsed = parse_prometheus(
+                    scrape_metrics(fleet.host, fleet.port))
+                if metric_sum(parsed,
+                              "lgbm_tpu_fleet_workers_alive") == 2:
+                    recovered = True
+                    break
+                time.sleep(0.25)
+            parsed = parse_prometheus(
+                scrape_metrics(fleet.host, fleet.port))
+            workers = {w.name: w.snapshot() for w in fleet.workers()}
+        finally:
+            fleet.shutdown()
+    return {
+        "ok": recovered and codes.get(200, 0) >= 28,
+        "recovered": recovered,
+        "client_codes": {str(k): v for k, v in sorted(codes.items())},
+        "fleet_restarts_total": metric_sum(
+            parsed, "lgbm_tpu_fleet_restarts_total"),
+        "fleet_retries_total": metric_sum(
+            parsed, "lgbm_tpu_fleet_retries_total"),
+        "fleet_workers_alive": metric_sum(
+            parsed, "lgbm_tpu_fleet_workers_alive"),
+        "fleet_workers_quarantined": metric_sum(
+            parsed, "lgbm_tpu_fleet_workers_quarantined"),
+        "workers": workers,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="recovery-telemetry.json")
     ap.add_argument("--flight-out", default="",
                     help="copy the crash's flight-recorder JSONL tape "
                          "here (CI artifact)")
+    ap.add_argument("--fleet", type=int, default=1,
+                    help="1 (default) also runs the serve-fleet "
+                         "worker-kill cycle; 0 skips it")
     args = ap.parse_args()
 
     import numpy as np
@@ -70,6 +152,20 @@ def main() -> int:
     bit_identical = resumed.model_to_string() == full.model_to_string()
     preds_equal = bool(np.array_equal(resumed.predict(X), full.predict(X)))
 
+    # serve-fleet worker-kill cycle: restart/breaker telemetry rides
+    # the same artifact (informational; the blocking fleet gate is the
+    # --fleet-chaos loadtest CI step)
+    fleet_block = None
+    if args.fleet:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        try:
+            fleet_block = _fleet_chaos_block(repo)
+        except Exception as exc:
+            print(f"chaos_snapshot: fleet block failed: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+            fleet_block = {"ok": False,
+                           "error": f"{type(exc).__name__}: {exc}"}
+
     snap = default_registry().snapshot()
     keep = ("checkpoint_write_seconds", "resume_total",
             "faults_injected_total")
@@ -82,6 +178,7 @@ def main() -> int:
         "flight_recorder_events": flight_events,
         "wall_seconds": round(time.time() - t0, 2),
         "metrics": {k: snap[k] for k in keep if k in snap},
+        "fleet": fleet_block,
     }
     with open(args.out, "w") as fh:
         json.dump(record, fh, indent=2)

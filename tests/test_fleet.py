@@ -1,0 +1,675 @@
+"""Serving-fleet tests (serve/fleet.py): supervision, dispatching and
+lifecycle against STUB workers (tier-1: plain-python subprocesses drive
+the full process-spawn / port-file / watchdog / breaker / retry
+machinery without a jax import), plus slow/chaos acceptance runs with
+REAL ``python -m lightgbm_tpu serve`` workers — dispatcher parity with
+a direct predictor, chaos-under-load recovery judged from fleet
+``/metrics``+``/slo`` scrapes only, the crash-loop breaker, and a
+zero-5xx rolling deploy under live load.
+"""
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve.fleet import FleetSupervisor
+from lightgbm_tpu.serve.loadgen import metric_sum, parse_prometheus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Minimal worker: answers the fleet's HTTP surface with deterministic
+# bodies, honors the chaos knobs through env vars, drains on SIGTERM
+# and exits 143 — every supervision path exercised without jax.
+STUB_WORKER = r'''
+import json, os, signal, sys, threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+PORT_FILE = sys.argv[1]
+WID = os.environ.get("STUB_WID", "?")
+CRASH_AFTER = int(os.environ.get("STUB_CRASH_AFTER", "0"))
+EXIT_FLAG = os.environ.get("STUB_EXIT_FLAG", "")
+STATUS = int(os.environ.get("STUB_STATUS", "200"))
+DROP_FIRST = int(os.environ.get("STUB_DROP_FIRST", "0"))
+MODELS_STATUS = int(os.environ.get("STUB_MODELS_STATUS", "200"))
+
+if EXIT_FLAG and os.path.exists(EXIT_FLAG):
+    sys.exit(7)          # crash-loop while the flag file exists
+
+count = [0]
+dropped = [0]
+models = {}
+
+class H(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    def log_message(self, *a): pass
+    def _reply(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply(200, {"status": "ok", "worker": WID})
+        elif self.path == "/models":
+            self._reply(200, {n: {"source": p} for n, p in models.items()})
+        elif self.path == "/slo":
+            self._reply(200, {"schema": "slo-report-v1", "ok": True,
+                              "worker": WID})
+        elif self.path == "/stats":
+            self._reply(200, {"requests": count[0]})
+        elif self.path == "/metrics":
+            body = ("lgbm_tpu_stub_requests_total %d\n"
+                    % count[0]).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._reply(404, {"error": "nope"})
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        req = json.loads(self.rfile.read(n)) if n else {}
+        if self.path == "/predict":
+            count[0] += 1
+            if CRASH_AFTER and count[0] > CRASH_AFTER:
+                os._exit(137)
+            if DROP_FIRST and dropped[0] < DROP_FIRST:
+                dropped[0] += 1
+                import socket as _s
+                try:
+                    self.connection.shutdown(_s.SHUT_RDWR)
+                except OSError:
+                    pass
+                self.close_connection = True
+                return
+            if STATUS != 200:
+                self._reply(STATUS, {"error": "injected 5xx"})
+                return
+            self._reply(200, {"worker": WID, "n": count[0],
+                              "deadline_ms": req.get("deadline_ms"),
+                              "predictions":
+                                  [0.5] * len(req.get("rows", []))})
+        elif self.path == "/models":
+            if MODELS_STATUS != 200:
+                self._reply(MODELS_STATUS, {"error": "injected load "
+                                                     "failure"})
+                return
+            models[req["name"]] = req["file"]
+            self._reply(200, {"model": req["name"]})
+        else:
+            self._reply(404, {"error": "nope"})
+
+srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+srv.daemon_threads = True
+
+def _term(signum, frame):
+    threading.Thread(target=srv.shutdown, daemon=True).start()
+
+signal.signal(signal.SIGTERM, _term)
+tmp = PORT_FILE + ".tmp"
+with open(tmp, "w") as fh:
+    fh.write(str(srv.server_address[1]))
+os.replace(tmp, PORT_FILE)
+srv.serve_forever()
+sys.exit(143)
+'''
+
+
+def _stub_fleet(tmp_path, workers=2, per_worker_env=None,
+                first_spawn_env=None, **kw):
+    stub = tmp_path / "stub_worker.py"
+    if not stub.exists():
+        stub.write_text(STUB_WORKER)
+    dummy_model = tmp_path / "model.txt"
+    if not dummy_model.exists():
+        dummy_model.write_text("stub")
+    per_env = {int(k): dict(v) for k, v in (per_worker_env or {}).items()}
+    for i in range(workers):
+        per_env.setdefault(i, {})
+        per_env[i].setdefault("STUB_WID", str(i))
+    defaults = dict(
+        probe_interval_s=0.1, probe_timeout_s=1.0, hang_probes=3,
+        breaker_failures=3, breaker_window_s=10.0,
+        breaker_halfopen_s=0.5, probe_ok_needed=2,
+        backoff_base_s=0.05, backoff_max_s=0.3,
+        startup_timeout_s=60.0, drain_timeout_s=10.0,
+        run_dir=str(tmp_path / "fleet-run"))
+    defaults.update(kw)
+    return FleetSupervisor(
+        [str(dummy_model)], workers=workers,
+        worker_cmd=lambda wid, port_file: [sys.executable, str(stub),
+                                           port_file],
+        per_worker_env=per_env, first_spawn_env=first_spawn_env,
+        **defaults)
+
+
+def _post(host, port, path, payload, headers=None, timeout=30):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        body = json.dumps(payload).encode()
+        conn.request("POST", path, body, {
+            "Content-Type": "application/json",
+            "Content-Length": str(len(body)), **(headers or {})})
+        r = conn.getresponse()
+        data = r.read()
+        return r.status, json.loads(data) if data else {}, \
+            dict(r.getheaders())
+    finally:
+        conn.close()
+
+
+def _get(host, port, path, timeout=30):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        data = r.read()
+        return r.status, data
+    finally:
+        conn.close()
+
+
+def _get_json(host, port, path, timeout=30):
+    status, data = _get(host, port, path, timeout=timeout)
+    return status, json.loads(data)
+
+
+def _scrape(fleet):
+    status, data = _get(fleet.host, fleet.port, "/metrics")
+    assert status == 200
+    return parse_prometheus(data.decode())
+
+
+def _wait_for(predicate, timeout=20.0, interval=0.05, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+# ---------------------------------------------------------------------------
+# tier-1: stub workers through the full supervision/dispatch machinery
+# ---------------------------------------------------------------------------
+
+def test_fleet_round_robin_and_deadline_decrement(tmp_path):
+    """Health-weighted round-robin spreads traffic over both workers,
+    the dispatch hop decrements deadline_ms before forwarding, and the
+    X-Request-Id is echoed end to end."""
+    fleet = _stub_fleet(tmp_path, workers=2).start()
+    try:
+        seen = set()
+        for i in range(8):
+            status, body, headers = _post(
+                fleet.host, fleet.port, "/predict",
+                {"rows": [[1.0, 2.0]], "deadline_ms": 5000},
+                headers={"X-Request-Id": f"rr-{i}"})
+            assert status == 200, body
+            seen.add(body["worker"])
+            assert 0 < body["deadline_ms"] < 5000
+            assert headers.get("X-Request-Id") == f"rr-{i}"
+        assert seen == {"0", "1"}, "round-robin never reached a worker"
+        status, health = _get_json(fleet.host, fleet.port, "/healthz")
+        assert status == 200 and health["status"] == "ok"
+        assert health["workers_alive"] == 2
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_crash_restart_under_traffic(tmp_path):
+    """A worker hard-killed mid-stream costs the client NOTHING: the
+    reset request is retried on the other worker, the supervisor
+    restarts the dead one, and the fleet metrics record both."""
+    fleet = _stub_fleet(
+        tmp_path, workers=2,
+        first_spawn_env={0: {"STUB_CRASH_AFTER": "3"}}).start()
+    try:
+        for i in range(20):
+            status, body, _ = _post(fleet.host, fleet.port, "/predict",
+                                    {"rows": [[1.0]]})
+            assert status == 200, (i, body)
+        _wait_for(lambda: all(w.state == "alive"
+                              for w in fleet.workers()),
+                  desc="both workers alive again")
+        parsed = _scrape(fleet)
+        assert metric_sum(parsed, "lgbm_tpu_fleet_restarts_total") >= 1
+        assert metric_sum(parsed, "lgbm_tpu_fleet_retries_total") >= 1
+        assert metric_sum(parsed, "lgbm_tpu_fleet_workers_alive") == 2
+        # replacement worker boots WITHOUT the first-spawn chaos env
+        for i in range(10):
+            status, _, _ = _post(fleet.host, fleet.port, "/predict",
+                                 {"rows": [[1.0]]})
+            assert status == 200
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_crash_loop_breaker_and_half_open(tmp_path):
+    """K failures in the window open the breaker: the worker is
+    quarantined instead of restart-storming, /predict fast-fails 503 +
+    Retry-After, /healthz goes degraded; once the fault clears, the
+    half-open probe restores the worker and closes the breaker."""
+    flag = tmp_path / "crash.flag"
+    fleet = _stub_fleet(
+        tmp_path, workers=1,
+        per_worker_env={0: {"STUB_EXIT_FLAG": str(flag)}}).start()
+    try:
+        flag.write_text("on")          # every respawn now dies at boot
+        w = fleet.workers()[0]
+        assert w.proc is not None
+        w.proc.kill()                  # trigger the first failure
+        _wait_for(lambda: w.state == "quarantined",
+                  desc="breaker open")
+        assert len(w.fail_times) >= 3  # K failures, then no storm
+        restarts_at_open = w.restarts
+        status, body, headers = _post(fleet.host, fleet.port,
+                                      "/predict", {"rows": [[1.0]]})
+        assert status == 503
+        assert "Retry-After" in headers
+        status, health = _get_json(fleet.host, fleet.port, "/healthz")
+        assert health["status"] == "degraded"
+        assert any("breaker" in r for r in health["reasons"])
+        parsed = _scrape(fleet)
+        assert metric_sum(parsed,
+                          "lgbm_tpu_fleet_workers_quarantined") == 1
+        assert metric_sum(parsed, "lgbm_tpu_fleet_workers_alive") == 0
+
+        flag.unlink()                  # fault cleared: half-open probe
+        _wait_for(lambda: w.state == "alive" and not w.probing and
+                  len(w.fail_times) == 0,
+                  desc="breaker closed after a clean probe")
+        assert w.restarts <= restarts_at_open + 2   # probe, not storm
+        status, body, _ = _post(fleet.host, fleet.port, "/predict",
+                                {"rows": [[1.0]]})
+        assert status == 200 and body["worker"] == "0"
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_5xx_forwarded_never_retried(tmp_path):
+    """A 5xx that REACHED a predictor is the worker's answer — the
+    dispatcher forwards it verbatim and spends no retry budget on it."""
+    fleet = _stub_fleet(
+        tmp_path, workers=2,
+        per_worker_env={0: {"STUB_STATUS": "500"}}).start()
+    try:
+        codes = []
+        for _ in range(8):
+            status, _, _ = _post(fleet.host, fleet.port, "/predict",
+                                 {"rows": [[1.0]]})
+            codes.append(status)
+        assert 500 in codes and 200 in codes, codes
+        parsed = _scrape(fleet)
+        assert metric_sum(parsed, "lgbm_tpu_fleet_retries_total") == 0
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_dropped_connection_retried_on_other_worker(tmp_path):
+    """A connection severed before any response (the serve_drop_conn
+    class) is retried against a DIFFERENT worker inside the budget —
+    the client sees one 200, the fleet counts one retry."""
+    fleet = _stub_fleet(
+        tmp_path, workers=2,
+        per_worker_env={0: {"STUB_DROP_FIRST": "1"},
+                        1: {"STUB_WID": "1"}}).start()
+    try:
+        outcomes = []
+        for _ in range(6):
+            status, body, _ = _post(fleet.host, fleet.port, "/predict",
+                                    {"rows": [[1.0]]})
+            outcomes.append((status, body.get("worker")))
+        assert all(s == 200 for s, _ in outcomes), outcomes
+        parsed = _scrape(fleet)
+        assert metric_sum(parsed, "lgbm_tpu_fleet_retries_total") >= 1
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_metrics_and_slo_aggregate_worker_scrapes(tmp_path):
+    """Fleet /metrics carries the supervision series AND each worker's
+    scrape re-labeled worker=wN; /slo wraps the fleet verdict with the
+    per-worker reports."""
+    fleet = _stub_fleet(tmp_path, workers=2).start()
+    try:
+        _post(fleet.host, fleet.port, "/predict", {"rows": [[1.0]]})
+        parsed = _scrape(fleet)
+        assert metric_sum(parsed, "lgbm_tpu_fleet_workers_alive") == 2
+        assert metric_sum(
+            parsed, "lgbm_tpu_serve_predict_responses_total",
+            code="200") >= 1
+        per_worker = parsed.get("lgbm_tpu_worker_stub_requests_total",
+                                [])
+        assert {lbl.get("worker") for lbl, _ in per_worker} == \
+            {"w0", "w1"}
+        # declared fleet SLOs evaluate against the fleet registry
+        assert metric_sum(parsed, "lgbm_tpu_slo_burn_rate",
+                          slo="fleet/workers_alive", window="fast") == 0
+        status, slo_rep = _get_json(fleet.host, fleet.port, "/slo")
+        assert status == 200
+        assert slo_rep["schema"] == "fleet-slo-report-v1"
+        assert slo_rep["ok"] is True
+        assert set(slo_rep["workers"]) == {"w0", "w1"}
+        names = {s["name"] for s in slo_rep["fleet"]["slos"]}
+        assert {"fleet/workers_alive", "fleet/retry_rate"} <= names
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_rolling_deploy_stub_order_and_abort(tmp_path):
+    """The roll walks workers in order; a worker that rejects the new
+    version aborts the roll with the already-swapped workers reported
+    (its own old version was never touched — registry load fails before
+    any swap)."""
+    new_file = tmp_path / "model_v2.txt"
+    new_file.write_text("stub v2")
+    fleet = _stub_fleet(tmp_path, workers=2).start()
+    try:
+        status, report, _ = _post(fleet.host, fleet.port, "/models",
+                                  {"name": "m", "file": str(new_file)})
+        assert status == 200, report
+        assert report["verdict"] == "deployed"
+        assert report["deployed"] == ["w0", "w1"]
+    finally:
+        fleet.shutdown()
+
+    fleet = _stub_fleet(tmp_path, workers=2,
+                        per_worker_env={
+                            1: {"STUB_MODELS_STATUS": "500"}}).start()
+    try:
+        status, report, _ = _post(fleet.host, fleet.port, "/models",
+                                  {"name": "m", "file": str(new_file)})
+        assert status == 409
+        assert report["verdict"] == "aborted"
+        assert report["deployed"] == ["w0"]
+        assert "w1" in report["error"]
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_deploy_survives_worker_respawn(tmp_path):
+    """A deployed version whose file name does not spell the logical
+    model name must still be served by a crash-restarted worker: the
+    supervisor records the deploy in _current_models (new names too)
+    and catches the respawned worker up over POST /models — without
+    this, the first crash after a deploy serves 404s for the deployed
+    name."""
+    stub = tmp_path / "stub_worker.py"
+    stub.write_text(STUB_WORKER)
+    model_a = tmp_path / "m_a.txt"
+    model_a.write_text("stub a")
+    model_b = tmp_path / "m_b.txt"
+    model_b.write_text("stub b")
+    v2 = tmp_path / "m_a_v2.txt"      # renamed source: basename-derived
+    v2.write_text("stub a v2")        # name "m_a_v2" != logical "m_a"
+    fleet = FleetSupervisor(
+        [str(model_a), str(model_b)], workers=2,
+        worker_cmd=lambda wid, port_file: [sys.executable, str(stub),
+                                           port_file],
+        per_worker_env={0: {"STUB_WID": "0"}, 1: {"STUB_WID": "1"}},
+        probe_interval_s=0.1, backoff_base_s=0.05, backoff_max_s=0.3,
+        breaker_failures=5, breaker_window_s=10.0,
+        startup_timeout_s=60.0, drain_timeout_s=10.0,
+        run_dir=str(tmp_path / "fleet-run")).start()
+    try:
+        status, report, _ = _post(fleet.host, fleet.port, "/models",
+                                  {"name": "m_a", "file": str(v2)})
+        assert status == 200 and report["verdict"] == "deployed", report
+        # kill w0; the respawned stub boots with an empty model table
+        w0 = fleet.workers()[0]
+        first_pid = w0.proc.pid
+        w0.proc.kill()
+        _wait_for(lambda: w0.state == "alive" and
+                  w0.proc.pid != first_pid and
+                  w0.synced_incarnation == w0.incarnation,
+                  desc="w0 respawned and model-synced")
+        status, models = _get_json(fleet.host, fleet.port, "/models")
+        assert status == 200
+        assert models["w0"].get("m_a", {}).get("source") == str(v2), \
+            models["w0"]
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_shutdown_is_a_rolling_drain(tmp_path):
+    """shutdown() SIGTERMs workers one at a time; each drains and exits
+    143 (128+SIGTERM), and the dispatcher socket closes last."""
+    fleet = _stub_fleet(tmp_path, workers=2).start()
+    port = fleet.port
+    procs = [w.proc for w in fleet.workers()]
+    fleet.shutdown()
+    for p in procs:
+        assert p is not None and p.poll() == 143, \
+            f"worker exit code {p.poll() if p else None}"
+    with pytest.raises(OSError):
+        _get(fleet.host, port, "/healthz", timeout=2)
+    fleet.shutdown()   # idempotent
+
+
+# ---------------------------------------------------------------------------
+# slow/chaos: real `python -m lightgbm_tpu serve` workers
+# ---------------------------------------------------------------------------
+
+SMALL = {"num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1}
+
+
+@pytest.fixture(scope="module")
+def fleet_booster(binary_data):
+    X, y = binary_data
+    p = {**SMALL, "objective": "binary"}
+    return lgb.train(p, lgb.Dataset(X, y, params=p), 15)
+
+
+def _real_fleet(tmp_path, model_file, workers=2, **kw):
+    defaults = dict(
+        worker_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO},
+        worker_args={"warmup": "0", "max_wait_ms": "0.5"},
+        probe_interval_s=0.25, probe_timeout_s=5.0,
+        breaker_failures=3, breaker_window_s=20.0,
+        breaker_halfopen_s=1.0,
+        backoff_base_s=0.2, backoff_max_s=1.0,
+        startup_timeout_s=180.0, drain_timeout_s=30.0,
+        forward_timeout_s=60.0,
+        run_dir=str(tmp_path / "fleet-run"))
+    defaults.update(kw)
+    return FleetSupervisor([model_file], workers=workers, **defaults)
+
+
+@pytest.mark.slow
+def test_fleet_parity_with_direct_predictor(tmp_path, binary_data,
+                                            fleet_booster):
+    """Acceptance: predictions routed through the dispatcher are
+    bit-identical to a direct single-worker PredictionServer and to
+    Booster.predict, across bucket boundaries (floats round-trip JSON
+    via repr, so equality is exact)."""
+    from lightgbm_tpu.serve import ModelRegistry, PredictionServer
+    X, _ = binary_data
+    model_file = str(tmp_path / "model.txt")
+    fleet_booster.save_model(model_file)
+    reg = ModelRegistry()
+    reg.load("model", model_file, warmup=False)
+    direct = PredictionServer(reg, port=0, max_wait_ms=0.5).start()
+    fleet = _real_fleet(tmp_path, model_file, workers=2).start()
+    try:
+        rng = np.random.RandomState(0)
+        for n in (1, 7, 8, 9, 511, 513):
+            Xq = rng.randn(n, X.shape[1]).astype(np.float32)
+            ref = fleet_booster.predict(Xq).tolist()
+            st_f, body_f, _ = _post(fleet.host, fleet.port, "/predict",
+                                    {"rows": Xq.tolist()}, timeout=120)
+            st_d, body_d, _ = _post(direct.host, direct.port,
+                                    "/predict", {"rows": Xq.tolist()},
+                                    timeout=120)
+            assert st_f == 200 and st_d == 200
+            assert body_f["predictions"] == ref, f"n={n}: fleet drift"
+            assert body_d["predictions"] == ref, f"n={n}: direct drift"
+    finally:
+        fleet.shutdown()
+        direct.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_chaos_under_load(tmp_path, fleet_booster):
+    """Acceptance: a 4-worker fleet under loadgen traffic survives
+    repeated worker kills — every client request gets a terminal
+    response, the fleet returns to full strength, and the verdict
+    (availability SLO met after the recovery window, restarts recorded)
+    is read from fleet /metrics + /slo scrapes only."""
+    from lightgbm_tpu.serve.loadgen import LoadGenerator, LoadSpec
+    model_file = str(tmp_path / "model.txt")
+    fleet_booster.save_model(model_file)
+    fleet = _real_fleet(tmp_path, model_file, workers=4).start()
+    try:
+        spec = LoadSpec(duration_s=6.0, target_qps=40.0, workers=2,
+                        features=6, bucket_mix={8: 1.0}, seed=3,
+                        timeout_s=30.0)
+        gen = LoadGenerator(fleet.host, fleet.port, spec)
+        kills = []
+
+        def killer():
+            for i in (0, 2):
+                time.sleep(1.5)
+                w = fleet.workers()[i]
+                if w.proc is not None and w.proc.poll() is None:
+                    w.proc.kill()
+                    kills.append(w.name)
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        client = gen.run()
+        kt.join(20)
+        assert len(kills) == 2
+        # every request the generator fired reached a terminal outcome
+        # (a code or a counted connection failure) — no hangs
+        terminal = sum(client.by_code.values()) + client.connect_errors
+        assert terminal == client.requests_sent
+        assert client.by_code.get(200, 0) > 0
+        # recovery: full strength within the recovery window
+        _wait_for(lambda: all(w.state == "alive"
+                              for w in fleet.workers()),
+                  timeout=60.0, desc="fleet back to 4 alive workers")
+        # the verdict inputs: fleet scrapes only
+        parsed = _scrape(fleet)
+        assert metric_sum(parsed, "lgbm_tpu_fleet_restarts_total") >= 2
+        assert metric_sum(parsed, "lgbm_tpu_fleet_workers_alive") == 4
+        total = metric_sum(parsed,
+                           "lgbm_tpu_serve_predict_responses_total")
+        bad = sum(metric_sum(parsed,
+                             "lgbm_tpu_serve_predict_responses_total",
+                             code=c) for c in ("500", "502", "503",
+                                               "504"))
+        assert total > 0
+        assert bad / total <= 0.05, (bad, total)
+        status, slo_rep = _get_json(fleet.host, fleet.port, "/slo")
+        assert status == 200 and slo_rep["ok"] is True, slo_rep
+    finally:
+        fleet.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_breaker_quarantines_crash_looping_worker(tmp_path,
+                                                        fleet_booster):
+    """Acceptance: a worker armed to crash on (almost) every request —
+    serve_crash_after_n on EVERY incarnation — opens the breaker within
+    K failures instead of restart-storming, while the healthy worker
+    keeps answering."""
+    model_file = str(tmp_path / "model.txt")
+    fleet_booster.save_model(model_file)
+    fleet = _real_fleet(
+        tmp_path, model_file, workers=2,
+        breaker_halfopen_s=300.0,   # keep it open for the assertion
+        per_worker_env={1: {"LGBM_TPU_FAULTS":
+                            "serve_crash_after_n=1"}}).start()
+    try:
+        w1 = fleet.workers()[1]
+        deadline = time.monotonic() + 120.0
+        while w1.state != "quarantined" and time.monotonic() < deadline:
+            status, _, _ = _post(fleet.host, fleet.port, "/predict",
+                                 {"rows": [[0.0] * 6]}, timeout=60)
+            assert status in (200, 502), status
+            time.sleep(0.05)
+        assert w1.state == "quarantined", w1.snapshot()
+        # breaker, not a storm: K failures -> quarantine, restarts
+        # bounded by K (plus the initial spawn)
+        assert w1.restarts <= 3, w1.snapshot()
+        parsed = _scrape(fleet)
+        assert metric_sum(parsed,
+                          "lgbm_tpu_fleet_workers_quarantined") == 1
+        status, health = _get_json(fleet.host, fleet.port, "/healthz")
+        assert health["status"] == "degraded"
+        # the healthy worker still answers
+        status, _, _ = _post(fleet.host, fleet.port, "/predict",
+                             {"rows": [[0.0] * 6]}, timeout=60)
+        assert status == 200
+    finally:
+        fleet.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_rolling_deploy_zero_5xx_under_load(tmp_path, binary_data,
+                                                  fleet_booster):
+    """Acceptance: hot-swapping a model version across the fleet under
+    live loadgen traffic serves ZERO 5xx attributable to the deploy —
+    old or new version answers every request during the roll — and the
+    fleet serves the new version afterwards."""
+    from lightgbm_tpu.serve.loadgen import LoadGenerator, LoadSpec
+    X, y = binary_data
+    p = {**SMALL, "objective": "binary"}
+    b2 = lgb.train(p, lgb.Dataset(X, y, params=p), 9)
+    model_file = str(tmp_path / "model.txt")
+    v2_file = str(tmp_path / "model_v2.txt")
+    fleet_booster.save_model(model_file)
+    b2.save_model(v2_file)
+    fleet = _real_fleet(tmp_path, model_file, workers=2).start()
+    try:
+        spec = LoadSpec(duration_s=5.0, target_qps=30.0, workers=2,
+                        features=6, bucket_mix={8: 1.0}, seed=5)
+        gen = LoadGenerator(fleet.host, fleet.port, spec)
+        deploy_result = {}
+
+        def deployer():
+            time.sleep(1.5)
+            status, report, _ = _post(
+                fleet.host, fleet.port, "/models",
+                {"name": "model", "file": v2_file}, timeout=120)
+            deploy_result["status"] = status
+            deploy_result["report"] = report
+
+        dt = threading.Thread(target=deployer, daemon=True)
+        dt.start()
+        client = gen.run()
+        dt.join(120)
+        assert deploy_result.get("status") == 200, deploy_result
+        assert deploy_result["report"]["verdict"] == "deployed"
+        assert deploy_result["report"]["deployed"] == ["w0", "w1"]
+        # zero 5xx through the roll, client side AND fleet side
+        bad_client = sum(v for c, v in client.by_code.items()
+                         if c >= 500)
+        assert bad_client == 0 and client.connect_errors == 0, \
+            client.summary()
+        parsed = _scrape(fleet)
+        bad = sum(metric_sum(parsed,
+                             "lgbm_tpu_serve_predict_responses_total",
+                             code=c) for c in ("500", "502", "503",
+                                               "504"))
+        assert bad == 0
+        # the fleet now answers with the NEW version
+        ref = b2.predict(X[:1]).tolist()
+        status, body, _ = _post(fleet.host, fleet.port, "/predict",
+                                {"rows": X[:1].tolist()}, timeout=60)
+        assert status == 200 and body["predictions"] == ref
+    finally:
+        fleet.shutdown()

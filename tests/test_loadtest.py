@@ -44,6 +44,64 @@ def test_parse_prometheus_text():
     assert "garbage" not in parsed
 
 
+def test_loadgen_survives_worker_restarts():
+    """Satellite: connection errors count as failed requests in the
+    client tally (connect_errors) instead of aborting the generator
+    thread, one bounded reconnect retries the request on a fresh
+    socket, and the connect/read timeout is configurable per spec."""
+    import json as _json
+    import socket
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from lightgbm_tpu.serve.loadgen import LoadGenerator, LoadSpec
+
+    hits = [0]
+
+    class Flaky(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            hits[0] += 1
+            if hits[0] % 5 == 0:      # sever every 5th connection
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self.close_connection = True
+                return
+            body = _json.dumps({"predictions": [0.0]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Flaky)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        spec = LoadSpec(duration_s=1.0, target_qps=60.0, workers=2,
+                        features=3, bucket_mix={1: 1.0}, timeout_s=5.0)
+        gen = LoadGenerator("127.0.0.1", srv.server_address[1], spec)
+        res = gen.run()
+        # the generator survived every severed connection: requests
+        # kept flowing after the resets, each one reached a terminal
+        # outcome (a code or a connect_error after the one retry)
+        assert res.requests_sent > 20
+        assert res.by_code.get(200, 0) > 10
+        assert sum(res.by_code.values()) + res.connect_errors == \
+            res.requests_sent
+        assert res.summary()["connect_errors"] == res.connect_errors
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 # ---------------------------------------------------------------------------
 # batcher saturation gauges + timing split
 # ---------------------------------------------------------------------------
@@ -178,6 +236,34 @@ def test_loadtest_e2e_verdict_from_scrapes():
     assert "loadtest_closed" in names and "loadtest_slo" in names
     assert "loadtest_closed_qps" in names   # qps judged on its own row
     assert any(n.startswith("loadtest_closed_p99_b") for n in names)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_chaos_harness_verdict_from_scrapes():
+    """The CI fleet-chaos smoke: serve_crash_after_n kills one worker
+    under loadgen traffic, and the pass verdict (crashed + recovered +
+    availability SLO met + every request terminal) comes exclusively
+    from fleet /metrics + /slo scrapes."""
+    bench_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks")
+    sys.path.insert(0, bench_dir)
+    try:
+        import loadtest
+        report = loadtest.run_fleet_chaos(
+            workers=2, duration_s=4.0, qps=25.0, crash_after=15,
+            recovery_window_s=20.0)
+        assert report["verdict"] == "pass", report
+        assert report["crashed"] and report["recovered"]
+        assert report["slo_ok"] and report["all_requests_terminal"]
+        assert report["fleet_restarts_total"] >= 1
+        assert report["verdict_source"] == \
+            "fleet /metrics + /slo scrapes only"
+        rec = loadtest.fleet_chaos_to_bench_matrix(report)
+        names = [r["name"] for r in rec["rows"]]
+        assert "fleet_chaos" in names and "fleet_chaos_slo" in names
+    finally:
+        sys.path.remove(bench_dir)
 
 
 @pytest.mark.slow

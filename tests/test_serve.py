@@ -300,6 +300,88 @@ def test_registry_swap_keeps_stats(booster):
     assert reg.stats()["m"]["batches"] == before
 
 
+def test_registry_load_failure_mid_hot_swap_leaves_old_serving(
+        tmp_path, binary_data, booster):
+    """A corrupt source mid-hot-swap surfaces the typed error and
+    leaves the OLD predictor serving untouched — same version, same
+    stats, never a torn or evicted entry."""
+    from lightgbm_tpu.models.model_text import ModelCorruptError
+    X, _ = binary_data
+    good = str(tmp_path / "good.txt")
+    booster.save_model(good)
+    corrupt = str(tmp_path / "corrupt.txt")
+    with open(good) as fh:
+        text = fh.read()
+    with open(corrupt, "w") as fh:
+        fh.write(text[: len(text) // 3])        # truncated mid-field
+    reg = ModelRegistry()
+    reg.load("m", good, warmup=False)
+    ref = reg.get("m").predict(X[:5])
+    reg.get("m").predict(X[:5])
+    batches_before = reg.stats()["m"]["batches"]
+    with pytest.raises(ModelCorruptError):
+        reg.load("m", corrupt, warmup=False)
+    # old version intact: same predictions, same version, same source,
+    # stats still accumulating on the same series
+    assert np.array_equal(reg.get("m").predict(X[:5]), ref)
+    info = reg.info()["m"]
+    assert info["version"] == 1 and info["source"] == good
+    reg.get("m").predict(X[:5])
+    # two predicts since the failed swap (the parity check + this one)
+    # landed on the SAME stats series — nothing was torn or reset
+    assert reg.stats()["m"]["batches"] == batches_before + 2
+    # a failed FIRST load leaves no phantom entry behind
+    reg2 = ModelRegistry()
+    with pytest.raises(ModelCorruptError):
+        reg2.load("x", corrupt, warmup=False)
+    assert reg2.names() == [] and reg2.stats() == {}
+    reg2.load("x", good, warmup=False)          # name still usable
+    assert reg2.info()["x"]["version"] == 1
+
+
+def test_shutdown_drain_exactly_one_terminal_response(tmp_path,
+                                                      binary_data,
+                                                      booster):
+    """Satellite: a queued request racing PredictionServer.shutdown()
+    gets exactly one terminal response — a result, or a typed 5xx from
+    the ServerClosed/draining path — never a hung future."""
+    import http.client
+    X, _ = binary_data
+    reg = _slow_registry(tmp_path, booster, delay=0.15)
+    srv = PredictionServer(reg, port=0, max_wait_ms=0.5,
+                           max_batch_rows=1).start()
+    row = X[0].tolist()
+    results = []
+    lock = threading.Lock()
+
+    def hit():
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=60)
+        try:
+            out = _post(conn, "/predict", {"rows": [row]})
+        except Exception as exc:      # severed mid-drain: terminal too
+            out = ("conn_error", type(exc).__name__)
+        with lock:
+            results.append(out)
+        conn.close()
+
+    threads = [threading.Thread(target=hit) for _ in range(8)]
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(0.2)            # some requests queued, one on device
+    srv.shutdown()
+    for t in threads:
+        t.join(30)
+    assert not any(t.is_alive() for t in threads), \
+        "a request hung through shutdown"
+    assert len(results) == 8   # every request got a terminal outcome
+    statuses = [r[0] for r in results]
+    assert all(s in (200, 503, 504, "conn_error") for s in statuses), \
+        statuses
+    assert statuses.count(200) >= 1  # in-flight work completed
+
+
 # -- end-to-end HTTP --------------------------------------------------------
 def _post(conn, path, payload):
     conn.request("POST", path, json.dumps(payload),
@@ -427,9 +509,13 @@ def _slow_registry(tmp_path, booster, delay):
     pred = reg.get("model")
     orig = pred.predict
 
-    def slow_predict(X, raw_score=False):
+    def slow_predict(X, raw_score=False, request_ids=()):
+        # keep the real predict's signature: the batcher propagates
+        # request_ids into predictors that accept them (PR 14), and a
+        # patched predict without the kwarg turns every batch into a
+        # TypeError 400
         time.sleep(delay)
-        return orig(X, raw_score=raw_score)
+        return orig(X, raw_score=raw_score, request_ids=request_ids)
     pred.predict = slow_predict
     return reg
 
@@ -508,6 +594,59 @@ def test_serve_deadline_504(tmp_path, binary_data, booster):
         assert status == 200
     finally:
         srv.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_serve_sigterm_drains_and_exits_128_plus_signum(tmp_path,
+                                                        booster,
+                                                        binary_data):
+    """Satellite: the serve CLI handles SIGTERM like training's
+    PreemptionGuard — stop accepting, drain, exit 128+15 — and
+    announces its port through port_file (the fleet supervisor's
+    discovery channel)."""
+    import http.client
+    import signal as _signal
+    import subprocess
+    import time
+    X, _ = binary_data
+    model_file = str(tmp_path / "model.txt")
+    booster.save_model(model_file)
+    port_file = str(tmp_path / "serve.port")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu", "serve", model_file,
+         "port=0", "warmup=0", f"port_file={port_file}"],
+        cwd=repo, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 120
+        port = None
+        while time.monotonic() < deadline and port is None:
+            try:
+                with open(port_file) as fh:
+                    port = int(fh.read().strip())
+            except (OSError, ValueError):
+                time.sleep(0.05)
+        assert port, "port_file never appeared"
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        status, body = _post(conn, "/predict", {"row": X[0].tolist()})
+        assert status == 200
+        conn.close()
+        proc.send_signal(_signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        assert rc == 128 + 15, f"exit code {rc}"
+        # the socket is gone: a late request is refused, not hung
+        with pytest.raises(OSError):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=5)
+            conn.request("GET", "/healthz")
+            conn.getresponse()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(30)
 
 
 def test_healthz_degraded_on_cpu_fallback(tmp_path, booster, monkeypatch):

@@ -138,6 +138,49 @@ def test_latency_slo_per_bucket_series():
         remove_slo("test/lat")
 
 
+def test_gauge_floor_slo_burns_while_below_floor():
+    """The fleet supervision kind: a gauge under its floor spends
+    budget per scrape; recovery + no-data scrapes decay the burn."""
+    reg = MetricsRegistry()
+    g = reg.gauge("t_workers_alive")
+    slo("test/floor", metric="t_workers_alive", kind="gauge_floor",
+        floor=1.0, target=0.5, window_fast_s=60, window_slow_s=600,
+        burn_fast=1.9, burn_slow=1.5)
+    try:
+        clk = _Clock()
+        eng = SloEngine(registry=reg, sustain=2, clock=clk)
+        # no series yet: a booting fleet must not page
+        r = eng.evaluate()
+        v = next(s for s in r["slos"] if s["name"] == "test/floor")
+        assert v["ok"] and v["burn"]["fast"] == 0.0
+        assert v["detail"]["value"] is None
+
+        g.set(2.0)
+        clk.t = 10.0
+        r = eng.evaluate()
+        v = next(s for s in r["slos"] if s["name"] == "test/floor")
+        assert v["ok"] and v["burn"]["fast"] == 0.0
+
+        # the whole fleet down: every scrape errors -> burn 2.0 over
+        # the 0.5 budget, breaching both windows once the down scrapes
+        # fill the fast window
+        g.set(0.0)
+        for clk.t in (20.0, 30.0, 40.0, 50.0, 60.0, 70.0, 80.0, 90.0):
+            r = eng.evaluate()
+        v = next(s for s in r["slos"] if s["name"] == "test/floor")
+        assert v["burn"]["fast"] > 1.9 and v["breached"], v
+        assert v["detail"]["floor"] == 1.0 and v["detail"]["value"] == 0
+
+        # recovery: alive again, the hot samples age out of fast
+        g.set(2.0)
+        for clk.t in (100.0, 110.0, 160.0, 230.0):
+            r = eng.evaluate()
+        v = next(s for s in r["slos"] if s["name"] == "test/floor")
+        assert not v["breached"] and v["burn"]["fast"] < 1.9, v
+    finally:
+        remove_slo("test/floor")
+
+
 def test_exemplar_ring_keeps_worst_n():
     ring = ExemplarRing(capacity=4)
     for i in range(100):
@@ -158,7 +201,8 @@ def test_slo_coverage_clean_at_head():
     # the shipped objectives are all declared
     names = set(all_slos())
     assert {"serve/latency_p99", "serve/availability", "serve/shed_rate",
-            "serve/compiler_fallback_rate"} <= names
+            "serve/compiler_fallback_rate", "fleet/workers_alive",
+            "fleet/retry_rate"} <= names
 
 
 def test_planted_dangling_metric_fails_coverage():
@@ -189,6 +233,25 @@ def test_planted_bad_selector_and_kind_fail_coverage():
     finally:
         remove_slo("test/bad_label")
         remove_slo("test/bad_kind")
+
+
+def test_planted_gauge_floor_violations_fail_coverage():
+    from lightgbm_tpu.analysis.slo_cover import check_slo_coverage
+    # gauge_floor pointed at a counter
+    slo("test/floor_on_counter", metric="serve_requests_total",
+        kind="gauge_floor", floor=1.0, target=0.5)
+    # gauge_floor with no floor declared
+    slo("test/floor_zero", metric="fleet_workers_alive",
+        kind="gauge_floor", target=0.5)
+    try:
+        vs = check_slo_coverage()
+        by_site = {v.site: v.message for v in vs}
+        assert "needs a gauge" in by_site["test/floor_on_counter"]
+        assert "floor > 0" in by_site["test/floor_zero"]
+    finally:
+        remove_slo("test/floor_on_counter")
+        remove_slo("test/floor_zero")
+    assert check_slo_coverage() == []
 
 
 def test_lint_trace_report_carries_slo_section():
