@@ -36,7 +36,16 @@ request reached a terminal outcome — is computed solely from the fleet
 ``/metrics`` + ``/slo`` scrapes (env knobs: FLEET_WORKERS,
 FLEET_DURATION, FLEET_QPS, FLEET_CRASH_AFTER, FLEET_RECOVERY_S).
 
-Exit code: 0 on pass, 1 on breach/underrun — CI runs both modes
+``--refresh`` runs the model-refresh-under-load rung: the same
+per-round updates are deployed to a live server as wire deltas
+(``POST /models/<name>/delta``, in-envelope dense splices) and as full
+hot-swaps (``POST /models`` reload) while open-loop traffic flows; the
+verdict requires the delta lane to reach the head round with ZERO dense
+recompiles and both lanes to stay 5xx-free, and the per-lane p99 +
+recompile counts land in the bench matrix (env knobs: REFRESH_DURATION,
+REFRESH_QPS, REFRESH_BASE_ROUNDS, REFRESH_ROUNDS, REFRESH_SHARD).
+
+Exit code: 0 on pass, 1 on breach/underrun — CI runs all modes
 blocking, next to the chaos step.
 """
 
@@ -371,6 +380,227 @@ def run_fleet_chaos(workers: int = 2, duration_s: float = 8.0,
     }
 
 
+def _post_json(host: str, port: int, path: str, payload: dict,
+               timeout: float = 60.0):
+    import http.client
+    body = json.dumps(payload).encode()
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", path, body, {
+            "Content-Type": "application/json",
+            "Content-Length": str(len(body))})
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            return resp.status, json.loads(raw)
+        except ValueError:
+            return resp.status, {}
+    finally:
+        conn.close()
+
+
+def run_refresh_under_load(duration_s: float = 6.0, qps: float = 40.0,
+                           features: int = 6, base_rounds: int = 4,
+                           refresh_rounds: int = 4, shard: int = 16,
+                           leaves: int = 15, bucket_rows: int = 8,
+                           workers: int = 2):
+    """Model-refresh-under-load rung: the same per-round updates are
+    deployed to a live server two ways — appended as wire deltas
+    (``POST /models/<name>/delta``, in-envelope dense splices) and as
+    full-model hot-swaps (``POST /models`` reload) — while open-loop
+    traffic flows.  Reports deploy-attributable p99 and the recompile
+    count per mode; the verdict requires the delta lane to reach the
+    head round with ZERO dense recompiles and both lanes to stay 5xx-
+    free, proving live refresh is latency-neutral where the old swap
+    path pays a re-lower per round."""
+    import base64
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.models.model_text import model_to_string
+    from lightgbm_tpu.publish.delta import DeltaJournal
+    from lightgbm_tpu.serve.loadgen import (LoadGenerator, LoadSpec,
+                                            metric_sum, parse_prometheus,
+                                            scrape_metrics)
+    from lightgbm_tpu.serve.registry import ModelRegistry
+    from lightgbm_tpu.serve.server import PredictionServer
+    from lightgbm_tpu.utils.backend import default_backend
+    from lightgbm_tpu.utils.log import set_verbosity
+
+    backend = default_backend()
+    set_verbosity(-1)
+    total = base_rounds + refresh_rounds
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rng = np.random.RandomState(0)
+        X = rng.randn(2000, features).astype(np.float32)
+        y = (X[:, 0] + 0.3 * rng.randn(2000) > 0).astype(np.float64)
+        p = {"objective": "binary", "num_leaves": leaves, "verbosity": -1}
+        bst = lgb.train(p, lgb.Dataset(X, y, params=p), total)
+
+        # journal: BASE at base_rounds, one delta per later round; the
+        # full-swap lane replays the same rounds as folded text files
+        j = DeltaJournal(os.path.join(tmp, "journal"))
+        j.write_base(model_to_string(bst._gbdt, num_iteration=base_rounds),
+                     base_rounds)
+        for r in range(base_rounds + 1, total + 1):
+            j.append_delta(model_to_string(bst._gbdt, start_iteration=r - 1,
+                                           num_iteration=1), r)
+        base_path, base_round = j.base_entry()
+        records = list(j.records_after(base_round))
+        folded = {}
+        for r in range(base_rounds + 1, total + 1):
+            path = os.path.join(tmp, f"folded_{r}.txt")
+            with open(path, "w") as fh:
+                fh.write(model_to_string(bst._gbdt, num_iteration=r))
+            folded[r] = path
+
+        registry = ModelRegistry()
+        from lightgbm_tpu.telemetry.slo import SloEngine
+        srv = PredictionServer(registry, port=0, max_wait_ms=0.5,
+                               slo_engine=SloEngine()).start()
+        host, port = srv.host, srv.port
+        lanes = []
+        try:
+            for mode in ("delta", "full"):
+                name = f"refresh-{mode}"
+                # force the dense compiler: the rung measures the dense
+                # tree-axis splice, which the CPU cost model would
+                # otherwise trade away for walk mode on small models
+                registry.load(name, base_path, warmup=True,
+                              shard=int(shard), compiler="dense")
+                pred0 = registry.get(name)
+                r0 = pred0.stats.snapshot()["recompiles"]
+                spec = LoadSpec(duration_s=duration_s, target_qps=qps,
+                                workers=int(workers), features=features,
+                                bucket_mix={int(bucket_rows): 1.0},
+                                model=name, seed=2)
+                gen = LoadGenerator(host, port, spec)
+                interval = duration_s / (len(records) + 1)
+                applies = []
+
+                def refresher():
+                    # one refresh per interval, spread across the rung
+                    for i, rec in enumerate(records):
+                        time.sleep(interval)
+                        rnd = rec.round
+                        try:
+                            if mode == "delta":
+                                b64 = base64.b64encode(
+                                    rec.to_bytes()).decode()
+                                code, body = _post_json(
+                                    host, port, f"/models/{name}/delta",
+                                    {"record_b64": b64})
+                            else:
+                                code, body = _post_json(
+                                    host, port, "/models",
+                                    {"name": name, "file": folded[rnd],
+                                     "shard": int(shard),
+                                     "compiler": "dense"})
+                            applies.append(
+                                {"round": rnd, "status": code,
+                                 "mode": body.get("mode", mode)})
+                        except Exception as exc:
+                            applies.append({"round": rnd, "status": 0,
+                                            "mode": f"error:{exc}"})
+
+                before = parse_prometheus(scrape_metrics(host, port))
+                t0 = time.perf_counter()
+                rt = threading.Thread(target=refresher, daemon=True)
+                rt.start()
+                client = gen.run()
+                rt.join(10.0)
+                after = parse_prometheus(scrape_metrics(host, port))
+                elapsed = time.perf_counter() - t0
+
+                def delta_m(metric, **labels):
+                    return metric_sum(after, metric, **labels) - \
+                        metric_sum(before, metric, **labels)
+
+                resp_total = delta_m(
+                    "lgbm_tpu_serve_predict_responses_total")
+                resp_5xx = sum(
+                    delta_m("lgbm_tpu_serve_predict_responses_total",
+                            code=c) for c in ("500", "503", "504"))
+                per_bucket = _bucket_latency(after, name)
+                p99 = max((b["p99_ms"] for b in per_bucket.values()),
+                          default=0.0)
+                recompiles = registry.get(name).stats.snapshot()[
+                    "recompiles"] - r0
+                lanes.append({
+                    "mode": mode,
+                    "config": {"target_qps": qps,
+                               "duration_s": duration_s,
+                               "base_rounds": base_rounds,
+                               "refresh_rounds": refresh_rounds,
+                               "shard": int(shard),
+                               "bucket_rows": int(bucket_rows),
+                               "backend": backend},
+                    "qps": round(delta_m(
+                        "lgbm_tpu_serve_requests_total",
+                        model=name) / elapsed, 2),
+                    "availability": round(
+                        1.0 - (resp_5xx / resp_total if resp_total
+                               else 0.0), 6),
+                    "p99_ms": p99,
+                    "per_bucket": per_bucket,
+                    "recompiles": recompiles,
+                    "final_round": registry.round_of(name),
+                    "applies": applies,
+                    "client": client.summary(),
+                })
+        finally:
+            srv.shutdown()
+
+    by_mode = {l["mode"]: l for l in lanes}
+    d = by_mode.get("delta", {})
+    delta_ok = (d.get("final_round") == total
+                and d.get("recompiles") == 0
+                and all(a["status"] == 200 and a["mode"] == "extend"
+                        for a in d.get("applies", []))
+                and len(d.get("applies", [])) == refresh_rounds)
+    avail_ok = all(l["availability"] >= 1.0 for l in lanes)
+    swaps_ok = all(a["status"] == 200
+                   for a in by_mode.get("full", {}).get("applies", []))
+    return {
+        "schema": "refresh-under-load-report-v1",
+        "git_sha": _git_sha(),
+        "backend": backend,
+        "verdict": "pass" if (delta_ok and avail_ok and swaps_ok)
+                   else "breach",
+        "delta_ok": delta_ok,
+        "availability_ok": avail_ok,
+        "full_swap_ok": swaps_ok,
+        "lanes": lanes,
+    }
+
+
+def refresh_to_bench_matrix(report) -> dict:
+    """bench-matrix-v1 rows for the nightly gate: per refresh lane one
+    p99 row and one recompile row (delta lane drifting off 0 recompiles
+    is a regression of the in-envelope splice), plus the verdict."""
+    rows = []
+    for lane in report["lanes"]:
+        rows.append({"name": f"refresh_{lane['mode']}_p99",
+                     "config": lane["config"],
+                     "p99_ms": lane["p99_ms"],
+                     "availability": lane["availability"],
+                     "interpreted": False})
+        rows.append({"name": f"refresh_{lane['mode']}_recompiles",
+                     "config": lane["config"],
+                     "recompiles": lane["recompiles"],
+                     "interpreted": False})
+    rows.append({"name": "refresh_verdict",
+                 "slo_ok": report["verdict"] == "pass",
+                 "verdict": report["verdict"]})
+    return {
+        "schema": "bench-matrix-v1",
+        "bench": "refresh-under-load",
+        "git_sha": report["git_sha"],
+        "backend": report["backend"],
+        "rows": rows,
+    }
+
+
 def fleet_chaos_to_bench_matrix(report) -> dict:
     """bench-matrix-v1 rows for the nightly regression gate: one qps
     row (throughput direction) and one SLO verdict row (a recovery that
@@ -461,6 +691,31 @@ def main(argv) -> int:
         if json_path:
             with open(json_path, "w") as fh:
                 json.dump(fleet_chaos_to_bench_matrix(report), fh,
+                          indent=2, default=str)
+        return 0 if report["verdict"] == "pass" else 1
+
+    if "--refresh" in argv:
+        report = run_refresh_under_load(
+            duration_s=float(os.environ.get("REFRESH_DURATION", 6.0)),
+            qps=float(os.environ.get("REFRESH_QPS", 40.0)),
+            base_rounds=int(os.environ.get("REFRESH_BASE_ROUNDS", 4)),
+            refresh_rounds=int(os.environ.get("REFRESH_ROUNDS", 4)),
+            shard=int(os.environ.get("REFRESH_SHARD", 16)))
+        print(json.dumps({
+            "verdict": report["verdict"],
+            "delta_ok": report["delta_ok"],
+            "availability_ok": report["availability_ok"],
+            "full_swap_ok": report["full_swap_ok"],
+            "lanes": [{k: l[k] for k in
+                       ("mode", "p99_ms", "recompiles", "availability",
+                        "final_round")} for l in report["lanes"]]},
+            indent=2), flush=True)
+        if slo_path:
+            with open(slo_path, "w") as fh:
+                json.dump(report, fh, indent=2, default=str)
+        if json_path:
+            with open(json_path, "w") as fh:
+                json.dump(refresh_to_bench_matrix(report), fh,
                           indent=2, default=str)
         return 0 if report["verdict"] == "pass" else 1
 

@@ -74,8 +74,9 @@ def check_slo_coverage(registry: Optional[MetricsRegistry] = None
             if s.kind == "ratio" and not isinstance(m, Counter):
                 v(name, f"ratio SLO needs counters but '{mname}' is a "
                         f"{m.kind}")
-            if s.kind == "gauge_floor" and not isinstance(m, Gauge):
-                v(name, f"gauge_floor SLO needs a gauge but '{mname}' "
+            if s.kind in ("gauge_floor", "gauge_ceiling") and \
+                    not isinstance(m, Gauge):
+                v(name, f"{s.kind} SLO needs a gauge but '{mname}' "
                         f"is a {m.kind}")
             selectors = dict(s.labels)
             if role == "metric":
@@ -91,6 +92,9 @@ def check_slo_coverage(registry: Optional[MetricsRegistry] = None
                     f"got {s.threshold_ms}")
         if s.kind == "gauge_floor" and s.floor <= 0:
             v(name, f"gauge_floor SLO needs floor > 0, got {s.floor}")
+        if s.kind == "gauge_ceiling" and s.ceiling < 0:
+            v(name, f"gauge_ceiling SLO needs ceiling >= 0, "
+                    f"got {s.ceiling}")
     return out
 
 

@@ -322,6 +322,15 @@ _PARAMS: List[ParamSpec] = [
     # (0/1 = single device); partial scores merge in ONE psum per
     # request (collective contract serve/dense_predict/score_psum)
     _p("tpu_predict_shard", int, 0, check=">=0"),
+    # --- continuous-learning lane (lightgbm_tpu/publish/) ---
+    # publish_dir: when set, the trainer appends a per-round model delta
+    # journal there (publish/delta.py) every publish_every rounds (0 =
+    # every round) plus a forced publish on the preemption drain path
+    # and at completion.  Run directives like checkpoint_dir: excluded
+    # from the model-text params dump so publishing runs serialize byte-
+    # identically to non-publishing ones.
+    _p("publish_dir", str, ""),
+    _p("publish_every", int, 0, check=">=0"),
 ]
 
 PARAM_SCHEMA: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}

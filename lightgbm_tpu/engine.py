@@ -253,6 +253,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
     manager = CheckpointManager(ckpt_dir, keep=int(cfg2.checkpoint_keep)) \
         if ckpt_dir else None
 
+    # -- continuous-learning lane (publish/) ---------------------------------
+    publisher = None
+    if str(cfg2.publish_dir):
+        from .publish.publisher import DeltaPublisher
+        publisher = DeltaPublisher(str(cfg2.publish_dir),
+                                   every=int(cfg2.publish_every) or 1)
+
     if resume_from is None and cfg2.resume:
         resume_from = _resolve_resume(cfg2, ckpt_dir)
     ckpt: Optional[Checkpoint] = None
@@ -266,8 +273,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
     run_history: Dict[str, Dict[str, List[float]]] = {}
     if ckpt is not None:
         if init_model is not None:
-            log_warning("both init_model and resume_from given: the "
-                        "checkpoint's model replaces the init_model trees")
+            # restoring would silently drop init_model's trees and fork
+            # the ensemble semantics — refuse instead of guessing
+            raise CheckpointError(
+                "both init_model and resume_from given: a checkpoint "
+                "restore replaces the whole model, which would silently "
+                "drop the init_model trees; continue from the checkpoint "
+                "alone, or start a fresh run from init_model")
         start_iter = _restore(ckpt, booster, train_set, cfg2,
                               callbacks_after)
         run_history = copy.deepcopy(ckpt.eval_history)
@@ -355,8 +367,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 # restore to the exact same boundary
                 if manager is not None and (it + 1) % snap_freq == 0:
                     _flush()
+                if publisher is not None:
+                    publisher.maybe_publish(booster._gbdt, it + 1)
                 if guard.fired is not None:
                     final_path = _flush(final=True)
+                    if publisher is not None:
+                        # drain path: the journal head lands on the same
+                        # iteration boundary as the final checkpoint
+                        publisher.publish(booster._gbdt)
                     _flight_dump("preempted")
                     raise TrainingPreempted(guard.fired, booster=booster,
                                             checkpoint=final_path)
@@ -367,6 +385,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # leave the post-mortem tape next to the checkpoints
         _flight_dump("crash")
         raise
+    if publisher is not None:
+        # completion flush: early-stop/no-split breaks leave off-cadence
+        # rounds unpublished — fold them in so journal head == final model
+        publisher.publish(booster._gbdt)
     if str(cfg2.flight_dir):
         # an explicit flight_dir asks for the tape even on success
         _flight_dump("completed")

@@ -193,7 +193,7 @@ def model_to_string(gbdt, start_iteration: int = 0,
     for key, value in sorted(gbdt.config.to_dict().items()):
         if key in ("resume", "checkpoint_dir", "checkpoint_keep",
                    "tpu_ingest_mode", "flight_recorder", "flight_events",
-                   "flight_dir"):
+                   "flight_dir", "publish_dir", "publish_every"):
             # transient run directives, not training config: a preempted-
             # and-resumed run must produce byte-identical model text to
             # the run that never stopped, a shipped model must not embed

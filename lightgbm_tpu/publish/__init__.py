@@ -1,0 +1,16 @@
+"""Continuous-learning lane: per-round model delta publishing.
+
+The trainer appends each published round's new trees to a crash-safe
+delta journal (:mod:`.delta`); the serving tier replays the journal to
+extend compiled ensembles in place (:mod:`.subscriber`), and the fleet
+supervisor pushes deltas to workers with a staleness SLO
+(:mod:`lightgbm_tpu.serve.fleet`)."""
+
+from .delta import (DeltaChainError, DeltaJournal, DeltaRecord,
+                    fingerprint_text)
+from .publisher import DeltaPublisher
+from .subscriber import fold_chain, load_journal, trees_from_fragment
+
+__all__ = ["DeltaChainError", "DeltaJournal", "DeltaRecord",
+           "fingerprint_text", "DeltaPublisher", "fold_chain",
+           "load_journal", "trees_from_fragment"]

@@ -194,11 +194,20 @@ class DenseExecutable:
     plus the jitted (optionally tree-sharded) prediction entries.
 
     Immutable once built — hot-swap replaces the whole object, so there
-    is no window where path matrices and leaf tables disagree."""
+    is no window where path matrices and leaf tables disagree.
+
+    ``real_trees`` tracks the live tree count separately from
+    ``meta.num_trees`` (the count at the ORIGINAL lowering): the jitted
+    program never reads the count — shard-padding trees are inert purely
+    through their array values — so :meth:`extended` can splice appended
+    trees into padding rows while keeping ``meta`` (and therefore the
+    jit cache signature) bit-identical: zero recompiles until the
+    padding envelope is exhausted."""
 
     def __init__(self, arrays: DenseArrays, meta: DenseMeta,
                  shard: int = 0) -> None:
         self.meta = meta
+        self.real_trees = meta.num_trees
         self.shard = 0
         self._sharded_fn: Optional[Any] = None
         if shard and shard > 1:
@@ -242,13 +251,97 @@ class DenseExecutable:
     def predict_leaf(self, Xp) -> Any:
         """(N, num_trees) leaf indices (shard-padding trees sliced)."""
         out = dense_predict_leaf(Xp, self.arrays, self.meta)
-        return out[:, :self.meta.num_trees]
+        return out[:, :self.real_trees]
+
+    @property
+    def capacity(self) -> int:
+        """Tree-axis rows in the lowered tables (real + shard padding):
+        the append envelope for :meth:`extended`."""
+        return int(self.arrays.path_dir.shape[0])
+
+    def extended(self, new_trees: List[Any], num_features: int
+                 ) -> Optional["DenseExecutable"]:
+        """Splice ``new_trees`` into this executable's padding rows.
+
+        Returns a NEW executable sharing ``meta`` (identical signature,
+        so the jit cache is hit — zero recompiles), or ``None`` when an
+        in-place extension cannot stay exact: the padding envelope is
+        exhausted, the new trees are wider/deeper than the lowered
+        tables, or they need table kinds (categorical splits, linear
+        leaves) the original lowering did not build.  ``None`` means
+        "rebuild from scratch", never a silent approximation."""
+        n = len(new_trees)
+        if n == 0:
+            return self
+        r = self.real_trees
+        if r + n > self.capacity:
+            return None
+        meta = self.meta
+        class_ids = [(r + i) % meta.num_class for i in range(n)]
+        try:
+            na, nm = lower_ensemble(
+                new_trees, meta.num_class, num_features, class_ids,
+                leaf_bits=meta.leaf_bits, mxu=meta.mxu, shard=1)
+        except DenseLoweringError:
+            return None
+        if nm.has_cat:
+            # splicing into the bitset-membership table would have to
+            # regrow (Fc*C, NCp) — a shape change, i.e. a recompile
+            return None
+        if nm.has_linear and not meta.has_linear:
+            return None
+        host = jax.device_get(self.arrays)
+        Nn = host.split_feature.shape[1]
+        L = host.qthresh.shape[1]
+        nNn = int(na.split_feature.shape[1])
+        nL = int(na.qthresh.shape[1])
+        if nNn > Nn or nL > L:
+            return None
+
+        def _pad(a, shape, fill=0.0):
+            out = np.full(shape, fill, dtype=np.asarray(a).dtype)
+            out[tuple(slice(0, s) for s in np.asarray(a).shape)] = \
+                np.asarray(a)
+            return out
+
+        vals = {k: np.array(v, copy=True) if v is not None else None
+                for k, v in host._asdict().items()}
+        vals["split_feature"][r:r + n] = _pad(na.split_feature, (n, Nn))
+        vals["threshold"][r:r + n] = _pad(na.threshold, (n, Nn))
+        vals["dleft"][r:r + n] = _pad(na.dleft, (n, Nn))
+        vals["miss_nan"][r:r + n] = _pad(na.miss_nan, (n, Nn))
+        vals["is_cat"][r:r + n] = _pad(na.is_cat, (n, Nn))
+        vals["path_dir"][r:r + n] = _pad(na.path_dir, (n, Nn, L))
+        # unreal leaf slots keep the 1e9 sentinel so they can never hit
+        vals["qthresh"][r:r + n] = _pad(na.qthresh, (n, L),
+                                        fill=np.float32(1e9))
+        vals["leaf_codes"][r:r + n] = _pad(na.leaf_codes, (n, L))
+        vals["leaf_scale"][r:r + n] = np.asarray(na.leaf_scale)
+        vals["class_onehot"][r:r + n] = np.asarray(na.class_onehot)
+        if meta.has_cat:
+            vals["node_cat_slot"][r:r + n] = 0
+        if meta.has_linear:
+            if nm.has_linear:
+                F = vals["lin_w"].shape[2]
+                vals["lin_w"][r:r + n] = _pad(na.lin_w, (n, L, F))
+                vals["lin_mask"][r:r + n] = _pad(na.lin_mask, (n, L, F))
+                vals["lin_const"][r:r + n] = _pad(na.lin_const, (n, L))
+                vals["lin_flag"][r:r + n] = np.asarray(na.lin_flag)
+            else:
+                vals["lin_w"][r:r + n] = 0.0
+                vals["lin_mask"][r:r + n] = 0.0
+                vals["lin_const"][r:r + n] = 0.0
+                vals["lin_flag"][r:r + n] = 0.0
+        ex = DenseExecutable(DenseArrays(**vals), meta, shard=self.shard)
+        ex.real_trees = r + n
+        return ex
 
     def info(self) -> Dict[str, Any]:
         return {
             "mode": "dense",
-            "num_trees": self.meta.num_trees,
+            "num_trees": self.real_trees,
             "num_class": self.meta.num_class,
+            "capacity": self.capacity,
             "has_cat": self.meta.has_cat,
             "has_linear": self.meta.has_linear,
             "leaf_bits": self.meta.leaf_bits,

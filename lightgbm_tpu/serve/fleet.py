@@ -42,6 +42,17 @@ version BEFORE its atomic registry swap), checks the worker's post-swap
 health, and automatically rolls the worker back to its previous source
 on a regression — old or new version answers every request throughout.
 
+**Continuous learning.**  With ``publish_dir=`` the supervisor follows
+a trainer's delta journal (``publish/delta.py``): every published round
+is pushed to each worker over ``POST /models/<name>/delta`` (an
+incremental tree append on the worker — zero recompiles inside the
+dense shard-padding envelope), per-worker acked rounds are tracked
+across respawns, and a worker that fell off the chain (respawn, 409
+chain mismatch) is re-anchored by a full reload of the journal's
+newest BASE and replayed forward.  ``fleet_model_rounds_behind``
+gauges the head-to-worker staleness and the ``fleet/model_staleness``
+SLO burns while any worker sits more than one round behind.
+
 **Observability.**  Fleet-level ``/metrics`` renders the fleet's own
 registry (``fleet_workers_{alive,quarantined}``,
 ``fleet_restarts_total{reason}``, ``fleet_retries_total``, dispatcher
@@ -54,6 +65,7 @@ kill-under-load recovery from these two endpoints alone.
 
 from __future__ import annotations
 
+import base64
 import http.client
 import json
 import os
@@ -91,6 +103,19 @@ slo("fleet/retry_rate", metric="fleet_retries_total",
     target=0.95, min_events=50,
     note="cross-worker connection-reset retry budget")
 
+# Continuous-learning freshness objective: while a trainer publishes
+# per-round deltas into the followed journal, no worker may serve a
+# model more than one published round behind the head.  The
+# rounds-behind gauge is maintained by the delta sync loop (it keeps
+# aging for a crashed worker as the head advances), so a worker that
+# keeps missing its pushes — crash-looping, rejecting the chain —
+# burns this budget until re-anchor + replay catches it up.
+slo("fleet/model_staleness", metric="fleet_model_rounds_behind",
+    kind="gauge_ceiling", ceiling=1.0, target=0.5,
+    burn_fast=1.9, burn_slow=1.5,
+    note="live-refresh freshness: every worker within one published "
+         "round of the delta journal head")
+
 
 @register_metric_ensurer
 def _ensure_fleet_metrics(reg: MetricsRegistry) -> None:
@@ -108,6 +133,15 @@ def _ensure_fleet_metrics(reg: MetricsRegistry) -> None:
     reg.counter("fleet_retries_total",
                 "/predict calls retried on another worker after a "
                 "connection reset", labels=())
+    reg.gauge("fleet_model_round",
+              "last published round acked by each worker",
+              labels=("model", "worker"))
+    reg.gauge("fleet_model_rounds_behind",
+              "delta journal head round minus the worker's acked round",
+              labels=("model", "worker"))
+    reg.counter("fleet_delta_pushes_total",
+                "delta records pushed to workers by outcome "
+                "(ok/reanchor/rejected/error)", labels=("outcome",))
 
 
 # connection-level failure classes that are safe to retry on another
@@ -147,6 +181,11 @@ class WorkerHandle:
         self.current_weight = 0.0       # smooth-WRR scheduling state
         self.synced_incarnation = 0     # last incarnation whose model
         #                                 set was caught up to deploys
+        self.acked_round: Optional[int] = None  # delta-chain position
+        #                                 this worker has acked
+        self.delta_incarnation = 0      # incarnation acked_round is
+        #                                 valid for (a respawn boots
+        #                                 from the CLI file: unknown)
 
     def snapshot(self) -> Dict[str, Any]:
         return {
@@ -155,6 +194,7 @@ class WorkerHandle:
             "last_health": self.last_health,
             "recent_failures": len(self.fail_times),
             "probing": self.probing,
+            "acked_round": self.acked_round,
             "pid": self.proc.pid if self.proc is not None else None,
         }
 
@@ -199,6 +239,8 @@ class FleetSupervisor:
                  deploy_timeout_s: float = 120.0,
                  startup_timeout_s: float = 120.0,
                  drain_timeout_s: float = 30.0,
+                 publish_dir: Optional[str] = None,
+                 publish_model: Optional[str] = None,
                  metrics_registry: Optional[MetricsRegistry] = None
                  ) -> None:
         if workers < 1:
@@ -241,6 +283,18 @@ class FleetSupervisor:
         self._startup_timeout_s = float(startup_timeout_s)
         self._drain_timeout_s = float(drain_timeout_s)
 
+        # continuous-learning lane: follow a trainer's delta journal
+        # and keep every worker's serving model within a round of it
+        self._journal = None
+        self._publish_model: Optional[str] = None
+        if publish_dir:
+            from ..publish.delta import DeltaJournal
+            self._journal = DeltaJournal(os.path.abspath(publish_dir))
+            self._publish_model = (str(publish_model) if publish_model
+                                   else next(iter(self._current_models)))
+        self._journal_head_round: Optional[int] = None
+        self._journal_poll_t = 0.0
+
         self._metrics = metrics_registry if metrics_registry is not None \
             else MetricsRegistry()
         self.slo_engine = SloEngine(registry=self._metrics)
@@ -258,6 +312,18 @@ class FleetSupervisor:
             "fleet_retries_total",
             "/predict calls retried on another worker after a "
             "connection reset", labels=())
+        self._model_round_g = self._metrics.gauge(
+            "fleet_model_round",
+            "last published round acked by each worker",
+            labels=("model", "worker"))
+        self._rounds_behind_g = self._metrics.gauge(
+            "fleet_model_rounds_behind",
+            "delta journal head round minus the worker's acked round",
+            labels=("model", "worker"))
+        self._delta_pushes = self._metrics.counter(
+            "fleet_delta_pushes_total",
+            "delta records pushed to workers by outcome "
+            "(ok/reanchor/rejected/error)", labels=("outcome",))
         self._responses = self._metrics.counter(
             "serve_http_responses_total", "HTTP responses by status code",
             labels=("code",))
@@ -457,6 +523,130 @@ class FleetSupervisor:
                          f"'{name}' ({os.path.basename(path)})")
         return ok
 
+    # -- continuous-learning lane (publish/) --------------------------------
+    def _journal_target(self, now: float) -> Optional[int]:
+        """Throttled journal head poll: the newest published round, or
+        None while no journal is followed / the journal is empty.  One
+        small read per probe interval, not per worker per tick."""
+        if self._journal is None:
+            return None
+        if self._journal_head_round is not None and \
+                now - self._journal_poll_t < self._probe_interval_s:
+            return self._journal_head_round
+        self._journal_poll_t = now
+        try:
+            h = self._journal.head()
+        except Exception as exc:
+            log_warning(f"fleet: delta journal head unreadable: "
+                        f"{type(exc).__name__}: {exc}")
+            return self._journal_head_round
+        if h is not None:
+            self._journal_head_round = int(h.round)
+        return self._journal_head_round
+
+    def _note_rounds(self, w: WorkerHandle, target: int) -> None:
+        """Refresh the per-worker freshness gauges.  Called for DEAD
+        workers too: a crashed worker's acked round freezes while the
+        head advances, so its rounds-behind gauge keeps aging and the
+        staleness SLO burns until re-anchor + replay catches it up."""
+        if w.acked_round is None or self._publish_model is None:
+            return
+        self._model_round_g.set(float(w.acked_round),
+                                model=self._publish_model, worker=w.name)
+        self._rounds_behind_g.set(float(max(0, target - w.acked_round)),
+                                  model=self._publish_model,
+                                  worker=w.name)
+
+    def _anchor_base(self, w: WorkerHandle) -> bool:
+        """Re-anchor one worker on the journal's newest BASE by a full
+        ``POST /models`` reload (which clears the worker registry's
+        chain position), so the next delta replays cleanly from the
+        base round."""
+        try:
+            entry = self._journal.base_entry()
+        except Exception:
+            return False
+        if entry is None:
+            return False
+        path, base_round = entry
+        name = self._publish_model
+        try:
+            status, detail = self._worker_post_json(
+                w, "/models", {"name": name, "file": path},
+                self._deploy_timeout_s)
+        except Exception as exc:
+            log_warning(f"fleet: {w.name} delta re-anchor failed: "
+                        f"{type(exc).__name__}: {exc}")
+            return False
+        if status != 200:
+            log_warning(f"fleet: {w.name} rejected re-anchor base for "
+                        f"'{name}' ({status}): "
+                        f"{detail.get('error', detail)}")
+            return False
+        w.acked_round = base_round
+        w.delta_incarnation = w.incarnation
+        log_info(f"fleet: {w.name} re-anchored '{name}' at round "
+                 f"{base_round} ({os.path.basename(path)})")
+        return True
+
+    def _sync_deltas(self, w: WorkerHandle, now: float) -> None:
+        """Push published delta records to one alive worker until it
+        serves the journal head round.  A worker with an unknown chain
+        position (fresh incarnation) or one that 409s a push (chain
+        mismatch after a deploy or a divergent base) is re-anchored by
+        a full reload of the newest BASE and replayed forward — the
+        fallback the DeltaChainError contract promises."""
+        target = self._journal_target(now)
+        if target is None or self._publish_model is None:
+            return
+        if w.delta_incarnation != w.incarnation or w.acked_round is None:
+            # a respawn boots from its CLI model file: position unknown
+            if not self._anchor_base(w):
+                return
+        if w.acked_round >= target:
+            self._note_rounds(w, target)
+            return
+        try:
+            records = self._journal.records_after(w.acked_round)
+        except Exception as exc:
+            log_warning(f"fleet: delta journal chain unreadable: "
+                        f"{type(exc).__name__}: {exc}")
+            return
+        name = self._publish_model
+        for rec in records:
+            if rec.round <= w.acked_round:
+                continue
+            try:
+                status, detail = self._worker_post_json(
+                    w, f"/models/{name}/delta",
+                    {"record_b64": base64.b64encode(
+                        rec.to_bytes()).decode("ascii")},
+                    self._deploy_timeout_s)
+            except Exception as exc:
+                self._delta_pushes.inc(1, outcome="error")
+                log_warning(f"fleet: {w.name} delta push (round "
+                            f"{rec.round}) failed: "
+                            f"{type(exc).__name__}: {exc}")
+                return
+            if status == 409:
+                # the worker's chain diverged: full reload + replay
+                # resumes next tick from the fresh anchor
+                self._delta_pushes.inc(1, outcome="reanchor")
+                w.acked_round = None
+                self._anchor_base(w)
+                return
+            if status != 200:
+                self._delta_pushes.inc(1, outcome="rejected")
+                log_warning(f"fleet: {w.name} rejected delta round "
+                            f"{rec.round} ({status}): "
+                            f"{detail.get('error', detail)}")
+                return
+            self._delta_pushes.inc(1, outcome="ok")
+            w.acked_round = int(rec.round)
+            log_debug(f"fleet: {w.name} applied delta round "
+                      f"{rec.round} ({detail.get('mode', '?')})")
+        self._note_rounds(w, max(target, w.acked_round))
+
     def _probe_health(self, w: WorkerHandle,
                       timeout: Optional[float] = None) -> Optional[str]:
         """One /healthz probe; the status string, or None when the
@@ -497,6 +687,7 @@ class FleetSupervisor:
                     w.last_health = boot_health
                     if self._sync_models(w):
                         w.synced_incarnation = w.incarnation
+                    self._sync_deltas(w, now)
                     log_info(f"fleet: {w.name} alive on port {w.port}"
                              + (" (breaker half-open probe)"
                                 if w.probing else ""))
@@ -547,6 +738,7 @@ class FleetSupervisor:
                 if w.synced_incarnation != w.incarnation and \
                         self._sync_models(w):
                     w.synced_incarnation = w.incarnation
+                self._sync_deltas(w, now)
                 if w.probing:
                     w.probe_ok_streak += 1
                     if w.probe_ok_streak >= self._probe_ok_needed:
@@ -560,6 +752,14 @@ class FleetSupervisor:
                           if w.state == "quarantined")
         self._alive_g.set(float(alive))
         self._quar_g.set(float(quarantined))
+        if self._journal is not None:
+            # age every worker's freshness gauge against the head —
+            # including dead/restarting workers, whose frozen acked
+            # round falls behind as the trainer keeps publishing
+            target = self._journal_target(now)
+            if target is not None:
+                for w in self._workers:
+                    self._note_rounds(w, target)
 
     def _run_supervision(self) -> None:
         while not self._stop.is_set():
@@ -1157,7 +1357,7 @@ _FLEET_KEYS = {
     "probe_interval_s", "probe_timeout_s", "hang_probes",
     "breaker_failures", "breaker_window_s", "breaker_halfopen_s",
     "backoff_base_s", "backoff_max_s", "drain_timeout_s",
-    "startup_timeout_s", "run_dir",
+    "startup_timeout_s", "run_dir", "publish_dir", "publish_model",
 }
 
 
@@ -1169,7 +1369,10 @@ def main(argv: List[str]) -> int:
     deadline_ms (0), probe_interval_s (1.0), probe_timeout_s (2.0),
     hang_probes (3), breaker_failures (3), breaker_window_s (30),
     breaker_halfopen_s (5), backoff_base_s (0.2), backoff_max_s (5),
-    drain_timeout_s (30), startup_timeout_s (120), run_dir.  Every
+    drain_timeout_s (30), startup_timeout_s (120), run_dir,
+    publish_dir (follow a trainer's delta journal and live-refresh
+    every worker), publish_model (logical name the deltas apply to;
+    defaults to the first model).  Every
     other ``key=value`` passes through to the worker serve processes
     (``max_queue_rows``, ``max_wait_ms``, ``deadline_ms`` stays
     fleet-side, ...).  SIGTERM runs a rolling drain and exits
@@ -1201,7 +1404,9 @@ def main(argv: List[str]) -> int:
         retry_budget=int(kv.get("retry_budget", 1)),
         deadline_ms=float(kv.get("deadline_ms", 0.0)),
         drain_timeout_s=float(kv.get("drain_timeout_s", 30.0)),
-        startup_timeout_s=float(kv.get("startup_timeout_s", 120.0)))
+        startup_timeout_s=float(kv.get("startup_timeout_s", 120.0)),
+        publish_dir=kv.get("publish_dir"),
+        publish_model=kv.get("publish_model"))
     fleet.start()
     try:
         fleet.install_signal_handlers()

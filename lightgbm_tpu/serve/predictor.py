@@ -101,6 +101,7 @@ class CompiledPredictor:
                  leaf_bits: Optional[int] = None,
                  shard: Optional[int] = None) -> None:
         gbdt = _resolve_gbdt(source)
+        self._gbdt = gbdt          # retained for delta appends (extended)
         self.buckets = tuple(sorted(buckets))
         self.stats = stats if stats is not None else ModelStats()
         self.objective = gbdt.objective
@@ -218,6 +219,48 @@ class CompiledPredictor:
         if raw_score or self.objective is None:
             return raw
         return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+
+    # -- delta append (publish/ continuous-learning lane) -------------------
+    def extended(self, new_trees) -> Tuple["CompiledPredictor", str]:
+        """A NEW predictor serving this model plus ``new_trees``
+        (appended boosting rounds, real/untranslated feature indices —
+        what a delta payload parses to).
+
+        Returns ``(predictor, mode)`` where mode is ``"extend"`` (the
+        dense tables were spliced in place inside the shard-padding
+        envelope — same jit signature, zero recompiles) or
+        ``"rebuild"`` (a full recompile was needed).  ``self`` is never
+        mutated, so a failure part-way leaves the serving predictor
+        untouched — the hot-swap discipline of ``ModelRegistry.load``."""
+        import copy as _copy
+        new_trees = list(new_trees)
+        if not new_trees:
+            return self, "noop"
+        if self._used is not None:
+            # a train-set-attached model stores INNER feature indices;
+            # delta trees carry real ones — mixing would misroute splits
+            raise ValueError(
+                "extended() needs a file/text-loaded predictor (real "
+                "feature indices); this one is train-set-attached")
+        g2 = _copy.copy(self._gbdt)
+        g2.models = list(self._gbdt.models[:self.num_trees]) + new_trees
+        g2.iter_ = len(g2.models) // max(1, self.num_class)
+        if self._avg_div == 1 and self._dense is not None:
+            ex = self._dense.extended(new_trees, self.num_features)
+            if ex is not None:
+                p2 = _copy.copy(self)
+                p2._gbdt = g2
+                p2._dense = ex
+                p2.num_trees = self.num_trees + len(new_trees)
+                p2._sig = ex.signature
+                return p2, "extend"
+        # RF (mean-output divisor changes with tree count), walk-path
+        # models, or an exhausted padding envelope: full rebuild
+        p2 = CompiledPredictor(
+            g2, buckets=self.buckets, stats=self.stats,
+            compiler=self._compiler_mode, leaf_bits=self._leaf_bits,
+            shard=self._shard)
+        return p2, "rebuild"
 
     # -- warmup -------------------------------------------------------------
     def warmup(self, buckets: Optional[Tuple[int, ...]] = None) -> int:

@@ -24,10 +24,16 @@ from typing import Dict, List, Optional
 
 from .predictor import CompiledPredictor
 from .stats import ModelStats
+from ..publish.delta import DeltaChainError, DeltaRecord, fingerprint_text
 from ..telemetry.metrics import default_registry
 from ..utils.log import log_info
 
-__all__ = ["ModelRegistry"]
+__all__ = ["ModelRegistry", "ModelInUseError"]
+
+
+class ModelInUseError(ValueError):
+    """Refused eviction: the model is the registry's only (i.e. the
+    default-served) entry.  Pass ``force=True`` to evict anyway."""
 
 
 class ModelRegistry:
@@ -45,6 +51,10 @@ class ModelRegistry:
         # file path behind each loaded name (None for in-memory sources)
         # — a rolling deploy reads it back to roll a regressed swap back
         self._sources: Dict[str, Optional[str]] = {}
+        # delta-chain position per name: (round, fingerprint) of the
+        # last applied record; cleared by load()/evict() so a full
+        # reload re-anchors the chain
+        self._chain: Dict[str, tuple] = {}
         self._max_models = max_models
         # registry-managed models report into the process-wide metrics
         # registry (labeled model=<name>) so /metrics covers them
@@ -91,6 +101,7 @@ class ModelRegistry:
             self._versions[name] = self._versions.get(name, 0) + 1
             self._sources[name] = source if isinstance(source, str) \
                 else None
+            self._chain.pop(name, None)   # full load re-anchors deltas
             if self._max_models is not None and \
                     len(self._models) > self._max_models:
                 # evict the oldest OTHER entry (insertion order)
@@ -99,6 +110,7 @@ class ModelRegistry:
                         del self._models[victim]
                         self._stats.pop(victim, None)
                         self._sources.pop(victim, None)
+                        self._chain.pop(victim, None)
                         break
         log_info(f"serve: {'hot-swapped' if swapped else 'loaded'} model "
                  f"'{name}' (v{self._versions[name]}, "
@@ -119,15 +131,106 @@ class ModelRegistry:
                 raise KeyError(f"unknown model '{name}'")
             return self._models[name]
 
-    def evict(self, name: str) -> bool:
+    def evict(self, name: str, force: bool = False) -> bool:
+        """Drop ``name``.  Evicting the registry's ONLY model — the one
+        unnamed requests resolve to — raises :class:`ModelInUseError`
+        unless ``force=True``, so a fat-fingered evict cannot take a
+        single-model deployment dark.  In-flight readers that already
+        resolved the predictor finish normally either way: predictors
+        are immutable and handlers hold their own reference."""
         with self._lock:
             if name not in self._models:
                 return False
+            if not force and len(self._models) == 1:
+                raise ModelInUseError(
+                    f"'{name}' is the only loaded model (the default "
+                    f"served one); evicting it would take the service "
+                    f"dark — pass force=True to do it anyway")
             del self._models[name]
             self._stats.pop(name, None)
             self._sources.pop(name, None)
+            self._chain.pop(name, None)
             log_info(f"serve: evicted model '{name}'")
             return True
+
+    # -- continuous-learning lane (publish/) --------------------------------
+    def apply_delta(self, name: str, record) -> dict:
+        """Append a published delta's trees to ``name`` without a full
+        reload: parse the fragment, extend the predictor (dense-table
+        splice inside the shard-padding envelope — zero recompiles — or
+        a rebuild), and hot-swap atomically exactly like :meth:`load`.
+
+        ``record`` is a :class:`DeltaRecord` or its wire bytes.  The
+        chain position is validated first — a round gap or fingerprint
+        mismatch raises :class:`DeltaChainError` BEFORE any work, and a
+        failed build leaves the old predictor serving — so a subscriber
+        that fell behind gets a typed signal to fall back to a full
+        reload instead of serving a torn ensemble."""
+        from ..publish.subscriber import trees_from_fragment
+        if isinstance(record, (bytes, bytearray)):
+            record = DeltaRecord.from_bytes(bytes(record))
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(f"unknown model '{name}'")
+            pred = self._models[name]
+            chain = self._chain.get(name)
+            source = self._sources.get(name)
+        if chain is not None:
+            rnd, fp = chain
+            if record.round <= rnd:
+                return {"model": name, "round": rnd, "mode": "noop",
+                        "num_trees": pred.num_trees}
+            if record.base_round != rnd or record.parent_fp != fp:
+                raise DeltaChainError(
+                    f"model '{name}' is at round {rnd} "
+                    f"(fp {fp[:12]}...); delta extends round "
+                    f"{record.base_round} (fp {record.parent_fp[:12]}...)"
+                    f" — reload the full model to re-anchor")
+        else:
+            k = max(1, pred.num_class)
+            have = pred.num_trees // k
+            if record.base_round != have:
+                raise DeltaChainError(
+                    f"model '{name}' holds {have} rounds; delta extends "
+                    f"round {record.base_round} — reload the full model "
+                    f"to re-anchor")
+            if source is not None:
+                with open(source, "rb") as fh:
+                    src_fp = fingerprint_text(fh.read().decode("utf-8"))
+                if src_fp != record.parent_fp:
+                    raise DeltaChainError(
+                        f"model '{name}' was loaded from a base with "
+                        f"fingerprint {src_fp[:12]}...; delta chains "
+                        f"from {record.parent_fp[:12]}... — reload the "
+                        f"full model to re-anchor")
+        trees, frag_k = trees_from_fragment(
+            record.payload, source=f"<delta round {record.round}>")
+        if frag_k != max(1, pred.num_class):
+            raise DeltaChainError(
+                f"delta num_tree_per_iteration {frag_k} != model "
+                f"{pred.num_class}")
+        # build outside the lock (hot-swap discipline): a failure here
+        # leaves the old predictor — and its chain position — untouched
+        pred2, mode = pred.extended(trees)
+        with self._lock:
+            if self._models.get(name) is not pred:
+                raise DeltaChainError(
+                    f"model '{name}' was swapped while the delta was "
+                    f"being applied; replay from its new round")
+            self._models[name] = pred2
+            self._versions[name] = self._versions.get(name, 0) + 1
+            self._chain[name] = (record.round, record.fp)
+        log_info(f"serve: applied delta to '{name}' -> round "
+                 f"{record.round} ({mode}, {pred2.num_trees} trees)")
+        return {"model": name, "round": record.round, "mode": mode,
+                "num_trees": pred2.num_trees}
+
+    def round_of(self, name: str) -> Optional[int]:
+        """Last delta-applied round for ``name`` (None before any
+        delta)."""
+        with self._lock:
+            chain = self._chain.get(name)
+            return chain[0] if chain is not None else None
 
     def source_of(self, name: str) -> Optional[str]:
         """File path serving under ``name`` (None when loaded from an

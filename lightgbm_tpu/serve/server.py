@@ -27,6 +27,11 @@ Endpoints:
                     assigns one
   POST /models   -> {"name": ..., "file": ...} loads or atomically
                     hot-swaps a model from a model_text file
+  POST /models/<name>/delta
+                 -> {"record_b64": ...} appends a published training
+                    delta (publish/delta.py wire record, base64) to the
+                    serving model without a full reload; 409 on a chain
+                    mismatch tells the caller to full-reload + replay
 
 Each HTTP request runs on its own thread (ThreadingHTTPServer); /predict
 routes through a per-model :class:`MicroBatcher`, so concurrent small
@@ -438,6 +443,10 @@ def _make_handler(server: PredictionServer):
                 self._predict(req)
             elif self.path == "/models":
                 self._load_model(req)
+            elif self.path.startswith("/models/") and \
+                    self.path.endswith("/delta"):
+                self._apply_delta(req, self.path[len("/models/"):
+                                                 -len("/delta")])
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -520,13 +529,56 @@ def _make_handler(server: PredictionServer):
                         "predictions": np.asarray(out).tolist(),
                         "request_id": rid})
 
+        def _apply_delta(self, req: dict, name: str) -> None:
+            """``POST /models/<name>/delta``: append a published delta's
+            trees to the serving model without a full reload.  The wire
+            record rides base64 inside the JSON body (``record_b64``) so
+            the one-body-shape-per-POST read above stands.  409 = chain
+            mismatch (the caller's typed signal to fall back to a full
+            reload + replay); 404 = unknown model."""
+            import base64
+            b64 = req.get("record_b64")
+            if not name or not isinstance(b64, str) or not b64:
+                self._reply(400, {"error": "body needs 'record_b64' (the "
+                                           "delta record, base64)"})
+                return
+            try:
+                raw = base64.b64decode(b64.encode("ascii"), validate=True)
+            except (ValueError, UnicodeEncodeError) as exc:
+                self._reply(400, {"error": f"bad record_b64: {exc}"})
+                return
+            from ..publish.delta import DeltaChainError
+            try:
+                out = server.registry.apply_delta(name, raw)
+            except KeyError as exc:
+                self._reply(404, {"error": str(exc.args[0])})
+                return
+            except DeltaChainError as exc:
+                self._reply(409, {"error": str(exc)})
+                return
+            except Exception as exc:
+                self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+                return
+            self._reply(200, out)
+
         def _load_model(self, req: dict) -> None:
             name, path = req.get("name"), req.get("file")
             if not name or not path:
                 self._reply(400, {"error": "body needs 'name' and 'file'"})
                 return
+            # optional lowering knobs ride the same body, so a reload
+            # can reproduce the serving config of the entry it replaces
+            kwargs = {}
             try:
-                pred = server.registry.load(str(name), str(path))
+                for key, cast in (("num_iteration", int), ("shard", int),
+                                  ("leaf_bits", int), ("compiler", str)):
+                    if req.get(key) is not None:
+                        kwargs[key] = cast(req[key])
+            except (TypeError, ValueError) as exc:
+                self._reply(400, {"error": f"bad lowering knob: {exc}"})
+                return
+            try:
+                pred = server.registry.load(str(name), str(path), **kwargs)
             except Exception as exc:
                 self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
                 return

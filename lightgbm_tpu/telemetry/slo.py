@@ -57,7 +57,10 @@ class SLO:
     (a gauge that must stay at or above ``floor`` — the fleet
     supervision kind: every evaluation with ANY matching series below
     the floor spends budget, so "no worker alive" burns exactly like
-    "every request 5xx").  ``target`` is the good fraction (0.999
+    "every request 5xx"), or ``"gauge_ceiling"`` (the mirror image: a
+    gauge that must stay at or below ``ceiling`` — the staleness kind:
+    any worker's rounds-behind gauge above the ceiling spends budget).
+    ``target`` is the good fraction (0.999
     availability = 0.1% error budget).  For ratio SLOs ``bad_labels``
     selects the bad series of ``metric`` (label values may be fnmatch
     patterns: ``{"code": "5*"}``) and ``total_metric`` names the
@@ -71,10 +74,11 @@ class SLO:
 
     name: str
     metric: str
-    kind: str                        # "ratio" | "latency" | "gauge_floor"
+    kind: str        # "ratio" | "latency" | "gauge_floor" | "gauge_ceiling"
     target: float
     threshold_ms: float = 0.0        # latency kind only
     floor: float = 0.0               # gauge_floor kind only
+    ceiling: float = 0.0             # gauge_ceiling kind only
     total_metric: str = ""           # ratio kind denominator
     bad_labels: Mapping[str, str] = field(default_factory=dict)
     labels: Mapping[str, str] = field(default_factory=dict)
@@ -100,7 +104,7 @@ _slos: Dict[str, SLO] = {}
 
 def slo(name: str, *, metric: str, kind: str, target: float,
         threshold_ms: float = 0.0, floor: float = 0.0,
-        total_metric: str = "",
+        ceiling: float = 0.0, total_metric: str = "",
         bad_labels: Optional[Mapping[str, str]] = None,
         labels: Optional[Mapping[str, str]] = None,
         window_fast_s: float = 300.0, window_slow_s: float = 3600.0,
@@ -114,12 +118,12 @@ def slo(name: str, *, metric: str, kind: str, target: float,
     declared_in = ""
     if frame is not None and frame.f_back is not None:
         declared_in = frame.f_back.f_globals.get("__name__", "")
-    if kind not in ("ratio", "latency", "gauge_floor"):
-        raise ValueError(f"SLO kind must be ratio|latency|gauge_floor, "
-                         f"got {kind!r}")
+    if kind not in ("ratio", "latency", "gauge_floor", "gauge_ceiling"):
+        raise ValueError(f"SLO kind must be ratio|latency|gauge_floor|"
+                         f"gauge_ceiling, got {kind!r}")
     s = SLO(name=name, metric=metric, kind=kind, target=float(target),
             threshold_ms=float(threshold_ms), floor=float(floor),
-            total_metric=total_metric,
+            ceiling=float(ceiling), total_metric=total_metric,
             bad_labels=dict(bad_labels or {}), labels=dict(labels or {}),
             window_fast_s=float(window_fast_s),
             window_slow_s=float(window_slow_s),
@@ -434,6 +438,31 @@ class SloEngine:
                            "value": min(values) if values else None,
                            "series": len(values)}}
 
+    def _eval_gauge_ceiling(self, s: SLO, now: float) -> Dict[str, Any]:
+        """Per-scrape binary error: 1.0 while any matching gauge series
+        sits ABOVE the declared ceiling (the staleness mirror of
+        :meth:`_eval_gauge_floor`; same no-series -> no-burn idle
+        rule — a fleet that has not measured staleness yet must not
+        page)."""
+        m = self.registry.get(s.metric)
+        values: List[float] = []
+        if isinstance(m, Gauge):
+            for lbl, val in m.series():
+                if _labels_match(lbl, s.labels) and \
+                        isinstance(val, (int, float)):
+                    values.append(float(val))
+        frac = 1.0 if values and max(values) > s.ceiling else 0.0
+        ring = self._samples.setdefault(s.name, [])
+        ring.append((now, frac))
+        self._trim(ring, now, s.window_slow_s * 1.25)
+        rf = self._latency_over(ring, now, s.window_fast_s)
+        rs = self._latency_over(ring, now, s.window_slow_s)
+        return {"error_ratio": {"fast": rf, "slow": rs},
+                "burn": {"fast": rf / s.budget, "slow": rs / s.budget},
+                "detail": {"ceiling": s.ceiling,
+                           "value": max(values) if values else None,
+                           "series": len(values)}}
+
     def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
         now = self._clock() if now is None else float(now)
         burn_g = self.registry.gauge(
@@ -450,6 +479,8 @@ class SloEngine:
                     ev = self._eval_ratio(s, now)
                 elif s.kind == "gauge_floor":
                     ev = self._eval_gauge_floor(s, now)
+                elif s.kind == "gauge_ceiling":
+                    ev = self._eval_gauge_ceiling(s, now)
                 else:
                     ev = self._eval_latency(s, now)
                 bf, bs = ev["burn"]["fast"], ev["burn"]["slow"]

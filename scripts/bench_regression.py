@@ -9,8 +9,9 @@ the threshold (default 10%), the way trace-lint fails on contract
 drift.  Three row classes:
 
   * throughput rows (higher is better): fail on drops > threshold;
-  * latency rows (``p99_ms``/``p50_ms`` with no throughput key, the
-    loadtest per-bucket tail rows): fail on INCREASES > threshold;
+  * latency rows (``p99_ms``/``p50_ms``/``recompiles`` with no
+    throughput key — the loadtest per-bucket tail rows and the
+    refresh-under-load deploy-cost rows): fail on INCREASES > threshold;
   * SLO verdict rows (``slo_ok``): fail when a previously-met objective
     is now breached (no envelope — a breach is binary).
 
@@ -31,7 +32,7 @@ import sys
 
 THROUGHPUT_KEYS = ("iters_per_sec", "models_per_sec", "builds_per_sec",
                    "rows_per_sec", "qps")
-LATENCY_KEYS = ("p99_ms", "p50_ms")
+LATENCY_KEYS = ("p99_ms", "p50_ms", "recompiles")
 
 
 def load_rows(path):

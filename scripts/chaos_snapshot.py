@@ -11,6 +11,13 @@ mid-traffic, snapshotting the fleet restart/retry counters and the
 per-worker breaker table after recovery (informational — the BLOCKING
 fleet gate is the ``--fleet-chaos`` loadtest step; ``--fleet 0`` skips).
 
+A third ``delta`` block covers the continuous-learning lane: a trainer
+publishing per-round deltas (``publish/``) is crashed mid-run, resumed
+(the restarted publisher re-anchors the journal with a fresh BASE), and
+the chain is replayed both folded and record-by-record through a
+serving registry — bit-identical predictions and zero dense recompiles
+required (BLOCKING; ``--delta 0`` skips).
+
 Usage: python scripts/chaos_snapshot.py [--out recovery-telemetry.json]
 """
 
@@ -97,6 +104,91 @@ def _fleet_chaos_block(repo: str) -> dict:
     }
 
 
+def _delta_chain_block() -> dict:
+    """Continuous-learning crash cycle: a trainer publishing per-round
+    deltas is crashed mid-run, resumed (the restarted publisher
+    re-anchors the journal with a fresh BASE), and the journal is then
+    replayed two ways — folded wholesale and applied record-by-record
+    to a serving registry — both of which must predict bit-identically
+    to a cold load of the finished model.  In-envelope appends must
+    splice (mode ``extend``), not rebuild."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.models.model_text import model_to_string
+    from lightgbm_tpu.publish.delta import DeltaJournal
+    from lightgbm_tpu.publish.subscriber import load_journal
+    from lightgbm_tpu.resilience.faults import InjectedFault, faults
+    from lightgbm_tpu.serve.registry import ModelRegistry
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 6)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    p = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    rounds, crash_at = 6, 4
+    with tempfile.TemporaryDirectory() as tmp:
+        jdir = os.path.join(tmp, "journal")
+        ck = os.path.join(tmp, "ck")
+        pp = {**p, "publish_dir": jdir, "publish_every": 1,
+              "checkpoint_dir": ck}
+        faults.configure(f"crash_at_iter={crash_at}")
+        crashed = False
+        try:
+            lgb.train(pp, lgb.Dataset(X, y, params=pp), rounds)
+        except InjectedFault:
+            crashed = True
+        faults.clear()
+        j = DeltaJournal(jdir)
+        head_mid = j.head()
+        # crash_at_iter=K fires entering 0-based iteration K, so rounds
+        # 1..K published before the crash; the journal must be readable
+        # at exactly that boundary
+        mid_ok = head_mid is not None and head_mid.round == crash_at
+        resumed = lgb.train({**pp, "resume": "latest"},
+                            lgb.Dataset(X, y, params=pp), rounds)
+        head = j.head()
+        reanchored = head is not None and head.round == rounds
+        # replay path 1: fold the whole chain
+        g, rnd = load_journal(jdir)
+        folded = lgb.Booster(model_str=model_to_string(g))
+        fold_equal = rnd == rounds and bool(
+            np.array_equal(folded.predict(X[:64]),
+                           resumed.predict(X[:64])))
+        # replay path 2: record-by-record through a serving registry
+        # (shard=8 leaves dense headroom past the re-anchored base, so
+        # the appends must be in-envelope splices)
+        mfile = os.path.join(tmp, "model.txt")
+        resumed.save_model(mfile)
+        base_path, base_round = j.base_entry()
+        reg = ModelRegistry()
+        reg.load("m", base_path, warmup=True, shard=8)
+        Xq = X[:64].astype(np.float32)
+        reg.get("m").predict(Xq)  # warm the query-shape bucket
+        r0 = reg.get("m").stats.snapshot()["recompiles"]
+        modes = [reg.apply_delta("m", rec)["mode"]
+                 for rec in j.records_after(base_round)]
+        hot_preds = np.asarray(reg.get("m").predict(Xq))
+        # per-name serve stats are shared, so count recompiles before
+        # the cold-load reference (whose first compile would leak in)
+        recompiles = reg.get("m").stats.snapshot()["recompiles"] - r0
+        cold = ModelRegistry()
+        cold.load("m", mfile, warmup=False, shard=8)
+        delta_equal = bool(np.array_equal(
+            hot_preds, np.asarray(cold.get("m").predict(Xq))))
+        zero_recompile = all(m == "extend" for m in modes) and \
+            recompiles == 0
+    return {
+        "ok": bool(crashed and mid_ok and reanchored and fold_equal
+                   and delta_equal and zero_recompile),
+        "crashed": crashed,
+        "journal_head_after_crash": head_mid.round if head_mid else None,
+        "publisher_reanchored": reanchored,
+        "fold_predictions_equal": fold_equal,
+        "delta_replay_bit_identical": delta_equal,
+        "apply_modes": modes,
+        "delta_recompiles": recompiles,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="recovery-telemetry.json")
@@ -106,6 +198,10 @@ def main() -> int:
     ap.add_argument("--fleet", type=int, default=1,
                     help="1 (default) also runs the serve-fleet "
                          "worker-kill cycle; 0 skips it")
+    ap.add_argument("--delta", type=int, default=1,
+                    help="1 (default) also runs the publish-journal "
+                         "crash/re-anchor/replay cycle (BLOCKING); 0 "
+                         "skips it")
     args = ap.parse_args()
 
     import numpy as np
@@ -166,6 +262,19 @@ def main() -> int:
             fleet_block = {"ok": False,
                            "error": f"{type(exc).__name__}: {exc}"}
 
+    # continuous-learning journal cycle: crash a publishing trainer,
+    # resume, and replay the re-anchored delta chain (BLOCKING — a torn
+    # or diverging journal fails the snapshot)
+    delta_block = None
+    if args.delta:
+        try:
+            delta_block = _delta_chain_block()
+        except Exception as exc:
+            print(f"chaos_snapshot: delta block failed: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+            delta_block = {"ok": False,
+                           "error": f"{type(exc).__name__}: {exc}"}
+
     snap = default_registry().snapshot()
     keep = ("checkpoint_write_seconds", "resume_total",
             "faults_injected_total")
@@ -179,11 +288,14 @@ def main() -> int:
         "wall_seconds": round(time.time() - t0, 2),
         "metrics": {k: snap[k] for k in keep if k in snap},
         "fleet": fleet_block,
+        "delta": delta_block,
     }
     with open(args.out, "w") as fh:
         json.dump(record, fh, indent=2)
     print(json.dumps(record, indent=2))
     ok = crashed and bit_identical and preds_equal
+    if delta_block is not None:
+        ok = ok and delta_block.get("ok", False)
     print(f"chaos_snapshot: {'OK' if ok else 'FAILED'}")
     return 0 if ok else 1
 

@@ -1,0 +1,635 @@
+"""Continuous-learning lane tests (publish/ + serve delta path):
+
+* delta wire format + crash-safe journal: crc/fingerprint guards, torn
+  tails, gaps, compaction, the restart re-anchor rule;
+* trainer-side publisher: cadence, completion flush, journal head ==
+  ``save_model`` at the same iteration;
+* incremental serving refresh: ``ModelRegistry.apply_delta`` builds a
+  predictor bitwise-identical to a cold full load at every published
+  round, across the dense/walk compilers and the quantized-leaf path,
+  with ZERO dense recompiles while the append fits inside the
+  shard-padding envelope (signature-cache asserted);
+* the eviction guard and the init_model+resume_from typed error;
+* the HTTP surface (``POST /models/<name>/delta``) and, slow/chaos, a
+  fleet live-refresh run with a worker killed mid-publish: every
+  response comes from a published round — never a torn mix — and the
+  ``fleet/model_staleness`` SLO is re-met after recovery.
+"""
+
+import base64
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.model_text import model_to_string
+from lightgbm_tpu.publish.delta import (DeltaChainError, DeltaJournal,
+                                        DeltaRecord, chain_fingerprint,
+                                        fingerprint_text)
+from lightgbm_tpu.publish.publisher import DeltaPublisher
+from lightgbm_tpu.publish.subscriber import fold_chain, load_journal
+from lightgbm_tpu.serve.registry import ModelInUseError, ModelRegistry
+
+SMALL = {"num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1}
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _train(binary_data, rounds, publish_dir=None, every=1, **extra):
+    X, y = binary_data
+    p = {**SMALL, "objective": "binary", **extra}
+    if publish_dir is not None:
+        p["publish_dir"] = str(publish_dir)
+        p["publish_every"] = every
+    return lgb.train(p, lgb.Dataset(X, y, params=p), rounds)
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+def _record(payload="tree text", base_round=1, round=2, parent_fp=None):
+    parent = parent_fp if parent_fp is not None \
+        else fingerprint_text("base")
+    return DeltaRecord(base_round=base_round, round=round,
+                       parent_fp=parent,
+                       fp=chain_fingerprint(parent, payload),
+                       num_tree_per_iteration=1, payload=payload)
+
+
+def test_record_wire_roundtrip():
+    rec = _record(payload="fragment é text")
+    back = DeltaRecord.from_bytes(rec.to_bytes())
+    assert back == rec
+
+
+def test_record_wire_guards():
+    rec = _record()
+    raw = rec.to_bytes()
+    with pytest.raises(DeltaChainError, match="truncated"):
+        DeltaRecord.from_bytes(raw[:10])
+    with pytest.raises(DeltaChainError, match="magic"):
+        DeltaRecord.from_bytes(b"X" * len(raw))
+    with pytest.raises(DeltaChainError, match="torn"):
+        DeltaRecord.from_bytes(raw[:-3])
+    flipped = bytearray(raw)
+    flipped[-1] ^= 0xFF            # payload bit flip -> crc mismatch
+    with pytest.raises(DeltaChainError, match="crc"):
+        DeltaRecord.from_bytes(bytes(flipped))
+    # a record whose payload does not hash to its declared fp
+    forged = DeltaRecord(base_round=1, round=2, parent_fp=rec.parent_fp,
+                         fp=rec.fp, num_tree_per_iteration=1,
+                         payload="tampered")
+    with pytest.raises(DeltaChainError, match="fingerprint"):
+        DeltaRecord.from_bytes(forged.to_bytes())
+    bad_rounds = DeltaRecord(base_round=3, round=3,
+                             parent_fp=rec.parent_fp,
+                             fp=chain_fingerprint(rec.parent_fp, "x"),
+                             num_tree_per_iteration=1, payload="x")
+    with pytest.raises(DeltaChainError, match="non-monotonic"):
+        DeltaRecord.from_bytes(bad_rounds.to_bytes())
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+def test_journal_chain_and_replay(tmp_path):
+    j = DeltaJournal(str(tmp_path / "j"))
+    assert j.head() is None
+    fp0 = j.write_base("base text", 2)
+    r3 = j.append_delta("round 3 trees", 3)
+    r4 = j.append_delta("round 4 trees", 4)
+    assert r3.parent_fp == fp0 and r4.parent_fp == r3.fp
+    h = j.head()
+    assert h is not None and h.round == 4 and h.fp == r4.fp
+    base_text, base_round, records = j.chain()
+    assert base_text == "base text" and base_round == 2
+    assert [r.round for r in records] == [3, 4]
+    assert [r.round for r in j.records_after(3)] == [4]
+    path, rnd = j.base_entry()
+    assert rnd == 2 and open(path).read() == "base text"
+
+
+def test_journal_append_guards(tmp_path):
+    j = DeltaJournal(str(tmp_path / "j"))
+    with pytest.raises(DeltaChainError, match="empty"):
+        j.append_delta("x", 1)
+    j.write_base("base", 3)
+    with pytest.raises(DeltaChainError, match="non-monotonic"):
+        j.append_delta("x", 3)
+
+
+def test_journal_torn_tail_falls_back(tmp_path):
+    """A crash mid-append can leave a torn tail entry; ``head`` must
+    fall back to the newest intact entry instead of failing."""
+    j = DeltaJournal(str(tmp_path / "j"))
+    j.write_base("base", 1)
+    rec = j.append_delta("round 2", 2)
+    torn = os.path.join(j.directory, "DELTA.00002")
+    with open(torn, "wb") as fh:
+        fh.write(rec.to_bytes()[:-5])     # torn write
+    h = j.head()
+    assert h is not None and h.kind == "base" and h.round == 1
+
+
+def test_journal_gap_detected(tmp_path):
+    j = DeltaJournal(str(tmp_path / "j"))
+    j.write_base("base", 1)
+    j.append_delta("round 2", 2)
+    j.append_delta("round 3", 3)
+    os.unlink(os.path.join(j.directory, "DELTA.00002"))
+    with pytest.raises(DeltaChainError, match="chain gap"):
+        j.chain()
+
+
+def test_journal_compact_prunes(tmp_path):
+    j = DeltaJournal(str(tmp_path / "j"))
+    j.write_base("base", 1)
+    j.append_delta("round 2", 2)
+    j.append_delta("round 3", 3)
+    assert j.chain_length() == 2
+    j.compact("folded text", 3)
+    assert j.chain_length() == 0
+    names = sorted(os.listdir(j.directory))
+    assert names == ["BASE.00003.txt", "HEAD"]
+    base_text, base_round, records = j.chain()
+    assert base_text == "folded text" and base_round == 3 and not records
+
+
+# ---------------------------------------------------------------------------
+# publisher (trainer side)
+# ---------------------------------------------------------------------------
+
+def test_publisher_cadence_and_journal_parity(tmp_path, binary_data):
+    X, y = binary_data
+    jdir = tmp_path / "journal"
+    bst = _train(binary_data, 6, publish_dir=jdir, every=2)
+    names = sorted(n for n in os.listdir(jdir) if n != "HEAD")
+    # cadence 2 over 6 rounds: BASE at the first publish, deltas after
+    assert names == ["BASE.00002.txt", "DELTA.00004", "DELTA.00006"]
+    g, rnd = load_journal(str(jdir))
+    assert rnd == 6 and len(g.models) == 6
+    # the folded chain predicts exactly like the trained booster
+    folded = lgb.Booster(model_str=model_to_string(g))
+    np.testing.assert_allclose(folded.predict(X[:64]),
+                               bst.predict(X[:64]), rtol=1e-6)
+    # publish knobs are deployment-transient: never serialized into the
+    # model text (a journal payload replayed elsewhere must not re-arm
+    # publishing there)
+    assert "publish_dir" not in model_to_string(bst._gbdt)
+
+
+def test_publisher_completion_flush_off_cadence(tmp_path, binary_data):
+    """5 rounds at cadence 2: rounds 2 and 4 publish in-loop, round 5
+    lands via the completion flush — the journal head always equals the
+    final model."""
+    jdir = tmp_path / "journal"
+    _train(binary_data, 5, publish_dir=jdir, every=2)
+    j = DeltaJournal(str(jdir))
+    assert j.head().round == 5
+    _, rnd = load_journal(str(jdir))
+    assert rnd == 5
+
+
+def test_publisher_restart_reanchors_with_fresh_base(tmp_path,
+                                                     binary_data):
+    X, y = binary_data
+    p = {**SMALL, "objective": "binary"}
+    b3 = lgb.train(p, lgb.Dataset(X, y, params=p), 3)
+    jdir = str(tmp_path / "journal")
+    p1 = DeltaPublisher(jdir)
+    assert p1.publish(b3._gbdt)
+    b5 = lgb.train(p, lgb.Dataset(X, y, params=p), 2, init_model=b3)
+    assert p1.publish(b5._gbdt)
+    j = DeltaJournal(jdir)
+    assert j.head().round == 5 and j.chain_length() == 1
+    # a restarted trainer must NOT guess at the prior chain: its first
+    # publish re-anchors with a fresh BASE at its own round
+    p2 = DeltaPublisher(jdir)
+    assert p2.publish(b5._gbdt)
+    h = j.head()
+    assert h.kind == "base" and h.round == 5
+    assert j.chain_length() == 0 and not j.records_after(5)
+
+
+def test_publisher_compacts_after_chain_limit(tmp_path, binary_data):
+    jdir = tmp_path / "journal"
+    _train(binary_data, 6, publish_dir=jdir, every=1)
+    j = DeltaJournal(str(jdir))
+    assert j.chain_length() == 5       # engine default: never compact
+    X, y = binary_data
+    p = {**SMALL, "objective": "binary"}
+    pub = DeltaPublisher(str(tmp_path / "j2"), compact_after=2)
+    b = lgb.train(p, lgb.Dataset(X, y, params=p), 1)
+    pub.publish(b._gbdt)
+    for _ in range(3):                 # rounds 2, 3, 4
+        b = lgb.train(p, lgb.Dataset(X, y, params=p), 1, init_model=b)
+        pub.publish(b._gbdt)
+    assert pub.journal.chain_length() < 2
+    assert pub.journal.head().round == 4
+
+
+# ---------------------------------------------------------------------------
+# incremental serving refresh: delta parity + zero-recompile envelope
+# ---------------------------------------------------------------------------
+
+def _journal_and_model(tmp_path, data, rounds=5, **extra):
+    jdir = tmp_path / "journal"
+    bst = _train(data, rounds, publish_dir=jdir, every=1, **extra)
+    mfile = str(tmp_path / "model.txt")
+    bst.save_model(mfile)
+    return DeltaJournal(str(jdir)), mfile
+
+
+@pytest.mark.parametrize("kwargs", [
+    {"shard": 4},                      # dense, in-envelope appends
+    {"compiler": "walk"},              # no dense tables: rebuild path
+    {"shard": 4, "leaf_bits": 8},      # quantized leaf codes
+], ids=["dense-shard4", "walk", "quantized-leaf8"])
+def test_delta_parity_bitwise_with_cold_load(tmp_path, binary_data,
+                                             kwargs):
+    """Acceptance: a predictor grown round-by-round via ``apply_delta``
+    is BITWISE identical to a cold full load at every published round,
+    across bucket boundaries."""
+    X, _ = binary_data
+    j, mfile = _journal_and_model(tmp_path, binary_data, rounds=5)
+    base_path, base_round = j.base_entry()
+    reg = ModelRegistry()
+    reg.load("m", base_path, warmup=False, **kwargs)
+    rng = np.random.RandomState(0)
+    queries = [rng.randn(n, X.shape[1]).astype(np.float32)
+               for n in (1, 7, 9, 63)]
+    for rec in j.records_after(base_round):
+        out = reg.apply_delta("m", rec)
+        assert out["round"] == rec.round
+        # cold-load reference at the SAME round
+        cold = ModelRegistry()
+        cold.load("m", mfile, warmup=False,
+                  num_iteration=rec.round, **kwargs)
+        for Xq in queries:
+            got = np.asarray(reg.get("m").predict(Xq))
+            ref = np.asarray(cold.get("m").predict(Xq))
+            assert np.array_equal(got, ref), \
+                f"round {rec.round}: delta-applied != cold load"
+
+
+def test_delta_parity_multiclass(tmp_path, multiclass_data):
+    X, y = multiclass_data
+    jdir = tmp_path / "journal"
+    p = {**SMALL, "objective": "multiclass", "num_class": 3,
+         "publish_dir": str(jdir), "publish_every": 1}
+    bst = lgb.train(p, lgb.Dataset(X, y, params=p), 3)
+    mfile = str(tmp_path / "model.txt")
+    bst.save_model(mfile)
+    j = DeltaJournal(str(jdir))
+    base_path, base_round = j.base_entry()
+    reg = ModelRegistry()
+    reg.load("m", base_path, warmup=False, shard=8)
+    for rec in j.records_after(base_round):
+        assert rec.num_tree_per_iteration == 3
+        reg.apply_delta("m", rec)
+    cold = ModelRegistry()
+    cold.load("m", mfile, warmup=False, shard=8)
+    got = np.asarray(reg.get("m").predict(X[:32]))
+    ref = np.asarray(cold.get("m").predict(X[:32]))
+    assert got.shape == (32, 3)
+    assert np.array_equal(got, ref)
+
+
+def test_zero_recompiles_inside_shard_envelope(tmp_path, binary_data):
+    """Acceptance: an in-envelope delta append splices lowered rows into
+    the shard-padding slack — the dense signature is UNCHANGED (same
+    jit cache entry) and serving the grown model recompiles nothing."""
+    X, _ = binary_data
+    j, _ = _journal_and_model(tmp_path, binary_data, rounds=2)
+    base_path, base_round = j.base_entry()
+    reg = ModelRegistry()
+    # shard=4 pads the 1-tree base to capacity 4: rounds 2..4 append
+    # in place; warmup compiles every bucket once
+    reg.load("m", base_path, warmup=True, shard=4)
+    p1 = reg.get("m")
+    assert p1.info()["dense"]["capacity"] == 4
+    sig_before = p1._sig
+    r_before = p1.stats.snapshot()["recompiles"]
+    (rec,) = j.records_after(base_round)
+    out = reg.apply_delta("m", rec)
+    assert out["mode"] == "extend"
+    p2 = reg.get("m")
+    assert p2 is not p1 and p2.num_trees == 2
+    assert p2._sig == sig_before, "in-envelope append changed the " \
+                                  "dense signature (jit cache miss)"
+    rng = np.random.RandomState(1)
+    for n in (1, 7, 8, 9, 63):
+        p2.predict(rng.randn(n, X.shape[1]))
+    assert p2.stats.snapshot()["recompiles"] == r_before, \
+        "in-envelope delta append must not trigger dense recompiles"
+
+
+def test_extend_past_envelope_rebuilds(tmp_path, binary_data):
+    """Appending past the padded capacity falls back to a full rebuild
+    (mode 'rebuild') and still serves the right ensemble."""
+    j, mfile = _journal_and_model(tmp_path, binary_data, rounds=6)
+    base_path, base_round = j.base_entry()
+    reg = ModelRegistry()
+    reg.load("m", base_path, warmup=False, shard=4)
+    modes = [reg.apply_delta("m", rec)["mode"]
+             for rec in j.records_after(base_round)]
+    assert "rebuild" in modes          # capacity 4 crossed at round 5
+    assert modes[0] == "extend"        # round 2 fit in the envelope
+    X, _ = binary_data
+    cold = ModelRegistry()
+    cold.load("m", mfile, warmup=False, shard=4)
+    assert np.array_equal(np.asarray(reg.get("m").predict(X[:16])),
+                          np.asarray(cold.get("m").predict(X[:16])))
+
+
+def test_extend_refuses_train_attached_predictor(binary_data):
+    """Delta trees are text-parsed (REAL feature indices); a train-set
+    attached predictor remaps through inner indices — mixing them would
+    mis-route splits, so ``extended`` refuses with a typed error."""
+    from lightgbm_tpu.serve.predictor import CompiledPredictor
+    X, y = binary_data
+    Xw = np.hstack([X, np.zeros((X.shape[0], 2))])   # unused columns
+    p = {**SMALL, "objective": "binary"}
+    bst = lgb.train(p, lgb.Dataset(Xw, y, params=p), 2)
+    pred = CompiledPredictor(bst)
+    if pred._used is None:
+        pytest.skip("all features used; no inner remap to guard")
+    with pytest.raises(ValueError, match="train-set-attached"):
+        pred.extended(bst._gbdt.models[:1])
+
+
+def test_registry_chain_guards(tmp_path, binary_data):
+    X, y = binary_data
+    j, mfile = _journal_and_model(tmp_path, binary_data, rounds=3)
+    base_path, base_round = j.base_entry()
+    recs = j.records_after(base_round)
+    reg = ModelRegistry()
+    reg.load("m", base_path, warmup=False, shard=4)
+    # gap: skipping a round is a typed chain error, not silent drift
+    with pytest.raises(DeltaChainError, match="re-anchor"):
+        reg.apply_delta("m", recs[1])
+    reg.apply_delta("m", recs[0])
+    assert reg.round_of("m") == recs[0].round
+    # replayed record -> idempotent noop (at-least-once push safe)
+    out = reg.apply_delta("m", recs[0])
+    assert out["mode"] == "noop"
+    # wire-bytes input works identically
+    out = reg.apply_delta("m", recs[1].to_bytes())
+    assert out["round"] == recs[1].round
+    # unknown model
+    with pytest.raises(KeyError):
+        reg.apply_delta("ghost", recs[0])
+    # divergent base: a different 1-round model has the right round
+    # count but the wrong fingerprint
+    p = {**SMALL, "objective": "binary", "learning_rate": 0.31}
+    other = lgb.train(p, lgb.Dataset(X, y, params=p), 1)
+    ofile = str(tmp_path / "other.txt")
+    other.save_model(ofile)
+    reg2 = ModelRegistry()
+    reg2.load("m", ofile, warmup=False)
+    with pytest.raises(DeltaChainError, match="fingerprint"):
+        reg2.apply_delta("m", recs[0])
+    # a full reload clears the chain position
+    reg.load("m", mfile, warmup=False)
+    assert reg.round_of("m") is None
+
+
+def test_evict_guard_and_inflight_readers(tmp_path, binary_data):
+    _, mfile = _journal_and_model(tmp_path, binary_data, rounds=2)
+    X, _ = binary_data
+    reg = ModelRegistry()
+    reg.load("only", mfile, warmup=False)
+    with pytest.raises(ModelInUseError, match="force=True"):
+        reg.evict("only")
+    assert reg.names() == ["only"]     # refused evict left it serving
+    # an in-flight reader that already resolved the predictor finishes
+    # even across a forced eviction (predictors are immutable; handlers
+    # hold their own reference)
+    pred = reg.get("only")
+    assert reg.evict("only", force=True)
+    out = pred.predict(X[:8])
+    assert np.asarray(out).shape == (8,)
+    assert reg.names() == []
+    # with >1 models the guard does not bite
+    reg.load("a", mfile, warmup=False)
+    reg.load("b", mfile, warmup=False)
+    assert reg.evict("b")
+    assert reg.names() == ["a"]
+    assert reg.evict("missing") is False
+
+
+def test_engine_refuses_init_model_plus_resume(tmp_path, binary_data):
+    from lightgbm_tpu.resilience.checkpoint import CheckpointError
+    X, y = binary_data
+    ck = str(tmp_path / "ckpt")
+    p = {**SMALL, "objective": "binary", "checkpoint_dir": ck}
+    warm = lgb.train(p, lgb.Dataset(X, y, params=p), 2)
+    with pytest.raises(CheckpointError, match="init_model and "
+                                              "resume_from"):
+        lgb.train({**p, "resume": "latest"}, lgb.Dataset(X, y, params=p),
+                  4, init_model=warm)
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+def _post(host, port, path, payload, timeout=30):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        body = json.dumps(payload).encode()
+        conn.request("POST", path, body,
+                     {"Content-Type": "application/json",
+                      "Content-Length": str(len(body))})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def test_server_delta_endpoint(tmp_path, binary_data):
+    from lightgbm_tpu.serve.server import PredictionServer
+    j, _ = _journal_and_model(tmp_path, binary_data, rounds=3)
+    base_path, base_round = j.base_entry()
+    recs = j.records_after(base_round)
+    reg = ModelRegistry()
+    reg.load("m", base_path, warmup=False, shard=4)
+    srv = PredictionServer(reg, port=0, max_wait_ms=0.5).start()
+    try:
+        def b64(rec):
+            return base64.b64encode(rec.to_bytes()).decode("ascii")
+
+        status, body = _post(srv.host, srv.port, "/models/m/delta",
+                             {"record_b64": b64(recs[0])})
+        assert status == 200 and body["round"] == recs[0].round, body
+        assert reg.round_of("m") == recs[0].round
+        # replay -> still 200, noop (pushes are at-least-once)
+        status, body = _post(srv.host, srv.port, "/models/m/delta",
+                             {"record_b64": b64(recs[0])})
+        assert status == 200 and body["mode"] == "noop"
+        # a gap is 409: the subscriber's fall-back-to-full-reload signal
+        bad = DeltaRecord(base_round=recs[1].round + 3,
+                          round=recs[1].round + 4,
+                          parent_fp=recs[1].fp,
+                          fp=chain_fingerprint(recs[1].fp, "x"),
+                          num_tree_per_iteration=1, payload="x")
+        status, body = _post(srv.host, srv.port, "/models/m/delta",
+                             {"record_b64": b64(bad)})
+        assert status == 409, body
+        status, body = _post(srv.host, srv.port, "/models/ghost/delta",
+                             {"record_b64": b64(recs[1])})
+        assert status == 404, body
+        status, body = _post(srv.host, srv.port, "/models/m/delta",
+                             {"record_b64": "!!!not-base64!!!"})
+        assert status == 400, body
+        status, body = _post(srv.host, srv.port, "/models/m/delta", {})
+        assert status == 400, body
+        # the happy path continues after the rejects
+        status, body = _post(srv.host, srv.port, "/models/m/delta",
+                             {"record_b64": b64(recs[1])})
+        assert status == 200 and body["round"] == recs[1].round
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# slow/chaos: fleet live refresh with a worker killed mid-publish
+# ---------------------------------------------------------------------------
+
+def _get_json(host, port, path, timeout=30):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _wait_for(predicate, timeout=60.0, interval=0.05, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_live_refresh_kill_mid_publish(tmp_path, binary_data):
+    """Acceptance: a 2-worker fleet following a delta journal under
+    live traffic, with one worker KILLED mid-publish, (a) serves every
+    response from some published round — never a torn mix of rounds,
+    (b) converges both workers to the journal head with delta pushes
+    (not just respawn reloads), and (c) re-meets the
+    ``fleet/model_staleness`` SLO after recovery."""
+    from lightgbm_tpu.serve.fleet import FleetSupervisor
+    X, y = binary_data
+    p = {**SMALL, "objective": "binary"}
+    full = lgb.train(p, lgb.Dataset(X, y, params=p), 6)
+    g = full._gbdt
+    jdir = str(tmp_path / "journal")
+    j = DeltaJournal(jdir)
+    base_text = model_to_string(g, num_iteration=3)
+    model_file = str(tmp_path / "model.txt")
+    with open(model_file, "w") as fh:
+        fh.write(base_text)
+    j.write_base(base_text, 3)
+    Xq = X[:4].astype(np.float32)
+    # reference predictions per published round: every served response
+    # must match one of these exactly (floats round-trip JSON via repr)
+    refs = {r: lgb.Booster(model_str=model_to_string(
+                g, num_iteration=r)).predict(Xq).tolist()
+            for r in (3, 4, 5, 6)}
+    assert len({tuple(v) for v in refs.values()}) == 4
+    fleet = FleetSupervisor(
+        [model_file], workers=2,
+        worker_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO},
+        worker_args={"warmup": "0", "max_wait_ms": "0.5"},
+        probe_interval_s=0.25, probe_timeout_s=5.0,
+        breaker_failures=5, breaker_window_s=20.0,
+        breaker_halfopen_s=1.0, backoff_base_s=0.2, backoff_max_s=1.0,
+        startup_timeout_s=180.0, drain_timeout_s=30.0,
+        forward_timeout_s=60.0, publish_dir=jdir,
+        run_dir=str(tmp_path / "fleet-run"))
+    fleet.start()
+    try:
+        _wait_for(lambda: all(w.acked_round == 3
+                              for w in fleet.workers()),
+                  desc="both workers anchored at the base round")
+        stop = threading.Event()
+        responses, mixes = [], []
+
+        def poller():
+            while not stop.is_set():
+                try:
+                    status, body = _post(fleet.host, fleet.port,
+                                         "/predict",
+                                         {"rows": Xq.tolist()},
+                                         timeout=60)[0:2]
+                except Exception:
+                    continue
+                if status != 200:
+                    continue
+                preds = body["predictions"]
+                rounds = [r for r, v in refs.items() if v == preds]
+                responses.append(rounds[0] if rounds else None)
+                if not rounds:
+                    mixes.append(preds)
+                time.sleep(0.02)
+
+        pt = threading.Thread(target=poller, daemon=True)
+        pt.start()
+        # publish rounds 4..6 while traffic flows; kill w0 right after
+        # round 5 lands (mid-publish: its round-5 push or replay races
+        # the respawn)
+        for r in (4, 5, 6):
+            j.append_delta(model_to_string(g, start_iteration=r - 1,
+                                           num_iteration=1), r)
+            if r == 5:
+                w0 = fleet.workers()[0]
+                if w0.proc is not None and w0.proc.poll() is None:
+                    w0.proc.kill()
+            time.sleep(0.8)
+        _wait_for(lambda: all(w.state == "alive" and w.acked_round == 6
+                              for w in fleet.workers()),
+                  timeout=90.0,
+                  desc="both workers recovered and caught up to round 6")
+        stop.set()
+        pt.join(10)
+        # (a) every successful response came from a published round
+        assert not mixes, f"responses matched NO published round: " \
+                          f"{mixes[:2]}"
+        assert len(responses) > 0 and None not in responses
+        # traffic actually observed a refresh, not one static round
+        assert len(set(responses)) >= 2, set(responses)
+        # (b) deltas were pushed and applied (the ok counter moved)
+        reg = fleet.metrics_registry
+        pushes = reg.get("fleet_delta_pushes_total")
+        assert pushes is not None and pushes.value(outcome="ok") >= 3
+        # the fleet now serves the head round everywhere
+        for _ in range(6):
+            status, body = _post(fleet.host, fleet.port, "/predict",
+                                 {"rows": Xq.tolist()}, timeout=60)
+            assert status == 200 and body["predictions"] == refs[6]
+        # (c) the staleness SLO is re-met after recovery: gauges read 0
+        # rounds behind and the objective is not breached
+        behind = reg.get("fleet_model_rounds_behind")
+        assert behind is not None
+        _wait_for(lambda: max(behind.value(model="model", worker=w.name)
+                              for w in fleet.workers()) == 0.0,
+                  timeout=15.0, desc="rounds-behind gauges back to 0")
+        report = fleet.slo_engine.evaluate()
+        stale = next(s for s in report["slos"]
+                     if s["name"] == "fleet/model_staleness")
+        assert stale["breached"] is False, stale
+    finally:
+        fleet.shutdown()
