@@ -45,6 +45,15 @@ recompiles and both lanes to stay 5xx-free, and the per-lane p99 +
 recompile counts land in the bench matrix (env knobs: REFRESH_DURATION,
 REFRESH_QPS, REFRESH_BASE_ROUNDS, REFRESH_ROUNDS, REFRESH_SHARD).
 
+``--zoo`` runs the multi-tenant model-zoo rung: zipf-distributed
+traffic over 16 same-shape tenants is served twice by the real HTTP
+server — once through the zoo's batched cross-model stacked dispatch
+and once with stacking off (per-model batchers) — and the verdict
+requires the stacked lane to deliver >= 2x rows/s OR >= 4x fewer MXU
+launches per 1k requests, with every cold load-on-miss counted and its
+p99 reported (env knobs: ZOO_MODELS, ZOO_DURATION, ZOO_THREADS,
+ZOO_ROWS, ZOO_ZIPF, ZOO_MAX_WAIT_MS).
+
 Exit code: 0 on pass, 1 on breach/underrun — CI runs all modes
 blocking, next to the chaos step.
 """
@@ -574,6 +583,227 @@ def run_refresh_under_load(duration_s: float = 6.0, qps: float = 40.0,
     }
 
 
+def _zoo_lane(stacking: bool, model_dir: str, names, duration_s: float,
+              threads_n: int, rows_per_req: int, features: int,
+              zipf_a: float, max_wait_ms: float):
+    """One zoo lane: a fresh zoo-mode server over ``model_dir``, every
+    tenant cold-loaded on its first touch, then ``duration_s`` of
+    zipf-distributed closed-loop traffic.  Returns server-side truth
+    (rows/s, device launches, cold-load p99) from /metrics deltas."""
+    from lightgbm_tpu.serve.loadgen import (metric_sum, parse_prometheus,
+                                            scrape_metrics)
+    from lightgbm_tpu.serve.registry import ModelRegistry
+    from lightgbm_tpu.serve.server import PredictionServer
+    from lightgbm_tpu.serve.zoo import ModelZoo
+    from lightgbm_tpu.telemetry.slo import SloEngine
+
+    registry = ModelRegistry()
+    zoo = ModelZoo(registry=registry, max_resident=len(names),
+                   source_resolver=model_dir, stacking=stacking,
+                   batching=True, max_wait_ms=max_wait_ms, warmup=False)
+    srv = PredictionServer(registry, port=0, zoo=zoo,
+                           slo_engine=SloEngine()).start()
+    host, port = srv.host, srv.port
+    rng0 = np.random.RandomState(7)
+    probe = rng0.randn(rows_per_req, features).tolist()
+    try:
+        # counters are process-cumulative across lanes: every read below
+        # is a delta against this lane's own start
+        start = parse_prometheus(scrape_metrics(host, port))
+        # first touch of every tenant IS its cold load (counted +
+        # timed by zoo_cold_load_ms); also warms the (stack, bucket)
+        # programs so the timed window measures steady state
+        for name in names:
+            code, _ = _post_json(host, port, "/predict",
+                                 {"model": name, "rows": probe})
+            if code != 200:
+                raise RuntimeError(f"prewarm of {name} -> HTTP {code}")
+        for name in names:  # second lap: post-stack-formation programs
+            _post_json(host, port, "/predict",
+                       {"model": name, "rows": probe})
+
+        before = parse_prometheus(scrape_metrics(host, port))
+        counts = {"sent": 0, "ok": 0, "errors": {}}
+        lock = threading.Lock()
+        t0 = time.perf_counter()
+        stop_at = t0 + duration_s
+        # synchronized burst ticks — the fan-out scoring pattern the
+        # stack exists for: every client fires at the same instant, each
+        # at its own zipf-sampled tenant, so one arrival wave holds many
+        # distinct tenants (per-model serving pays one launch per tenant
+        # in the wave; stacked dispatch one launch per wave)
+        barrier = threading.Barrier(threads_n)
+
+        def worker(wid):
+            rng = np.random.RandomState(100 + wid)
+            rows = rng.randn(rows_per_req, features).tolist()
+            sent = ok = 0
+            errors = {}
+            while time.perf_counter() < stop_at:
+                try:
+                    barrier.wait(timeout=10.0)
+                except threading.BrokenBarrierError:
+                    break
+                i = min(int(rng.zipf(zipf_a)) - 1, len(names) - 1)
+                sent += 1
+                try:
+                    code, _ = _post_json(host, port, "/predict",
+                                         {"model": names[i],
+                                          "rows": rows})
+                except Exception:
+                    errors["connect"] = errors.get("connect", 0) + 1
+                    continue
+                if code == 200:
+                    ok += 1
+                else:
+                    errors[str(code)] = errors.get(str(code), 0) + 1
+            barrier.abort()   # release peers parked on the next tick
+            with lock:
+                counts["sent"] += sent
+                counts["ok"] += ok
+                for k, v in errors.items():
+                    counts["errors"][k] = counts["errors"].get(k, 0) + v
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(threads_n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        after = parse_prometheus(scrape_metrics(host, port))
+
+        def delta(metric, **labels):
+            return metric_sum(after, metric, **labels) - \
+                metric_sum(before, metric, **labels)
+
+        rows_served = delta("lgbm_tpu_serve_rows_total")
+        reqs = delta("lgbm_tpu_serve_requests_total")
+        fused = delta("lgbm_tpu_zoo_stack_batches_total")
+        # in stacked mode serve_batches_total counts per-LANE slices of
+        # a fused launch, so device launches = the fused counter; with
+        # stacking off every batch is its own launch
+        launches = fused if stacking else delta(
+            "lgbm_tpu_serve_batches_total")
+        return {
+            "mode": "stacked" if stacking else "per-model",
+            "rows_per_sec": round(rows_served / elapsed, 1),
+            "qps": round(reqs / elapsed, 2),
+            "requests": int(reqs),
+            "launches": int(launches),
+            "launches_per_1k_requests": round(
+                1000.0 * launches / reqs, 2) if reqs else 0.0,
+            "fused_launches": int(fused),
+            "cold_loads": int(
+                metric_sum(after, "lgbm_tpu_zoo_cold_loads_total") -
+                metric_sum(start, "lgbm_tpu_zoo_cold_loads_total")),
+            "cold_load_p99_ms": metric_sum(
+                after, "lgbm_tpu_zoo_cold_load_ms_p99"),
+            "stack_groups": len(zoo.stack_membership()),
+            "availability": round(
+                counts["ok"] / counts["sent"], 6) if counts["sent"]
+                else 0.0,
+            "client": counts,
+        }
+    finally:
+        srv.shutdown()
+        zoo.close()
+
+
+def run_zoo_loadtest(models: int = 16, duration_s: float = 5.0,
+                     threads_n: int = 24, rows_per_req: int = 4,
+                     features: int = 6, trees: int = 20, leaves: int = 15,
+                     zipf_a: float = 1.3, max_wait_ms: float = 10.0):
+    """Multi-tenant zoo rung: the SAME zipf workload over ``models``
+    same-shape tenants, served stacked (batched cross-model dispatch)
+    and per-model; pass needs >= 2x rows/s OR >= 4x fewer launches per
+    1k requests for the stacked lane, on top of full availability and
+    every tenant cold-loading exactly once."""
+    from lightgbm_tpu.utils.backend import default_backend
+    from lightgbm_tpu.utils.log import set_verbosity
+
+    backend = default_backend()
+    set_verbosity(-1)
+    names = [f"tenant{i:02d}" for i in range(int(models))]
+    with tempfile.TemporaryDirectory() as tmp:
+        model_file = _train_model(trees, leaves, features, tmp)
+        zoo_dir = os.path.join(tmp, "zoo")
+        os.makedirs(zoo_dir)
+        with open(model_file) as fh:
+            text = fh.read()
+        for name in names:
+            with open(os.path.join(zoo_dir, f"{name}.txt"), "w") as fh:
+                fh.write(text)
+        lanes = [
+            _zoo_lane(True, zoo_dir, names, duration_s, threads_n,
+                      rows_per_req, features, zipf_a, max_wait_ms),
+            _zoo_lane(False, zoo_dir, names, duration_s, threads_n,
+                      rows_per_req, features, zipf_a, max_wait_ms),
+        ]
+    stacked, solo = lanes
+    rows_ratio = (stacked["rows_per_sec"] / solo["rows_per_sec"]
+                  if solo["rows_per_sec"] else 0.0)
+    launch_ratio = (solo["launches_per_1k_requests"] /
+                    stacked["launches_per_1k_requests"]
+                    if stacked["launches_per_1k_requests"] else 0.0)
+    speedup_ok = rows_ratio >= 2.0 or launch_ratio >= 4.0
+    avail_ok = all(l["availability"] >= 1.0 for l in lanes)
+    cold_ok = all(l["cold_loads"] == len(names) for l in lanes)
+    fused_ok = stacked["fused_launches"] > 0 and \
+        stacked["stack_groups"] >= 1
+    return {
+        "schema": "zoo-loadtest-report-v1",
+        "git_sha": _git_sha(),
+        "backend": backend,
+        "verdict": "pass" if (speedup_ok and avail_ok and cold_ok and
+                              fused_ok) else "breach",
+        "speedup_ok": speedup_ok,
+        "availability_ok": avail_ok,
+        "cold_loads_ok": cold_ok,
+        "fused_ok": fused_ok,
+        "rows_ratio": round(rows_ratio, 2),
+        "launch_ratio": round(launch_ratio, 2),
+        "config": {"models": int(models), "duration_s": duration_s,
+                   "threads": int(threads_n),
+                   "rows_per_request": int(rows_per_req),
+                   "features": int(features), "zipf_a": zipf_a,
+                   "max_wait_ms": max_wait_ms, "backend": backend},
+        "lanes": lanes,
+    }
+
+
+def zoo_to_bench_matrix(report) -> dict:
+    """bench-matrix-v1 rows for the nightly gate: per lane one rows/s
+    row and one launches-per-1k row (the stacked lane drifting toward
+    the per-model launch count is a regression of the fused dispatch),
+    one cold-load p99 row, and the verdict."""
+    rows = []
+    for lane in report["lanes"]:
+        rows.append({"name": f"zoo_{lane['mode']}",
+                     "config": report["config"],
+                     "rows_per_sec": lane["rows_per_sec"],
+                     "availability": lane["availability"],
+                     "interpreted": False})
+        rows.append({"name": f"zoo_{lane['mode']}_launches",
+                     "config": report["config"],
+                     "launches_per_1k": lane["launches_per_1k_requests"],
+                     "interpreted": False})
+    rows.append({"name": "zoo_cold_load",
+                 "config": report["config"],
+                 "p99_ms": report["lanes"][0]["cold_load_p99_ms"],
+                 "interpreted": False})
+    rows.append({"name": "zoo_verdict",
+                 "slo_ok": report["verdict"] == "pass",
+                 "verdict": report["verdict"]})
+    return {
+        "schema": "bench-matrix-v1",
+        "bench": "zoo-loadtest",
+        "git_sha": report["git_sha"],
+        "backend": report["backend"],
+        "rows": rows,
+    }
+
+
 def refresh_to_bench_matrix(report) -> dict:
     """bench-matrix-v1 rows for the nightly gate: per refresh lane one
     p99 row and one recompile row (delta lane drifting off 0 recompiles
@@ -691,6 +921,35 @@ def main(argv) -> int:
         if json_path:
             with open(json_path, "w") as fh:
                 json.dump(fleet_chaos_to_bench_matrix(report), fh,
+                          indent=2, default=str)
+        return 0 if report["verdict"] == "pass" else 1
+
+    if "--zoo" in argv:
+        report = run_zoo_loadtest(
+            models=int(os.environ.get("ZOO_MODELS", 16)),
+            duration_s=float(os.environ.get("ZOO_DURATION", 5.0)),
+            threads_n=int(os.environ.get("ZOO_THREADS", 24)),
+            rows_per_req=int(os.environ.get("ZOO_ROWS", 4)),
+            zipf_a=float(os.environ.get("ZOO_ZIPF", 1.3)),
+            max_wait_ms=float(os.environ.get("ZOO_MAX_WAIT_MS", 10.0)))
+        print(json.dumps({
+            "verdict": report["verdict"],
+            "speedup_ok": report["speedup_ok"],
+            "availability_ok": report["availability_ok"],
+            "cold_loads_ok": report["cold_loads_ok"],
+            "rows_ratio": report["rows_ratio"],
+            "launch_ratio": report["launch_ratio"],
+            "lanes": [{k: l[k] for k in
+                       ("mode", "rows_per_sec", "qps",
+                        "launches_per_1k_requests", "cold_loads",
+                        "cold_load_p99_ms", "availability")}
+                      for l in report["lanes"]]}, indent=2), flush=True)
+        if slo_path:
+            with open(slo_path, "w") as fh:
+                json.dump(report, fh, indent=2, default=str)
+        if json_path:
+            with open(json_path, "w") as fh:
+                json.dump(zoo_to_bench_matrix(report), fh,
                           indent=2, default=str)
         return 0 if report["verdict"] == "pass" else 1
 

@@ -13,7 +13,12 @@ executed) program per supported training/serving shape:
 * ``serve_dense`` — the inference compiler's fused dense program
   (serve/compiler.py): bucket-ladder retrace probes plus the
   tree-sharded top-bucket program whose single score psum and
-  per-shard memory are contract-checked.
+  per-shard memory are contract-checked;
+* ``serve_zoo``  — the model zoo's stacked cross-model program
+  (serve/zoo.py): M same-signature lanes vmapped over the dense
+  program across the bucket ladder, plus the tree-sharded stacked
+  top-bucket program whose ONE-psum-per-stack collective contract and
+  M-scaled memory budget are machine-checked.
 
 Every config is traced TWICE with freshly built same-shape inputs so
 the retrace rule sees real hash probes, and the telemetry collective
@@ -49,7 +54,8 @@ __all__ = ["MATRIX_CONFIGS", "Geometry", "TRACE_GEOMETRY", "MEM_GEOMETRY",
            "parse_kv_args", "run_lint", "main"]
 
 MATRIX_CONFIGS = ("serial", "wave", "dp_scatter", "spec_ramp",
-                  "multitrain", "serve", "serve_dense", "ingest")
+                  "multitrain", "serve", "serve_dense", "serve_zoo",
+                  "ingest")
 
 # every rule the matrix runs: the six PR-10 program-contract rules plus
 # the SPMD-safety pair (collective-order, sharding-consistency)
@@ -444,6 +450,67 @@ def _build_serve_dense_unit(geom: Geometry, ctx: Dict[str, Any],
                      collectives=tally, hashes=hashes)
 
 
+def _build_serve_zoo_unit(geom: Geometry, ctx: Dict[str, Any],
+                          nshards: int) -> TraceUnit:
+    """The zoo's stacked cross-model program: M same-signature lanes of
+    the dense serving ensemble vmapped into one fused launch.  Retrace
+    probes cover the whole bucket ladder (the stacked jit signature is
+    fixed per (stack, bucket) — idle lanes ride zero-filled, so WHICH
+    tenants are active can never force a trace); the MAIN jaxpr is the
+    tree-sharded stacked top-bucket program, whose one-psum-per-STACK
+    collective contract and M-scaled memory budget the rules check."""
+    import numpy as np
+    from ..models.dense_predict import (lower_ensemble,
+                                        make_stacked_sharded_predict,
+                                        stack_dense_arrays,
+                                        stacked_predict_raw)
+    from ..models.tree import SHAPE_BUCKETS
+    # importing the zoo registers the serve/zoo_stack memory budget +
+    # one-psum collective contract
+    from ..serve import zoo as _zoo  # noqa: F401
+    trees = _mk_serve_dense_ensemble(geom)
+    m = 3
+    arrays, meta = lower_ensemble(trees, 1, geom.features)
+    stacked = stack_dense_arrays([arrays] * m)
+    hashes: List[Tuple[str, str]] = []
+    for bucket in SHAPE_BUCKETS:
+        for rep in range(2):
+            Xs = np.zeros((m, bucket, geom.features), np.float32) + rep
+            jx = ir.trace(
+                lambda Xa, S: stacked_predict_raw(Xa, S, meta),
+                Xs, stacked)
+            hashes.append((f"bucket{bucket}", ir.stable_hash(jx)))
+    k = max(2, min(nshards, 4))
+    mesh, _abstract = _trace_mesh(k, "trees")
+    sh_arrays, sh_meta = lower_ensemble(trees, 1, geom.features, shard=k)
+    sh_stacked = stack_dense_arrays([sh_arrays] * m)
+    fn = make_stacked_sharded_predict(sh_stacked, sh_meta, mesh)
+    Xtop = np.zeros((m, max(SHAPE_BUCKETS), geom.features), np.float32)
+    jaxpr0, tally = _trace_with_tally(lambda Xa, S: fn(Xa, S),
+                                      (Xtop, sh_stacked))
+    jx1, _ = _trace_with_tally(lambda Xa, S: fn(Xa, S),
+                               (Xtop + 1.0, sh_stacked))
+    hashes.append(("sharded_top", ir.stable_hash(jaxpr0)))
+    hashes.append(("sharded_top", ir.stable_hash(jx1)))
+    ctx = dict(ctx)
+    # one stacked program per ladder rung plus the sharded top bucket
+    ctx["max_distinct_programs"] = len(SHAPE_BUCKETS) + 1
+    ctx["models"] = m
+    ctx["bucket"] = max(SHAPE_BUCKETS)
+    ctx["trees"] = sh_arrays.path_dir.shape[0]
+    ctx["leaves"] = sh_arrays.path_dir.shape[2]
+    ctx["num_class"] = 1
+    ctx["cat_cols"] = (0 if sh_arrays.cat_table is None
+                       else sh_arrays.cat_table.shape[0])
+    ctx["cat_nodes"] = (0 if sh_arrays.cat_table is None
+                        else sh_arrays.cat_table.shape[1])
+    ctx["nshards"] = k
+    ctx["world_size"] = k
+    ctx["mesh_axes"] = ("trees",)
+    return TraceUnit(name="serve_zoo", jaxpr=jaxpr0, ctx=ctx,
+                     collectives=tally, hashes=hashes)
+
+
 def _build_serve_unit(geom: Geometry, ctx: Dict[str, Any]) -> TraceUnit:
     import numpy as np
     from ..models.tree import SHAPE_BUCKETS, predict_raw_ensemble
@@ -497,6 +564,8 @@ def build_unit(name: str, nshards: int = 8,
         return _build_serve_unit(geom, _base_ctx(geom))
     if name == "serve_dense":
         return _build_serve_dense_unit(geom, _base_ctx(geom), nshards)
+    if name == "serve_zoo":
+        return _build_serve_zoo_unit(geom, _base_ctx(geom), nshards)
     if name == "ingest":
         return _unit_from_traces(
             "ingest", _mk_ingest_chunk(geom),
@@ -537,6 +606,18 @@ def build_callable(name: str, nshards: int = 8,
         arrays, meta = lower_ensemble(trees, 1, geom.features)
         X = np.zeros((max(SHAPE_BUCKETS), geom.features), np.float32)
         return (lambda Xa, A: dense_predict_raw(Xa, A, meta), (X, arrays))
+    if name == "serve_zoo":
+        import numpy as np
+        from ..models.dense_predict import (lower_ensemble,
+                                            stack_dense_arrays,
+                                            stacked_predict_raw)
+        from ..models.tree import SHAPE_BUCKETS
+        trees = _mk_serve_dense_ensemble(geom)
+        arrays, meta = lower_ensemble(trees, 1, geom.features)
+        stacked = stack_dense_arrays([arrays] * 3)
+        Xs = np.zeros((3, max(SHAPE_BUCKETS), geom.features), np.float32)
+        return (lambda Xa, S: stacked_predict_raw(Xa, S, meta),
+                (Xs, stacked))
     return None
 
 

@@ -54,6 +54,8 @@ from .tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN, Tree, TreeBatch
 __all__ = ["DenseLoweringError", "DenseMeta", "DenseArrays",
            "lower_ensemble", "dense_predict_raw", "dense_predict_leaf",
            "make_sharded_predict", "dense_table_bytes",
+           "stack_dense_arrays", "stacked_predict_raw",
+           "make_stacked_sharded_predict",
            "CAT_TABLE_BUDGET", "LINEAR_TABLE_BUDGET"]
 
 # Lowering budgets: a categorical bitset table or a linear-leaf
@@ -420,6 +422,66 @@ def dense_predict_leaf(X, arrays: DenseArrays, meta: DenseMeta):
     dec = _decision_matrix(X, arrays, meta)
     hit = _hit_matrix(dec, arrays, meta)
     return jnp.argmax(hit, axis=2).astype(jnp.int32).T
+
+
+def stack_dense_arrays(arrays_list):
+    """Stack M same-signature models' lowered tables on a NEW leading
+    model axis: every (T, ...) table becomes (M, T, ...).  Requires
+    identical shapes/dtypes AND identical optional-field presence (both
+    guaranteed by an equal ``DenseExecutable.signature``), so the None
+    fields collapse consistently and the tree structures match."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *arrays_list)
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def stacked_predict_raw(Xs, stacked: DenseArrays, meta: DenseMeta):
+    """(M, N, K) raw scores for M same-signature models in ONE fused
+    launch — the zoo's cross-model hot path.  ``Xs`` is (M, N, F): each
+    lane carries its own tenant's padded micro-batch.  vmap over the
+    model axis turns every contraction of :func:`_dense_raw` into a
+    batched contraction of the same per-slice shape, so each lane's
+    scores are bitwise identical to a solo :func:`dense_predict_raw`
+    call (asserted by the zoo parity tests)."""
+    return jax.vmap(lambda x, a: _dense_raw(x, a, meta))(Xs, stacked)
+
+
+def _stacked_shard_specs(stacked: DenseArrays, axis: str):
+    """PartitionSpec tree for tree-axis sharding of STACKED tables: the
+    model axis is leading and never sharded; the tree axis (now dim 1)
+    splits; the categorical contraction tables stay replicated."""
+    from jax.sharding import PartitionSpec as P
+    replicated = ("cat_feats", "cat_table")
+    vals = {}
+    for name in stacked._fields:
+        a = getattr(stacked, name)
+        if a is None:
+            vals[name] = None
+        elif name in replicated:
+            vals[name] = P()
+        else:
+            vals[name] = P(None, axis)
+    return DenseArrays(**vals)
+
+
+def make_stacked_sharded_predict(stacked: DenseArrays, meta: DenseMeta,
+                                 mesh, axis: str = "trees"):
+    """Tree-sharded stacked prediction: per-shard partials over every
+    model lane and exactly ONE psum of the (M, N, K) partial scores —
+    the ``serve/zoo_stack/score_psum`` collective contract (one psum
+    per STACK, not one per tenant; declared in serve/zoo.py)."""
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import shard_map_compat
+    from ..telemetry.train_record import note_collective
+
+    def body(Xs, A):
+        part = jax.vmap(lambda x, a: _dense_raw(x, a, meta))(Xs, A)
+        note_collective("serve/zoo_stack/score_psum", "psum", part)
+        return jax.lax.psum(part, axis)
+
+    return jax.jit(shard_map_compat(
+        body, mesh=mesh,
+        in_specs=(P(), _stacked_shard_specs(stacked, axis)),
+        out_specs=P()))
 
 
 def _shard_specs(arrays: DenseArrays, axis: str):

@@ -14,6 +14,11 @@ per-request Python dispatch costs or fresh XLA traces:
   endpoint (``/predict``, ``/models``, ``/healthz``, ``/stats``),
   exposed as the ``python -m lightgbm_tpu serve`` CLI verb;
 - :class:`ModelStats` — per-model serving counters behind ``/stats``;
+- :class:`ModelZoo` — bounded multi-tenant tier over the registry:
+  traffic-weighted LRU eviction under a resident budget, cold
+  load-on-miss inside the request deadline, per-tenant quotas, and
+  batched cross-model dispatch (same-lowering-shape tenants fused into
+  one stacked MXU launch per (stack, bucket) super-batch);
 - :class:`FleetSupervisor` — N worker processes behind one dispatcher
   with crash-restart, a crash-loop circuit breaker, rolling drain and
   zero-downtime rolling deploys (``python -m lightgbm_tpu
@@ -28,8 +33,9 @@ from .predictor import SHAPE_BUCKETS, CompiledPredictor
 from .registry import ModelRegistry
 from .server import PredictionServer
 from .stats import ModelStats
+from .zoo import ModelZoo
 
 __all__ = ["CompiledPredictor", "MicroBatcher", "ModelRegistry",
            "PredictionServer", "ModelStats", "SHAPE_BUCKETS",
            "DenseExecutable", "DenseLoweringError", "compile_ensemble",
-           "fallback_counts", "FleetSupervisor"]
+           "fallback_counts", "FleetSupervisor", "ModelZoo"]

@@ -54,12 +54,20 @@ from ..resilience.admission import (DeadlineExceeded, QueueFullError,
                                     shed_counter)
 from ..telemetry.metrics import default_registry
 
-__all__ = ["MicroBatcher"]
+__all__ = ["MicroBatcher", "TenantQueueFull"]
 
 _CLOSE = object()
 
-# queue item slots: (X, raw_score, future, deadline, request_id, t_submit)
-_X, _RAW, _FUT, _DEADLINE, _RID, _TSUB = range(6)
+# queue item slots:
+# (X, raw_score, future, deadline, request_id, t_submit, lane)
+_X, _RAW, _FUT, _DEADLINE, _RID, _TSUB, _LANE = range(7)
+
+
+class TenantQueueFull(QueueFullError):
+    """Per-tenant quota shed: ONE tenant's lane backlog hit its bound
+    while the shared queue still had room — the hot tenant is refused
+    before it can crowd out co-batched neighbours (zoo quota
+    semantics: per-tenant shed happens BEFORE cross-tenant shed)."""
 
 
 class MicroBatcher:
@@ -79,11 +87,15 @@ class MicroBatcher:
                  max_queue_rows: int = 0,
                  name: str = "default",
                  stats=None,
-                 buckets: Optional[tuple] = None) -> None:
+                 buckets: Optional[tuple] = None,
+                 tenant_queue_rows: int = 0) -> None:
         self._predict_fn = predict_fn
         self._max_rows = int(max_batch_rows)
         self._max_wait = max(0.0, float(max_wait_ms)) / 1e3
         self._max_queue_rows = max(0, int(max_queue_rows))  # 0 = unbounded
+        # per-lane (tenant) row bound, checked BEFORE the shared bound
+        self._tenant_rows = max(0, int(tenant_queue_rows))
+        self._lane_rows: dict = {}
         self.name = str(name)
         self.stats = stats
         self._buckets = tuple(buckets) if buckets is not None else None
@@ -128,12 +140,16 @@ class MicroBatcher:
 
     def submit(self, X: np.ndarray, raw_score: bool = False,
                deadline: Optional[float] = None,
-               request_id: Optional[str] = None) -> Future:
+               request_id: Optional[str] = None,
+               lane: Optional[str] = None) -> Future:
         """Queue one request.  ``deadline`` is an absolute
         ``time.monotonic()`` instant after which the request is failed
         with :class:`DeadlineExceeded` rather than dispatched;
         ``request_id`` tags the request's telemetry trail (exemplars,
-        recompile attribution)."""
+        recompile attribution).  ``lane`` names the tenant for
+        cross-model batchers: it keys the per-tenant quota and tells the
+        dispatcher which model lane of the stacked program the rows ride
+        (plain per-model batchers leave it None)."""
         X = np.asarray(X, np.float32)
         if X.ndim == 1:
             X = X.reshape(1, -1)
@@ -145,6 +161,14 @@ class MicroBatcher:
         with self._state_lock:
             if self._closed:
                 raise ServerClosed("batcher is closed")
+            if lane is not None and self._tenant_rows:
+                cur = self._lane_rows.get(lane, 0)
+                if cur + rows > self._tenant_rows:
+                    # the tenant's own quota sheds first — attributed to
+                    # the TENANT's series, not the shared batcher's
+                    retry = self._retry_after_locked()
+                    self._shed.inc(1, model=lane)
+                    raise TenantQueueFull(cur, self._tenant_rows, retry)
             if self._max_queue_rows and \
                     self._backlog_rows + rows > self._max_queue_rows:
                 retry = self._retry_after_locked()
@@ -152,6 +176,8 @@ class MicroBatcher:
                 raise QueueFullError(self._backlog_rows,
                                      self._max_queue_rows, retry)
             self._backlog_rows += rows
+            if lane is not None:
+                self._lane_rows[lane] = self._lane_rows.get(lane, 0) + rows
             self._queue_gauge.set(self._backlog_rows, model=self.name)
             self._inflight_gauge.add(1, model=self.name)
             # the done-callback fires exactly once whichever path settles
@@ -160,7 +186,7 @@ class MicroBatcher:
             fut.add_done_callback(
                 lambda _f: self._inflight_gauge.add(-1, model=self.name))
             self._q.put((X, bool(raw_score), fut, deadline, request_id,
-                         time.monotonic()))
+                         time.monotonic(), lane))
         return fut
 
     def _retry_after_locked(self) -> float:
@@ -171,14 +197,15 @@ class MicroBatcher:
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 timeout_s: Optional[float] = None,
-                request_id: Optional[str] = None) -> np.ndarray:
+                request_id: Optional[str] = None,
+                lane: Optional[str] = None) -> np.ndarray:
         """Blocking submit; with ``timeout_s`` the call raises
         :class:`DeadlineExceeded` at the deadline instead of hanging the
         calling (handler) thread on a future that is still queued."""
         deadline = None if timeout_s is None else \
             time.monotonic() + float(timeout_s)
         fut = self.submit(X, raw_score, deadline=deadline,
-                          request_id=request_id)
+                          request_id=request_id, lane=lane)
         if deadline is None:
             return fut.result()
         try:
@@ -214,9 +241,7 @@ class MicroBatcher:
                 break
             if item is not _CLOSE:
                 with self._state_lock:
-                    self._backlog_rows -= int(item[_X].shape[0])
-                    self._queue_gauge.set(self._backlog_rows,
-                                          model=self.name)
+                    self._debit_locked(item)
                 try:
                     item[_FUT].set_exception(ServerClosed(
                         "batcher closed while the request was queued"))
@@ -224,12 +249,25 @@ class MicroBatcher:
                     pass  # its waiter expired it in the race window
 
     # -- worker side --------------------------------------------------------
+    def _debit_locked(self, item) -> None:
+        """Release one item's backlog accounting (shared + per-lane).
+        Caller holds ``_state_lock``."""
+        rows = int(item[_X].shape[0])
+        self._backlog_rows -= rows
+        lane = item[_LANE]
+        if lane is not None and lane in self._lane_rows:
+            left = self._lane_rows[lane] - rows
+            if left > 0:
+                self._lane_rows[lane] = left
+            else:
+                del self._lane_rows[lane]
+        self._queue_gauge.set(self._backlog_rows, model=self.name)
+
     def _take(self, item) -> bool:
         """Account one dequeued request; expire it instead of batching it
         when its deadline already passed."""
         with self._state_lock:
-            self._backlog_rows -= int(item[_X].shape[0])
-            self._queue_gauge.set(self._backlog_rows, model=self.name)
+            self._debit_locked(item)
         if item[_DEADLINE] is not None and \
                 time.monotonic() > item[_DEADLINE]:
             if not item[_FUT].done():
@@ -305,34 +343,41 @@ class MicroBatcher:
         for item in batch:
             groups.setdefault((item[_RAW], item[_X].shape[1]),
                               []).append(item)
-        for (raw, _cols), group in groups.items():
-            t0 = time.monotonic()
+        for (raw, cols), group in groups.items():
             try:
-                X = (group[0][_X] if len(group) == 1 else
-                     np.concatenate([g[_X] for g in group], axis=0))
-                if self._fn_takes_rids:
-                    out = self._predict_fn(
-                        X, raw, request_ids=tuple(
-                            g[_RID] for g in group if g[_RID]))
-                else:
-                    out = self._predict_fn(X, raw)
-                t1 = time.monotonic()
-                ofs = 0
-                for g in group:
-                    n = g[_X].shape[0]
-                    try:
-                        g[_FUT].set_result(out[ofs:ofs + n])
-                    except InvalidStateError:
-                        pass  # its waiter expired it in the race window
-                    ofs += n
-                self._record_timing(group, t0, t1 - t0, time.monotonic())
-                # retry-after estimates ride this (reads are unlocked —
-                # a slightly stale float is fine)
-                self._ewma_batch_s = 0.8 * self._ewma_batch_s + \
-                    0.2 * (t1 - t0)
+                self._dispatch_group(raw, cols, group)
             except Exception as exc:  # propagate to every waiter in group
                 for g in group:
                     try:
                         g[_FUT].set_exception(exc)
                     except InvalidStateError:
                         pass  # its waiter expired it in the race window
+
+    def _dispatch_group(self, raw: bool, cols: int, group) -> None:
+        """Run one (raw_score, feature-count) group as a single device
+        call and slice results back per request.  The cross-model stack
+        batcher (serve/zoo.py) overrides this to form (model-lane,
+        bucket) super-batches; everything upstream — window drain,
+        deadline expiry, admission accounting — is shared."""
+        t0 = time.monotonic()
+        X = (group[0][_X] if len(group) == 1 else
+             np.concatenate([g[_X] for g in group], axis=0))
+        if self._fn_takes_rids:
+            out = self._predict_fn(
+                X, raw, request_ids=tuple(
+                    g[_RID] for g in group if g[_RID]))
+        else:
+            out = self._predict_fn(X, raw)
+        t1 = time.monotonic()
+        ofs = 0
+        for g in group:
+            n = g[_X].shape[0]
+            try:
+                g[_FUT].set_result(out[ofs:ofs + n])
+            except InvalidStateError:
+                pass  # its waiter expired it in the race window
+            ofs += n
+        self._record_timing(group, t0, t1 - t0, time.monotonic())
+        # retry-after estimates ride this (reads are unlocked — a
+        # slightly stale float is fine)
+        self._ewma_batch_s = 0.8 * self._ewma_batch_s + 0.2 * (t1 - t0)

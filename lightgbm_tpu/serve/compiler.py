@@ -40,13 +40,15 @@ from ..analysis.contracts import collective_contract, memory_budget, \
 from ..models.dense_predict import (DenseArrays, DenseLoweringError,
                                     DenseMeta, dense_predict_leaf,
                                     dense_predict_raw, dense_table_bytes,
-                                    lower_ensemble, make_sharded_predict)
+                                    lower_ensemble, make_sharded_predict,
+                                    stack_dense_arrays, stacked_predict_raw)
 from ..models.tree import SHAPE_BUCKETS, TreeBatch
 from ..telemetry.metrics import default_registry
 from ..utils.backend import default_backend
 
-__all__ = ["DenseExecutable", "compile_ensemble", "DenseLoweringError",
-           "dense_cost_model", "fallback_counts", "FALLBACK_COUNTER"]
+__all__ = ["DenseExecutable", "StackedExecutable", "compile_ensemble",
+           "DenseLoweringError", "dense_cost_model", "fallback_counts",
+           "FALLBACK_COUNTER"]
 
 # ---------------------------------------------------------------------------
 # program contracts — declared next to the code they constrain
@@ -349,6 +351,82 @@ class DenseExecutable:
             "shard": self.shard,
             "table_bytes": self.table_bytes,
         }
+
+
+class StackedExecutable:
+    """M same-signature :class:`DenseExecutable`s fused on a leading
+    model axis — ONE MXU launch serves every member's micro-batch.
+
+    Built by the zoo (serve/zoo.py) from unsharded dense executables
+    whose :attr:`DenseExecutable.signature` match exactly: same meta
+    (tree/node/leaf envelope, leaf_bits, MXU flag), same shard spec,
+    same table shapes/dtypes.  The stacked tables are (M, T, ...);
+    ``predict_raw`` takes an (M, N, F) lane-block and returns (M, N, K)
+    — each lane bitwise identical to the member's solo dispatch.
+
+    Immutable like its members: membership changes rebuild the stack
+    (cheap — one jnp.stack of resident device arrays, no recompile as
+    long as M is unchanged), and a delta-extended member splices ONLY
+    its lane via :meth:`splice` (same shapes, so the jit cache is hit —
+    zero recompiles in-envelope)."""
+
+    def __init__(self, names: List[str],
+                 exes: List["DenseExecutable"]) -> None:
+        if len(names) != len(exes) or not exes:
+            raise ValueError("stack needs one name per executable")
+        sig = exes[0].signature
+        for e in exes[1:]:
+            if e.signature != sig:
+                raise ValueError("stack members must share one signature")
+        if exes[0].shard:
+            raise ValueError("sharded executables ride their own "
+                             "shard_map entry; stacks take unsharded ones")
+        self.names = tuple(names)
+        self.meta = exes[0].meta
+        self.member_sig = sig
+        self.stacked = stack_dense_arrays([e.arrays for e in exes])
+
+    @property
+    def width(self) -> int:
+        return len(self.names)
+
+    @property
+    def signature(self) -> tuple:
+        """The stacked program's jit-cache key: member signature plus
+        the model-axis width (a different M is a different program)."""
+        return ("zoo_stack", self.width, self.member_sig)
+
+    def lane(self, name: str) -> int:
+        return self.names.index(name)
+
+    def predict_raw(self, Xs) -> Any:
+        """(M, N, K) raw scores for an (M, N, F) lane-block — one fused
+        launch for the whole stack."""
+        return stacked_predict_raw(Xs, self.stacked, self.meta)
+
+    def splice(self, name: str, exe: "DenseExecutable"
+               ) -> "StackedExecutable":
+        """A NEW stack with ``name``'s lane replaced by ``exe``'s tables
+        (a delta-extended member inside the shard-padding envelope:
+        same signature, so every other lane's rows are untouched and
+        the stacked program's jit cache is hit — zero recompiles)."""
+        if exe.signature != self.member_sig:
+            raise ValueError("spliced member changed signature; "
+                             "rebuild the stack")
+        i = self.lane(name)
+        out = StackedExecutable.__new__(StackedExecutable)
+        out.names = self.names
+        out.meta = self.meta
+        out.member_sig = self.member_sig
+        out.stacked = jax.tree_util.tree_map(
+            lambda S, a: S.at[i].set(a), self.stacked, exe.arrays)
+        return out
+
+    def info(self) -> Dict[str, Any]:
+        return {"mode": "zoo_stack", "width": self.width,
+                "members": list(self.names),
+                "num_class": self.meta.num_class,
+                "leaf_bits": self.meta.leaf_bits}
 
 
 def compile_ensemble(trees: List[Any], num_class: int, num_features: int,

@@ -42,6 +42,20 @@ version BEFORE its atomic registry swap), checks the worker's post-swap
 health, and automatically rolls the worker back to its previous source
 on a regression — old or new version answers every request throughout.
 
+**Zoo placement.**  ``placement=hash`` stops replicating the model set
+and SHARDS it: a consistent-hash ring (vnodes over the static worker-id
+set) assigns each model name one owner, workers boot + sync only their
+placed subset (zoo mode is switched on for them, so each worker runs
+bounded admission and stacks its co-placed same-shape tenants), and the
+dispatcher routes ``/predict`` by the request's ``model`` to the owner.
+Re-placement is the ring's routability filter: a dead worker's names
+fall to the next node at lookup time — no migration step — and the
+per-tick placement sync loads them onto the new owner; when the worker
+revives, its names come home and the squatter's stale copies decay out
+through the zoo's traffic-weighted LRU (the dispatcher no longer routes
+to them).  The delta journal follow tracks the OWNER of the published
+model, not every worker.
+
 **Continuous learning.**  With ``publish_dir=`` the supervisor follows
 a trainer's delta journal (``publish/delta.py``): every published round
 is pushed to each worker over ``POST /models/<name>/delta`` (an
@@ -66,6 +80,8 @@ kill-under-load recovery from these two endpoints alone.
 from __future__ import annotations
 
 import base64
+import bisect
+import hashlib
 import http.client
 import json
 import os
@@ -154,6 +170,41 @@ _WEIGHT_OK = 4
 _WEIGHT_DEGRADED = 1
 
 
+def _ring_hash(s: str) -> int:
+    return int(hashlib.sha1(s.encode()).hexdigest()[:8], 16)
+
+
+class _HashRing:
+    """Consistent-hash placement over a STATIC worker-id set.
+
+    The ring never changes shape — liveness is a routability filter at
+    lookup time: :meth:`owner` walks clockwise from the name's hash to
+    the first vnode whose worker is in ``routable``.  A worker death
+    therefore re-places only ITS names (each falls to the next distinct
+    node on the ring), and its revival takes exactly those names back —
+    the minimal-disruption property replication-by-rendezvous would
+    also give, bought here with one sorted array and a bisect."""
+
+    def __init__(self, wids: List[int], vnodes: int = 64) -> None:
+        self.vnodes = int(vnodes)
+        points = [(_ring_hash(f"w{wid}#{v}"), wid)
+                  for wid in wids for v in range(self.vnodes)]
+        points.sort()
+        self._ring = points
+        self._keys = [h for h, _ in points]
+
+    def owner(self, name: str, routable) -> Optional[int]:
+        """The routable worker id owning ``name``, or None."""
+        if not self._ring or not routable:
+            return None
+        i = bisect.bisect_right(self._keys, _ring_hash(name))
+        for k in range(len(self._ring)):
+            wid = self._ring[(i + k) % len(self._ring)][1]
+            if wid in routable:
+                return wid
+        return None
+
+
 class WorkerHandle:
     """Supervision record for one worker process."""
 
@@ -181,6 +232,9 @@ class WorkerHandle:
         self.current_weight = 0.0       # smooth-WRR scheduling state
         self.synced_incarnation = 0     # last incarnation whose model
         #                                 set was caught up to deploys
+        self.placed_gen = 0             # last placement epoch this
+        #                                 worker's model set was synced
+        #                                 against (hash placement only)
         self.acked_round: Optional[int] = None  # delta-chain position
         #                                 this worker has acked
         self.delta_incarnation = 0      # incarnation acked_round is
@@ -241,6 +295,8 @@ class FleetSupervisor:
                  drain_timeout_s: float = 30.0,
                  publish_dir: Optional[str] = None,
                  publish_model: Optional[str] = None,
+                 placement: str = "replicate",
+                 placement_vnodes: int = 64,
                  metrics_registry: Optional[MetricsRegistry] = None
                  ) -> None:
         if workers < 1:
@@ -338,6 +394,23 @@ class FleetSupervisor:
             WorkerHandle(i, os.path.join(run_dir, f"worker-{i}.port"),
                          os.path.join(run_dir, f"worker-{i}.log"))
             for i in range(int(workers))]
+        # zoo placement: hash mode shards the model set across workers
+        # (one owner per name) instead of replicating it on every one
+        if placement not in ("replicate", "hash"):
+            raise ValueError(f"placement must be 'replicate' or 'hash', "
+                             f"got {placement!r}")
+        self.placement = placement
+        self._ring = _HashRing([w.wid for w in self._workers],
+                               vnodes=placement_vnodes) \
+            if placement == "hash" else None
+        self._placement_gen = 1
+        self._alive_ids: Tuple[int, ...] = ()
+        if self._ring is not None and not any(
+                k in self._worker_args for k in
+                ("zoo", "max_resident", "zoo_dir", "tenant_queue_rows")):
+            # placed workers run the zoo tier (bounded admission +
+            # cross-model stacking over their placed subset) by default
+            self._worker_args["zoo"] = "1"
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._sup_thread: Optional[threading.Thread] = None
@@ -370,7 +443,22 @@ class FleetSupervisor:
         return list(self._workers)
 
     # -- spawning -----------------------------------------------------------
-    def _boot_models(self) -> Dict[str, str]:
+    def _placed_models(self, w: WorkerHandle,
+                       routable=None) -> Dict[str, str]:
+        """The ``_current_models`` subset the ring places on ``w``
+        among ``routable`` workers (default: the alive set plus ``w``
+        itself, so a booting worker syncs what it is ABOUT to own).
+        Replicate mode: everything."""
+        if self._ring is None:
+            return dict(self._current_models)
+        if routable is None:
+            with self._lock:
+                routable = {x.wid for x in self._workers
+                            if x.state == "alive"} | {w.wid}
+        return {n: p for n, p in self._current_models.items()
+                if self._ring.owner(n, routable) == w.wid}
+
+    def _boot_models(self, w: WorkerHandle) -> Dict[str, str]:
         """The ``_current_models`` entries a worker CLI spawn registers
         under the right logical name: all of them for a single-model
         fleet (the ``name=`` pin), otherwise those whose
@@ -378,21 +466,35 @@ class FleetSupervisor:
         caught up over ``POST /models`` once the worker is alive
         (``_sync_models``) — the worker still needs >= 1 CLI file to
         boot, so an all-renamed fleet boots its first entry and lets
-        the sync re-register it."""
+        the sync re-register it.
+
+        Hash placement boots only the worker's STATIC share (the ring
+        over the full id set — liveness at spawn time is stale by the
+        time the worker answers): the placement sync settles the live
+        assignment.  A worker whose static share is empty still needs a
+        boot file unless a ``zoo_dir`` resolver can cold-load on
+        demand."""
         if len(self._current_models) == 1:
             return dict(self._current_models)
-        boot = {n: p for n, p in self._current_models.items()
+        pool = self._current_models
+        if self._ring is not None:
+            all_ids = {x.wid for x in self._workers}
+            pool = {n: p for n, p in pool.items()
+                    if self._ring.owner(n, all_ids) == w.wid}
+        boot = {n: p for n, p in pool.items()
                 if os.path.splitext(os.path.basename(p))[0] == n}
-        if not boot:
-            n = next(iter(self._current_models))
-            boot = {n: self._current_models[n]}
+        if not boot and not (self._ring is not None and
+                             self._worker_args.get("zoo_dir")):
+            src = pool if pool else self._current_models
+            n = next(iter(src))
+            boot = {n: src[n]}
         return boot
 
     def _build_cmd(self, w: WorkerHandle) -> List[str]:
         if self._worker_cmd is not None:
             return list(self._worker_cmd(w.wid, w.port_file))
         cmd = [sys.executable, "-m", "lightgbm_tpu", "serve"]
-        boot = self._boot_models()
+        boot = self._boot_models(w)
         cmd += list(boot.values())
         if len(self._current_models) == 1:
             # pin the registry name so a deploy's renamed file still
@@ -484,7 +586,44 @@ class FleetSupervisor:
         under the right logical name (renamed deploy sources in a
         multi-model fleet) is loaded over ``POST /models``.  Returns
         True when the worker serves every logical name (retried next
-        tick otherwise)."""
+        tick otherwise).
+
+        Hash placement syncs the worker's PLACED subset instead of the
+        whole set — including names just re-placed onto it by another
+        worker's death.  Names that moved away are not evicted here:
+        the dispatcher already routes them elsewhere, so the stale
+        copies cool off and fall to the worker zoo's traffic-weighted
+        LRU."""
+        if self._ring is not None:
+            placed = self._placed_models(w)
+            try:
+                have = self._worker_get_json(w, "/models",
+                                             self._probe_timeout_s)
+            except Exception:
+                return False
+            pending = {n: p for n, p in placed.items()
+                       if (have.get(n) or {}).get("source") != p}
+            ok = True
+            for name, path in pending.items():
+                try:
+                    status, detail = self._worker_post_json(
+                        w, "/models", {"name": name, "file": path},
+                        self._deploy_timeout_s)
+                except Exception as exc:
+                    log_warning(f"fleet: {w.name} placement sync "
+                                f"'{name}' failed: "
+                                f"{type(exc).__name__}: {exc}")
+                    ok = False
+                    continue
+                if status != 200:
+                    log_warning(f"fleet: {w.name} rejected placed model "
+                                f"'{name}' ({status}): "
+                                f"{detail.get('error', detail)}")
+                    ok = False
+                else:
+                    log_info(f"fleet: placed '{name}' on {w.name} "
+                             f"({os.path.basename(path)})")
+            return ok
         if len(self._current_models) == 1:
             return True   # the spawn's name= pin registers it correctly
         # pending = every entry the CLI spawn registers under the WRONG
@@ -557,6 +696,24 @@ class FleetSupervisor:
                                   model=self._publish_model,
                                   worker=w.name)
 
+    def _owns_published(self, w: WorkerHandle) -> bool:
+        """Hash placement: only the published model's current OWNER is
+        followed by the delta lane — pushing rounds to workers the
+        dispatcher never routes the model to would just burn deploy
+        bandwidth.  A non-owner's freshness series is dropped (not
+        frozen): a dead ex-owner must not burn the staleness SLO while
+        the live owner is current."""
+        if self._ring is None or self._publish_model is None:
+            return True
+        with self._lock:
+            alive = {x.wid for x in self._workers if x.state == "alive"}
+        if self._ring.owner(self._publish_model, alive or {w.wid}) \
+                == w.wid:
+            return True
+        self._model_round_g.remove_series(worker=w.name)
+        self._rounds_behind_g.remove_series(worker=w.name)
+        return False
+
     def _anchor_base(self, w: WorkerHandle) -> bool:
         """Re-anchor one worker on the journal's newest BASE by a full
         ``POST /models`` reload (which clears the worker registry's
@@ -598,6 +755,8 @@ class FleetSupervisor:
         fallback the DeltaChainError contract promises."""
         target = self._journal_target(now)
         if target is None or self._publish_model is None:
+            return
+        if not self._owns_published(w):
             return
         if w.delta_incarnation != w.incarnation or w.acked_round is None:
             # a respawn boots from its CLI model file: position unknown
@@ -662,6 +821,19 @@ class FleetSupervisor:
 
     def _tick(self) -> None:
         now = time.monotonic()
+        if self._ring is not None:
+            # placement epoch: any alive-set change re-places names, so
+            # every worker's placed subset is re-synced against the new
+            # assignment (death -> the fallen names load onto the next
+            # ring node; revival -> the names come home)
+            cur = tuple(sorted(w.wid for w in self._workers
+                               if w.state == "alive"))
+            if cur != self._alive_ids:
+                self._alive_ids = cur
+                self._placement_gen += 1
+                log_info(f"fleet: placement epoch {self._placement_gen} "
+                         f"over alive workers "
+                         f"{[f'w{i}' for i in cur] or 'none'}")
         for w in self._workers:
             state = w.state
             if state in ("stopped", "draining"):
@@ -687,6 +859,7 @@ class FleetSupervisor:
                     w.last_health = boot_health
                     if self._sync_models(w):
                         w.synced_incarnation = w.incarnation
+                        w.placed_gen = self._placement_gen
                     self._sync_deltas(w, now)
                     log_info(f"fleet: {w.name} alive on port {w.port}"
                              + (" (breaker half-open probe)"
@@ -735,9 +908,12 @@ class FleetSupervisor:
                     w.fail_times.popleft()
                 if not w.fail_times and not w.probing:
                     w.backoff_s = 0.0
-                if w.synced_incarnation != w.incarnation and \
+                if (w.synced_incarnation != w.incarnation or
+                        (self._ring is not None and
+                         w.placed_gen != self._placement_gen)) and \
                         self._sync_models(w):
                     w.synced_incarnation = w.incarnation
+                    w.placed_gen = self._placement_gen
                 self._sync_deltas(w, now)
                 if w.probing:
                     w.probe_ok_streak += 1
@@ -759,7 +935,8 @@ class FleetSupervisor:
             target = self._journal_target(now)
             if target is not None:
                 for w in self._workers:
-                    self._note_rounds(w, target)
+                    if self._owns_published(w):
+                        self._note_rounds(w, target)
 
     def _run_supervision(self) -> None:
         while not self._stop.is_set():
@@ -892,6 +1069,26 @@ class FleetSupervisor:
             best.current_weight -= total
             return best
 
+    def _pick_placed(self, name: Optional[str],
+                     exclude: Tuple[int, ...] = ()
+                     ) -> Optional[WorkerHandle]:
+        """Hash placement's router: the ring owner of ``name`` among
+        routable workers.  ``exclude`` (connection-reset retries) walks
+        to the NEXT ring node — the same fallback order re-placement
+        uses, so the retry lands where the model will live next."""
+        if name is None:
+            name = next(iter(self._current_models), None)
+            if name is None:
+                return None
+        with self._lock:
+            routable = {w.wid for w in self._workers
+                        if w.state == "alive" and w.port is not None and
+                        w.wid not in exclude}
+            wid = self._ring.owner(name, routable)
+            if wid is None:
+                return None
+            return next(w for w in self._workers if w.wid == wid)
+
     def _retry_after_s(self) -> float:
         """Backoff hint while nothing is routable: time to the next
         restart attempt or breaker half-open probe."""
@@ -916,18 +1113,27 @@ class FleetSupervisor:
         t0 = time.monotonic()
         base_deadline = 0.0
         req: Optional[Dict[str, Any]] = None
-        if self._deadline_ms > 0 or b"deadline_ms" in body:
+        if self._ring is not None or self._deadline_ms > 0 or \
+                b"deadline_ms" in body:
+            # hash placement must parse the body regardless of deadline
+            # config: routing is BY the request's model name
             try:
                 req = json.loads(body)
                 base_deadline = float(req.get("deadline_ms") or
                                       self._deadline_ms)
             except (ValueError, TypeError, AttributeError):
                 req = None   # malformed body: forward raw, worker 400s
+        route_model: Optional[str] = None
+        if self._ring is not None and req is not None and \
+                req.get("model"):
+            route_model = str(req["model"])
         tried: List[int] = []
         attempts = 0
         last_err = "no routable worker"
         while attempts <= self._retry_budget:
-            w = self.pick_worker(exclude=tuple(tried))
+            w = self._pick_placed(route_model, exclude=tuple(tried)) \
+                if self._ring is not None \
+                else self.pick_worker(exclude=tuple(tried))
             if w is None:
                 if not tried:
                     # nothing routable at all (every worker quarantined
@@ -1067,8 +1273,17 @@ class FleetSupervisor:
                                   "deployed": [], "skipped": [],
                                   "rolled_back": []}
         with self._deploy_lock:
+            if self._ring is not None:
+                with self._lock:
+                    alive = {w.wid for w in self._workers
+                             if w.state == "alive" and
+                             w.port is not None}
+                owner = self._ring.owner(name, alive)
             for w in list(self._workers):
-                if w.state != "alive" or w.port is None:
+                if w.state != "alive" or w.port is None or \
+                        (self._ring is not None and w.wid != owner):
+                    # hash placement deploys to the name's OWNER only;
+                    # everyone else picks the version up on re-placement
                     report["skipped"].append(w.name)
                     continue
                 before = self._probe_health(w) or "unreachable"
@@ -1226,12 +1441,43 @@ class FleetSupervisor:
                                "error": f"{type(exc).__name__}"}
         return out
 
+    def placement_table(self) -> Optional[Dict[str, Any]]:
+        """The live worker -> placed-models map (hash placement only,
+        None otherwise): every ``_current_models`` name resolved
+        through the ring against the routable set — the assignment the
+        dispatcher is using RIGHT NOW, dead workers already routed
+        around."""
+        if self._ring is None:
+            return None
+        with self._lock:
+            routable = {w.wid for w in self._workers
+                        if w.state == "alive" and w.port is not None}
+        table: Dict[str, List[str]] = {w.name: [] for w in self._workers}
+        unplaced: List[str] = []
+        for n in sorted(self._current_models):
+            wid = self._ring.owner(n, routable)
+            if wid is None:
+                unplaced.append(n)
+            else:
+                table[f"w{wid}"].append(n)
+        out: Dict[str, Any] = {"mode": "hash",
+                               "vnodes": self._ring.vnodes,
+                               "epoch": self._placement_gen,
+                               "workers": table}
+        if unplaced:
+            out["unplaced"] = unplaced
+        return out
+
     def workers_table(self) -> Dict[str, Any]:
-        return {"workers": {w.name: w.snapshot()
-                            for w in self._workers},
-                "breaker": {"failures": self._breaker_failures,
-                            "window_s": self._breaker_window_s,
-                            "halfopen_s": self._halfopen_s}}
+        out = {"workers": {w.name: w.snapshot()
+                           for w in self._workers},
+               "breaker": {"failures": self._breaker_failures,
+                           "window_s": self._breaker_window_s,
+                           "halfopen_s": self._halfopen_s}}
+        pl = self.placement_table()
+        if pl is not None:
+            out["placement"] = pl
+        return out
 
     # -- dispatcher handler accounting --------------------------------------
     def _enter(self) -> bool:
@@ -1288,7 +1534,14 @@ def _make_fleet_handler(fleet: FleetSupervisor):
                 self._reply_raw(200, fleet.metrics_text().encode(),
                                 content_type=PROMETHEUS_CONTENT_TYPE)
             elif self.path in ("/models", "/stats"):
-                self._reply(200, fleet.proxy_get(self.path))
+                out = fleet.proxy_get(self.path)
+                if self.path == "/models":
+                    pl = fleet.placement_table()
+                    if pl is not None:
+                        # the worker -> placed-models aggregation rides
+                        # the same payload under a non-worker key
+                        out["_placement"] = pl
+                self._reply(200, out)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -1358,6 +1611,7 @@ _FLEET_KEYS = {
     "breaker_failures", "breaker_window_s", "breaker_halfopen_s",
     "backoff_base_s", "backoff_max_s", "drain_timeout_s",
     "startup_timeout_s", "run_dir", "publish_dir", "publish_model",
+    "placement", "vnodes",
 }
 
 
@@ -1372,7 +1626,11 @@ def main(argv: List[str]) -> int:
     drain_timeout_s (30), startup_timeout_s (120), run_dir,
     publish_dir (follow a trainer's delta journal and live-refresh
     every worker), publish_model (logical name the deltas apply to;
-    defaults to the first model).  Every
+    defaults to the first model), placement (replicate | hash — hash
+    shards the model set across workers by consistent hash: the
+    dispatcher routes /predict by the request's model to its owner,
+    workers boot/sync only their placed subset in zoo mode, a dead
+    worker's names fall to the next ring node), vnodes (64).  Every
     other ``key=value`` passes through to the worker serve processes
     (``max_queue_rows``, ``max_wait_ms``, ``deadline_ms`` stays
     fleet-side, ...).  SIGTERM runs a rolling drain and exits
@@ -1406,7 +1664,9 @@ def main(argv: List[str]) -> int:
         drain_timeout_s=float(kv.get("drain_timeout_s", 30.0)),
         startup_timeout_s=float(kv.get("startup_timeout_s", 120.0)),
         publish_dir=kv.get("publish_dir"),
-        publish_model=kv.get("publish_model"))
+        publish_model=kv.get("publish_model"),
+        placement=kv.get("placement", "replicate"),
+        placement_vnodes=int(kv.get("vnodes", 64)))
     fleet.start()
     try:
         fleet.install_signal_handlers()

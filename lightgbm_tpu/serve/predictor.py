@@ -69,6 +69,26 @@ def _note_dispatch(key) -> bool:
         return True
 
 
+def release_compile_keys(sig) -> int:
+    """Drop every ``(sig, bucket)`` entry of one shape signature from the
+    dispatch mirror.  The registry calls this when the LAST model of a
+    shape is evicted: the mirror must shrink with the cache it mirrors or
+    zoo churn ratchets it without bound.  Returns entries removed."""
+    with _COMPILE_LOCK:
+        doomed = [k for k in _COMPILE_KEYS
+                  if isinstance(k, tuple) and len(k) == 2 and k[0] == sig]
+        for k in doomed:
+            _COMPILE_KEYS.discard(k)
+    return len(doomed)
+
+
+def compile_key_count() -> int:
+    """Current size of the process-wide dispatch mirror (the churn
+    regression test bounds this)."""
+    with _COMPILE_LOCK:
+        return len(_COMPILE_KEYS)
+
+
 def _resolve_gbdt(source):
     """Accept a Booster, a GBDT, a model file path, or a model string."""
     from ..basic import Booster
@@ -164,6 +184,30 @@ class CompiledPredictor:
         leaves = jax.tree_util.tree_leaves(self._per_class)
         self._sig = (self._kinds,
                      tuple((a.shape, str(a.dtype)) for a in leaves))
+
+    # -- zoo grouping -------------------------------------------------------
+    @property
+    def signature(self) -> tuple:
+        """The shape signature XLA's compile cache keys on (and the zoo
+        groups stacked tenants by): dense meta + shard + array shapes,
+        or walk kinds + array shapes."""
+        return self._sig
+
+    @property
+    def group_key(self) -> str:
+        """Short stable digest of :attr:`signature` — the operator-facing
+        lowering-shape group id (`GET /models` reports it so co-batching
+        tenants are visible)."""
+        import hashlib
+        return hashlib.sha1(repr(self._sig).encode()).hexdigest()[:12]
+
+    @property
+    def stackable(self) -> bool:
+        """Whether this predictor can join a cross-model stack: dense
+        program, unsharded executable (sharded stacks ride their own
+        shard_map entry); the RF mean divisor is fine (elementwise,
+        applied per lane), but a walk-path model never stacks."""
+        return self._dense is not None and not self._dense.shard
 
     # -- core ---------------------------------------------------------------
     def predict_raw(self, X: np.ndarray,
@@ -284,6 +328,10 @@ class CompiledPredictor:
             "compiler": "dense" if self._dense is not None else "walk",
             "compiler_mode": self._compiler_mode,
             "fallback_reason": self._fallback_reason,
+            # lowering-shape group: tenants sharing this key share XLA
+            # programs, and (dense, unsharded) ones co-batch in a stack
+            "group_key": self.group_key,
+            "stackable": self.stackable,
         }
         if self._dense is not None:
             out["dense"] = self._dense.info()

@@ -22,7 +22,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
-from .predictor import CompiledPredictor
+from .predictor import CompiledPredictor, release_compile_keys
 from .stats import ModelStats
 from ..publish.delta import DeltaChainError, DeltaRecord, fingerprint_text
 from ..telemetry.metrics import default_registry
@@ -107,10 +107,7 @@ class ModelRegistry:
                 # evict the oldest OTHER entry (insertion order)
                 for victim in list(self._models):
                     if victim != name:
-                        del self._models[victim]
-                        self._stats.pop(victim, None)
-                        self._sources.pop(victim, None)
-                        self._chain.pop(victim, None)
+                        self._drop_locked(victim)
                         break
         log_info(f"serve: {'hot-swapped' if swapped else 'loaded'} model "
                  f"'{name}' (v{self._versions[name]}, "
@@ -146,12 +143,26 @@ class ModelRegistry:
                     f"'{name}' is the only loaded model (the default "
                     f"served one); evicting it would take the service "
                     f"dark — pass force=True to do it anyway")
-            del self._models[name]
-            self._stats.pop(name, None)
-            self._sources.pop(name, None)
-            self._chain.pop(name, None)
+            self._drop_locked(name)
             log_info(f"serve: evicted model '{name}'")
             return True
+
+    def _drop_locked(self, name: str) -> None:
+        """Remove ``name`` and release everything it held: its metric
+        series (stats.release) and — when no surviving model shares its
+        shape signature — the signature's compile-cache mirror entries.
+        Without this, zoo churn ratchets the process: same-shape compile
+        caches are shared (PR 1), so only the LAST model of a shape may
+        release them.  Caller holds ``self._lock``."""
+        victim = self._models.pop(name)
+        stats = self._stats.pop(name, None)
+        self._sources.pop(name, None)
+        self._chain.pop(name, None)
+        if stats is not None:
+            stats.release()
+        sig = victim.signature
+        if not any(p.signature == sig for p in self._models.values()):
+            release_compile_keys(sig)
 
     # -- continuous-learning lane (publish/) --------------------------------
     def apply_delta(self, name: str, record) -> dict:

@@ -150,7 +150,14 @@ class PredictionServer:
                  max_wait_ms: float = 2.0, batching: bool = True,
                  max_queue_rows: int = 0,
                  deadline_ms: float = 0.0,
-                 slo_engine: Optional[SloEngine] = None) -> None:
+                 slo_engine: Optional[SloEngine] = None,
+                 zoo=None) -> None:
+        # zoo mode (serve/zoo.py): admission/eviction + cross-model
+        # stacked dispatch replace the per-model batcher path; the zoo's
+        # registry IS the server's registry
+        self._zoo = zoo
+        if zoo is not None:
+            registry = zoo.registry
         self.registry = registry
         self._batching = batching
         self._batch_opts = (max_batch_rows, max_wait_ms)
@@ -170,7 +177,14 @@ class PredictionServer:
         self._draining = False
         self.signal_received: Optional[int] = None
         handler = _make_handler(self)
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        # http.server's default listen backlog is 5: a fan-out wave (N
+        # clients scoring N zoo tenants in the same instant) overflows
+        # it, and the dropped SYNs come back ~1s later via retransmit —
+        # a latency cliff no queue metric ever sees.  Size the backlog
+        # for burst arrival instead.
+        server_cls = type("_ZooHTTPServer", (ThreadingHTTPServer,),
+                          {"request_queue_size": 128})
+        self._httpd = server_cls((host, port), handler)
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 
@@ -189,12 +203,21 @@ class PredictionServer:
                  raw_score: bool,
                  deadline_ms: Optional[float] = None,
                  request_id: Optional[str] = None) -> np.ndarray:
-        pred = self.registry.get(name)  # resolves None -> the single model
-        pred.stats.record_request(X.shape[0])
         if deadline_ms is None:
             deadline_ms = self._deadline_ms
         timeout_s = float(deadline_ms) / 1e3 if deadline_ms and \
             deadline_ms > 0 else None
+        if self._zoo is not None:
+            # zoo path: per-tenant admission, cold load-on-miss inside
+            # the deadline, stacked or solo dispatch (serve/zoo.py).  A
+            # nameless request still resolves the single resident model.
+            resolved = name if name is not None \
+                else self.registry.get(None).stats.model
+            return self._zoo.predict(resolved, X, raw_score=raw_score,
+                                     timeout_s=timeout_s,
+                                     request_id=request_id)
+        pred = self.registry.get(name)  # resolves None -> the single model
+        pred.stats.record_request(X.shape[0])
         if not self._batching:
             # direct-dispatch path: no queue, so the split is all device
             t0 = time.monotonic()
@@ -270,10 +293,20 @@ class PredictionServer:
             report["exemplars"] = request_exemplars().snapshot()
         return report
 
+    def models_info(self) -> dict:
+        """``/models`` payload: registry info, with per-model stack
+        membership merged in when the zoo is on.  Stays a name->dict
+        mapping either way — the fleet supervisor's model sync reads it
+        as one."""
+        return self._zoo.info() if self._zoo is not None \
+            else self.registry.info()
+
     def stats_payload(self) -> dict:
         """``/stats`` payload: per-model counters plus live batcher
         saturation — a load test can watch the backlog build, not just
-        requests die."""
+        requests die.  Zoo mode adds a ``_zoo`` section (resident count,
+        stack groups, traffic weights); existing consumers key by model
+        name, so the extra entry is inert to them."""
         out = self.registry.stats()
         with self._batchers_lock:
             batchers = list(self._batchers.values())
@@ -284,6 +317,8 @@ class PredictionServer:
                 "inflight_requests": b.inflight_requests(),
                 "max_queue_rows": self._max_queue_rows,
             }
+        if self._zoo is not None:
+            out["_zoo"] = self._zoo.zoo_stats()
         return out
 
     # -- lifecycle ----------------------------------------------------------
@@ -329,6 +364,8 @@ class PredictionServer:
             batchers, self._batchers = dict(self._batchers), {}
         for b in batchers.values():
             b.close()
+        if self._zoo is not None:
+            self._zoo.close()
         deadline = time.monotonic() + max(0.0, timeout)
         with self._active_cv:
             while self._active_predicts > 0:
@@ -410,7 +447,7 @@ def _make_handler(server: PredictionServer):
             if self.path == "/healthz":
                 self._reply(200, server.health())
             elif self.path == "/models":
-                self._reply(200, server.registry.info())
+                self._reply(200, server.models_info())
             elif self.path == "/stats":
                 self._reply(200, server.stats_payload())
             elif self.path == "/slo":
@@ -549,7 +586,11 @@ def _make_handler(server: PredictionServer):
                 return
             from ..publish.delta import DeltaChainError
             try:
-                out = server.registry.apply_delta(name, raw)
+                # zoo mode: an in-envelope delta splices only this
+                # tenant's stacked lane (zero recompiles for neighbours)
+                out = server._zoo.apply_delta(name, raw) \
+                    if server._zoo is not None \
+                    else server.registry.apply_delta(name, raw)
             except KeyError as exc:
                 self._reply(404, {"error": str(exc.args[0])})
                 return
@@ -578,7 +619,11 @@ def _make_handler(server: PredictionServer):
                 self._reply(400, {"error": f"bad lowering knob: {exc}"})
                 return
             try:
-                pred = server.registry.load(str(name), str(path), **kwargs)
+                # zoo mode: admission goes through the zoo so the budget
+                # is enforced and stack membership refreshes
+                pred = server._zoo.load(str(name), str(path), **kwargs) \
+                    if server._zoo is not None \
+                    else server.registry.load(str(name), str(path), **kwargs)
             except Exception as exc:
                 self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
                 return
@@ -612,6 +657,16 @@ def main(argv: List[str]) -> int:
     port=0 workers).  Multiple model files register under their
     basenames.
 
+    Zoo keys (any of them switches on zoo mode, serve/zoo.py):
+    zoo (0; force-enable), max_resident (0 = unbounded; over budget the
+    zoo evicts by traffic-weighted LRU), zoo_dir (cold load-on-miss
+    directory — requests for <name> load <zoo_dir>/<name>.txt inside
+    their deadline, so a zoo server can start with NO model files),
+    tenant_queue_rows (0 = no per-tenant quota; a tenant over its own
+    backlog bound is shed before the shared queue bound), stacking (1;
+    fuse same-lowering-shape tenants into one stacked MXU launch per
+    (stack, bucket) super-batch).
+
     SIGTERM/SIGINT drain the server (stop accepting, fail queued
     futures with ServerClosed, finish in-flight requests) and exit
     ``128+signum``; a repeat signal aborts immediately.
@@ -627,15 +682,34 @@ def main(argv: List[str]) -> int:
           (a.split("=", 1) for a in argv if "=" in a)}
     if kv.get("model"):
         files.append(kv["model"])
-    if not files:
+    max_resident = int(kv.get("max_resident", 0))
+    tenant_rows = int(kv.get("tenant_queue_rows", 0))
+    zoo_mode = _parse_bool(kv.get("zoo"), False) or max_resident > 0 \
+        or bool(kv.get("zoo_dir")) or tenant_rows > 0
+    if not files and not kv.get("zoo_dir"):
         log_fatal("serve needs at least one model file: "
-                  "python -m lightgbm_tpu serve model.txt [port=8080 ...]")
+                  "python -m lightgbm_tpu serve model.txt [port=8080 ...] "
+                  "(or zoo_dir=<dir> to cold-load models on demand)")
     if kv.get("slo_latency_ms"):
         from ..telemetry.slo import set_latency_threshold
         set_latency_threshold("serve/latency_p99",
                               float(kv["slo_latency_ms"]))
     registry = ModelRegistry()
     n_iter = int(kv.get("num_iteration", -1))
+    zoo = None
+    if zoo_mode:
+        from .zoo import ModelZoo
+        zoo = ModelZoo(
+            registry=registry, max_resident=max_resident,
+            source_resolver=kv.get("zoo_dir") or None,
+            stacking=_parse_bool(kv.get("stacking"), True),
+            batching=_parse_bool(kv.get("batching"), True),
+            max_batch_rows=int(kv.get("max_batch", 4096)),
+            max_wait_ms=float(kv.get("max_wait_ms", 2.0)),
+            max_queue_rows=int(kv.get("max_queue_rows", 0)),
+            tenant_queue_rows=tenant_rows,
+            warmup=_parse_bool(kv.get("warmup"), True),
+            load_kwargs={} if n_iter < 0 else {"num_iteration": n_iter})
     seen = set()
     for path in files:
         name = (kv["name"] if len(files) == 1 and kv.get("name") else
@@ -645,9 +719,12 @@ def main(argv: List[str]) -> int:
                       f"(names come from basenames); rename one file or "
                       f"serve them from separate processes")
         seen.add(name)
-        registry.load(name, path,
-                      warmup=_parse_bool(kv.get("warmup"), True),
-                      num_iteration=None if n_iter < 0 else n_iter)
+        if zoo is not None:
+            zoo.load(name, path)
+        else:
+            registry.load(name, path,
+                          warmup=_parse_bool(kv.get("warmup"), True),
+                          num_iteration=None if n_iter < 0 else n_iter)
     srv = PredictionServer(
         registry, host=kv.get("host", "127.0.0.1"),
         port=int(kv.get("port", 8080)),
@@ -655,7 +732,8 @@ def main(argv: List[str]) -> int:
         max_wait_ms=float(kv.get("max_wait_ms", 2.0)),
         batching=_parse_bool(kv.get("batching"), True),
         max_queue_rows=int(kv.get("max_queue_rows", 0)),
-        deadline_ms=float(kv.get("deadline_ms", 0.0)))
+        deadline_ms=float(kv.get("deadline_ms", 0.0)),
+        zoo=zoo)
     if kv.get("port_file"):
         # atomic announce AFTER the bind: a supervisor polling this file
         # can only ever read a complete, live port

@@ -152,6 +152,15 @@ class ModelStats:
                                   self.last_recompile_requests),
             })
 
+    def release(self) -> int:
+        """Retire every ``model=<name>`` series this instance created in
+        its registry (counters, per-bucket histograms, the batcher's
+        saturation gauges).  Called on zoo eviction so a churned tenant
+        leaves nothing behind; returns the number of series dropped.
+        The instance must not record after release."""
+        self._timing_handles.clear()
+        return self._reg.remove_series(model=self.model)
+
     def bucket_timing(self, bucket: int) -> Dict[str, list]:
         """One bucket's raw timing windows (sorted copies) — the
         serve-latency benchmark reads the queue-wait vs device-compute

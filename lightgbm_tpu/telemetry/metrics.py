@@ -117,6 +117,27 @@ class _Metric:
             s = self._series[key] = self._new_series()
         return s
 
+    def remove_series(self, **labels) -> int:
+        """Drop every series whose labels match ``labels`` (a SUBSET of
+        the metric's label names — ``model="a"`` drops all buckets of
+        model a).  Returns the number of series removed.  This is the
+        zoo-eviction path: a bounded model cache must be able to retire
+        a tenant's series or the registry ratchets under churn."""
+        unknown = set(labels) - set(self.label_names)
+        if unknown:
+            raise ValueError(f"metric {self.name!r} has no labels "
+                             f"{sorted(unknown)} (labels: "
+                             f"{self.label_names})")
+        want = {k: str(v) for k, v in labels.items()}
+        idx = [self.label_names.index(k) for k in want]
+        vals = [want[self.label_names[i]] for i in idx]
+        with self._lock:
+            doomed = [key for key in self._series
+                      if all(key[i] == v for i, v in zip(idx, vals))]
+            for key in doomed:
+                del self._series[key]
+        return len(doomed)
+
     def series(self) -> List[Tuple[Dict[str, str], object]]:
         """[(labels dict, snapshot value)] — value is a float for
         counter/gauge, a summary dict for windowed histograms.  Snapped
@@ -285,6 +306,17 @@ class MetricsRegistry:
     def unregister(self, name: str) -> bool:
         with self._lock:
             return self._metrics.pop(name, None) is not None
+
+    def remove_series(self, **labels) -> int:
+        """Drop matching label-series from EVERY metric that carries all
+        the given label names (metrics without them are untouched).
+        Returns total series removed — the registry-wide half of zoo
+        eviction (``remove_series(model="tenant-7")``)."""
+        removed = 0
+        for m in self.collect():
+            if set(labels) <= set(m.label_names):
+                removed += m.remove_series(**labels)
+        return removed
 
     def clear(self) -> None:
         with self._lock:

@@ -18,6 +18,15 @@ the chain is replayed both folded and record-by-record through a
 serving registry — bit-identical predictions and zero dense recompiles
 required (BLOCKING; ``--delta 0`` skips).
 
+A fourth ``zoo`` block covers hash-placed multi-tenant serving: a
+2-worker fleet with ``placement=hash`` sharding six zoo tenants is hit
+with a SIGKILL on the worker holding the larger placed share; the
+ring must re-place the fallen tenants onto the survivor (placement
+epoch bump), the survivor must cold-load and serve them from the
+``zoo_dir`` resolver, and the supervisor must restart the dead worker
+back to a fully-alive fleet with every tenant answering again
+(BLOCKING; ``--zoo 0`` skips).
+
 Usage: python scripts/chaos_snapshot.py [--out recovery-telemetry.json]
 """
 
@@ -101,6 +110,140 @@ def _fleet_chaos_block(repo: str) -> dict:
         "fleet_workers_quarantined": metric_sum(
             parsed, "lgbm_tpu_fleet_workers_quarantined"),
         "workers": workers,
+    }
+
+
+def _zoo_placement_block(repo: str) -> dict:
+    """Kill the worker holding the larger placed-tenant share of a
+    hash-placement zoo fleet; assert the ring re-places its tenants on
+    the survivor (epoch bump + cold load from ``zoo_dir``), every
+    tenant keeps answering, and the fleet recovers to full strength."""
+    import http.client
+    import shutil
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve.fleet import FleetSupervisor
+    from lightgbm_tpu.serve.loadgen import metric_sum, parse_prometheus, \
+        scrape_json, scrape_metrics
+
+    def _post(host, port, name, rows, timeout=60.0):
+        body = json.dumps({"model": name, "rows": rows}).encode()
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request("POST", "/predict", body, {
+                "Content-Type": "application/json",
+                "Content-Length": str(len(body))})
+            return conn.getresponse().status
+        except OSError:
+            return -1
+        finally:
+            conn.close()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rng = np.random.RandomState(3)
+        X = rng.randn(400, 4).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float64)
+        p = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+        bst = lgb.train(p, lgb.Dataset(X, y, params=p), 5)
+        zdir = os.path.join(tmp, "zoo")
+        os.makedirs(zdir)
+        base = os.path.join(zdir, "t0.txt")
+        bst.save_model(base)
+        names = [f"t{i}" for i in range(6)]
+        for n in names[1:]:
+            shutil.copyfile(base, os.path.join(zdir, f"{n}.txt"))
+        rows = X[:4].tolist()
+        fleet = FleetSupervisor(
+            [os.path.join(zdir, f"{n}.txt") for n in names], workers=2,
+            placement="hash",
+            worker_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo},
+            worker_args={"warmup": "0", "max_wait_ms": "0.5",
+                         "zoo_dir": zdir},
+            probe_interval_s=0.25, backoff_base_s=0.2,
+            backoff_max_s=1.0, startup_timeout_s=300.0,
+            run_dir=os.path.join(tmp, "fleet"))
+        fleet.start()
+        try:
+            lap0 = {n: _post(fleet.host, fleet.port, n, rows)
+                    for n in names}
+            pl0 = fleet.placement_table()
+            epoch0 = pl0["epoch"]
+            # the worker holding the larger placed share is the victim
+            victim_name = max(pl0["workers"],
+                              key=lambda w: len(pl0["workers"][w]))
+            fallen = list(pl0["workers"][victim_name])
+            victim = next(w for w in fleet.workers()
+                          if w.name == victim_name)
+            victim.proc.kill()
+            killed_t = time.time()
+            # re-placement: the ring's routability filter drops the dead
+            # worker, so its names land on the survivor — observed as an
+            # epoch bump with every fallen tenant owned elsewhere
+            replaced = False
+            replaced_in_s = None
+            pl1 = pl0
+            while time.time() - killed_t < 30.0:
+                pl1 = fleet.placement_table()
+                owned = {n for w, ns in pl1["workers"].items()
+                         for n in ns if w != victim_name}
+                if pl1["epoch"] > epoch0 and all(n in owned
+                                                 for n in fallen):
+                    replaced = True
+                    replaced_in_s = round(time.time() - killed_t, 2)
+                    break
+                time.sleep(0.1)
+            # the fallen tenants must answer from the survivor, which
+            # cold-loads them through the zoo_dir resolver; retry until
+            # the window closes (dispatch may race the death detection)
+            outage_codes = {}
+            deadline = time.time() + 30.0
+            for n in fallen:
+                code = _post(fleet.host, fleet.port, n, rows)
+                while code != 200 and time.time() < deadline:
+                    time.sleep(0.2)
+                    code = _post(fleet.host, fleet.port, n, rows)
+                outage_codes[n] = code
+            # supervisor recovery: the killed worker restarts and the
+            # fleet returns to full strength
+            recovered = False
+            deadline = time.time() + 60.0
+            while time.time() < deadline:
+                parsed = parse_prometheus(
+                    scrape_metrics(fleet.host, fleet.port))
+                if metric_sum(parsed,
+                              "lgbm_tpu_fleet_workers_alive") == 2:
+                    recovered = True
+                    break
+                time.sleep(0.25)
+            lap1 = {n: _post(fleet.host, fleet.port, n, rows)
+                    for n in names}
+            parsed = parse_prometheus(
+                scrape_metrics(fleet.host, fleet.port))
+            models = scrape_json(fleet.host, fleet.port, "/models")
+            pl_final = fleet.placement_table()
+        finally:
+            fleet.shutdown()
+    all_200 = lambda lap: all(c == 200 for c in lap.values())  # noqa: E731
+    return {
+        "ok": bool(all_200(lap0) and fallen and replaced and
+                   all_200(outage_codes) and recovered and
+                   all_200(lap1)),
+        "tenants": names,
+        "placement_before": pl0,
+        "victim": victim_name,
+        "fallen_tenants": fallen,
+        "replaced": replaced,
+        "replaced_in_s": replaced_in_s,
+        "placement_after_kill": pl1,
+        "outage_codes": outage_codes,
+        "recovered": recovered,
+        "placement_final": pl_final,
+        "final_codes": lap1,
+        "models_placement": models.get("_placement"),
+        "fleet_restarts_total": metric_sum(
+            parsed, "lgbm_tpu_fleet_restarts_total"),
+        "fleet_workers_alive": metric_sum(
+            parsed, "lgbm_tpu_fleet_workers_alive"),
     }
 
 
@@ -202,6 +345,10 @@ def main() -> int:
                     help="1 (default) also runs the publish-journal "
                          "crash/re-anchor/replay cycle (BLOCKING); 0 "
                          "skips it")
+    ap.add_argument("--zoo", type=int, default=1,
+                    help="1 (default) also runs the hash-placement zoo "
+                         "worker-kill/re-placement cycle (BLOCKING); 0 "
+                         "skips it")
     args = ap.parse_args()
 
     import numpy as np
@@ -275,6 +422,20 @@ def main() -> int:
             delta_block = {"ok": False,
                            "error": f"{type(exc).__name__}: {exc}"}
 
+    # multi-tenant zoo cycle: kill the worker holding placed tenants,
+    # assert ring re-placement + cold-load serving on the survivor and
+    # full fleet recovery (BLOCKING — a tenant going dark fails it)
+    zoo_block = None
+    if args.zoo:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        try:
+            zoo_block = _zoo_placement_block(repo)
+        except Exception as exc:
+            print(f"chaos_snapshot: zoo block failed: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+            zoo_block = {"ok": False,
+                         "error": f"{type(exc).__name__}: {exc}"}
+
     snap = default_registry().snapshot()
     keep = ("checkpoint_write_seconds", "resume_total",
             "faults_injected_total")
@@ -289,6 +450,7 @@ def main() -> int:
         "metrics": {k: snap[k] for k in keep if k in snap},
         "fleet": fleet_block,
         "delta": delta_block,
+        "zoo": zoo_block,
     }
     with open(args.out, "w") as fh:
         json.dump(record, fh, indent=2)
@@ -296,6 +458,8 @@ def main() -> int:
     ok = crashed and bit_identical and preds_equal
     if delta_block is not None:
         ok = ok and delta_block.get("ok", False)
+    if zoo_block is not None:
+        ok = ok and zoo_block.get("ok", False)
     print(f"chaos_snapshot: {'OK' if ok else 'FAILED'}")
     return 0 if ok else 1
 

@@ -1,0 +1,540 @@
+"""Model-zoo tests (serve/zoo.py): batched cross-model dispatch +
+bounded admission/eviction.
+
+* stacked-vs-solo BITWISE parity: a tenant served through the fused
+  cross-model stack returns exactly the bytes its solo predictor would,
+  across bucket boundaries, with quantized leaves, and alongside
+  walk-path tenants (which never stack but still serve correctly);
+* ONE fused launch per (stack, bucket): serving M co-batched tenants
+  adds exactly one compile key — and the stacked jaxpr is loop-free
+  (no lax.scan / while over tenants), the tree-sharded stacked program
+  carries exactly ONE psum (asserted via the analysis walker);
+* apply_delta lane splice: an in-envelope delta extend splices ONLY
+  that tenant's lane of the stacked tables — zero recompiles, zero new
+  compile keys, co-tenant outputs bit-identical before and after;
+* eviction under traffic: every in-flight request either completes
+  with correct values or fails with a typed error — never a torn
+  result — and capacity evictions are counted, never silent;
+* churn regression (compile-cache leak fix): repeated load/evict keeps
+  the process-wide dispatch mirror and the metric series set bounded.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis import ir
+from lightgbm_tpu.models.dense_predict import (make_stacked_sharded_predict,
+                                               stack_dense_arrays,
+                                               stacked_predict_raw)
+from lightgbm_tpu.publish.delta import DeltaJournal
+from lightgbm_tpu.resilience.admission import (DeadlineExceeded,
+                                               QueueFullError, ServerClosed)
+from lightgbm_tpu.serve.batcher import TenantQueueFull
+from lightgbm_tpu.serve.predictor import compile_key_count
+from lightgbm_tpu.serve.registry import ModelRegistry
+from lightgbm_tpu.serve.zoo import ModelZoo
+from lightgbm_tpu.telemetry.metrics import default_registry
+
+SMALL = {"num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1}
+
+# row counts straddling the 8/64 bucket boundaries of the serve ladder
+BOUNDARY_NS = (1, 7, 8, 9, 63, 64, 65)
+
+
+def _train_variant(binary_data, seed, rounds=5, **extra):
+    """One tenant's model: same features, per-seed label noise, so the
+    ensembles differ but the lowered table shapes (and therefore the
+    zoo's stack signature) coincide."""
+    X, y = binary_data
+    yv = np.asarray(y, np.float64)
+    if seed:
+        rng = np.random.RandomState(seed)
+        yv = np.where(rng.rand(len(yv)) < 0.08, 1.0 - yv, yv)
+    p = {**SMALL, "objective": "binary", **extra}
+    return lgb.train(p, lgb.Dataset(X, yv, params=p), rounds)
+
+
+def _model_dir(tmp_path, binary_data, names, **extra):
+    d = tmp_path / "models"
+    d.mkdir(exist_ok=True)
+    for i, name in enumerate(names):
+        _train_variant(binary_data, seed=i, **extra).save_model(
+            str(d / f"{name}.txt"))
+    return str(d)
+
+
+def _series_total() -> int:
+    return sum(len(m.series()) for m in default_registry().collect())
+
+
+# ---------------------------------------------------------------------------
+# stacked-vs-solo bitwise parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kwargs", [
+    {},
+    {"leaf_bits": 8},
+], ids=["dense", "quantized-leaf8"])
+def test_stacked_parity_bitwise(tmp_path, binary_data, kwargs):
+    """A tenant's answer through the fused cross-model launch is BITWISE
+    the answer its solo predictor gives, across bucket boundaries and
+    for raw and transformed scores."""
+    X, _ = binary_data
+    names = ["m0", "m1", "m2"]
+    d = _model_dir(tmp_path, binary_data, names)
+    zoo = ModelZoo(stacking=True, batching=True, max_wait_ms=1.0)
+    try:
+        for n in names:
+            zoo.load(n, os.path.join(d, f"{n}.txt"), **kwargs)
+        groups = zoo.stack_membership()
+        assert groups and sorted(sum(groups.values(), [])) == names, \
+            f"same-shape tenants must co-stack, got {groups}"
+        solo = ModelRegistry()
+        rng = np.random.RandomState(0)
+        for n in names:
+            solo.load(f"solo-{n}", os.path.join(d, f"{n}.txt"),
+                      warmup=False, **kwargs)
+        for rows in BOUNDARY_NS:
+            Xq = rng.randn(rows, X.shape[1]).astype(np.float32)
+            for n in names:
+                ref = np.asarray(solo.get(f"solo-{n}").predict(Xq))
+                got = np.asarray(zoo.predict(n, Xq))
+                assert np.array_equal(got, ref), \
+                    f"{n} rows={rows}: stacked != solo (probabilities)"
+                ref_r = np.asarray(
+                    solo.get(f"solo-{n}").predict(Xq, raw_score=True))
+                got_r = np.asarray(zoo.predict(n, Xq, raw_score=True))
+                assert np.array_equal(got_r, ref_r), \
+                    f"{n} rows={rows}: stacked != solo (raw)"
+    finally:
+        zoo.close()
+
+
+def test_stacked_parity_concurrent_super_batch(tmp_path, binary_data):
+    """Concurrent submits from every tenant land in ONE coalescing
+    window (a genuine multi-lane super-batch) and each still gets its
+    solo-identical slice back."""
+    X, _ = binary_data
+    names = ["m0", "m1", "m2", "m3"]
+    d = _model_dir(tmp_path, binary_data, names)
+    zoo = ModelZoo(stacking=True, batching=True, max_wait_ms=25.0)
+    solo = ModelRegistry()
+    try:
+        for n in names:
+            zoo.load(n, os.path.join(d, f"{n}.txt"))
+            solo.load(n, os.path.join(d, f"{n}.txt"), warmup=False)
+        rng = np.random.RandomState(1)
+        queries = {n: rng.randn(5 + i, X.shape[1]).astype(np.float32)
+                   for i, n in enumerate(names)}
+        # warm the (stack, bucket) program so the timed window is tight
+        for n in names:
+            zoo.predict(n, queries[n])
+        results, errs = {}, []
+
+        def hit(n):
+            try:
+                results[n] = np.asarray(zoo.predict(n, queries[n]))
+            except Exception as exc:  # noqa: BLE001 - recorded for assert
+                errs.append((n, exc))
+        threads = [threading.Thread(target=hit, args=(n,)) for n in names]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, f"concurrent stacked predicts failed: {errs}"
+        for n in names:
+            ref = np.asarray(solo.get(n).predict(queries[n]))
+            assert np.array_equal(results[n], ref), \
+                f"{n}: super-batched slice != solo"
+    finally:
+        zoo.close()
+
+
+def test_walk_tenant_serves_but_never_stacks(tmp_path, binary_data):
+    """A walk-path tenant (no dense tables) rides its own solo batcher:
+    correct answers, no stack membership — and it does not poison the
+    dense tenants' stack."""
+    X, _ = binary_data
+    d = _model_dir(tmp_path, binary_data, ["m0", "m1"])
+    zoo = ModelZoo(stacking=True, batching=True, max_wait_ms=1.0)
+    try:
+        zoo.load("m0", os.path.join(d, "m0.txt"))
+        zoo.load("m1", os.path.join(d, "m1.txt"))
+        zoo.load("w0", os.path.join(d, "m0.txt"), compiler="walk")
+        info = zoo.info()
+        assert not info["w0"]["stackable"] and info["w0"]["stack"] is None
+        members = sum(zoo.stack_membership().values(), [])
+        assert "w0" not in members
+        assert sorted(members) == ["m0", "m1"]
+        solo = ModelRegistry()
+        solo.load("ref", os.path.join(d, "m0.txt"), warmup=False,
+                  compiler="walk")
+        Xq = X[:9].astype(np.float32)
+        assert np.array_equal(np.asarray(zoo.predict("w0", Xq)),
+                              np.asarray(solo.get("ref").predict(Xq)))
+    finally:
+        zoo.close()
+
+
+# ---------------------------------------------------------------------------
+# one fused launch per (stack, bucket)
+# ---------------------------------------------------------------------------
+
+def test_one_compile_key_per_stack_bucket(tmp_path, binary_data):
+    """Serving M tenants of one stack at one bucket adds exactly ONE
+    entry to the process-wide dispatch mirror — one fused program, not
+    one per tenant — and a second bucket adds exactly one more."""
+    X, _ = binary_data
+    names = ["m0", "m1", "m2"]
+    d = _model_dir(tmp_path, binary_data, names)
+    zoo = ModelZoo(stacking=True, batching=True, max_wait_ms=1.0)
+    try:
+        for n in names:
+            zoo.load(n, os.path.join(d, f"{n}.txt"))
+        rng = np.random.RandomState(2)
+        before = compile_key_count()
+        for n in names:  # all pad to the 8-row bucket
+            zoo.predict(n, rng.randn(5, X.shape[1]))
+        assert compile_key_count() == before + 1, \
+            "M tenants at one bucket must share ONE fused program"
+        for n in names:  # all pad to the 64-row bucket
+            zoo.predict(n, rng.randn(33, X.shape[1]))
+        assert compile_key_count() == before + 2
+        snap = default_registry().get("zoo_stack_batches_total").series()
+        assert sum(v for _lbl, v in snap) >= 6
+    finally:
+        zoo.close()
+
+
+def test_stacked_jaxpr_loop_free_one_launch(binary_data):
+    """The analysis walker on the stacked program: no per-tenant loop
+    primitive survives tracing (the model axis is a vmapped batch dim of
+    ONE fused launch, not an unrolled or scanned dispatch)."""
+    X, _ = binary_data
+    bst = _train_variant(binary_data, seed=0)
+    reg = ModelRegistry()
+    reg.load("m", bst.model_to_string(), warmup=False)
+    exe = reg.get("m")._dense
+    assert exe is not None and not exe.shard
+    host = jax.device_get(exe.arrays)
+    stacked = stack_dense_arrays([host] * 3)
+    Xs = np.zeros((3, 64, X.shape[1]), np.float32)
+    jx = ir.trace(lambda Xa, S: stacked_predict_raw(Xa, S, exe.meta),
+                  Xs, stacked)
+    for loop_prim in ("while", "scan", "fori_loop"):
+        assert ir.count_primitive(jx, loop_prim) == 0, \
+            f"stacked dispatch must be loop-free, found {loop_prim}"
+
+
+def test_sharded_stack_exactly_one_psum(binary_data):
+    """Tree-sharded stacked program: ONE psum of the (M, bucket, class)
+    partials per launch — one collective per STACK, never per tenant
+    (the serve/zoo_stack/score_psum contract, asserted directly)."""
+    X, _ = binary_data
+    bst = _train_variant(binary_data, seed=0)
+    reg = ModelRegistry()
+    reg.load("s", bst.model_to_string(), warmup=False, shard=4)
+    exe = reg.get("s")._dense
+    assert exe is not None and exe.shard == 4
+    host = jax.device_get(exe.arrays)
+    stacked = stack_dense_arrays([host] * 3)
+    fn = make_stacked_sharded_predict(stacked, exe.meta, exe._mesh)
+    Xs = np.zeros((3, 64, X.shape[1]), np.float32)
+    colls = ir.collect_collectives(lambda Xa, S: fn(Xa, S), Xs, stacked)
+    assert sum(len(v) for k, v in colls.items() if "psum" in k) == 1, \
+        f"sharded stack must carry exactly one psum, got {colls}"
+
+
+# ---------------------------------------------------------------------------
+# apply_delta lane splice
+# ---------------------------------------------------------------------------
+
+def test_apply_delta_splices_one_lane_zero_recompiles(tmp_path,
+                                                      binary_data,
+                                                      monkeypatch):
+    """An in-envelope delta extend splices ONLY that tenant's stack
+    lane: same signature, zero recompiles, zero new compile keys, and
+    the co-tenant's bytes are untouched.
+
+    shard=4 pads the 1-tree base to capacity 4; the executable must
+    stay UNSHARDED to be stackable, so the loads see a 1-device world
+    (on one device the shard request degrades to pure tree-axis
+    padding — exactly the envelope-without-mesh configuration a small
+    zoo host runs)."""
+    X, y = binary_data
+    jdir = tmp_path / "journal"
+    p = {**SMALL, "objective": "binary", "publish_dir": str(jdir),
+         "publish_every": 1}
+    bst = lgb.train(p, lgb.Dataset(X, y, params=p), 2)
+    mfile = str(tmp_path / "model.txt")
+    bst.save_model(mfile)
+    j = DeltaJournal(str(jdir))
+    base_path, base_round = j.base_entry()
+
+    zoo = ModelZoo(stacking=True, batching=True, max_wait_ms=1.0)
+    try:
+        real_devices = jax.devices
+        with monkeypatch.context() as m:
+            m.setattr(jax, "devices",
+                      lambda *a, **kw: real_devices(*a, **kw)[:1])
+            zoo.load("a", base_path, shard=4)
+            zoo.load("b", base_path, shard=4)
+        pa, pb = zoo.peek("a"), zoo.peek("b")
+        assert pa.stackable and pb.stackable
+        assert pa.info()["dense"]["capacity"] == 4
+        sig = pa.signature
+        stack_before = zoo.current_stack(sig)
+        assert stack_before is not None and stack_before.width == 2
+
+        rng = np.random.RandomState(3)
+        queries = [rng.randn(n, X.shape[1]).astype(np.float32)
+                   for n in (1, 7, 9)]
+        b_before = [np.asarray(zoo.predict("b", Xq)) for Xq in queries]
+        for Xq in queries:
+            zoo.predict("a", Xq)
+        (rec,) = j.records_after(base_round)
+        # cold-load reference at the delta round, predicted OUTSIDE the
+        # measured window (its solo dispatches own compile keys too)
+        cold = ModelRegistry()
+        cold.load("cold", mfile, warmup=False, num_iteration=rec.round)
+        a_refs = [np.asarray(cold.get("cold").predict(Xq))
+                  for Xq in queries]
+        keys_before = compile_key_count()
+        recompiles_before = pb.stats.snapshot()["recompiles"]
+
+        out = zoo.apply_delta("a", rec)
+        assert out["mode"] == "extend"
+        # the splice replaced the stack object but kept its signature
+        # (and therefore the fused program's jit-cache entry)
+        stack_after = zoo.current_stack(sig)
+        assert stack_after is not stack_before
+        assert stack_after.names == stack_before.names
+        assert stack_after.signature == stack_before.signature
+
+        # grown tenant now answers like a cold load at the new round...
+        for Xq, ref in zip(queries, a_refs):
+            got = np.asarray(zoo.predict("a", Xq))
+            assert np.array_equal(got, ref), \
+                "spliced lane != cold load at the delta round"
+        # ...the co-tenant's lane is bit-for-bit untouched...
+        for Xq, ref in zip(queries, b_before):
+            assert np.array_equal(np.asarray(zoo.predict("b", Xq)), ref), \
+                "co-tenant bytes changed across a neighbour's splice"
+        # ...and nothing recompiled anywhere
+        assert compile_key_count() == keys_before, \
+            "in-envelope splice must not mint new compile keys"
+        assert zoo.peek("b").stats.snapshot()["recompiles"] == \
+            recompiles_before
+    finally:
+        zoo.close()
+
+
+# ---------------------------------------------------------------------------
+# admission / eviction
+# ---------------------------------------------------------------------------
+
+def test_cold_load_on_miss_and_capacity_eviction(tmp_path, binary_data):
+    """A request for a non-resident model cold-loads it through the
+    resolver; over budget the coldest tenant is evicted (counted, never
+    silent); an unknown name stays a typed KeyError."""
+    X, _ = binary_data
+    names = ["m0", "m1", "m2"]
+    d = _model_dir(tmp_path, binary_data, names)
+    zoo = ModelZoo(max_resident=2, source_resolver=d,
+                   stacking=True, batching=False)
+    try:
+        evi = default_registry().get("zoo_evictions_total")
+        cold = default_registry().get("zoo_cold_loads_total")
+        evi_0 = sum(v for lbl, v in evi.series()
+                    if lbl.get("reason") == "capacity")
+        cold_0 = sum(v for _lbl, v in cold.series())
+        Xq = X[:4].astype(np.float32)
+        out = zoo.predict("m0", Xq)          # miss -> cold load
+        assert out.shape == (4,)
+        zoo.predict("m1", Xq)                # miss -> cold load
+        zoo.predict("m0", Xq)                # m0 hotter than m1
+        zoo.predict("m2", Xq)                # miss -> evicts coldest (m1)
+        assert sorted(zoo.registry.names()) == ["m0", "m2"]
+        assert sum(v for _lbl, v in cold.series()) == cold_0 + 3
+        assert sum(v for lbl, v in evi.series()
+                   if lbl.get("reason") == "capacity") == evi_0 + 1
+        with pytest.raises(KeyError, match="nope"):
+            zoo.predict("nope", Xq)
+        # a re-request of the victim cold-loads it right back
+        assert zoo.predict("m1", Xq).shape == (4,)
+    finally:
+        zoo.close()
+
+
+@pytest.mark.slow
+def test_eviction_under_traffic_never_torn(tmp_path, binary_data):
+    """Hammer a 6-tenant zipfish workload through a 3-resident zoo while
+    eviction churn runs: every request either completes with CORRECT
+    bytes or raises a typed shed/evict error — never a torn or
+    wrong-tenant result."""
+    X, _ = binary_data
+    names = [f"m{i}" for i in range(6)]
+    d = _model_dir(tmp_path, binary_data, names, rounds=3)
+    zoo = ModelZoo(max_resident=3, source_resolver=d,
+                   stacking=True, batching=True, max_wait_ms=1.0)
+    solo = ModelRegistry()
+    rng = np.random.RandomState(4)
+    Xq = rng.randn(5, X.shape[1]).astype(np.float32)
+    refs = {}
+    for n in names:
+        solo.load(n, os.path.join(d, f"{n}.txt"), warmup=False)
+        refs[n] = np.asarray(solo.get(n).predict(Xq))
+    torn, typed, ok = [], [], [0]
+    stop = time.monotonic() + 2.0
+    lock = threading.Lock()
+
+    def worker(wid):
+        r = np.random.RandomState(wid)
+        while time.monotonic() < stop:
+            n = names[min(int(r.zipf(1.5)) - 1, 5)]
+            try:
+                got = np.asarray(zoo.predict(n, Xq, timeout_s=10.0))
+            except (ServerClosed, DeadlineExceeded, QueueFullError,
+                    KeyError):
+                with lock:
+                    typed.append(n)
+                continue
+            except Exception as exc:  # noqa: BLE001 - recorded for assert
+                with lock:
+                    torn.append((n, repr(exc)))
+                continue
+            if np.array_equal(got, refs[n]):
+                with lock:
+                    ok[0] += 1
+            else:
+                with lock:
+                    torn.append((n, "wrong bytes"))
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not torn, f"torn/untyped results under eviction: {torn[:5]}"
+        assert ok[0] > 50, "churn run served too little to prove anything"
+        evi = default_registry().get("zoo_evictions_total")
+        assert sum(v for lbl, v in evi.series()
+                   if lbl.get("reason") == "capacity") > 0, \
+            "test never actually evicted under traffic"
+    finally:
+        zoo.close()
+
+
+def test_tenant_quota_sheds_before_shared_queue(tmp_path, binary_data):
+    """One tenant's oversized burst is refused by ITS quota (typed 429
+    + zoo_tenant_shed_total{model=...}) while the shared queue still
+    has room — and the co-tenant keeps serving."""
+    X, _ = binary_data
+    d = _model_dir(tmp_path, binary_data, ["m0", "m1"])
+    zoo = ModelZoo(stacking=True, batching=True, max_wait_ms=1.0,
+                   tenant_queue_rows=4, max_queue_rows=1024)
+    try:
+        zoo.load("m0", os.path.join(d, "m0.txt"))
+        zoo.load("m1", os.path.join(d, "m1.txt"))
+        shed = default_registry().get("zoo_tenant_shed_total")
+        shed_0 = sum(v for lbl, v in shed.series()
+                     if lbl.get("model") == "m0")
+        with pytest.raises(TenantQueueFull):
+            zoo.predict("m0", np.zeros((8, X.shape[1]), np.float32))
+        assert sum(v for lbl, v in shed.series()
+                   if lbl.get("model") == "m0") == shed_0 + 1
+        out = zoo.predict("m1", np.zeros((3, X.shape[1]), np.float32))
+        assert out.shape == (3,)
+    finally:
+        zoo.close()
+
+
+# ---------------------------------------------------------------------------
+# churn regression: the compile-cache mirror and metric series stay bounded
+# ---------------------------------------------------------------------------
+
+def test_churn_keeps_compile_keys_and_series_bounded(tmp_path,
+                                                     binary_data):
+    """Load/serve/evict the same shapes repeatedly: after the first lap
+    warms the caches, later laps add NO compile keys and NO metric
+    series — the leak this PR's release path exists to prevent."""
+    X, _ = binary_data
+    d = _model_dir(tmp_path, binary_data, ["m0", "m1"])
+    Xq = np.zeros((3, X.shape[1]), np.float32)
+
+    def one_lap():
+        zoo = ModelZoo(stacking=True, batching=True, max_wait_ms=1.0)
+        try:
+            zoo.load("churn-a", os.path.join(d, "m0.txt"))
+            zoo.load("churn-b", os.path.join(d, "m1.txt"))
+            zoo.predict("churn-a", Xq)
+            zoo.predict("churn-b", Xq)
+            assert zoo.evict("churn-a") and zoo.evict("churn-b")
+        finally:
+            zoo.close()
+
+    one_lap()
+    keys_1, series_1 = compile_key_count(), _series_total()
+    for _ in range(4):
+        one_lap()
+    assert compile_key_count() == keys_1, \
+        "zoo churn ratcheted the compile-key mirror"
+    assert _series_total() == series_1, \
+        "zoo churn ratcheted the metric series set"
+
+
+def test_evict_releases_stack_and_member_keys(tmp_path, binary_data):
+    """Evicting down to one tenant dissolves the stack and releases the
+    fused program's dispatch-mirror entries; evicting the last model of
+    the shape releases the member entries too."""
+    X, _ = binary_data
+    d = _model_dir(tmp_path, binary_data, ["m0", "m1"])
+    before = compile_key_count()
+    zoo = ModelZoo(stacking=True, batching=True, max_wait_ms=1.0)
+    try:
+        zoo.load("m0", os.path.join(d, "m0.txt"))
+        zoo.load("m1", os.path.join(d, "m1.txt"))
+        zoo.predict("m0", np.zeros((3, X.shape[1]), np.float32))
+        assert compile_key_count() > before
+        assert zoo.evict("m0")
+        assert zoo.stack_membership() == {}
+        assert zoo.evict("m1")
+        assert compile_key_count() == before, \
+            "last-of-shape eviction left compile keys behind"
+    finally:
+        zoo.close()
+
+
+# ---------------------------------------------------------------------------
+# introspection
+# ---------------------------------------------------------------------------
+
+def test_info_reports_group_and_stack_membership(tmp_path, binary_data):
+    X, _ = binary_data
+    d = _model_dir(tmp_path, binary_data, ["m0", "m1"])
+    zoo = ModelZoo(stacking=True, batching=False, max_resident=8)
+    try:
+        zoo.load("m0", os.path.join(d, "m0.txt"))
+        zoo.load("m1", os.path.join(d, "m1.txt"))
+        info = zoo.info()
+        for n in ("m0", "m1"):
+            ent = info[n]
+            assert ent["stackable"] is True
+            assert ent["group_key"] == zoo.peek(n).group_key
+            assert ent["stack"]["members"] == ["m0", "m1"]
+            assert ent["stack"]["width"] == 2
+            assert ent["stack"]["lane"] == ("m0", "m1").index(n)
+            assert ent["stack"]["group"] in zoo.stack_membership()
+        zs = zoo.zoo_stats()
+        assert zs["resident"] == 2 and zs["max_resident"] == 8
+        assert zs["stacking"] is True
+        assert sorted(sum(zs["groups"].values(), [])) == ["m0", "m1"]
+        assert set(zs["traffic_weight"]) == {"m0", "m1"}
+    finally:
+        zoo.close()
