@@ -30,7 +30,7 @@ __all__ = ["CollectiveContract", "collective_contract", "contract_for",
            "all_contracts", "resolve_limit", "DonationContract",
            "donation_contract", "all_donation_contracts", "MemoryBudget",
            "memory_budget", "memory_budget_for", "all_memory_budgets",
-           "world_size"]
+           "world_size", "hosts", "dcn_fraction"]
 
 Limit = Union[int, Callable[[Dict[str, Any]], int], None]
 
@@ -56,6 +56,32 @@ def world_size(ctx: Dict[str, Any]) -> int:
     return max(1, int(ctx.get("world_size", ctx.get("nshards", 1))))
 
 
+#: devices per host the pod model assumes when a ctx doesn't say
+DEVICES_PER_HOST = 8
+
+
+def hosts(ctx: Dict[str, Any]) -> int:
+    """Host count from a lint ctx — the pod-topology half of the byte
+    split.  Explicit ``hosts`` wins; otherwise the canonical model of
+    one host per ``DEVICES_PER_HOST`` devices (a v5e host board), so a
+    W=64 abstract trace models an 8-host pod without any ctx churn."""
+    h = ctx.get("hosts")
+    if h is not None:
+        return max(1, int(h))
+    return max(1, world_size(ctx) // DEVICES_PER_HOST)
+
+
+def dcn_fraction(ctx: Dict[str, Any]) -> float:
+    """Modeled cross-host share of an allreduce-family payload.
+
+    On a host-major 1-D axis a hierarchical collective (intra-host ICI
+    reduce, inter-host DCN exchange, intra-host ICI broadcast) moves
+    (H-1)/H of the payload over DCN — the quantity PV-Tree optimizes and
+    the one the per-host/cross-host contract split bounds."""
+    h = hosts(ctx)
+    return (h - 1) / h if h > 1 else 0.0
+
+
 @dataclass(frozen=True)
 class CollectiveContract:
     """Per-site ceiling on collective count and per-op payload bytes.
@@ -64,7 +90,12 @@ class CollectiveContract:
     kinds the site may tally (a site like the wave winner exchange
     legitimately mixes pmax/pmin/psum).  ``max_count`` bounds tallied
     calls per traced program, ``max_bytes_per_op`` the mean per-op
-    payload."""
+    payload.  ``max_dcn_bytes_per_op`` additionally bounds the modeled
+    CROSS-HOST slice of that payload (``dcn_fraction(ctx)`` of the mean
+    per-op bytes on a host-major axis) — the pod-budget half of the
+    split: a site may be cheap on ICI yet blow the DCN budget at W=64,
+    and that is exactly what this ceiling catches at abstract trace
+    time."""
 
     site: str
     ops: Tuple[str, ...]
@@ -72,6 +103,7 @@ class CollectiveContract:
     max_bytes_per_op: Limit = None
     declared_in: str = ""
     note: str = ""
+    max_dcn_bytes_per_op: Limit = None
 
 
 _lock = threading.Lock()
@@ -80,6 +112,7 @@ _registry: Dict[str, CollectiveContract] = {}
 
 def collective_contract(site: str, ops, *, max_count: Limit = None,
                         max_bytes_per_op: Limit = None,
+                        max_dcn_bytes_per_op: Limit = None,
                         note: str = "") -> CollectiveContract:
     """Declare (or redeclare) the contract for one collective site.
 
@@ -94,6 +127,7 @@ def collective_contract(site: str, ops, *, max_count: Limit = None,
         ops = (ops,)
     c = CollectiveContract(site=site, ops=tuple(ops), max_count=max_count,
                            max_bytes_per_op=max_bytes_per_op,
+                           max_dcn_bytes_per_op=max_dcn_bytes_per_op,
                            declared_in=declared_in, note=note)
     with _lock:
         _registry[site] = c

@@ -53,7 +53,7 @@ __all__ = ["MATRIX_CONFIGS", "Geometry", "TRACE_GEOMETRY", "MEM_GEOMETRY",
            "build_unit", "build_callable", "environment_info",
            "parse_kv_args", "run_lint", "main"]
 
-MATRIX_CONFIGS = ("serial", "wave", "dp_scatter", "spec_ramp",
+MATRIX_CONFIGS = ("serial", "wave", "dp_scatter", "spec_ramp", "voting",
                   "multitrain", "serve", "serve_dense", "serve_zoo",
                   "ingest")
 
@@ -252,6 +252,25 @@ def _dp_builder(k: int, geom: Geometry, spec: bool):
         grow = _mk_wave_grow(
             WaveDPStrategy(ax, nshards=k, hist_scatter=True), geom,
             quantized=True, spec=spec)
+        return _dp_entry(grow, mesh, ax), _mk_train_args(
+            i, k * 4096, geom, True)
+
+    return build
+
+
+def _voting_builder(k: int, geom: Geometry, top_k: int):
+    """The voting-parallel wave grower (PV-Tree comms on the wave
+    grower): local top-k vote, one O(W*k) id allgather, psum of the
+    selected-2k histogram slices only — the config whose DCN contracts
+    the W=64 abstract trace enforces."""
+    from ..parallel.voting_parallel import WaveVotingStrategy
+    mesh, _abstract = _trace_mesh(k)
+    ax = mesh.axis_names[0]
+
+    def build(i: int):
+        grow = _mk_wave_grow(
+            WaveVotingStrategy(ax, nshards=k, top_k=top_k), geom,
+            quantized=True, spec=False)
         return _dp_entry(grow, mesh, ax), _mk_train_args(
             i, k * 4096, geom, True)
 
@@ -557,6 +576,16 @@ def build_unit(name: str, nshards: int = 8,
             _base_ctx(geom, nshards=nshards, world_size=nshards,
                       quantized=True, spec_ramp=True,
                       rows=nshards * 4096, mesh_axes=("workers",)))
+    if name == "voting":
+        # top_k=2 keeps 2k < F at the trace geometry so the voted psum
+        # genuinely moves fewer bytes than the full (F,B,3) merge —
+        # the ratio the DCN contracts bound
+        return _unit_from_traces(
+            "voting", _voting_builder(nshards, geom, top_k=2),
+            _base_ctx(geom, nshards=nshards, world_size=nshards,
+                      quantized=True, top_k=2, rows=nshards * 4096,
+                      hosts=max(1, nshards // 8),
+                      mesh_axes=("workers",)))
     if name == "multitrain":
         return _unit_from_traces("multitrain", _multitrain_builder(geom),
                                  _base_ctx(geom, models=3))

@@ -129,6 +129,23 @@ class CollectiveBudgetRule(Rule):
                     f"in {contract.declared_in} budgets "
                     f"{max_bpo} bytes/op — a full-histogram payload "
                     f"leaked onto a sliced path?"))
+            max_dcn = resolve_limit(contract.max_dcn_bytes_per_op, ctx)
+            if max_dcn is not None and count > 0:
+                # modeled cross-host slice of the mean per-op payload:
+                # (H-1)/H of the bytes leave the host on a host-major
+                # axis (contracts.dcn_fraction) — the pod-budget check
+                # that fires at abstract W=64 before chips exist
+                from .contracts import dcn_fraction
+                dcn_bytes = int((nbytes / count) * dcn_fraction(ctx))
+                if dcn_bytes > max_dcn:
+                    out.append(self._v(
+                        unit, site,
+                        f"site '{site}' models {dcn_bytes} CROSS-HOST "
+                        f"bytes/op at {ctx.get('hosts', 'derived')} "
+                        f"host(s) (mean payload "
+                        f"{nbytes // max(count, 1)} B); the contract in "
+                        f"{contract.declared_in} budgets {max_dcn} DCN "
+                        f"bytes/op — this path is not pod-safe"))
         if unit.jaxpr is not None and ctx.get("crosscheck_tally", True):
             in_program = sum(len(v) for v in
                              ir.collectives_of(unit.jaxpr).values())

@@ -131,12 +131,32 @@ class StreamedDataset(Dataset):
             [f"Column_{i}" for i in range(f)]
         self.efb = None
         self.raw_used = None
-        self.distributed_rows = False
+        # pre-partitioned multi-host streaming (ISSUE 18 tentpole): each
+        # process streams only ITS shard's ChunkSource; the per-rank
+        # sketches ride the mergeable-summary wire format over the host
+        # allgather (sketch.allgather_merge), so every rank derives
+        # identical mappers while no host ever materializes — or even
+        # streams — another host's rows
+        from .. import distributed as _dist
+        dist_rows = (bool(cfg.pre_partition) and _dist.is_initialized()
+                     and _dist.process_count() > 1
+                     and self.reference is None)
+        self.distributed_rows = dist_rows
+        if dist_rows and self._group_arg is not None:
+            raise ValueError(
+                "pre_partition cannot shard query/group data (queries "
+                "must not straddle partitions); drop pre_partition or "
+                "the group argument")
         cat_indices = self._resolve_categoricals(self.feature_names_)
         forced_bins = self._load_forced_bins(cfg)
 
         # ---- pass 1: sketch + metadata ------------------------------------
-        sample_idx = sample_row_indices(n, cfg.bin_construct_sample_cnt,
+        if dist_rows:
+            sample_cnt = max(1, int(cfg.bin_construct_sample_cnt) //
+                             _dist.process_count())
+        else:
+            sample_cnt = int(cfg.bin_construct_sample_cnt)
+        sample_idx = sample_row_indices(n, sample_cnt,
                                         cfg.data_random_seed)
         sketch = BinningSketch(f, cat_indices)
         label = None
@@ -160,11 +180,21 @@ class StreamedDataset(Dataset):
                 rows_ctr.inc(m)
                 chunks_ctr.inc()
 
+        n_total = n
+        if dist_rows:
+            # merge every rank's summaries in rank order (the mergeable
+            # sketch wire format over distributed.allgather_host) —
+            # after this, all ranks hold IDENTICAL summaries and derive
+            # identical mappers from their disjoint streamed shards
+            n_total = int(_dist.allgather_host(
+                np.asarray([n], np.float64)).sum())
+            sketch.allgather_merge()
+
         def _filt(sample_total: int) -> int:
             if not cfg.feature_pre_filter:
                 return 0
             return max(1, int(cfg.min_data_in_leaf * sample_total /
-                              max(1, n)))
+                              max(1, n_total)))
 
         self.bin_mappers = sketch.finalize(
             max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
@@ -209,7 +239,16 @@ class StreamedDataset(Dataset):
             self._label_arg
         self._weight_arg = weight if self._weight_arg is None else \
             self._weight_arg
-        self._set_metadata(n)
+        n_rows = n
+        if dist_rows:
+            # pad the LOCAL binned shard to the mesh quantum and
+            # replicate the small metadata (shared Dataset machinery);
+            # the feature shard itself never leaves this process — the
+            # padded copy is the per-host upload staging buffer the DP
+            # assembly (gbdt pre_partition route) hands to
+            # jax.make_array_from_process_local_data
+            n_rows = self._finalize_distributed_rows(n)
+        self._set_metadata(n_rows)
         self.constructed = True
         log_info(f"StreamedDataset: {n} rows x {len(used)} features binned "
                  f"in {src.num_chunks()} chunks of {self.chunk_rows} "
@@ -218,13 +257,18 @@ class StreamedDataset(Dataset):
         return self
 
     # -- chunk access for the chunked trainer --------------------------------
+    # (LOCAL rows: under pre_partition the spill cache holds only this
+    # process's shard, while num_data() reports the global row count)
     def num_chunks(self) -> int:
         self._check_constructed()
-        return -(-self.num_data() // self.chunk_rows)
+        return -(-self._local_rows() // self.chunk_rows)
+
+    def _local_rows(self) -> int:
+        return int(self.X_binned.shape[0])
 
     def chunk_bounds(self, i: int) -> Tuple[int, int]:
         lo = i * self.chunk_rows
-        return lo, min(lo + self.chunk_rows, self.num_data())
+        return lo, min(lo + self.chunk_rows, self._local_rows())
 
     def binned_chunk(self, i: int) -> np.ndarray:
         """(m, F) binned codes of chunk ``i``, read with a positioned

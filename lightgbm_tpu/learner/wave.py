@@ -57,7 +57,8 @@ from ..analysis.contracts import collective_contract, memory_budget
 from ..models.tree import CAT_MASK, DEFAULT_LEFT_MASK, MISSING_NAN
 from ..ops.histogram import build_histogram_leaves, histogram_subtract
 from ..ops.quantize import dequant_scales, quantize_wch
-from ..ops.split import (BIG, NEG_INF, _leaf_gain, leaf_output,
+from ..ops.split import (BIG, NEG_INF, _leaf_gain, best_split_per_feature,
+                         leaf_output,
                          leaf_output_smoothed)
 from .endgame import patch_child_pointers, write_split_records
 from .serial import CommStrategy, GrownTree, local_best_candidate
@@ -164,26 +165,41 @@ def _exchange_payload_bytes(ctx):
         int(ctx.get("itemsize", 4))
 
 
+def _dcn_of(limit):
+    """DCN ceiling derived from a per-op payload curve: the modeled
+    cross-host share — dcn_fraction(ctx), (H-1)/H on a host-major axis —
+    of that payload.  Declared explicitly per site so lint-trace bounds
+    the pod (DCN) bytes separately from the per-op (ICI) bytes."""
+    def dcn_bytes(ctx):
+        from ..analysis.contracts import dcn_fraction
+        return limit(ctx) * dcn_fraction(ctx)
+    return dcn_bytes
+
+
 collective_contract(
     "data_parallel/wave/hist_psum", "psum",
     max_count=_wave_merge_budget, max_bytes_per_op=_hist_batch_bytes,
+    max_dcn_bytes_per_op=_dcn_of(_hist_batch_bytes),
     note="one full-batch histogram psum per merge site")
 collective_contract(
     "data_parallel/wave/hist_reduce_scatter", "psum_scatter",
     max_count=_wave_merge_budget, max_bytes_per_op=_hist_slice_bytes,
+    max_dcn_bytes_per_op=_dcn_of(_hist_slice_bytes),
     note="one reduce_scatter per merge site, 1/k received payload")
 collective_contract(
     "data_parallel/wave/winner_exchange", ("pmax", "pmin", "psum"),
     max_count=lambda ctx: 3 * _wave_merge_budget(ctx),
     max_bytes_per_op=_exchange_payload_bytes,
+    max_dcn_bytes_per_op=_dcn_of(_exchange_payload_bytes),
     note="pmax/pmin/psum triple per candidate-scan site, O(W*k) bytes")
 collective_contract(
     "data_parallel/wave/scalar_sum", "psum",
     max_count=8, max_bytes_per_op=_exchange_payload_bytes,
+    max_dcn_bytes_per_op=_dcn_of(_exchange_payload_bytes),
     note="leaf totals / root sums — small vectors only")
 collective_contract(
     "data_parallel/wave/quant_scale", "pmax",
-    max_count=2, max_bytes_per_op=8,
+    max_count=2, max_bytes_per_op=8, max_dcn_bytes_per_op=8,
     note="global gradient/hessian quantization scales (two scalars)")
 
 
@@ -396,6 +412,24 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
         FP_SC = -(-G // k_sc) * k_sc   # feature axis padded to k blocks
         FB_SC = FP_SC // k_sc          # features owned per shard
         F_PAD_SC = FP_SC - G
+    # PV-Tree voting histogram merge (arXiv:1611.01276) on the wave batch
+    # (all static): under a row-sharded strategy with ``hist_voting``, the
+    # per-leaf histogram POOL stays shard-LOCAL (so the subtraction trick
+    # still holds shard-by-shard) and only the voted top-2k features'
+    # slices of each scan batch are psum'd — per-leaf cross-shard wire
+    # volume drops from F*B to 2k*B.  Quantized batches merge as exact
+    # int32 and dequantize after the psum, so at 2k >= F the voted path
+    # is bit-identical to the full-batch DP merge.  Gated off the same
+    # shapes as scatter (cats / EFB / lazy CEGB / forced splits need
+    # full-feature merged histograms); those configs fall back to the
+    # strategy's full reduce_hist.  Mutually exclusive with scatter: a
+    # strategy declares one merge mode.
+    use_voting = (bool(getattr(strategy, "hist_voting", False)) and
+                  k_sc > 1 and not use_scatter and not any_cat and
+                  not use_efb and not use_lazy and not forced_waves)
+    if use_voting:
+        TOPK_V = max(1, min(int(getattr(strategy, "top_k", 10)), F))
+        SEL_V = min(2 * TOPK_V, F)     # voted features aggregated per leaf
     G_loc = FB_SC if use_scatter else G   # this shard's histogram width
     if use_bynode:
         import math as _math
@@ -554,6 +588,16 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
 
         _dqh = dq if quantized else (lambda h: h)
 
+        def _scan_hists(h, totals):
+            """The histogram form the candidate scans consume: the
+            dequantized (and, under EFB, feature-expanded) batch
+            normally; under voting the RAW shard-local batch — the
+            voted merge inside many_candidates dequantizes AFTER its
+            exact integer psum of the selected slices."""
+            if use_voting:
+                return h
+            return jax.vmap(expand_hist)(_dqh(h), totals)
+
         def _reduce_waves(h, k, with_totals=False):
             """Merge a freshly built (c, G, Bb, 3) histogram batch across
             row shards, trimmed to the first ``k`` channels.  Scatter
@@ -568,8 +612,15 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             all); otherwise from the merged batch.  Quantized batches
             stay int32 end to end and dequantize AFTER the exact integer
             sum, so totals are identical across shards and across merge
-            modes."""
+            modes.  Voting mode returns the batch UNMERGED (shard-local):
+            the vote-and-psum of the winning feature slices happens
+            inside many_candidates; only the (k, 3) leaf totals cross
+            the wire here."""
             hk = h[:k]
+            if use_voting:
+                if not with_totals:
+                    return hk
+                return hk, _dqh(strat.reduce_sum(hk[:, 0].sum(axis=1)))
             if use_scatter:
                 hp = jnp.pad(hk, ((0, 0), (0, F_PAD_SC), (0, 0), (0, 0))) \
                     if F_PAD_SC else hk
@@ -628,6 +679,88 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 return v                                     # uint8
             return bundle_decode(v.astype(jnp.int32), feat)
 
+        def _voting_candidates(hists, sums, bounds, depths, pouts, fms,
+                               rbs, cegb2, cegb, contri):
+            """PV-Tree voted merge + scan for k leaves (the voting
+            counterpart of the scatter exchange).  ``hists`` arrive RAW
+            and shard-LOCAL (int32 under quantized): each shard scores
+            its local batch with the 1/num_machines-relaxed constraints
+            (voting_parallel_tree_learner.cpp:62-63), votes its top-k
+            features per leaf, the votes ride one small all_gather, and
+            only the global top-2k features' histogram slices are
+            psum'd — (k, 2k, B, 3) on the wire instead of (k, F, B, 3).
+            The final scan runs on the merged slices with the FULL
+            split params and global leaf sums; the winner's slice-local
+            feature index maps back through ``selected``.  Every shard
+            computes identical votes and identical merged slices, so
+            candidates are replicated without any exchange — and with
+            2k >= F, ``selected`` (sorted ascending) is the identity
+            permutation and the scan is bit-identical to the full-batch
+            DP merge."""
+            kl = hists.shape[0]
+            # 1. local candidate gains, relaxed constraints, local view
+            #    (the local leaf totals are exact: any feature's bins sum
+            #    to the shard's total — EFB is gated out under voting)
+            lp_v = getattr(strat, "local_params", None) or sp
+            lsum_loc = _dqh(hists[:, 0].sum(axis=1))
+
+            def one_local(h, s, bd, d, po):
+                fs = best_split_per_feature(
+                    h, s, nb_full, ic_full, hn_full, lp_v, monotone,
+                    bd if use_mc else None, d, parent_out=po)
+                return fs.gain
+            gains = jax.vmap(one_local)(_dqh(hists), lsum_loc, bounds,
+                                        depths, pouts)
+            gains = jnp.where(fms, gains, NEG_INF)
+            # 2. local top-k vote -> one all_gather of (k, top_k) ids
+            _, top_ids = jax.lax.top_k(gains, TOPK_V)
+            all_ids = strat.vote_allgather(top_ids)   # (k_sc, kl, TOPK_V)
+            # 3. global voting; ties break toward the lower feature index
+            #    (GlobalVoting, voting_parallel_tree_learner.cpp:151)
+            votes = jnp.zeros((kl, F), jnp.float32).at[
+                jnp.arange(kl)[None, :, None], all_ids].add(
+                    1.0, mode="drop")
+            anti = -jnp.arange(F, dtype=jnp.float32) * 1e-6
+            _, selected = jax.lax.top_k(votes + anti[None, :], SEL_V)
+            # ascending order: at 2k >= F this is the identity map, and
+            # argmax's first-max tie-break matches the full scan's
+            selected = jnp.sort(selected, axis=1)
+            # 4. merge ONLY the selected slices; dequantize after the
+            #    exact integer sum (same ordering contract as scatter)
+            sel_raw = jnp.take_along_axis(
+                hists, selected[:, :, None, None], axis=1)
+            hist_sel = _dqh(strat.reduce_hist_voted(sel_raw))
+            # 5. full-constraint scan on the merged slices
+            nb_v = nb_full[selected]
+            ic_v = ic_full[selected]
+            hn_v = hn_full[selected]
+            mono_v = monotone[selected]
+            fm_v = jnp.take_along_axis(fms, selected, axis=1)
+            pen = cegb2 if cegb2 is not None else (
+                jnp.broadcast_to(cegb, fms.shape)
+                if cegb is not None else None)
+            pen_v = None if pen is None else \
+                jnp.take_along_axis(pen, selected, axis=1)
+            contri_v = None if contri is None else contri[selected]
+            rb_v = None if rbs is None else \
+                jnp.take_along_axis(rbs, selected, axis=1)
+
+            def one_sel(h, s, nb_, ic_, hn_, fm, mo, bd, d, po, *rest):
+                it = iter(rest)
+                pr = next(it) if pen_v is not None else None
+                ct = next(it) if contri_v is not None else None
+                rb = next(it) if rb_v is not None else None
+                return local_best_candidate(
+                    h, s, nb_, ic_, hn_, fm, sp, mo,
+                    bd if use_mc else None, d, pr, ct, po, rb)
+            extras = [a for a in (pen_v, contri_v, rb_v) if a is not None]
+            g, f_loc, b, dl, ls, rs, member = jax.vmap(one_sel)(
+                hist_sel, sums, nb_v, ic_v, hn_v, fm_v, mono_v, bounds,
+                depths, pouts, *extras)
+            f_glob = jnp.take_along_axis(
+                selected, f_loc[:, None], axis=1)[:, 0]
+            return (g, f_glob, b, dl, ls, rs, member)
+
         def many_candidates(hists, sums, bounds, depths, pouts, fms,
                             rbs=None, cegb2=None):
             """Best-split candidates for k leaves in one vmapped scan.
@@ -643,6 +776,10 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             consistent candidates (global feature indices)."""
             cegb = getattr(strat, "cegb_full", None)
             contri = getattr(strat, "contri_full", None)
+            if use_voting:
+                return _voting_candidates(hists, sums, bounds, depths,
+                                          pouts, fms, rbs, cegb2, cegb,
+                                          contri)
             if use_scatter:
                 nb_s, ic_s, hn_s, mono_s = nb_sc, ic_sc, hn_sc, mono_sc
                 fms = _slf2(fms, False)
@@ -797,10 +934,9 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                 # from _reduce_waves so they are shard-consistent under
                 # scatter.
                 h_ss, sums_pl = _reduce_waves(h_ss, Kc, with_totals=True)
-                hfs = dqh(h_ss)                            # (Kc, G*, Bb, 3)
                 lvp = leaf_output(sums_pl[:, 0], sums_pl[:, 1], sp)
                 cnds = many_candidates(
-                    jax.vmap(expand_hist)(hfs, sums_pl), sums_pl,
+                    _scan_hists(h_ss, sums_pl), sums_pl,
                     zb_k, zd_k, lvp, fm_k)
                 g = jnp.where(jar < nlp, cnds[0], NEG_INF)
                 vals, sel_l = jax.lax.top_k(g, Kc)
@@ -863,7 +999,10 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             # -- ONE full-data pass: exact per-prov-leaf channel sums --
             h_ch, leaf_tot = hist_waves(rl_full.astype(jnp.int8), k=Kc,
                                         with_totals=True)     # (Kc, 3)
-            hf_ch = dqh(h_ch)
+            # voting: keep the batch RAW and shard-local — the node-sum
+            # einsum is exact in int32 and _voting_candidates merges
+            # (and dequantizes) only the voted slices
+            hf_ch = h_ch if use_voting else dqh(h_ch)
 
             # -- exact node aggregates + commit tests --
             lt3 = Lm.astype(jnp.float32) @ leaf_tot          # (K1, 3)
@@ -874,6 +1013,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                                 Dn.astype(hf_ch.dtype), hf_ch)
             lvn = leaf_output(pt3[:, 0], pt3[:, 1], sp)
             bg = many_candidates(
+                H_node if use_voting else
                 jax.vmap(expand_hist)(H_node, pt3), pt3,
                 jnp.zeros((K1, 2), jnp.float32),
                 jnp.zeros((K1,), jnp.int32), lvn,
@@ -957,7 +1097,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             lval0 = jnp.where(live, leaf_output(lsum0[:, 0], lsum0[:, 1],
                                                 sp), 0.0)
             cnds0 = many_candidates(
-                jax.vmap(expand_hist)(dqh(hists0[:Kc]), lsum0[:Kc]),
+                _scan_hists(hists0[:Kc], lsum0[:Kc]),
                 lsum0[:Kc], zb_k, ldep0[:Kc], lval0[:Kc], fm_k)
             cg0 = jnp.where(jar < nl_run, cnds0[0], NEG_INF)
             return {
@@ -1046,12 +1186,13 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     preferred_element_type=jnp.float32)[:, 0])       # (F,)
                 strat.cegb_full = base + lazy_pen * jnp.maximum(
                     root_sum[2] - used_root, 0.0)
-            if use_scatter:
-                # the root scan rides the sliced many_candidates path (a
-                # 1-channel batch) so it too scans only this shard's
-                # block and exchanges the winner
+            if use_scatter or use_voting:
+                # the root scan rides the sliced/voted many_candidates
+                # path (a 1-channel batch) so it too scans only this
+                # shard's block (scatter) or merges only the voted
+                # feature slices (voting)
                 c1 = many_candidates(
-                    expand_hist(root_hist_f, root_sum)[None],
+                    _scan_hists(root_hist[None], root_sum[None]),
                     root_sum[None], root_bound[None],
                     jnp.zeros((1,), jnp.int32), root_out[None],
                     fm_root[None],
@@ -1415,8 +1556,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
             hists2 = jnp.concatenate([hist_l, hist_r])      # (2W, G, Bb, 3)
             sums2 = jnp.concatenate([lsum, rsum])
             totals2 = sums2
-            ex2 = jax.vmap(expand_hist)(
-                dq(hists2) if quantized else hists2, totals2)
+            ex2 = _scan_hists(hists2, totals2)
             depth2 = jnp.concatenate([child_depth, child_depth])
             lv2 = jnp.concatenate([out_l, out_r])
             fm2 = jnp.broadcast_to(feature_mask, (2 * W, F))
@@ -1690,7 +1830,7 @@ def make_wave_grow_fn(*, num_leaves: int, num_features: int, max_bins: int,
                     lv2 = jnp.stack([out_l, out_r])
                     d2 = jnp.full((2,), child_depth, jnp.int32)
                     cnds = many_candidates(
-                        jax.vmap(expand_hist)(_dqh(hists2), sums2), sums2,
+                        _scan_hists(hists2, sums2), sums2,
                         jnp.zeros((2, 2), jnp.float32), d2, lv2,
                         jnp.broadcast_to(feature_mask, (2, F)))
                     depth_ok = jnp.logical_or(max_depth <= 0,

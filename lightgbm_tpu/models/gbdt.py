@@ -316,6 +316,27 @@ class GBDT:
         is_cat = np.array([m.is_categorical for m in mappers], bool)
         has_nan = np.array([m.missing_type == MissingType.NAN for m in mappers],
                            bool)
+        if cfg.tree_learner == "auto":
+            # world-size + modeled-bytes learner selection (PV-Tree,
+            # arXiv:1611.01276): voting when its modeled CROSS-HOST
+            # histogram bytes per pass undercut the DP reduce-scatter
+            # path's, data-parallel otherwise; single-device worlds are
+            # the serial learner.  Resolved in place so every downstream
+            # gate (EFB, pre_partition, shard counts, model text) sees
+            # the concrete learner.
+            from ..parallel.voting_parallel import voting_favored
+            _world = jax.device_count()
+            if _world <= 1 and jax.process_count() == 1:
+                cfg.tree_learner = "serial"
+            elif voting_favored(self.num_features, self.max_bins,
+                                int(cfg.top_k), _world):
+                cfg.tree_learner = "voting"
+            else:
+                cfg.tree_learner = "data"
+            log_info(f"tree_learner=auto resolved to "
+                     f"'{cfg.tree_learner}' (world={_world}, "
+                     f"features={self.num_features}, "
+                     f"top_k={int(cfg.top_k)})")
         learner_cfg = cfg
         from ..utils.backend import default_backend as _safe_backend
         _backend = _safe_backend()
@@ -382,9 +403,9 @@ class GBDT:
             # pre-partitioned ingest: assemble the global row-sharded
             # matrix from each process's local shard (features never
             # replicate across hosts)
-            if cfg.tree_learner != "data":
+            if cfg.tree_learner not in ("data", "voting"):
                 raise ValueError("pre_partition-ed training requires "
-                                 "tree_learner=data")
+                                 "tree_learner=data or voting")
             from jax.sharding import NamedSharding, PartitionSpec as _P
             from ..parallel.mesh import get_mesh as _get_mesh
             _mesh = _get_mesh(int(cfg.num_devices))

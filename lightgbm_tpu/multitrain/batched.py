@@ -477,18 +477,28 @@ class BatchTrainer:
         # helpers under eager vmap
         self._vm_grad = jax.vmap(objective.get_gradients)
         vm_grow = jax.vmap(one_grow, in_axes=(None, 0, 0, 0, 0, 0, 0, 0))
-        # model-axis sharding: pmap the vmapped grower so each device
-        # grows M/k models concurrently.  Per-lane values are identical
-        # either way (a vmap lane's arithmetic is batch-width
+        # model-axis sharding: shard_map the vmapped grower over the
+        # GLOBAL device mesh so each device grows M/k model lanes
+        # concurrently (per-device model lanes; multi-host pods shard
+        # the lane axis across every host's devices — the pmap this
+        # replaces could only see local devices and forced a host-side
+        # (k, M/k) reshape round-trip per step).  Per-lane values are
+        # identical either way (a vmap lane's arithmetic is batch-width
         # independent — the bit-identity suite pins this), so sharding
         # is purely a throughput choice.
-        ndev = jax.local_device_count()
+        ndev = jax.device_count()
         self._shard = (bool(self.cfg.tpu_multitrain_shard) and ndev > 1
                        and self.M >= ndev and self.M % ndev == 0)
         if self._shard:
+            from jax.sharding import PartitionSpec as P
+            from ..parallel.mesh import get_mesh, shard_map_compat
             self._ndev = ndev
-            self._vm_grow = jax.pmap(vm_grow,
-                                     in_axes=(None, 0, 0, 0, 0, 0, 0, 0))
+            mesh = get_mesh(ndev, "models")
+            ax = mesh.axis_names[0]
+            self._vm_grow = jax.jit(shard_map_compat(
+                vm_grow, mesh=mesh,
+                in_specs=(P(),) + (P(ax),) * 7,
+                out_specs=P(ax)))
         else:
             self._vm_grow = jax.jit(vm_grow)
         self._vm_walk = jax.vmap(walk_fn,
@@ -564,19 +574,11 @@ class BatchTrainer:
         with self.record.phase("gradients"):
             grad, hess = self._vm_grad(self.score)
         with self.record.phase("grow"):
-            if self._shard:
-                k = self._ndev
-                dev = lambda a: a.reshape((k, self.M // k) + a.shape[1:])
-                grown = self._vm_grow(self._X_arg, dev(grad), dev(hess),
-                                      dev(self._mask_dev),
-                                      dev(self._fmask_dev),
-                                      dev(self._sweep_dev), dev(qk), dev(nk))
-                grown = jax.tree_util.tree_map(
-                    lambda a: a.reshape((self.M,) + a.shape[2:]), grown)
-            else:
-                grown = self._vm_grow(self._X_arg, grad, hess,
-                                      self._mask_dev, self._fmask_dev,
-                                      self._sweep_dev, qk, nk)
+            # sharded or not, one (M, ...) call: the shard_map lane
+            # split happens on-device (no host (k, M/k) reshape)
+            grown = self._vm_grow(self._X_arg, grad, hess,
+                                  self._mask_dev, self._fmask_dev,
+                                  self._sweep_dev, qk, nk)
         # eager multiply: its rounding is the standalone
         # `grown.leaf_value * shrinkage` dispatch's rounding
         lv = grown.leaf_value * self._lr_dev[:, None]

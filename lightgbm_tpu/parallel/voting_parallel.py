@@ -33,7 +33,18 @@ from ..analysis.contracts import collective_contract
 from ..telemetry.train_record import note_collective
 from .mesh import get_mesh, shard_map_compat
 
-__all__ = ["VotingParallelTreeLearner", "VotingStrategy"]
+__all__ = ["VotingParallelTreeLearner", "VotingStrategy",
+           "WaveVotingStrategy", "QuantizedGradUnsupportedError",
+           "modeled_pass_bytes", "voting_favored"]
+
+
+class QuantizedGradUnsupportedError(ValueError):
+    """use_quantized_grad requested on a grower that cannot honor it.
+
+    The WAVE voting learner trains quantized for real (int32 voted
+    slices psum exactly); only the masked sequential fallback cannot —
+    and silently downgrading to exact gradients there would make two
+    'identical' configs train different models."""
 
 
 def _vote_budget(ctx):
@@ -60,6 +71,211 @@ collective_contract("voting_parallel/voted_hist_psum", "psum",
                     max_count=_vote_budget,
                     max_bytes_per_op=_voted_hist_bytes,
                     note="top-2k voted feature histograms only")
+
+
+# ---------------------------------------------------------------------------
+# Contracts for the WAVE voting learner's sites (WaveVotingStrategy below;
+# the per-wave machinery lives in learner/wave.py _voting_candidates).
+# Counts mirror the DP-wave merge budget: one vote + one voted psum per
+# candidate-scan site (root / wave body / endgame, plus the spec-ramp
+# provisional passes), because the voted merge IS the merge on this path.
+# Cross-host (DCN) limits: on a host-major 1-D mesh a hierarchical
+# collective moves (H-1)/H of the payload over DCN — declared explicitly
+# so lint-trace at abstract W=64 bounds the pod bytes, not just the
+# per-op payload (analysis/contracts.py max_dcn_bytes_per_op).
+# ---------------------------------------------------------------------------
+
+def _wave_vote_budget(ctx):
+    from ..learner.wave import _wave_merge_budget
+    return _wave_merge_budget(ctx)
+
+
+def _wave_vote_ids_bytes(ctx):
+    """all_gather operand: (k_leaves, top_k) int32 feature ids — O(W*k)
+    ints, never a histogram."""
+    from ..learner.wave import WAVE_SIZE
+    w = int(ctx.get("wave_size", WAVE_SIZE))
+    return (4 * max(2 * w, int(ctx.get("leaves", 2 * w))) *
+            int(ctx.get("top_k", 10)))
+
+
+def _wave_voted_batch_bytes(ctx):
+    """The voted merge payload: (k_leaves, min(2k, F), B, 3) selected
+    slices — the 2k/F refinement of the full (k_leaves, F, B, 3) psum."""
+    from ..learner.wave import WAVE_SIZE
+    w = int(ctx.get("wave_size", WAVE_SIZE))
+    two_k = min(2 * int(ctx.get("top_k", 10)), int(ctx["features"]))
+    return (max(2 * w, int(ctx.get("leaves", 2 * w))) * two_k *
+            int(ctx["bins"]) * 3 * int(ctx.get("itemsize", 4)))
+
+
+def _dcn(limit):
+    """DCN ceiling: the modeled cross-host share — (H-1)/H on a
+    host-major axis, analysis.contracts.dcn_fraction — of a payload."""
+    def dcn_bytes(ctx):
+        from ..analysis.contracts import dcn_fraction
+        base = limit(ctx) if callable(limit) else limit
+        return base * dcn_fraction(ctx)
+    return dcn_bytes
+
+
+def _wave_exchange_bytes(ctx):
+    from ..learner.wave import _exchange_payload_bytes
+    return _exchange_payload_bytes(ctx)
+
+
+def _wave_full_batch_bytes(ctx):
+    from ..learner.wave import _hist_batch_bytes
+    return _hist_batch_bytes(ctx)
+
+
+collective_contract(
+    "voting_parallel/wave/vote_allgather", "all_gather",
+    max_count=_wave_vote_budget, max_bytes_per_op=_wave_vote_ids_bytes,
+    max_dcn_bytes_per_op=_dcn(_wave_vote_ids_bytes),
+    note="local top-k feature-id vote per scan site, O(W*k) ints")
+collective_contract(
+    "voting_parallel/wave/voted_hist_psum", "psum",
+    max_count=_wave_vote_budget, max_bytes_per_op=_wave_voted_batch_bytes,
+    max_dcn_bytes_per_op=_dcn(_wave_voted_batch_bytes),
+    note="voted top-2k feature slices only — the PV-Tree merge")
+collective_contract(
+    "voting_parallel/wave/hist_psum", "psum",
+    max_count=_wave_vote_budget, max_bytes_per_op=_wave_full_batch_bytes,
+    max_dcn_bytes_per_op=_dcn(_wave_full_batch_bytes),
+    note="full-batch fallback merge for voting-gated shapes (cats/EFB)")
+collective_contract(
+    "voting_parallel/wave/scalar_sum", "psum",
+    max_count=8, max_bytes_per_op=_wave_exchange_bytes,
+    max_dcn_bytes_per_op=_dcn(_wave_exchange_bytes),
+    note="leaf totals / root sums — small vectors only")
+collective_contract(
+    "voting_parallel/wave/quant_scale", "pmax",
+    max_count=2, max_bytes_per_op=8, max_dcn_bytes_per_op=8,
+    note="global gradient/hessian quantization scales (two scalars)")
+
+
+# ---------------------------------------------------------------------------
+# Modeled bytes per histogram pass: the auto-selection rule and the
+# multichip artifact both read this ONE model, so the CI snapshot and the
+# learner pick cannot drift.
+# ---------------------------------------------------------------------------
+
+def modeled_pass_bytes(num_features: int, bins: int, top_k: int,
+                       world: int, *, wave: int = 0, itemsize: int = 4,
+                       devices_per_host: int = 8) -> dict:
+    """Modeled per-pass histogram-merge bytes for the DP reduce-scatter
+    path vs the voting path at world size ``world``, split per-host
+    (ICI) vs cross-host (DCN) assuming a host-major 1-D axis with
+    ``devices_per_host`` devices per host.
+
+    Reduce-scatter moves the whole (W, F, B, 3) batch once around the
+    ring (each shard receives its F/k block fully reduced); voting moves
+    the O(k) vote ids plus the (W, 2k, B, 3) selected slices, allreduced
+    (2x a reduce-scatter's volume for the slice payload)."""
+    from ..learner.wave import WAVE_SIZE
+    w = int(wave) or WAVE_SIZE
+    hosts_ = max(1, int(world) // max(1, int(devices_per_host)))
+    dcn = (hosts_ - 1) / hosts_ if hosts_ > 1 else 0.0
+    two_k = min(2 * int(top_k), int(num_features))
+    ch = 3 * int(itemsize) * int(bins) * w
+    full = int(num_features) * ch          # (W, F, B, 3) batch bytes
+    voted = two_k * ch                     # (W, 2k, B, 3) voted slices
+    vote_ids = 4 * w * int(top_k) * int(world)   # gathered id payload
+    rs_total = full                        # reduce-scatter: ~1x volume
+    vote_total = 2 * voted + vote_ids      # allreduce: ~2x + the vote
+    return {
+        "world": int(world),
+        "hosts": hosts_,
+        "reduce_scatter": {
+            "total": rs_total,
+            "cross_host": int(rs_total * dcn),
+            "per_host": int(rs_total * (1.0 - dcn)),
+        },
+        "voting": {
+            "total": vote_total,
+            "cross_host": int(vote_total * dcn),
+            "per_host": int(vote_total * (1.0 - dcn)),
+        },
+        "voted_full_ratio": voted / full,
+    }
+
+
+#: world size at or above which ``tree_learner=auto`` considers voting
+AUTO_VOTING_MIN_WORLD = 4
+
+
+def voting_favored(num_features: int, bins: int, top_k: int,
+                   world: int, **kw) -> bool:
+    """The ``tree_learner=auto`` flip rule: voting wins when its modeled
+    CROSS-HOST bytes per pass undercut the reduce-scatter path's (PV-Tree
+    is a DCN optimisation — on a single host the scatter path's exact
+    merge is strictly better)."""
+    if int(world) < AUTO_VOTING_MIN_WORLD:
+        return False
+    m = modeled_pass_bytes(num_features, bins, top_k, world, **kw)
+    if m["hosts"] > 1:
+        return m["voting"]["cross_host"] < m["reduce_scatter"]["cross_host"]
+    return m["voting"]["total"] < m["reduce_scatter"]["total"]
+
+
+class WaveVotingStrategy(CommStrategy):
+    """Row-sharded strategy for the WAVE grower with the PV-Tree voted
+    merge (learner/wave.py use_voting): the per-leaf histogram pool stays
+    shard-LOCAL and each candidate scan votes, all_gathers O(k) feature
+    ids and psums only the voted top-2k feature slices — per-leaf wire
+    volume drops from F*B to 2k*B, the communication-efficient recipe
+    for DCN-bound pod meshes (arXiv:1611.01276).
+
+    Voting-gated shapes (cats / EFB / lazy CEGB / forced splits) fall
+    back to ``reduce_hist``'s full-batch psum, so every config still
+    trains correctly.  ``spec_ok`` unlocks the speculative ramp: the
+    provisional passes vote exactly like committed waves."""
+
+    rows_sharded = True
+    spec_ok = True
+    hist_voting = True
+
+    def __init__(self, axis_name: str, nshards: int = 1, top_k: int = 20,
+                 local_params=None):
+        self.axis_name = axis_name
+        self.nshards = int(nshards)
+        self.top_k = int(top_k)
+        self.local_params = local_params
+        self.monotone_full = None
+
+    def reduce_sum(self, v):
+        note_collective("voting_parallel/wave/scalar_sum", "psum", v)
+        return jax.lax.psum(v, self.axis_name)
+
+    def reduce_max(self, v):
+        """Global quantization scales (shared with the DP wave path)."""
+        note_collective("voting_parallel/wave/quant_scale", "pmax", v)
+        return jax.lax.pmax(v, self.axis_name)
+
+    def shard_key(self, key):
+        """Independent stochastic-rounding streams per row shard."""
+        return jax.random.fold_in(key, jax.lax.axis_index(self.axis_name))
+
+    def reduce_hist(self, hist):
+        # fallback full-batch merge for the voting-gated configs — and
+        # the single collective those configs pay per wave
+        note_collective("voting_parallel/wave/hist_psum", "psum", hist)
+        return jax.lax.psum(hist, self.axis_name)
+
+    def vote_allgather(self, top_ids):
+        """(k_leaves, top_k) local winner ids -> (nshards, k_leaves,
+        top_k): the ONLY full-world exchange the vote needs."""
+        note_collective("voting_parallel/wave/vote_allgather",
+                        "all_gather", top_ids)
+        return jax.lax.all_gather(top_ids, self.axis_name)
+
+    def reduce_hist_voted(self, sel):
+        """Exact merge of the voted (k_leaves, 2k, B, 3) slices —
+        int32 under quantized gradients, so the sum is order-free."""
+        note_collective("voting_parallel/wave/voted_hist_psum", "psum",
+                        sel)
+        return jax.lax.psum(sel, self.axis_name)
 
 
 class VotingStrategy(CommStrategy):
@@ -135,17 +351,20 @@ class VotingStrategy(CommStrategy):
 
 
 class VotingParallelTreeLearner:
+    """Two growers, like the DP learner: the WAVE grower with the voted
+    merge (first-class: quantized gradients, exact endgame, spec ramp —
+    learner/wave.py use_voting + WaveVotingStrategy) and the masked
+    sequential grower with per-scan voting (VotingStrategy; off-TPU
+    fallback).  The masked fallback cannot train quantized — that combo
+    raises QuantizedGradUnsupportedError instead of silently training a
+    different model."""
+
     name = "voting"
 
     def __init__(self, config: Config, num_features: int, max_bins: int,
                  num_bins: np.ndarray, is_cat: np.ndarray, has_nan: np.ndarray,
                  monotone: Optional[np.ndarray] = None):
         self.config = config
-        if config.use_quantized_grad:
-            from ..utils.log import log_warning
-            log_warning("use_quantized_grad is only applied by the wave "
-                        "grower (serial / tree_learner=data); training "
-                        "with exact gradients")
         self.max_bins = int(max_bins)
         self.num_features = num_features
         self.mesh = get_mesh(int(config.num_devices))
@@ -157,20 +376,46 @@ class VotingParallelTreeLearner:
         self.monotone = jnp.asarray(
             monotone if monotone is not None else np.zeros(num_features),
             jnp.int32)
+        self.top_k = max(1, min(int(config.top_k), num_features))
+        sp = split_params_from_config(config, num_bins, is_cat)
+        local_sp = sp._replace(
+            min_data_in_leaf=max(1, sp.min_data_in_leaf // self.ndev),
+            min_sum_hessian_in_leaf=sp.min_sum_hessian_in_leaf / self.ndev)
+        self._local_sp = local_sp
+        mode = str(config.tree_grow_mode)
+        impl_wave = resolve_hist_impl(config, parallel=True, wave=True,
+                                      max_bins=self.max_bins)
+        wave_able = (int(config.num_leaves) > 2 and
+                     hist_pool_fits(config, num_features, self.max_bins))
+        self.wave = wave_able and (mode == "wave" or
+                                   (mode == "auto" and
+                                    impl_wave == "pallas"))
+        if not self.wave and config.use_quantized_grad and wave_able \
+                and mode != "partition":
+            # quantized voting is a wave-grower feature; ride it rather
+            # than refuse when the config merely defaulted off-TPU
+            self.wave = True
+        if self.wave:
+            self._init_wave(config, num_features, num_bins, is_cat,
+                            has_nan, monotone, impl_wave, sp, local_sp)
+            return
+        self.quantized = False
+        self.supports_extras = False
+        if config.use_quantized_grad:
+            raise QuantizedGradUnsupportedError(
+                "use_quantized_grad with tree_learner=voting requires the "
+                "wave grower (tree_grow_mode=wave, or auto on TPU); the "
+                "masked voting grower trains exact gradients only — "
+                "drop use_quantized_grad or enable the wave grower")
         from ..learner.serial import resolve_monotone_method
         resolve_monotone_method(
             config, bool(config.monotone_constraints and
                          any(int(v) for v in
                              config.monotone_constraints)),
             wave=False)
-        sp = split_params_from_config(config, num_bins, is_cat)
-        local_sp = sp._replace(
-            min_data_in_leaf=max(1, sp.min_data_in_leaf // self.ndev),
-            min_sum_hessian_in_leaf=sp.min_sum_hessian_in_leaf / self.ndev)
-        top_k = max(1, min(int(config.top_k), num_features))
-        strategy = VotingStrategy(self.axis, top_k, num_features, self.ndev,
-                                  self.num_bins, self.is_cat, self.has_nan,
-                                  local_sp)
+        strategy = VotingStrategy(self.axis, self.top_k, num_features,
+                                  self.ndev, self.num_bins, self.is_cat,
+                                  self.has_nan, local_sp)
         grow_t = make_grow_fn(
             num_leaves=int(config.num_leaves), max_bins=self.max_bins,
             max_depth=int(config.max_depth), split_params=sp,
@@ -181,13 +426,7 @@ class VotingParallelTreeLearner:
 
         def grow(X, g, h, m, nb, ic, hn, mono, fm):
             return grow_t(X, None, g, h, m, nb, ic, hn, mono, fm)
-        tree_specs = GrownTree(
-            split_feature=P(), threshold_bin=P(), nan_bin=P(),
-            cat_member=P(), decision_type=P(), left_child=P(), right_child=P(),
-            split_gain=P(), internal_value=P(), internal_weight=P(),
-            internal_count=P(), leaf_value=P(), leaf_weight=P(),
-            leaf_count=P(), num_leaves=P(), row_leaf=P(self.axis),
-            hist_passes=P())
+        tree_specs = self._tree_specs(self.axis)
         self._grow = jax.jit(shard_map_compat(
             grow, mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis), P(self.axis), P(self.axis),
@@ -195,12 +434,119 @@ class VotingParallelTreeLearner:
             out_specs=tree_specs,
             check_vma=False))
 
+    @staticmethod
+    def _tree_specs(axis):
+        return GrownTree(
+            split_feature=P(), threshold_bin=P(), nan_bin=P(),
+            cat_member=P(), decision_type=P(), left_child=P(),
+            right_child=P(), split_gain=P(), internal_value=P(),
+            internal_weight=P(), internal_count=P(), leaf_value=P(),
+            leaf_weight=P(), leaf_count=P(), num_leaves=P(),
+            row_leaf=P(axis), hist_passes=P())
+
+    def _init_wave(self, config, num_features, num_bins, is_cat, has_nan,
+                   monotone, impl, sp, local_sp):
+        from ..learner.wave import make_wave_grow_fn
+        from ..ops.quantize import quant_levels
+        self.pallas = impl == "pallas"
+        self._x_src = None
+        self.supports_extras = True
+        self.quantized = bool(config.use_quantized_grad)
+        if np.any(np.asarray(is_cat)):
+            # voting gates cats off inside the grower (full-batch psum
+            # fallback) but the wave scan still runs full feature space
+            sp = sp._replace(cat_idx=tuple(
+                int(j) for j in np.where(np.asarray(is_cat))[0]))
+        self.split_params = sp
+        from ..learner.serial import resolve_monotone_method
+        mc_inter = resolve_monotone_method(config, sp.use_monotone,
+                                           wave=True)
+        self._use_node_key = sp.feature_fraction_bynode < 1.0 or \
+            sp.extra_trees
+        gq_max, hq_max = quant_levels(int(config.num_grad_quant_bins))
+        strategy = WaveVotingStrategy(self.axis, nshards=self.ndev,
+                                      top_k=self.top_k,
+                                      local_params=local_sp)
+        grow_w = make_wave_grow_fn(
+            num_leaves=int(config.num_leaves), num_features=num_features,
+            max_bins=self.max_bins, max_depth=int(config.max_depth),
+            split_params=sp,
+            hist_impl=impl, any_cat=bool(np.any(np.asarray(is_cat))),
+            wave_size=int(config.tpu_wave_size), strategy=strategy,
+            jit=False, quantized=self.quantized, gq_max=gq_max,
+            hq_max=hq_max,
+            renew_leaf=bool(config.quant_train_renew_leaf),
+            stochastic=bool(config.stochastic_rounding),
+            mc_inter=mc_inter,
+            spec_ramp=bool(config.tpu_speculative_ramp),
+            spec_tol=float(config.tpu_spec_tolerance),
+            exact_endgame=bool(config.tpu_exact_endgame))
+
+        nq = int(self.quantized)
+        nn = int(self._use_node_key)
+
+        def grow(X_T, g, h, m, nb, ic, hn, mono, fm, cegb, *rest):
+            kw = {}
+            ki = 0
+            if nq:
+                kw["quant_key"] = rest[ki]
+                ki += 1
+            if nn:
+                kw["node_key"] = rest[ki]
+            return grow_w(X_T, g, h, m, nb, ic, hn, mono, cegb, (), fm,
+                          **kw)
+
+        tree_specs = self._tree_specs(self.axis)
+        self._grow = jax.jit(shard_map_compat(
+            grow, mesh=self.mesh,
+            in_specs=(P(None, self.axis), P(self.axis), P(self.axis),
+                      P(self.axis), P(), P(), P(), P(), P(), P()) +
+            (P(),) * (nq + nn),
+            out_specs=tree_specs,
+            check_vma=False))
+
     def train(self, X_dev: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               sample_mask: jnp.ndarray,
-              feature_mask: Optional[jnp.ndarray] = None) -> GrownTree:
+              feature_mask: Optional[jnp.ndarray] = None,
+              quant_key=None, cegb_penalty=None,
+              node_key=None) -> GrownTree:
         if feature_mask is None:
             feature_mask = jnp.ones((self.num_features,), jnp.bool_)
         n = X_dev.shape[0]
+        if self.wave:
+            if self.pallas:
+                from ..ops.histogram_pallas import DEFAULT_ROW_BLOCK
+                quantum = self.ndev * DEFAULT_ROW_BLOCK
+            else:
+                quantum = self.ndev * 8
+            pad = (-n) % quantum
+            if self._x_src is not X_dev:
+                Xp = jnp.pad(X_dev, ((0, pad), (0, 0))) if pad else X_dev
+                self._XpT = jnp.asarray(jnp.swapaxes(Xp, 0, 1))
+                self._x_src = X_dev
+            if pad:
+                grad = jnp.pad(grad, (0, pad))
+                hess = jnp.pad(hess, (0, pad))
+                sample_mask = jnp.pad(sample_mask, (0, pad))
+            if cegb_penalty is None:
+                cegb_penalty = jnp.zeros((self.num_features,), jnp.float32)
+            keys = []
+            if self.quantized:
+                if quant_key is None:
+                    self._quant_calls = getattr(self, "_quant_calls", 0) + 1
+                    quant_key = jax.random.PRNGKey(self._quant_calls)
+                keys.append(quant_key)
+            if self._use_node_key:
+                if node_key is None:
+                    node_key = jnp.zeros((2, 2), jnp.uint32)
+                keys.append(node_key)
+            grown = self._grow(self._XpT, grad, hess, sample_mask,
+                               self.num_bins, self.is_cat, self.has_nan,
+                               self.monotone, feature_mask, cegb_penalty,
+                               *keys)
+            if pad:
+                grown = grown._replace(row_leaf=grown.row_leaf[:n])
+            return grown
         pad = (-n) % self.ndev
         if pad:
             X_dev = jnp.pad(X_dev, ((0, pad), (0, 0)))

@@ -15,6 +15,14 @@ drift.  Three row classes:
   * SLO verdict rows (``slo_ok``): fail when a previously-met objective
     is now breached (no envelope — a breach is binary).
 
+Two non-bench artifacts are adapted into rows so the same gate judges
+them: ``multihost-smoke-v1`` (the 2-process bit-identity verdicts become
+SLO rows — a pass that flips to fail is a regression) and
+``multichip-dryrun-v1`` (the dryrun/voting-budget verdicts become SLO
+rows and the voted per-leaf histogram byte ratio becomes a lower-better
+``bytes_ratio`` row, so a comms-efficiency giveback past the threshold
+fails the night it lands).
+
 Usage:
     python scripts/bench_regression.py --baseline prev.json \
         --current cur.json [--threshold 0.10] [--out diff.json]
@@ -32,19 +40,44 @@ import sys
 
 THROUGHPUT_KEYS = ("iters_per_sec", "models_per_sec", "builds_per_sec",
                    "rows_per_sec", "qps")
-LATENCY_KEYS = ("p99_ms", "p50_ms", "recompiles")
+LATENCY_KEYS = ("p99_ms", "p50_ms", "recompiles", "bytes_ratio")
+
+
+def _adapt_rows(rec, path):
+    """Rows for one artifact; multihost-smoke-v1 and multichip-dryrun-v1
+    are adapted into bench-matrix rows, anything else must BE
+    bench-matrix-v1."""
+    schema = rec.get("schema")
+    if schema == "bench-matrix-v1":
+        return rec.get("rows", [])
+    if schema == "multihost-smoke-v1":
+        rows = [{"name": "multihost/smoke", "slo_ok": bool(rec.get("ok"))}]
+        for check, val in sorted((rec.get("bit_identical") or {}).items()):
+            rows.append({"name": f"multihost/{check}", "slo_ok": bool(val)})
+        return rows
+    if schema == "multichip-dryrun-v1":
+        col = rec.get("collectives") or {}
+        rows = [{"name": "multichip/dryrun", "slo_ok": bool(rec.get("ok"))},
+                {"name": "multichip/contracts-per-w",
+                 "slo_ok": bool(rec.get("contracts_per_w_ok"))},
+                {"name": "multichip/voting-budget",
+                 "slo_ok": bool(col.get("voting_ratio_ok"))}]
+        ratio = (col.get("hist_bytes_per_leaf") or {}).get("ratio")
+        if ratio is not None:
+            rows.append({"name": "multichip/voting-bytes-per-leaf",
+                         "bytes_ratio": float(ratio)})
+        return rows
+    raise ValueError(f"{path}: not a gate-readable artifact "
+                     f"(schema={schema!r})")
 
 
 def load_rows(path):
-    """name -> (metric_key, value, direction) for one bench-matrix-v1
-    artifact.  direction: "higher" | "lower" | "bool"."""
+    """name -> (metric_key, value, direction) for one artifact.
+    direction: "higher" | "lower" | "bool"."""
     with open(path) as fh:
         rec = json.load(fh)
-    if rec.get("schema") != "bench-matrix-v1":
-        raise ValueError(f"{path}: not a bench-matrix-v1 artifact "
-                         f"(schema={rec.get('schema')!r})")
     rows = {}
-    for row in rec.get("rows", []):
+    for row in _adapt_rows(rec, path):
         if row.get("interpreted"):
             continue                 # correctness proxy, not a perf claim
         name = row.get("name")

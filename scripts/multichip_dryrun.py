@@ -35,10 +35,12 @@ def collective_bytes_snapshot(n_devices: int) -> dict:
     from lightgbm_tpu.parallel.data_parallel import (
         DataParallelTreeLearner, WaveDPStrategy)
     from lightgbm_tpu.parallel.mesh import get_mesh, shard_map_compat
+    from lightgbm_tpu.parallel.voting_parallel import WaveVotingStrategy
     from lightgbm_tpu.telemetry.train_record import (collectives_reset,
                                                      collectives_snapshot)
 
     f, b, n = 8, 64, n_devices * 4096
+    top_k = 2                        # 2k=4 < F=8: real voted filtering
     rng = np.random.RandomState(0)
     args = (jnp.asarray(rng.randint(0, b - 1, (f, n)).astype(np.uint8)),
             jnp.asarray(rng.randn(n).astype(np.float32)),
@@ -51,14 +53,18 @@ def collective_bytes_snapshot(n_devices: int) -> dict:
     sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=0.0,
                      any_cat=False)
     out = {}
-    for mode, scatter in (("scatter", True), ("allreduce", False)):
+    strategies = {
+        "scatter": WaveDPStrategy(ax, nshards=n_devices,
+                                  hist_scatter=True),
+        "allreduce": WaveDPStrategy(ax, nshards=n_devices),
+        "voting": WaveVotingStrategy(ax, nshards=n_devices, top_k=top_k),
+    }
+    for mode, strategy in strategies.items():
         grow = make_wave_grow_fn(
             num_leaves=15, num_features=f, max_bins=b, max_depth=0,
             split_params=sp, hist_impl="pallas", any_cat=False,
             interpret=True, jit=False, wave_size=4, stochastic=False,
-            quantized=True,
-            strategy=WaveDPStrategy(ax, nshards=n_devices,
-                                    hist_scatter=scatter))
+            quantized=True, strategy=strategy)
         wrapped = shard_map_compat(
             lambda X_T, g, h, m, nb, ic, hn, mono, cp, fm: grow(
                 X_T, g, h, m, nb, ic, hn, mono, cp, (), fm),
@@ -77,9 +83,39 @@ def collective_bytes_snapshot(n_devices: int) -> dict:
 
     sc = per_pass(out["scatter"], "data_parallel/wave/hist_reduce_scatter")
     ar = per_pass(out["allreduce"], "data_parallel/wave/hist_psum")
-    out["hist_bytes_per_pass"] = {"scatter": sc, "allreduce": ar}
+    vo = per_pass(out["voting"], "voting_parallel/wave/voted_hist_psum")
+    vo_ids = per_pass(out["voting"], "voting_parallel/wave/vote_allgather")
+    out["hist_bytes_per_pass"] = {"scatter": sc, "allreduce": ar,
+                                  "voting": vo, "voting_ids": vo_ids}
     out["hist_bytes_ratio_allreduce_over_scatter"] = (
         round(ar / sc, 3) if sc and ar else None)
+    # PV-Tree acceptance: voted-2k*B slices vs the full-F*B merge, PER
+    # LEAF — every voted psum moves exactly sel*B*3 ints per candidate
+    # leaf against the allreduce merge's F*B*3, so the per-leaf ratio is
+    # 2k/F.  Derive the per-leaf payloads from the tallied totals (both
+    # must divide exactly; a full-F histogram leaking into the voting
+    # program breaks the divisibility and fails the gate), and record
+    # the raw per-pass total ratio too — the voting program psums BOTH
+    # children where allreduce psums the smaller child only, so its
+    # per-pass total carries more (cheap) leaves.
+    sel = min(2 * top_k, f)
+    leaf_vo = sel * b * 3 * 4       # voted bytes per candidate leaf
+    leaf_ar = f * b * 3 * 4         # full-merge bytes per leaf
+    vo_tot = out["voting"].get("voting_parallel/wave/voted_hist_psum",
+                               {}).get("bytes", 0)
+    ar_tot = out["allreduce"].get("data_parallel/wave/hist_psum",
+                                  {}).get("bytes", 0)
+    ratio_budget = sel / f
+    per_leaf_ratio = leaf_vo / leaf_ar
+    out["hist_bytes_ratio_voting_over_allreduce_total"] = (
+        round(vo / ar, 4) if vo and ar else None)
+    out["hist_bytes_per_leaf"] = {"voting": leaf_vo, "allreduce": leaf_ar,
+                                  "ratio": round(per_leaf_ratio, 4)}
+    out["voting_ratio_ok"] = bool(
+        vo_tot and ar_tot and vo_tot % leaf_vo == 0
+        and ar_tot % leaf_ar == 0
+        and per_leaf_ratio <= ratio_budget + 1e-9)
+    out["voting_ratio_budget_2k_over_f"] = ratio_budget
     return out
 
 
@@ -99,7 +135,7 @@ def contract_sweep_per_w(ws=(4, 8, 64)) -> dict:
            "worlds": {}}
     for w in ws:
         entry = {}
-        for cfg in ("dp_scatter", "spec_ramp"):
+        for cfg in ("dp_scatter", "spec_ramp", "voting"):
             t0 = time.perf_counter()
             unit = lint.build_unit(cfg, nshards=w)
             vs = run_rules([unit], rules=ALL_RULES)
@@ -152,13 +188,18 @@ def main() -> int:
                        "error": traceback.format_exc(limit=20)}, fh,
                       indent=2)
     rec["contracts_per_w_ok"] = per_w_ok
+    voting_ok = rec.get("collectives", {}).get("voting_ratio_ok", False)
     with open(ns.out, "w") as fh:
         json.dump(rec, fh, indent=2, default=str)
     print(json.dumps({k: rec[k] for k in ("ok", "dryrun_seconds")} |
                      {"ratio": rec.get("collectives", {}).get(
                          "hist_bytes_ratio_allreduce_over_scatter"),
+                      "voting_ratio_per_leaf": rec.get(
+                          "collectives", {}).get(
+                          "hist_bytes_per_leaf", {}).get("ratio"),
+                      "voting_ratio_ok": voting_ok,
                       "contracts_per_w_ok": per_w_ok}))
-    return 0 if rec["ok"] and per_w_ok else 1
+    return 0 if rec["ok"] and per_w_ok and voting_ok else 1
 
 
 if __name__ == "__main__":

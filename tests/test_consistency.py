@@ -32,9 +32,16 @@ def _run_cli_train(example, tmp_path, extra=()):
     return model_out
 
 
-def _python_train(example):
+# CLI<->API parity holds round-by-round, so the examples' full 40-60
+# round configs are capped here (training cost is linear in rounds)
+ROUNDS = 15
+
+
+def _python_train(example, num_rounds=None):
     d = os.path.join(EXAMPLES, example)
     params = parse_config_file(os.path.join(d, "train.conf"))
+    if num_rounds is not None:  # params' own num_trees wins over the
+        params["num_trees"] = num_rounds  # num_boost_round argument
     cfg = Config(params)
     data_path = os.path.join(d, cfg.data)
     X, _, y = load_data_file(data_path, params)
@@ -46,22 +53,24 @@ def _python_train(example):
     if g is not None:
         ds.set_group(g.astype(np.int64))
     bst = lgb.train({**params, "verbosity": -1}, ds,
-                    num_boost_round=cfg.num_iterations)
+                    num_boost_round=num_rounds or cfg.num_iterations)
     return bst, X
 
 
 @pytest.mark.parametrize("example", ["binary_classification", "regression",
                                      "lambdarank"])
 def test_cli_matches_python_api(example, tmp_path):
-    model_path = _run_cli_train(example, tmp_path)
+    model_path = _run_cli_train(example, tmp_path,
+                                extra=(f"num_trees={ROUNDS}",))
     cli_bst = lgb.Booster(model_file=model_path)
-    py_bst, X = _python_train(example)
+    py_bst, X = _python_train(example, num_rounds=ROUNDS)
     np.testing.assert_allclose(cli_bst.predict(X), py_bst.predict(X),
                                rtol=1e-6, atol=1e-9)
 
 
 def test_cli_predict_writes_results(tmp_path):
-    model_path = _run_cli_train("regression", tmp_path)
+    model_path = _run_cli_train("regression", tmp_path,
+                                extra=(f"num_trees={ROUNDS}",))
     d = os.path.join(EXAMPLES, "regression")
     out = str(tmp_path / "preds.txt")
     cli_main([f"config={os.path.join(d, 'predict.conf')}",
@@ -75,7 +84,8 @@ def test_cli_predict_writes_results(tmp_path):
 
 
 def test_cli_refit(tmp_path):
-    model_path = _run_cli_train("regression", tmp_path)
+    model_path = _run_cli_train("regression", tmp_path,
+                                extra=(f"num_trees={ROUNDS}",))
     d = os.path.join(EXAMPLES, "regression")
     out_model = str(tmp_path / "refit.txt")
     cli_main(["task=refit",
@@ -90,7 +100,8 @@ def test_cli_refit(tmp_path):
 def test_cli_convert_model_compiles_and_matches(tmp_path):
     import ctypes
     import shutil
-    model_path = _run_cli_train("regression", tmp_path)
+    model_path = _run_cli_train("regression", tmp_path,
+                                extra=(f"num_trees={ROUNDS}",))
     src = str(tmp_path / "model.cpp")
     cli_main(["task=convert_model", f"input_model={model_path}",
               f"convert_model={src}", "verbosity=-1"])

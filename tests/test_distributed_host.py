@@ -1,0 +1,169 @@
+"""Direct tests for the host-side allgather behind pre-partitioned
+ingest (``distributed.allgather_host``) and the mergeable-sketch wire
+format that rides it (ISSUE 18 satellites).
+
+A 2-rank world is SIMULATED: ``multihost_utils.process_allgather`` is
+replaced with a fake that answers each rank's calls from the full set of
+per-rank operands (the transform allgather_host applies to its operand —
+length probe, then max-pad — is reproduced per rank), so the collective's
+padding/trim/rank-order logic runs exactly as in a real 2-process gloo
+run, in one process.  Covered:
+
+  * float64 bit-exactness — x64 is off in JAX, so f64 payloads ship as
+    uint32 bit-pairs; NaN payloads, -0.0, denormals and full-precision
+    pi must survive BIT-identically (bin boundaries and labels ride
+    this);
+  * variable / empty per-rank lengths — the max-pad + trim must
+    reassemble exactly, including a rank contributing zero rows;
+  * rank-order preservation — the concatenation is rank-major;
+  * single-process passthrough — no collective, the input comes back;
+  * sketch.allgather_merge — two half-data sketches merged over the
+    simulated wire finalize into the SAME BinMappers as one sketch over
+    the full matrix (the distributed-binning bit-identity root).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from lightgbm_tpu import distributed as dist
+from lightgbm_tpu.ingest.sketch import BinningSketch
+
+
+class _FakeWorld:
+    """Answers ``process_allgather`` for a simulated rank set.
+
+    allgather_host issues exactly two collectives per (non-f64) call —
+    the int32 length probe, then the max-padded payload — so the fake
+    alternates: even calls return every rank's length, odd calls every
+    rank's padded operand.  ``rank_inputs`` holds each rank's operand in
+    the SAME form allgather_host would send (f64 callers recurse through
+    the uint32 view before gathering, so f64 world inputs are viewed
+    here too)."""
+
+    def __init__(self, rank_inputs):
+        self.rank_inputs = [
+            np.asarray(a).view(np.uint32) if np.asarray(a).dtype ==
+            np.float64 else np.asarray(a) for a in rank_inputs]
+        self.calls = 0
+
+    def __call__(self, x):
+        i, self.calls = self.calls, self.calls + 1
+        if i % 2 == 0:      # length probe
+            return np.stack([np.asarray([a.shape[0]], np.int32)
+                             for a in self.rank_inputs])
+        m = max(a.shape[0] for a in self.rank_inputs)
+
+        def pad(a):
+            if m > a.shape[0]:
+                z = np.zeros((m - a.shape[0],) + a.shape[1:], a.dtype)
+                return np.concatenate([a, z], axis=0)
+            return a
+
+        return np.stack([pad(a) for a in self.rank_inputs])
+
+
+def _gather_as_rank(rank_inputs, rank=0, monkeypatch=None):
+    """Run rank ``rank``'s allgather_host against the simulated world."""
+    world = _FakeWorld(rank_inputs)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr("jax.experimental.multihost_utils.process_allgather",
+                        world)
+    return dist.allgather_host(np.asarray(rank_inputs[rank]))
+
+
+def test_float64_bits_survive_the_uint32_roundtrip(monkeypatch):
+    """NaN payload bits, -0.0, a denormal and full-precision pi must
+    come back BIT-identical (f64 would silently round to f32 in transit
+    with x64 off; the uint32 view is the wire format)."""
+    a0 = np.array([np.pi, -0.0, 5e-324, 1.0 + 2 ** -52], np.float64)
+    a1 = np.array([np.nan, -np.inf, 1e308], np.float64)
+    got = _gather_as_rank([a0, a1], monkeypatch=monkeypatch)
+    want = np.concatenate([a0, a1])
+    assert got.dtype == np.float64
+    np.testing.assert_array_equal(got.view(np.uint64), want.view(np.uint64))
+
+
+def test_empty_rank_arrays(monkeypatch):
+    """A rank contributing zero rows must vanish from the result (and
+    an all-empty world must produce an empty array, not an error)."""
+    a0 = np.arange(6, dtype=np.int32)
+    a1 = np.zeros((0,), np.int32)
+    got = _gather_as_rank([a0, a1], monkeypatch=monkeypatch)
+    np.testing.assert_array_equal(got, a0)
+    got2 = _gather_as_rank([a1, a0], monkeypatch=monkeypatch)
+    np.testing.assert_array_equal(got2, a0)
+    got3 = _gather_as_rank([a1, a1.copy()], monkeypatch=monkeypatch)
+    assert got3.shape == (0,)
+
+
+def test_rank_order_and_variable_lengths(monkeypatch):
+    """Rank-major concatenation with unequal lengths (max-pad + trim):
+    no pad value may leak and order is rank 0 then rank 1."""
+    a0 = np.full((3, 2), 7, np.int32)
+    a1 = np.full((5, 2), 9, np.int32)
+    got = _gather_as_rank([a0, a1], monkeypatch=monkeypatch)
+    np.testing.assert_array_equal(got, np.concatenate([a0, a1]))
+
+
+def test_single_process_passthrough():
+    """process_count()==1: the input comes back unchanged, no collective
+    touched (a real multihost_utils call here would require a
+    distributed client)."""
+    a = np.array([1.5, np.nan, -0.0], np.float64)
+    got = dist.allgather_host(a)
+    np.testing.assert_array_equal(np.asarray(got).view(np.uint64),
+                                  a.view(np.uint64))
+
+
+def test_sketch_allgather_merge_matches_in_core(monkeypatch):
+    """Two ranks each sketch HALF the rows; after allgather_merge over
+    the simulated wire both finalize the SAME BinMappers as one sketch
+    over all rows — the distributed-binning parity contract
+    (dataset_loader.cpp:1040-1130's BinMapper allgather at summary
+    granularity)."""
+    rng = np.random.RandomState(0)
+    rows = rng.randn(400, 5)
+    rows[rng.rand(400) < 0.1, 2] = np.nan
+    rows[:, 4] = rng.randint(0, 6, 400)          # categorical-ish
+    half = [rows[:200], rows[200:]]
+
+    sketches = []
+    for part in half:
+        sk = BinningSketch(5, cat_indices=[4])
+        sk.update(part)
+        sketches.append(sk)
+    payloads = [sk.serialize() for sk in sketches]
+
+    calls = {"n": 0}
+
+    def fake_allgather(arr):
+        # allgather_merge's fixed call sequence: sizes, flats, layouts
+        i, calls["n"] = calls["n"], calls["n"] + 1
+        if i % 3 == 0:
+            return np.asarray([[len(p[0])] for p in payloads],
+                              np.float64).ravel()
+        if i % 3 == 1:
+            return np.concatenate([p[0] for p in payloads])
+        return np.concatenate([p[1].astype(np.float64).reshape(-1)
+                               for p in payloads])
+
+    monkeypatch.setattr(dist, "is_initialized", lambda: True)
+    monkeypatch.setattr(dist, "process_count", lambda: 2)
+    monkeypatch.setattr(dist, "allgather_host", fake_allgather)
+
+    merged = sketches[0].allgather_merge()
+    assert merged.rows_seen == 400
+
+    full = BinningSketch(5, cat_indices=[4])
+    full.update(rows)
+    kw = dict(max_bin=63, min_data_in_bin=3)
+    got = merged.finalize(**kw)
+    want = full.finalize(**kw)
+    for j, (g, w) in enumerate(zip(got, want)):
+        assert g.num_bin == w.num_bin, j
+        assert g.is_categorical == w.is_categorical, j
+        np.testing.assert_array_equal(
+            np.asarray(g.bin_upper_bound, np.float64),
+            np.asarray(w.bin_upper_bound, np.float64), err_msg=f"f{j}")

@@ -40,8 +40,8 @@ def test_bundles_shrink_device_width():
 
 def test_bundled_matches_unbundled_predictions():
     X, y = _sparse_data()
-    b_on = lgb.train(P, lgb.Dataset(X, y), 15)
-    b_off = lgb.train({**P, "enable_bundle": False}, lgb.Dataset(X, y), 15)
+    b_on = lgb.train(P, lgb.Dataset(X, y), 10)
+    b_off = lgb.train({**P, "enable_bundle": False}, lgb.Dataset(X, y), 10)
     assert b_on._gbdt.train_set.efb is not None
     assert b_off._gbdt.train_set.efb is None
     np.testing.assert_allclose(b_on.predict(X), b_off.predict(X),
@@ -63,7 +63,7 @@ def test_sparse_csr_input_no_densify():
 def test_wide_sparse_trains():
     """10k-feature 99%-sparse synthetic (the verdict's acceptance bar)."""
     rng = np.random.RandomState(3)
-    n, f = 4000, 10000
+    n, f = 3000, 10000
     nnz_per_row = 40
     rows = np.repeat(np.arange(n), nnz_per_row)
     cols = rng.randint(0, f, n * nnz_per_row)
@@ -73,14 +73,17 @@ def test_wide_sparse_trains():
     w[:50] = rng.randn(50)
     y = np.asarray(Xs[:, :50] @ w[:50]).ravel() + 0.1 * rng.randn(n)
     ds = lgb.Dataset(Xs, y, params=P)
-    bst = lgb.train({**P, "num_leaves": 31}, ds, 10)
+    bst = lgb.train({**P, "num_leaves": 31}, ds, 5)
     efb = bst._gbdt.train_set.efb
     assert efb is not None
     width = bst._gbdt.train_set.X_binned.shape[1]
     assert width == efb.n_bundles
     assert width < f / 10  # 10k features in <1k device columns
-    mse = np.mean((bst.predict(np.asarray(Xs.todense())) - y) ** 2)
-    assert mse < np.var(y) * 0.6
+    # quality bar on a fixed slice (densifying all 3000x10000 rows just
+    # to score them dominated this test's runtime on 1 core)
+    sl = slice(0, 1000)
+    mse = np.mean((bst.predict(np.asarray(Xs[sl].todense())) - y[sl]) ** 2)
+    assert mse < np.var(y[sl]) * 0.6
 
 
 def test_efb_model_io_roundtrip(tmp_path):

@@ -136,7 +136,7 @@ def test_contracts_hold_at_world_size(w):
     """The re-parameterized contracts: the same declarations pass at a
     real W=4 submesh and a trace-only W=64 AbstractMesh (W=8 is the
     whole-suite default exercised by test_analysis.py)."""
-    for cfg in ("dp_scatter", "spec_ramp"):
+    for cfg in ("dp_scatter", "spec_ramp", "voting"):
         unit = lint.build_unit(cfg, nshards=w)
         assert unit.ctx["world_size"] == w
         vs = run_rules([unit], rules=ALL_RULES)
@@ -144,6 +144,13 @@ def test_contracts_hold_at_world_size(w):
         rs = unit.collectives.get("data_parallel/wave/hist_reduce_scatter")
         if rs is not None:
             assert rs["count"] == (3 if cfg == "dp_scatter" else 5)
+        if cfg == "voting":
+            # PV-Tree wire shape: an id all_gather and a voted-slice
+            # psum per merge site, with the modeled DCN split bounded
+            # by the contracts the rules just enforced
+            ag = unit.collectives["voting_parallel/wave/vote_allgather"]
+            vp = unit.collectives["voting_parallel/wave/voted_hist_psum"]
+            assert ag["count"] == vp["count"] == 3
 
 
 def test_w64_traces_over_abstract_mesh():
