@@ -54,6 +54,16 @@ launches per 1k requests, with every cold load-on-miss counted and its
 p99 reported (env knobs: ZOO_MODELS, ZOO_DURATION, ZOO_THREADS,
 ZOO_ROWS, ZOO_ZIPF, ZOO_MAX_WAIT_MS).
 
+``--explain`` runs the explanation-serving rung: closed-loop
+``POST /explain`` traffic with interleaved ``/predict`` requests on the
+same model; the verdict requires a 5xx-free explain response counter,
+the ``serve/explain_latency_p99`` SLO met on the /slo scrape, ZERO
+dense->walk fallback batches (a silent host-walk regression fails the
+rung even if latency survives), and the untouched predict lane to stay
+5xx-free (env knobs: EXPLAIN_DURATION, EXPLAIN_THREADS, EXPLAIN_ROWS,
+EXPLAIN_FEATURES, EXPLAIN_TREES, EXPLAIN_LEAVES, EXPLAIN_PREDICT_EVERY,
+EXPLAIN_P99_MS).
+
 Exit code: 0 on pass, 1 on breach/underrun — CI runs all modes
 blocking, next to the chaos step.
 """
@@ -772,6 +782,249 @@ def run_zoo_loadtest(models: int = 16, duration_s: float = 5.0,
     }
 
 
+def run_explain_loadtest(duration_s: float = 5.0, threads_n: int = 4,
+                         rows_per_req: int = 8, features: int = 6,
+                         trees: int = 20, leaves: int = 15,
+                         predict_every: int = 4,
+                         p99_threshold_ms: float = 0.0,
+                         scrape_interval_s: float = 1.0):
+    """Explanation-serving rung: closed-loop ``POST /explain`` traffic
+    against a fresh server, with interleaved ``/predict`` requests on
+    the same model so the run exercises both lanes at once (the explain
+    lane has its own batchers and response counter precisely so a phi
+    burst cannot dilute predict availability).  The verdict is read
+    back from the server's own telemetry: the explain response counter
+    must be 5xx-free, ``serve/explain_latency_p99`` must be met on the
+    /slo scrape, the dense compiler must actually have served (ZERO
+    fallback batches — a silent walk-path regression flips this), and
+    enough requests must land for the SLO window to be falsifiable.
+    Client-side additivity (sum(phi) vs served raw scores) rides along
+    as context, never as the verdict."""
+    from lightgbm_tpu.serve.loadgen import (metric_sum, parse_prometheus,
+                                            scrape_json, scrape_metrics)
+    from lightgbm_tpu.serve.registry import ModelRegistry
+    from lightgbm_tpu.serve.server import PredictionServer
+    from lightgbm_tpu.telemetry.slo import SloEngine, set_latency_threshold
+    from lightgbm_tpu.utils.backend import default_backend
+    from lightgbm_tpu.utils.log import set_verbosity
+
+    backend = default_backend()
+    set_verbosity(-1)
+    if p99_threshold_ms and p99_threshold_ms > 0:
+        set_latency_threshold("serve/explain_latency_p99", p99_threshold_ms)
+
+    model_name = "explain-rung"
+    with tempfile.TemporaryDirectory() as tmp:
+        model_file = _train_model(trees, leaves, features, tmp)
+        registry = ModelRegistry()
+        srv = PredictionServer(registry, port=0,
+                               slo_engine=SloEngine()).start()
+        host, port = srv.host, srv.port
+        try:
+            registry.load(model_name, model_file, warmup=True)
+            rng0 = np.random.RandomState(11)
+            probe = rng0.randn(rows_per_req, features).tolist()
+            # first /explain pays the lazy dense compile + per-bucket
+            # jits; warm it out of the timed window like warmup=True
+            # does for the predict lane
+            code, warm = _post_json(host, port, "/explain",
+                                    {"model": model_name, "rows": probe})
+            if code != 200:
+                raise RuntimeError(f"explain prewarm -> HTTP {code}")
+            # client-side context: served additivity across the HTTP
+            # boundary — sum(phi) row-wise vs the raw scores the SAME
+            # server serves for the SAME rows
+            code, raw = _post_json(host, port, "/predict",
+                                   {"model": model_name, "rows": probe,
+                                    "raw_score": True})
+            phi = np.asarray(warm["contributions"], np.float64)
+            additive_ok = bool(
+                code == 200 and np.allclose(
+                    phi.sum(axis=1),
+                    np.asarray(raw["predictions"], np.float64),
+                    rtol=1e-4, atol=1e-4))
+            # coalesced batches pad to the bucket covering the whole
+            # in-flight wave (threads * rows): warm that program too or
+            # its jit lands inside the timed window and pollutes p99
+            wave_rows = int(threads_n) * int(rows_per_req)
+            if wave_rows > rows_per_req:
+                _post_json(host, port, "/explain",
+                           {"model": model_name,
+                            "rows": rng0.randn(
+                                wave_rows, features).tolist()})
+
+            before = parse_prometheus(scrape_metrics(host, port))
+            counts = {"sent": 0, "ok": 0, "predict_sent": 0,
+                      "predict_ok": 0, "errors": {}}
+            lock = threading.Lock()
+            stop = threading.Event()
+
+            def scraper():
+                # burn windows must sample DURING the rung
+                while not stop.wait(scrape_interval_s):
+                    try:
+                        scrape_json(host, port, "/slo")
+                    except Exception:
+                        pass
+
+            t0 = time.perf_counter()
+            stop_at = t0 + duration_s
+
+            def worker(wid):
+                rng = np.random.RandomState(200 + wid)
+                rows = rng.randn(rows_per_req, features).tolist()
+                sent = ok = psent = pok = 0
+                errors = {}
+                i = 0
+                while time.perf_counter() < stop_at:
+                    i += 1
+                    # every Nth request rides the predict lane: both
+                    # lanes stay hot so the isolation claim is tested,
+                    # not assumed
+                    path = "/predict" if (predict_every and
+                                          i % predict_every == 0) \
+                        else "/explain"
+                    try:
+                        code, _ = _post_json(
+                            host, port, path,
+                            {"model": model_name, "rows": rows})
+                    except Exception:
+                        errors["connect"] = errors.get("connect", 0) + 1
+                        continue
+                    if path == "/predict":
+                        psent += 1
+                        pok += code == 200
+                    else:
+                        sent += 1
+                        ok += code == 200
+                    if code != 200:
+                        errors[str(code)] = errors.get(str(code), 0) + 1
+                with lock:
+                    counts["sent"] += sent
+                    counts["ok"] += ok
+                    counts["predict_sent"] += psent
+                    counts["predict_ok"] += pok
+                    for k, v in errors.items():
+                        counts["errors"][k] = \
+                            counts["errors"].get(k, 0) + v
+
+            sc = threading.Thread(target=scraper, daemon=True)
+            sc.start()
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(int(threads_n))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            stop.set()
+            sc.join(2.0)
+            elapsed = time.perf_counter() - t0
+            after = parse_prometheus(scrape_metrics(host, port))
+            slo_rep = scrape_json(host, port, "/slo")
+
+            def delta(metric, **labels):
+                return metric_sum(after, metric, **labels) - \
+                    metric_sum(before, metric, **labels)
+
+            explain_reqs = delta("lgbm_tpu_serve_explain_requests_total",
+                                 model=model_name)
+            resp_total = delta("lgbm_tpu_serve_explain_responses_total")
+            resp_5xx = sum(
+                delta("lgbm_tpu_serve_explain_responses_total", code=c)
+                for c in ("500", "503", "504"))
+            fallback_batches = delta(
+                "lgbm_tpu_serve_explain_fallback_batches_total")
+            fallback_by_reason = {
+                lbl.get("reason", "?"): val for lbl, val in
+                after.get("lgbm_tpu_serve_explain_fallback", ())
+                if val > 0}
+            per_bucket = {}
+            for lbl, val in after.get(
+                    "lgbm_tpu_serve_explain_latency_ms_p99", ()):
+                if lbl.get("model") == model_name:
+                    per_bucket[lbl.get("bucket", "?")] = {
+                        "p99_ms": val,
+                        "p50_ms": metric_sum(
+                            after, "lgbm_tpu_serve_explain_latency_ms_p50",
+                            model=model_name, bucket=lbl.get("bucket"))}
+            predict_5xx = sum(
+                delta("lgbm_tpu_serve_predict_responses_total", code=c)
+                for c in ("500", "503", "504"))
+        finally:
+            srv.shutdown()
+
+    explain_ent = next(
+        (s for s in slo_rep.get("slos", ())
+         if s.get("name") == "serve/explain_latency_p99"), {})
+    availability = 1.0 - (resp_5xx / resp_total if resp_total else 0.0)
+    slo_ok = bool(slo_rep.get("ok"))
+    volume_ok = explain_reqs >= 20  # the SLO's min_events window
+    dense_ok = fallback_batches == 0
+    verdict = "pass" if (slo_ok and availability >= 1.0 and dense_ok and
+                         volume_ok and predict_5xx == 0) else "breach"
+    return {
+        "schema": "explain-loadtest-report-v1",
+        "git_sha": _git_sha(),
+        "backend": backend,
+        "verdict": verdict,
+        "verdict_source": "/metrics + /slo scrapes only",
+        "slo_ok": slo_ok,
+        "availability": round(availability, 6),
+        "dense_ok": dense_ok,
+        "volume_ok": volume_ok,
+        "predict_lane_clean": predict_5xx == 0,
+        "explain_qps": round(explain_reqs / elapsed, 2),
+        "explain_rows_per_sec": round(
+            explain_reqs * rows_per_req / elapsed, 1),
+        "fallback_batches": int(fallback_batches),
+        "fallback_by_reason": fallback_by_reason,
+        "per_bucket": per_bucket,
+        "explain_slo": explain_ent,
+        "additive_ok": additive_ok,
+        "config": {"duration_s": duration_s, "threads": int(threads_n),
+                   "rows_per_request": int(rows_per_req),
+                   "features": int(features), "trees": int(trees),
+                   "leaves": int(leaves),
+                   "predict_every": int(predict_every),
+                   "backend": backend},
+        "slo": slo_rep,
+        "client": counts,
+    }
+
+
+def explain_to_bench_matrix(report) -> dict:
+    """bench-matrix-v1 rows for the nightly gate: one explain qps row,
+    one p99 row per bucket, one fallback row (any drift off 0 means the
+    dense compiler stopped serving and the host walk absorbed the load
+    — a perf cliff the latency rows alone could survive), and the
+    verdict."""
+    rows = [{"name": "explain_loadtest",
+             "config": report["config"],
+             "qps": report["explain_qps"],
+             "rows_per_sec": report["explain_rows_per_sec"],
+             "availability": report["availability"],
+             "interpreted": False}]
+    for b, lat in sorted(report["per_bucket"].items()):
+        rows.append({"name": f"explain_loadtest_p99_b{b}",
+                     "config": {"bucket": b, **report["config"]},
+                     "p99_ms": lat["p99_ms"],
+                     "interpreted": False})
+    rows.append({"name": "explain_fallbacks",
+                 "config": report["config"],
+                 "fallback_batches": report["fallback_batches"],
+                 "interpreted": False})
+    rows.append({"name": "explain_verdict",
+                 "slo_ok": bool(report["slo_ok"]),
+                 "verdict": report["verdict"]})
+    return {
+        "schema": "bench-matrix-v1",
+        "bench": "explain-loadtest",
+        "git_sha": report["git_sha"],
+        "backend": report["backend"],
+        "rows": rows,
+    }
+
+
 def zoo_to_bench_matrix(report) -> dict:
     """bench-matrix-v1 rows for the nightly gate: per lane one rows/s
     row and one launches-per-1k row (the stacked lane drifting toward
@@ -975,6 +1228,37 @@ def main(argv) -> int:
         if json_path:
             with open(json_path, "w") as fh:
                 json.dump(refresh_to_bench_matrix(report), fh,
+                          indent=2, default=str)
+        return 0 if report["verdict"] == "pass" else 1
+
+    if "--explain" in argv:
+        report = run_explain_loadtest(
+            duration_s=float(os.environ.get("EXPLAIN_DURATION", 5.0)),
+            threads_n=int(os.environ.get("EXPLAIN_THREADS", 4)),
+            rows_per_req=int(os.environ.get("EXPLAIN_ROWS", 8)),
+            features=int(os.environ.get("EXPLAIN_FEATURES", 6)),
+            trees=int(os.environ.get("EXPLAIN_TREES", 20)),
+            leaves=int(os.environ.get("EXPLAIN_LEAVES", 15)),
+            predict_every=int(os.environ.get("EXPLAIN_PREDICT_EVERY", 4)),
+            p99_threshold_ms=float(os.environ.get("EXPLAIN_P99_MS", 0.0)))
+        print(json.dumps({
+            "verdict": report["verdict"],
+            "slo_ok": report["slo_ok"],
+            "availability": report["availability"],
+            "dense_ok": report["dense_ok"],
+            "volume_ok": report["volume_ok"],
+            "predict_lane_clean": report["predict_lane_clean"],
+            "additive_ok": report["additive_ok"],
+            "explain_qps": report["explain_qps"],
+            "explain_rows_per_sec": report["explain_rows_per_sec"],
+            "fallback_batches": report["fallback_batches"],
+            "per_bucket": report["per_bucket"]}, indent=2), flush=True)
+        if slo_path:
+            with open(slo_path, "w") as fh:
+                json.dump(report, fh, indent=2, default=str)
+        if json_path:
+            with open(json_path, "w") as fh:
+                json.dump(explain_to_bench_matrix(report), fh,
                           indent=2, default=str)
         return 0 if report["verdict"] == "pass" else 1
 

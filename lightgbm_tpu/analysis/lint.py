@@ -18,7 +18,11 @@ executed) program per supported training/serving shape:
   (serve/zoo.py): M same-signature lanes vmapped over the dense
   program across the bucket ladder, plus the tree-sharded stacked
   top-bucket program whose ONE-psum-per-stack collective contract and
-  M-scaled memory budget are machine-checked.
+  M-scaled memory budget are machine-checked;
+* ``serve_explain`` — the dense TreeSHAP explain program
+  (explain/dense_shap.py) across the bucket ladder: retrace-stable per
+  rung, zero while-loops in the row dimension (the whole point of the
+  dense lowering), bounded by the serve/dense_explain memory budget.
 
 Every config is traced TWICE with freshly built same-shape inputs so
 the retrace rule sees real hash probes, and the telemetry collective
@@ -55,7 +59,7 @@ __all__ = ["MATRIX_CONFIGS", "Geometry", "TRACE_GEOMETRY", "MEM_GEOMETRY",
 
 MATRIX_CONFIGS = ("serial", "wave", "dp_scatter", "spec_ramp", "voting",
                   "multitrain", "serve", "serve_dense", "serve_zoo",
-                  "ingest")
+                  "serve_explain", "ingest")
 
 # every rule the matrix runs: the six PR-10 program-contract rules plus
 # the SPMD-safety pair (collective-order, sharding-consistency)
@@ -530,6 +534,54 @@ def _build_serve_zoo_unit(geom: Geometry, ctx: Dict[str, Any],
                      collectives=tally, hashes=hashes)
 
 
+def _mk_serve_explain(geom: Geometry):
+    """(arrays, dmeta, exp, emeta) for the dense TreeSHAP program over
+    the mixed serving ensemble — importing the explain compiler
+    registers the serve/dense_explain memory budget the lint-mem pass
+    bounds this config with."""
+    from ..explain import compiler as _explain_compiler  # noqa: F401
+    from ..explain.dense_shap import lower_explain
+    from ..models.dense_predict import lower_ensemble
+    trees = _mk_serve_dense_ensemble(geom)
+    arrays, dmeta = lower_ensemble(trees, 1, geom.features)
+    exp, emeta = lower_explain(trees, 1, geom.features + 1)
+    return arrays, dmeta, exp, emeta
+
+
+def _build_serve_explain_unit(geom: Geometry,
+                              ctx: Dict[str, Any]) -> TraceUnit:
+    """The explain lane's lint unit: the dense TreeSHAP program traced
+    across the whole bucket ladder (retrace-stability probes per rung),
+    with the top-bucket program as the MAIN jaxpr so the no-row-loop
+    guarantee and the declared memory curve are machine-checked."""
+    import numpy as np
+    from ..explain.dense_shap import dense_explain
+    from ..models.tree import SHAPE_BUCKETS
+    arrays, dmeta, exp, emeta = _mk_serve_explain(geom)
+    hashes: List[Tuple[str, str]] = []
+    jaxpr0 = None
+    tally: Dict[str, Dict[str, Any]] = {}
+    for bucket in SHAPE_BUCKETS:
+        for rep in range(2):
+            X = np.zeros((bucket, geom.features), np.float32) + rep
+            fn = lambda Xa, A, E: dense_explain(Xa, A, dmeta, E, emeta)
+            jx, t = _trace_with_tally(fn, (X, arrays, exp))
+            hashes.append((f"bucket{bucket}", ir.stable_hash(jx)))
+            if bucket == max(SHAPE_BUCKETS):
+                jaxpr0, tally = jx, t
+    ctx = dict(ctx)
+    # one explain program per ladder rung and not one more
+    ctx["max_distinct_programs"] = len(SHAPE_BUCKETS)
+    ctx["bucket"] = max(SHAPE_BUCKETS)
+    ctx["trees"] = emeta.num_trees
+    ctx["leaves"] = int(exp.leaf_val.shape[2])
+    ctx["depth"] = emeta.depth
+    ctx["num_class"] = emeta.num_class
+    ctx["cols"] = emeta.num_cols
+    return TraceUnit(name="serve_explain", jaxpr=jaxpr0, ctx=ctx,
+                     collectives=tally, hashes=hashes)
+
+
 def _build_serve_unit(geom: Geometry, ctx: Dict[str, Any]) -> TraceUnit:
     import numpy as np
     from ..models.tree import SHAPE_BUCKETS, predict_raw_ensemble
@@ -595,6 +647,8 @@ def build_unit(name: str, nshards: int = 8,
         return _build_serve_dense_unit(geom, _base_ctx(geom), nshards)
     if name == "serve_zoo":
         return _build_serve_zoo_unit(geom, _base_ctx(geom), nshards)
+    if name == "serve_explain":
+        return _build_serve_explain_unit(geom, _base_ctx(geom))
     if name == "ingest":
         return _unit_from_traces(
             "ingest", _mk_ingest_chunk(geom),
@@ -647,6 +701,14 @@ def build_callable(name: str, nshards: int = 8,
         Xs = np.zeros((3, max(SHAPE_BUCKETS), geom.features), np.float32)
         return (lambda Xa, S: stacked_predict_raw(Xa, S, meta),
                 (Xs, stacked))
+    if name == "serve_explain":
+        import numpy as np
+        from ..explain.dense_shap import dense_explain
+        from ..models.tree import SHAPE_BUCKETS
+        arrays, dmeta, exp, emeta = _mk_serve_explain(geom)
+        X = np.zeros((max(SHAPE_BUCKETS), geom.features), np.float32)
+        return (lambda Xa, A, E: dense_explain(Xa, A, dmeta, E, emeta),
+                (X, arrays, exp))
     return None
 
 

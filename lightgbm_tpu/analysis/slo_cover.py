@@ -38,6 +38,7 @@ def _import_declaring_modules() -> None:
     """Import every module that declares SLOs / registers ensurers (the
     declarations live next to the code they bound, so importing the
     subsystems collects them)."""
+    from ..explain import compiler as _explain_compiler  # noqa: F401
     from ..resilience import admission  # noqa: F401
     from ..serve import compiler, fleet, server, stats  # noqa: F401
 

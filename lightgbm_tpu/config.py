@@ -322,6 +322,16 @@ _PARAMS: List[ParamSpec] = [
     # (0/1 = single device); partial scores merge in ONE psum per
     # request (collective contract serve/dense_predict/score_psum)
     _p("tpu_predict_shard", int, 0, check=">=0"),
+    # --- explanation compiler (lightgbm_tpu/explain/) ---
+    # dense = force the loop-free dense TreeSHAP program (per-leaf
+    # root-path slot tensors contracted with the PR-13 condition
+    # matrix; exact f32 leaf values, never quantized); walk = the host
+    # TreeSHAP recursion (models/shap.py); auto = dense whenever the
+    # ensemble lowers — no CPU cost model: the host walk is Python-
+    # recursive, so the vectorized program wins on every backend — with
+    # any lowering fallback (depth/table budget) RECORDED in the
+    # serve_explain_fallback counter, never silent
+    _p("tpu_explain_compiler", str, "auto"),
     # --- continuous-learning lane (lightgbm_tpu/publish/) ---
     # publish_dir: when set, the trainer appends a per-round model delta
     # journal there (publish/delta.py) every publish_every rounds (0 =
@@ -484,6 +494,8 @@ class Config:
              "tpu_predict_compiler must be auto|dense|walk"),
             (self.tpu_predict_leaf_bits in (0, 8, 16),
              "tpu_predict_leaf_bits must be 0|8|16"),
+            (self.tpu_explain_compiler in ("auto", "dense", "walk"),
+             "tpu_explain_compiler must be auto|dense|walk"),
         ]
         for ok, msg in checks:
             if not ok:

@@ -1,0 +1,23 @@
+"""Explanation serving: the dense MXU TreeSHAP lowering + compile entry.
+
+``dense_shap`` lowers an ensemble's TreeSHAP computation (Lundberg's
+Algorithm 2, the exact algebra of ``models/shap.py``) into ONE
+loop-free-in-rows jitted program; ``compiler`` wraps it into the
+serving-compiler contract — ``compile_explain`` returning either an
+executable or a machine-usable fallback reason, never silence.
+"""
+
+from .compiler import (EXPLAIN_FALLBACK_COUNTER, ExplainAdditivityError,
+                       ExplainExecutable, check_additivity, compile_explain,
+                       explain_fallback_counts, note_explain_fallback_batch)
+from .dense_shap import (EXPLAIN_DEPTH_BUDGET, EXPLAIN_TABLE_BUDGET,
+                         ExplainArrays, ExplainMeta, dense_explain,
+                         lower_explain)
+
+__all__ = [
+    "EXPLAIN_DEPTH_BUDGET", "EXPLAIN_TABLE_BUDGET",
+    "EXPLAIN_FALLBACK_COUNTER", "ExplainAdditivityError", "ExplainArrays",
+    "ExplainExecutable", "ExplainMeta", "check_additivity",
+    "compile_explain", "dense_explain", "explain_fallback_counts",
+    "lower_explain", "note_explain_fallback_batch",
+]

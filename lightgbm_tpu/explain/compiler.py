@@ -1,0 +1,257 @@
+"""Explain compile entry — the serving-compiler contract for TreeSHAP.
+
+Mirrors ``serve/compiler.py``: :func:`compile_explain` returns either an
+:class:`ExplainExecutable` or ``(None, reason)`` with the reason
+recorded in the ``serve_explain_fallback`` counter — a fallback to the
+host walk is NEVER silent (the PR-13 rule).  The executable evaluates
+the :mod:`.dense_shap` program on row chunks sized by the declared
+working-set budget and enforces the additivity invariant (phi rows sum
+to the plain raw score) on every batch it returns.
+
+Policy note: unlike prediction there is no CPU cost model — the host
+TreeSHAP walk is a Python-level recursion per tree, so the vectorized
+dense program wins on every backend whenever it lowers; ``auto`` only
+falls back on lowering budgets (depth/table), which it records.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..analysis.contracts import memory_budget
+from ..models.dense_predict import (DenseArrays, DenseLoweringError,
+                                    DenseMeta, lower_ensemble)
+from ..models.tree import SHAPE_BUCKETS, Tree, TreeBatch, pad_rows
+from ..telemetry.metrics import default_registry
+from ..telemetry.slo import register_metric_ensurer
+from ..utils.backend import default_backend
+from .dense_shap import (EXPLAIN_DEPTH_BUDGET, EXPLAIN_TABLE_BUDGET,
+                         ExplainArrays, ExplainMeta, dense_explain,
+                         lower_explain)
+
+__all__ = ["EXPLAIN_FALLBACK_COUNTER", "EXPLAIN_FALLBACK_BATCHES",
+           "EXPLAIN_WORKSET_BUDGET", "ExplainAdditivityError",
+           "ExplainExecutable", "check_additivity", "compile_explain",
+           "explain_fallback_counts", "note_explain_fallback_batch",
+           "dense_explain_hbm_bytes"]
+
+# The pweight state (T, rows, L, D+1) f32 is the explain program's
+# working set; chunk rows so the handful of live copies the unrolled
+# algebra keeps stays under this.
+EXPLAIN_WORKSET_BUDGET = 256 << 20
+
+# additivity slack: f32 accumulation over the tree axis vs the exact
+# sum — generous so legitimate programs never trip it, tight enough
+# that a wrong unwind (systematic, O(leaf value)) always does
+ADDITIVITY_RTOL = 5e-3
+ADDITIVITY_ATOL = 1e-3
+
+
+def dense_explain_hbm_bytes(ctx):
+    """Per-device HBM curve of one explain bucket program: the (T*L, D)
+    root-path working set of the issue title — condition matrix, slot
+    one-fractions, a few live (bucket, T, L, D+1) pweight copies from
+    the unrolled extend/unwind chain, the phi/scatter block, and the
+    static occurrence table."""
+    n = int(ctx.get("bucket", max(SHAPE_BUCKETS)))
+    t = int(ctx.get("trees", 64))
+    leaves = int(ctx.get("leaves", 64))
+    nn = max(leaves - 1, 1)
+    d = max(int(ctx.get("depth", 8)), 1)
+    k = max(int(ctx.get("num_class", 1)), 1)
+    cols = int(ctx.get("cols", int(ctx.get("features", 32)) + 1))
+    rows = n * (3 * 4 * t * nn              # P / isn / dec blocks
+                + 4 * 4 * t * leaves * (d + 1)   # live pweight copies
+                + 4 * 4 * t * leaves * d    # one-fractions + contribs
+                + 2 * 4 * k * cols)         # phi + scatter update
+    tables = 4 * t * nn * leaves * d + 12 * t * leaves * d
+    return rows + tables + (8 << 20)
+
+
+memory_budget("serve/dense_explain", ("serve_explain",),
+              dense_explain_hbm_bytes,
+              note="condition matrix + (T*L, D) path slots + unrolled "
+                   "pweight chain + occurrence table")
+
+
+# ---------------------------------------------------------------------------
+# fallback telemetry — never silent
+# ---------------------------------------------------------------------------
+
+EXPLAIN_FALLBACK_COUNTER = "serve_explain_fallback"
+EXPLAIN_FALLBACK_BATCHES = "serve_explain_fallback_batches_total"
+_fb_lock = threading.Lock()
+_fb_counts: Dict[str, int] = {}
+
+
+def _note_fallback(reason: str, model: str = "") -> None:
+    with _fb_lock:
+        _fb_counts[reason] = _fb_counts.get(reason, 0) + 1
+    default_registry().counter(
+        EXPLAIN_FALLBACK_COUNTER,
+        "dense-explain compiler fallbacks to the host TreeSHAP walk, "
+        "by reason", labels=("reason", "model")).inc(
+        reason=reason, model=model or "-")
+
+
+def note_explain_fallback_batch(reason: str, model: str) -> None:
+    """One served explain batch answered by the host walk (the
+    predictor calls this per dispatch, so the fallback rate is measured
+    in traffic, not in compiles)."""
+    default_registry().counter(
+        EXPLAIN_FALLBACK_BATCHES,
+        "explain batches served by the host-walk fallback, by reason",
+        labels=("reason", "model")).inc(1, reason=reason,
+                                        model=model or "-")
+
+
+@register_metric_ensurer
+def _ensure_explain_metrics(reg) -> None:
+    reg.counter(EXPLAIN_FALLBACK_COUNTER,
+                "dense-explain compiler fallbacks to the host TreeSHAP "
+                "walk, by reason", labels=("reason", "model"))
+    reg.counter(EXPLAIN_FALLBACK_BATCHES,
+                "explain batches served by the host-walk fallback, by "
+                "reason", labels=("reason", "model"))
+
+
+def explain_fallback_counts() -> Dict[str, int]:
+    """Process-wide explain-fallback tally by reason (mirrors the
+    labeled ``serve_explain_fallback`` counter series)."""
+    with _fb_lock:
+        return dict(_fb_counts)
+
+
+class ExplainAdditivityError(RuntimeError):
+    """The dense program's phi rows failed to sum to its raw score —
+    the invariant every TreeSHAP result must satisfy.  Callers fall
+    back to the host walk and record reason ``additivity``."""
+
+
+class ExplainExecutable:
+    """A lowered dense-TreeSHAP program bound to one ensemble."""
+
+    def __init__(self, arrays: DenseArrays, dmeta: DenseMeta,
+                 exp: ExplainArrays, emeta: ExplainMeta) -> None:
+        self.arrays = arrays
+        self.dmeta = dmeta
+        self.exp = exp
+        self.emeta = emeta
+        self._leaves = int(exp.leaf_val.shape[2])
+        self._nodes = int(arrays.split_feature.shape[1])
+
+    @property
+    def signature(self):
+        """Shape/dtype signature — programs with equal signatures share
+        the XLA cache entries (same contract as ``DenseExecutable``)."""
+        return ("explain", self.emeta,
+                tuple((tuple(a.shape), str(a.dtype))
+                      for a in self.exp if a is not None))
+
+    def max_rows(self, budget: int = EXPLAIN_WORKSET_BUDGET) -> int:
+        """Largest shape bucket whose pweight working set fits."""
+        d = max(self.emeta.depth, 1)
+        t = max(self.emeta.num_trees, 1)
+        per_row = 4 * t * (3 * self._nodes
+                           + 8 * self._leaves * (d + 1))
+        best = SHAPE_BUCKETS[0]
+        for b in SHAPE_BUCKETS:
+            if b * per_row <= budget:
+                best = b
+        return best
+
+    def explain_padded(self, Xp):
+        """(phi, raw) device arrays for an already-padded row block —
+        the predictor's bucket-ladder entry."""
+        return dense_explain(Xp, self.arrays, self.dmeta,
+                             self.exp, self.emeta)
+
+    def explain(self, X: np.ndarray, check: bool = True,
+                buckets=None) -> np.ndarray:
+        """Chunked, padded, additivity-checked phi for arbitrary rows
+        (the Booster predict path's and the serving lane's entry)."""
+        n = X.shape[0]
+        chunk = self.max_rows()
+        outs = []
+        for lo in range(0, n, chunk):
+            Xc = np.asarray(X[lo:lo + chunk], np.float32)
+            nc = Xc.shape[0]
+            Xp = pad_rows(Xc, buckets) if buckets is not None \
+                else pad_rows(Xc)
+            phi, raw = self.explain_padded(Xp)
+            phi = np.asarray(phi[:nc], np.float64)
+            if check:
+                check_additivity(phi, np.asarray(raw[:nc], np.float64),
+                                 self.emeta.num_cols)
+            outs.append(phi)
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+
+    def info(self) -> Dict[str, object]:
+        return {"compiled": True, "trees": self.emeta.num_trees,
+                "depth": self.emeta.depth, "leaves": self._leaves,
+                "num_class": self.emeta.num_class,
+                "cols": self.emeta.num_cols, "mxu": self.emeta.mxu}
+
+
+def check_additivity(phi: np.ndarray, raw: np.ndarray, num_cols: int,
+                     rtol: float = ADDITIVITY_RTOL,
+                     atol: float = ADDITIVITY_ATOL) -> None:
+    """Enforce ``sum(phi block) == raw score`` per class; raises
+    :class:`ExplainAdditivityError` with the worst row's numbers."""
+    n = phi.shape[0]
+    k = max(phi.shape[1] // num_cols, 1)
+    sums = phi.reshape(n, k, num_cols).sum(axis=2)
+    err = np.abs(sums - raw)
+    lim = atol + rtol * np.abs(raw)
+    if np.all(err <= lim):
+        return
+    i = int(np.unravel_index(np.argmax(err - lim), err.shape)[0])
+    raise ExplainAdditivityError(
+        f"phi rows do not sum to the raw score: worst row {i}: "
+        f"sum={sums[i].tolist()} raw={raw[i].tolist()}")
+
+
+def compile_explain(trees: List[Tree], num_class: int, num_features: int,
+                    class_ids: Optional[List[int]] = None, *,
+                    mode: str = "auto", num_cols: Optional[int] = None,
+                    batch: Optional[TreeBatch] = None,
+                    depth_budget: int = EXPLAIN_DEPTH_BUDGET,
+                    table_budget: int = EXPLAIN_TABLE_BUDGET,
+                    model_label: str = "",
+                    ) -> Tuple[Optional[ExplainExecutable], Optional[str]]:
+    """Compile the dense TreeSHAP program, or report why not.
+
+    ``num_features`` is the inner (used-column) width the condition
+    matrix reads; ``num_cols`` the phi block width (defaults to
+    ``num_features + 1`` — Boosters pass their full feature count + 1
+    so the output layout matches the host ``predict_contrib``).
+    Returns ``(executable, None)`` or ``(None, reason)`` with the
+    reason recorded in ``serve_explain_fallback`` — mirror of
+    ``serve/compiler.compile_ensemble``."""
+    if mode not in ("auto", "dense", "walk"):
+        raise ValueError(f"tpu_explain_compiler must be auto|dense|walk, "
+                         f"got {mode!r}")
+    if mode == "walk":
+        _note_fallback("forced_walk", model_label)
+        return None, "forced_walk"
+    if not trees:
+        _note_fallback("no_trees", model_label)
+        return None, "no_trees"
+    mxu = default_backend() == "tpu"
+    cols = num_features + 1 if num_cols is None else num_cols
+    try:
+        arrays, dmeta = lower_ensemble(
+            trees, num_class, num_features, class_ids,
+            leaf_bits=0, mxu=mxu, shard=1, batch=batch)
+        exp, emeta = lower_explain(
+            trees, num_class, cols, class_ids, mxu=mxu, batch=batch,
+            depth_budget=depth_budget, table_budget=table_budget)
+    except DenseLoweringError as e:
+        if mode == "dense":
+            raise
+        _note_fallback(e.reason, model_label)
+        return None, e.reason
+    return ExplainExecutable(arrays, dmeta, exp, emeta), None

@@ -1,0 +1,342 @@
+"""Dense TreeSHAP — Algorithm 2 lowered to loop-free-in-rows MXU algebra.
+
+The host reference (:mod:`..models.shap`) recurses every tree per row
+chunk in Python.  But the recursion's CONTROL structure is entirely
+row-independent: which nodes each root path visits, which feature sits
+at each path level, and every zero fraction (count ratio) are model
+constants — only the per-(row, node) hot-branch bit varies.  That bit
+is exactly the condition matrix the PR-13 serving compiler already
+builds (``models/dense_predict._decision_matrix``), so TreeSHAP lowers
+the same way prediction did:
+
+* **Host lowering** (:func:`lower_explain`) walks each tree's leaf root
+  paths once (the same DFS as ``TreeBatch``'s path matrices) and merges
+  duplicate features into at most ``D`` *slots* per path — Algorithm
+  2's unwind-on-revisit collapses statically: a revisited feature's
+  zero fraction is the PRODUCT of its occurrences' count ratios and its
+  one fraction the AND of their hot bits.  Out come padded per-tree
+  tensors over (leaf, slot): feature column, static zero fraction, and
+  a signed node-occurrence matrix ``occ_dir`` (+1 left-expected, -1
+  right-expected) whose contraction with the condition matrix counts
+  matching hot bits per slot.
+* **Padding is exactly inert**: a slot with (z=1, o=1) leaves the
+  subset-weight algebra invariant (extending Algorithm 2 with a dummy
+  (1, 1) item rescales pweights by precisely the factor the unwound sum
+  divides back out), so every path pads to ``D`` slots and every tree
+  to ``L`` leaves with zero-valued leaves — no masks in the kernel.
+* **Device program** (:func:`dense_explain`): one-fractions are
+  ``relu(dec @ occ_dir + negs - count + 1)`` — integer-valued counts,
+  so the ReLU is an EXACT 0/1 AND, the ``_hit_matrix`` trick — then the
+  extend recursion and Sum(UNWIND) evaluate as Python-unrolled
+  elementwise f32 ops over a static (D+1) position axis: the jaxpr
+  contains NO while/scan at all, in rows or otherwise (machine-checked
+  by the ``serve_explain`` lint config).  Per-leaf contributions
+  scatter-add into the phi block with STATIC column indices, and the
+  program also returns the plain raw score (reach-indicator dot leaf
+  values) so callers enforce the additivity invariant on every batch.
+
+Parity: matches the f64 host walk within rtol 1e-4 (exact f32 leaf
+values — the explain path never quantizes leaf tables).  Linear-leaf
+trees attribute each leaf's PLAIN output, same as the host warning
+path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.dense_predict import DenseArrays, DenseLoweringError, DenseMeta
+from ..models.dense_predict import _decision_matrix
+from ..models.shap import node_expectations
+from ..models.tree import Tree, TreeBatch
+
+__all__ = ["EXPLAIN_DEPTH_BUDGET", "EXPLAIN_TABLE_BUDGET", "ExplainArrays",
+           "ExplainMeta", "lower_explain", "dense_explain"]
+
+# Budgets (lowering falls back to the host walk with a recorded reason
+# past these — the PR-13 never-silent contract):
+#   depth — the unwound-sum algebra unrolls O(D^2) elementwise steps;
+#   past ~48 unique features per path the program would trade compile
+#   time for no win over the walk.
+#   table — bytes of the (T, Nn, L*D) signed occurrence matrix, the one
+#   static tensor that scales with all three model axes at once.
+EXPLAIN_DEPTH_BUDGET = 48
+EXPLAIN_TABLE_BUDGET = 256 << 20
+
+
+class ExplainMeta(NamedTuple):
+    """Static (hashable) half of an explain lowering — the jit cache key
+    next to the array shapes."""
+
+    num_class: int
+    num_trees: int            # real trees
+    num_cols: int             # phi block width = feature columns + bias
+    depth: int                # D: merged-slot count per root path
+    mxu: bool                 # bf16 contraction w/ f32 accum (TPU)
+
+
+class ExplainArrays(NamedTuple):
+    """Device half: padded per-(tree, leaf, slot) root-path tensors (all
+    static host work, a la the ``TreeBatch`` path matrices)."""
+
+    occ_dir: jnp.ndarray      # (T, Nn, L*D) f32 +1 left / -1 right / 0
+    occ_neg: jnp.ndarray      # (T, 1, L*D) f32 — right-expected count
+    occ_cnt: jnp.ndarray      # (T, 1, L*D) f32 — occurrences (0 = pad)
+    zfrac: jnp.ndarray        # (T, 1, L, D) f32 — static zero fractions
+    leaf_val: jnp.ndarray     # (T, 1, L) f32 — PLAIN leaf values, exact
+    seg: jnp.ndarray          # (T*L*D,) i32 — phi column per slot
+    bias: jnp.ndarray         # (K*num_cols,) f32 — expected-value row
+    class_onehot: jnp.ndarray  # (T, K) f32
+
+
+def _leaf_paths(tree: Tree) -> List[List[Tuple[int, bool]]]:
+    """Root path of every leaf as (internal node, went_left) pairs —
+    the same DFS the ``TreeBatch`` path matrices run."""
+    nl = int(tree.num_leaves)
+    if nl <= 1:
+        return [[]]
+    out: List[Optional[List[Tuple[int, bool]]]] = [None] * nl
+    work: List[Tuple[int, List[Tuple[int, bool]]]] = [(0, [])]
+    while work:
+        node, path = work.pop()
+        for child, went_left in ((int(tree.left_child[node]), True),
+                                 (int(tree.right_child[node]), False)):
+            p2 = path + [(node, went_left)]
+            if child < 0:
+                out[~child] = p2
+            else:
+                work.append((child, p2))
+    return out  # type: ignore[return-value]
+
+
+def _node_count(tree: Tree, node: int) -> float:
+    if node < 0:
+        return float(tree.leaf_count[~node])
+    return float(tree.internal_count[node])
+
+
+def lower_explain(trees: List[Tree], num_class: int, num_cols: int,
+                  class_ids: Optional[List[int]] = None, *,
+                  mxu: bool = False, batch: Optional[TreeBatch] = None,
+                  depth_budget: int = EXPLAIN_DEPTH_BUDGET,
+                  table_budget: int = EXPLAIN_TABLE_BUDGET,
+                  ) -> Tuple[ExplainArrays, ExplainMeta]:
+    """Lower ``trees`` into the dense TreeSHAP tensors.
+
+    ``num_cols`` is the phi block width (``num_features + 1``; the bias
+    sits in the last column, matching ``models/shap.predict_contrib``'s
+    layout).  Raises :class:`DenseLoweringError` with reason
+    ``explain_depth_budget`` / ``explain_table_budget`` when the
+    unrolled algebra or the occurrence table would blow its budget."""
+    if not trees:
+        raise DenseLoweringError("no_trees")
+    b = batch if batch is not None else TreeBatch(trees)
+    T = b.num_trees
+    L = int(b.max_leaves)
+    Nn = max(L - 1, 1)
+    if class_ids is None:
+        class_ids = [t % num_class for t in range(T)]
+
+    # pass 1: merge duplicate features into slots; find the slot depth D
+    merged = []   # per tree: list over leaves of (feat[], z[], occ[][])
+    depth = 0
+    for tree in trees:
+        per_leaf = []
+        for li, path in enumerate(_leaf_paths(tree)):
+            slots: dict = {}
+            feat: List[int] = []
+            zfrac: List[float] = []
+            occ: List[List[Tuple[int, bool]]] = []
+            for pos, (node, went_left) in enumerate(path):
+                f = int(tree.split_feature[node])
+                child = int(tree.left_child[node] if went_left
+                            else tree.right_child[node])
+                cnt = _node_count(tree, node)
+                ratio = _node_count(tree, child) / cnt if cnt > 0 else 0.0
+                if f in slots:
+                    s = slots[f]
+                    zfrac[s] *= ratio
+                    occ[s].append((node, went_left))
+                else:
+                    slots[f] = len(feat)
+                    feat.append(f)
+                    zfrac.append(ratio)
+                    occ.append([(node, went_left)])
+            depth = max(depth, len(feat))
+            per_leaf.append((feat, zfrac, occ))
+        merged.append(per_leaf)
+    D = depth
+    if D > depth_budget:
+        raise DenseLoweringError(
+            "explain_depth_budget",
+            f"{D} merged path slots > budget {depth_budget}")
+    table = 4 * T * Nn * L * max(D, 1)
+    if table > table_budget:
+        raise DenseLoweringError(
+            "explain_table_budget",
+            f"occurrence table {table} B > budget {table_budget} B")
+
+    occ_dir = np.zeros((T, Nn, L * max(D, 1)), np.float32)
+    occ_neg = np.zeros((T, 1, L * max(D, 1)), np.float32)
+    occ_cnt = np.zeros((T, 1, L * max(D, 1)), np.float32)
+    zfr = np.ones((T, 1, L, max(D, 1)), np.float32)
+    leaf_val = np.zeros((T, 1, L), np.float32)
+    # inert pads scatter into their class's bias column (their
+    # contribution is exactly zero, so the target only has to be valid)
+    seg = np.empty((T, L, max(D, 1)), np.int32)
+    bias = np.zeros(num_class * num_cols, np.float64)
+    class_onehot = np.zeros((T, num_class), np.float32)
+    for t, tree in enumerate(trees):
+        cid = int(class_ids[t])
+        class_onehot[t, cid] = 1.0
+        seg[t] = cid * num_cols + (num_cols - 1)
+        nl = int(tree.num_leaves)
+        if nl <= 1:
+            # stump: empty path — only the bias moves, but the leaf
+            # value still rides the reach indicator so the returned raw
+            # score (the additivity right-hand side) includes it
+            bias[cid * num_cols + num_cols - 1] += float(tree.leaf_value[0])
+            leaf_val[t, 0, 0] = np.float32(tree.leaf_value[0])
+            continue
+        bias[cid * num_cols + num_cols - 1] += float(
+            node_expectations(tree)[0])
+        for li in range(nl):
+            leaf_val[t, 0, li] = np.float32(tree.leaf_value[li])
+            feat, zf, occ = merged[t][li]
+            for s in range(len(feat)):
+                col = li * D + s
+                seg[t, li, s] = cid * num_cols + feat[s]
+                zfr[t, 0, li, s] = np.float32(zf[s])
+                occ_cnt[t, 0, col] = float(len(occ[s]))
+                for node, went_left in occ[s]:
+                    if went_left:
+                        occ_dir[t, node, col] = 1.0
+                    else:
+                        occ_dir[t, node, col] = -1.0
+                        occ_neg[t, 0, col] += 1.0
+
+    arrays = ExplainArrays(
+        occ_dir=jnp.asarray(occ_dir), occ_neg=jnp.asarray(occ_neg),
+        occ_cnt=jnp.asarray(occ_cnt), zfrac=jnp.asarray(zfr),
+        leaf_val=jnp.asarray(leaf_val),
+        seg=jnp.asarray(seg.reshape(-1)),
+        bias=jnp.asarray(bias.astype(np.float32)),
+        class_onehot=jnp.asarray(class_onehot))
+    meta = ExplainMeta(num_class=num_class, num_trees=T, num_cols=num_cols,
+                       depth=D, mxu=bool(mxu))
+    return arrays, meta
+
+
+# ---------------------------------------------------------------------------
+# device program
+# ---------------------------------------------------------------------------
+
+def _one_fractions(dec, E: ExplainArrays, emeta: ExplainMeta):
+    """(T, N, L, D) EXACT 0/1 slot one-fractions: the signed-occurrence
+    contraction counts matching hot bits (left-expected nodes contribute
+    ``dec``, right-expected ``1 - dec`` via the folded ``occ_neg``
+    constant), and ``relu(count - total + 1)`` is 1 exactly when every
+    occurrence matches — integer-valued, so no equality select (the
+    ``_hit_matrix`` trick).  Zero-occurrence pads come out 1: inert."""
+    acc = jnp.bfloat16 if emeta.mxu else jnp.float32
+    dec_t = jnp.transpose(dec, (1, 0, 2)).astype(acc)        # (T, N, Nn)
+    hot = jax.lax.dot_general(dec_t, E.occ_dir.astype(acc),
+                              (((2,), (1,)), ((0,), (0,))),
+                              preferred_element_type=jnp.float32)
+    o = jax.nn.relu(hot + E.occ_neg - E.occ_cnt + 1.0)       # (T, N, L*D)
+    T, n = o.shape[0], o.shape[1]
+    L = E.leaf_val.shape[2]
+    return o.reshape(T, n, L, max(emeta.depth, 1))
+
+
+def _extend_all(O, Z, emeta: ExplainMeta):
+    """Algorithm 2's EXTEND over the full path, Python-unrolled: pweight
+    state (T, N, L, D+1) over a static position axis; step ``s`` folds
+    slot ``s``'s fractions in with static numpy coefficient rows (the
+    (l-i)/(l+1), (i+1)/(l+1) factors).  No scan: D is a model constant
+    and each step is a handful of fused elementwise ops."""
+    D = emeta.depth
+    T, n, L = O.shape[0], O.shape[1], O.shape[2]
+    w = jnp.concatenate([jnp.ones((T, n, L, 1), jnp.float32),
+                         jnp.zeros((T, n, L, D), jnp.float32)], axis=-1)
+    pos = np.arange(D + 1, dtype=np.float64)
+    for s in range(1, D + 1):
+        pz = Z[..., s - 1:s]                                  # (T,1,L,1)
+        po = O[..., s - 1:s]                                  # (T,N,L,1)
+        keep = jnp.asarray(np.maximum(s - pos, 0.0) / (s + 1.0),
+                           jnp.float32)
+        shift = jnp.asarray(pos / (s + 1.0), jnp.float32)
+        shifted = jnp.concatenate(
+            [jnp.zeros_like(w[..., :1]), w[..., :-1]], axis=-1)
+        w = pz * w * keep + po * shifted * shift
+    return w
+
+
+def _unwound_contribs(w, O, Z, E: ExplainArrays, emeta: ExplainMeta):
+    """(T, N, L, D) per-slot contributions ``Sum(UNWIND(w, i)) *
+    (o_i - z_i) * leaf_value`` — the host walk's ``unwound_sum`` with
+    both loops unrolled over the static slot/position axes.  Inert pads
+    have o == z == 1, so their factor is exactly 0."""
+    D = emeta.depth
+    out = []
+    for i in range(1, D + 1):
+        o = O[..., i - 1]                                     # (T,N,L)
+        z = Z[..., i - 1]                                     # (T,1,L)
+        o_nz = o != 0
+        o_safe = jnp.where(o_nz, o, 1.0)
+        z_safe = jnp.where(z != 0, z, 1.0)
+        nn = w[..., D]
+        total = jnp.zeros_like(o)
+        for j in range(D - 1, -1, -1):
+            t = nn * ((D + 1.0) / (j + 1.0)) / o_safe
+            total = total + jnp.where(
+                o_nz, t, w[..., j] * ((D + 1.0) / (D - j)) / z_safe)
+            nn = jnp.where(o_nz, w[..., j] - t * z * ((D - j) / (D + 1.0)),
+                           nn)
+        out.append(total * (o - z))
+    c = jnp.stack(out, axis=-1) if out else \
+        jnp.zeros(O.shape[:3] + (0,), jnp.float32)
+    return c * E.leaf_val[..., None]
+
+
+def _explain(X, A: DenseArrays, dmeta: DenseMeta, E: ExplainArrays,
+             emeta: ExplainMeta):
+    n = X.shape[0]
+    dec = _decision_matrix(X, A, dmeta)                       # (N, T, Nn)
+    O = _one_fractions(dec, E, emeta)
+    if emeta.depth == 0:
+        # all-stump ensemble: one inert slot per leaf (matching seg's
+        # max(D, 1) layout), zero contribution — only bias + raw move
+        c = jnp.zeros(O.shape[:3] + (1,), jnp.float32)
+    else:
+        w = _extend_all(O, E.zfrac, emeta)
+        c = _unwound_contribs(w, O, E.zfrac, E, emeta)        # (T,N,L,D)
+    T = c.shape[0]
+    L = c.shape[2]
+    flat = jnp.transpose(c, (1, 0, 2, 3)).reshape(
+        n, T * L * max(emeta.depth, 1))
+    phi = jnp.zeros((n, emeta.num_class * emeta.num_cols), jnp.float32)
+    phi = phi.at[:, E.seg].add(flat) + E.bias[None, :]
+    # plain raw score for the additivity invariant: the product of a
+    # path's slot one-fractions is its reach indicator (pads are 1)
+    reach = jnp.prod(O, axis=-1)                              # (T, N, L)
+    per_tree = jnp.sum(reach * E.leaf_val, axis=-1)           # (T, N)
+    raw = jax.lax.dot_general(per_tree.T, E.class_onehot,
+                              (((1,), (0,)), ((), ())),
+                              precision=jax.lax.Precision.HIGHEST)
+    return phi, raw
+
+
+@functools.partial(jax.jit, static_argnames=("dmeta", "emeta"))
+def dense_explain(X, arrays: DenseArrays, dmeta: DenseMeta,
+                  exp: ExplainArrays, emeta: ExplainMeta):
+    """Jitted dense TreeSHAP: ``(phi (N, K*num_cols) f32, raw (N, K)
+    f32)``.  The lowered arrays are ARGUMENTS so the XLA cache keys on
+    shapes only (the ``CompiledPredictor`` contract); ``raw`` is the
+    plain-leaf raw score the phi rows must sum to."""
+    return _explain(X, arrays, dmeta, exp, emeta)

@@ -1156,6 +1156,52 @@ class GBDT:
             cache[ck] = dense
         return dense
 
+    def _explain_program(self, t0: int, t1: int, num_features: int):
+        """The explain compiler's dense TreeSHAP program for trees
+        [t0, t1), or None when the host walk serves this call
+        (mode/budget — the reason is recorded by explain/compiler.py,
+        never silent).  Memoized in the per-call ``_predict_cache`` so
+        chunked contrib predicts lower once."""
+        cache = getattr(self, "_predict_cache", None)
+        ck = ("explain", t0, t1)
+        if cache is not None and ck in cache:
+            return cache[ck]
+        from ..explain.compiler import compile_explain
+        k = self.num_tree_per_iteration
+        full = t0 == 0 and t1 == len(self.models)
+        exe, _reason = compile_explain(
+            self.models[t0:t1], k, num_features,
+            class_ids=[t % k for t in range(t0, t1)],
+            mode=getattr(self.config, "tpu_explain_compiler", "auto"),
+            num_cols=self.num_features + 1,
+            batch=self._tree_batch() if full else None)
+        if cache is not None:
+            cache[ck] = exe
+        return exe
+
+    def _predict_contrib(self, Xi, start_iteration, num_iteration):
+        """SHAP contributions, routed through tpu_explain_compiler: the
+        dense TreeSHAP program when it lowers, else the host walk —
+        both respect the iteration window, and the dense result is
+        additivity-checked (a failed invariant falls back WITH a
+        recorded reason, like every other fallback)."""
+        from .shap import predict_contrib, trees_window
+        t0, t1 = trees_window(self, start_iteration, num_iteration)
+        exe = self._explain_program(t0, t1, Xi.shape[1]) if t1 > t0 else None
+        if exe is not None:
+            from ..explain.compiler import (ExplainAdditivityError,
+                                            note_explain_fallback_batch)
+            if any(t.is_linear for t in self.models[t0:t1]):
+                from ..utils.log import log_warning
+                log_warning("pred_contrib on linear trees attributes each "
+                            "leaf's PLAIN output (per-leaf linear terms "
+                            "are not decomposed)")
+            try:
+                return exe.explain(Xi)
+            except ExplainAdditivityError:
+                note_explain_fallback_batch("additivity", "")
+        return predict_contrib(self, Xi, start_iteration, num_iteration)
+
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 start_iteration: int = 0,
                 num_iteration: Optional[int] = None,
@@ -1209,8 +1255,7 @@ class GBDT:
         if pred_leaf:
             return self._predict_leaf(Xi, start_iteration, num_iteration)
         if pred_contrib:
-            from .shap import predict_contrib
-            return predict_contrib(self, Xi)
+            return self._predict_contrib(Xi, start_iteration, num_iteration)
         if pred_early_stop or self.config.pred_early_stop:
             out = self._predict_early_stop(
                 Xi, start_iteration, num_iteration,

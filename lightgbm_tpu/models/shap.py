@@ -135,34 +135,88 @@ def _tree_shap_batch(tree: Tree, X: np.ndarray, phi: np.ndarray) -> None:
     recurse(0, [], ones, ones, -1)
 
 
+def node_expectations(tree: Tree) -> np.ndarray:
+    """Leaf-count-weighted expected value of every INTERNAL node, in one
+    bottom-up pass (shape (num_leaves - 1,)).  Memoized on the tree; the
+    token guards against in-place leaf mutation (refit decay,
+    ``LGBM_BoosterSetLeafValue``) so a stale memo can never survive a
+    value edit."""
+    nl = int(tree.num_leaves)
+    if nl <= 1:
+        return np.zeros(0, np.float64)
+    token = hash((tree.leaf_value.tobytes(), tree.leaf_count.tobytes(),
+                  tree.internal_count.tobytes()))
+    memo = getattr(tree, "_expected_memo", None)
+    if memo is not None and memo[0] == token:
+        return memo[1]
+    exp = np.zeros(nl - 1, np.float64)
+    # iterative post-order: reversed preorder visits children before
+    # parents without assuming any index ordering (and without Python
+    # recursion limits on deep trees)
+    order = []
+    stack = [0]
+    while stack:
+        node = stack.pop()
+        order.append(node)
+        for ch in (int(tree.left_child[node]), int(tree.right_child[node])):
+            if ch >= 0:
+                stack.append(ch)
+
+    def val(ch: int) -> float:
+        return float(tree.leaf_value[~ch]) if ch < 0 else exp[ch]
+
+    def cnt(ch: int) -> float:
+        return float(tree.leaf_count[~ch]) if ch < 0 \
+            else float(tree.internal_count[ch])
+
+    for node in reversed(order):
+        l, r = int(tree.left_child[node]), int(tree.right_child[node])
+        c = float(tree.internal_count[node])
+        exp[node] = ((cnt(l) * val(l) + cnt(r) * val(r)) / c) if c > 0 \
+            else 0.0
+    tree._expected_memo = (token, exp)
+    return exp
+
+
 def _expected_value(tree: Tree, node: int) -> float:
     if node < 0:
         return float(tree.leaf_value[~node])
-    cnt = float(tree.internal_count[node])
-    l, r = int(tree.left_child[node]), int(tree.right_child[node])
-    lc = float(tree.leaf_count[~l]) if l < 0 else float(tree.internal_count[l])
-    rc = float(tree.leaf_count[~r]) if r < 0 else float(tree.internal_count[r])
-    if cnt <= 0:
-        return 0.0
-    return (lc * _expected_value(tree, l) + rc * _expected_value(tree, r)) / cnt
+    return float(node_expectations(tree)[node])
 
 
-def predict_contrib(gbdt, Xi: np.ndarray) -> np.ndarray:
+def trees_window(gbdt, start_iteration: int = 0,
+                 num_iteration=None):
+    """The (t0, t1) tree-index window of an iteration range — the same
+    slice ``_tree_batch`` serves, so contrib/leaf/raw predictions all
+    window identically."""
+    k = gbdt.num_tree_per_iteration
+    t0 = start_iteration * k
+    t1 = len(gbdt.models) if num_iteration is None else min(
+        len(gbdt.models), (start_iteration + num_iteration) * k)
+    return t0, max(t0, t1)
+
+
+def predict_contrib(gbdt, Xi: np.ndarray, start_iteration: int = 0,
+                    num_iteration=None) -> np.ndarray:
     """Per-feature SHAP contributions + bias column
     (reference predictor contrib path; output (N, num_features+1), or
-    num_class stacked blocks for multiclass)."""
-    if any(t.is_linear for t in gbdt.models):
+    num_class stacked blocks for multiclass).  Respects the
+    start_iteration/num_iteration window exactly like raw prediction
+    (the reference windows its contrib path too)."""
+    k = gbdt.num_tree_per_iteration
+    t0, t1 = trees_window(gbdt, start_iteration, num_iteration)
+    models = gbdt.models[t0:t1]
+    if any(t.is_linear for t in models):
         from ..utils.log import log_warning
         log_warning("pred_contrib on linear trees attributes each leaf's "
                     "PLAIN output (per-leaf linear terms are not decomposed)")
     n = Xi.shape[0]
-    k = gbdt.num_tree_per_iteration
     nf = gbdt.num_features
     out = np.zeros((n, (nf + 1) * k), np.float64)
     for lo in range(0, n, _CHUNK):
         hi = min(lo + _CHUNK, n)
         chunk = Xi[lo:hi]
-        for t, tree in enumerate(gbdt.models):
+        for t, tree in enumerate(models, start=t0):
             cid = t % k
             _tree_shap_batch(tree, chunk,
                              out[lo:hi, cid * (nf + 1):(cid + 1) * (nf + 1)])

@@ -29,7 +29,7 @@ from ..learner.serial import (CommStrategy, GrownTree, local_best_candidate,
                               make_grow_fn, hist_pool_fits, resolve_hist_impl,
                               split_params_from_config)
 from ..ops.split import NEG_INF, best_split_per_feature
-from ..analysis.contracts import collective_contract
+from ..analysis.contracts import collective_contract, memory_budget
 from ..telemetry.train_record import note_collective
 from .mesh import get_mesh, shard_map_compat
 
@@ -153,6 +153,39 @@ collective_contract(
     "voting_parallel/wave/quant_scale", "pmax",
     max_count=2, max_bytes_per_op=8, max_dcn_bytes_per_op=8,
     note="global gradient/hessian quantization scales (two scalars)")
+
+
+# ---------------------------------------------------------------------------
+# Memory budget for the voting-wave program (lint-mem enforced).  Voting
+# trades WIRE bytes, not resident bytes: every device keeps FULL-F local
+# kernel banks AND the full-F per-leaf pool (only the voted 2k slices
+# are psum'd), so unlike the scatter path there is no post-merge F/k
+# slicing — the pool and scan temporaries stay on all F features.
+# ---------------------------------------------------------------------------
+
+def voting_wave_hbm_bytes(ctx):
+    """Per-device HBM curve of one voting-wave tree program: the DP
+    local-bank term plus pool/scan temporaries on FULL F (the voted
+    merge never slices the resident histograms)."""
+    from ..learner.wave import Q_WAVE_SIZE, WAVE_SIZE
+    from ..analysis.contracts import world_size
+    k = world_size(ctx)
+    f = int(ctx["features"])
+    b = int(ctx["bins"])
+    it = int(ctx.get("itemsize", 4))
+    r = -(-int(ctx["rows"]) // k)
+    wave = int(ctx.get("wave_size", WAVE_SIZE))
+    kernel_ch = Q_WAVE_SIZE if ctx.get("quantized", True) else WAVE_SIZE
+    local_banks = int(2.5 * max(2 * wave, kernel_ch) * f * b * 3 * it)
+    pool = (int(ctx.get("leaves", 2)) + 6 * wave) * f * b * 3 * it
+    rows = r * (f + 24)
+    return local_banks + pool + rows + (1 << 20)
+
+
+memory_budget(
+    "voting_parallel/wave_full", ("voting",), voting_wave_hbm_bytes,
+    note="2.5 local full-F kernel banks + full-F pool/scan (voting "
+         "slices the wire, not the residents) + rows")
 
 
 # ---------------------------------------------------------------------------

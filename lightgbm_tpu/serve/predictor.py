@@ -119,7 +119,8 @@ class CompiledPredictor:
                  stats: Optional[ModelStats] = None,
                  compiler: Optional[str] = None,
                  leaf_bits: Optional[int] = None,
-                 shard: Optional[int] = None) -> None:
+                 shard: Optional[int] = None,
+                 explain_compiler: Optional[str] = None) -> None:
         gbdt = _resolve_gbdt(source)
         self._gbdt = gbdt          # retained for delta appends (extended)
         self.buckets = tuple(sorted(buckets))
@@ -147,6 +148,14 @@ class CompiledPredictor:
             int(getattr(cfg, "tpu_predict_leaf_bits", 0))
         self._shard = shard if shard is not None else \
             int(getattr(cfg, "tpu_predict_shard", 0))
+        self._explain_mode = explain_compiler if explain_compiler is not None \
+            else getattr(cfg, "tpu_explain_compiler", "auto")
+        # explain lane state: compiled LAZILY on the first explain()
+        # call — the (T, Nn, L*D) occurrence table costs real host work
+        # and HBM, and most predictors (fleet workers, zoo tenants)
+        # never serve /explain traffic
+        self._explain_lock = threading.Lock()
+        self._explain_state: Optional[tuple] = None
         self._dense: Optional[DenseExecutable] = None
         self._fallback_reason: Optional[str] = None
         self._kinds: tuple = ()
@@ -254,6 +263,67 @@ class CompiledPredictor:
             out = out / self._avg_div
         return out[:, 0] if self.num_class == 1 else out
 
+    # -- explanation lane ---------------------------------------------------
+    def _explain_program(self) -> tuple:
+        """``(executable | None, fallback_reason | None)``, compiled on
+        first use and cached for the predictor's lifetime (immutable
+        like the predict program; hot-swap replaces the whole object)."""
+        st = self._explain_state
+        if st is not None:
+            return st
+        with self._explain_lock:
+            if self._explain_state is None:
+                from ..explain.compiler import compile_explain
+                models = self._gbdt.models
+                sel = [models[t] for t in range(self.num_trees)]
+                self._explain_state = compile_explain(
+                    sel, self.num_class,
+                    len(self._used) if self._used is not None
+                    else self.num_features,
+                    mode=self._explain_mode,
+                    num_cols=self.num_features + 1,
+                    model_label=getattr(self.stats, "model", "") or "")
+            return self._explain_state
+
+    def explain(self, X: np.ndarray,
+                request_ids: tuple = ()) -> np.ndarray:
+        """Bucketed SHAP contributions ``(N, (num_features + 1) *
+        num_class)`` — the /explain serving lane's device entry, same
+        layout as ``Booster.predict(pred_contrib=True)``.
+
+        Rides the dense TreeSHAP program on the shape-bucket ladder
+        when it lowers; otherwise the host walk serves the batch and
+        the reason lands in ``serve_explain_fallback_batches_total`` —
+        per dispatched batch, never silent.  Dense results are
+        additivity-checked (phi rows sum to the raw score); a failed
+        invariant falls back with reason ``additivity``."""
+        from ..telemetry.trace import span
+        X = np.asarray(X, np.float32)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        n = X.shape[0]
+        if X.shape[1] != self.num_features:
+            raise ValueError(
+                f"request has {X.shape[1]} features; model expects "
+                f"{self.num_features}")
+        Xi = X[:, self._used] if self._used is not None else X
+        nb = bucket_rows(n, self.buckets)
+        exe, reason = self._explain_program()
+        if exe is not None:
+            from ..explain.compiler import ExplainAdditivityError
+            try:
+                with span(f"serve/explain/b{nb}"):
+                    return exe.explain(Xi, buckets=self.buckets)
+            except ExplainAdditivityError:
+                reason = "additivity"
+        from ..explain.compiler import note_explain_fallback_batch
+        note_explain_fallback_batch(reason or "unknown",
+                                    getattr(self.stats, "model", "") or "")
+        from ..models.shap import predict_contrib
+        with span(f"serve/explain_walk/b{nb}"):
+            return predict_contrib(self._gbdt, Xi, 0,
+                                   self.num_trees // max(1, self.num_class))
+
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 request_ids: tuple = ()) -> np.ndarray:
         """Prediction with the model objective's output transform (same
@@ -297,13 +367,17 @@ class CompiledPredictor:
                 p2._dense = ex
                 p2.num_trees = self.num_trees + len(new_trees)
                 p2._sig = ex.signature
+                # the explain program binds the OLD tree set: recompile
+                # lazily on the new predictor's first explain() call
+                p2._explain_lock = threading.Lock()
+                p2._explain_state = None
                 return p2, "extend"
         # RF (mean-output divisor changes with tree count), walk-path
         # models, or an exhausted padding envelope: full rebuild
         p2 = CompiledPredictor(
             g2, buckets=self.buckets, stats=self.stats,
             compiler=self._compiler_mode, leaf_bits=self._leaf_bits,
-            shard=self._shard)
+            shard=self._shard, explain_compiler=self._explain_mode)
         return p2, "rebuild"
 
     # -- warmup -------------------------------------------------------------
@@ -332,7 +406,19 @@ class CompiledPredictor:
             # programs, and (dense, unsharded) ones co-batch in a stack
             "group_key": self.group_key,
             "stackable": self.stackable,
+            # the explain lane's compiler decision ("lazy" = no explain
+            # traffic yet, nothing compiled)
+            "explain_mode": self._explain_mode,
+            "explain_compiler": (
+                "lazy" if self._explain_state is None else
+                "dense" if self._explain_state[0] is not None else "walk"),
+            "explain_fallback_reason": (
+                None if self._explain_state is None
+                else self._explain_state[1]),
         }
         if self._dense is not None:
             out["dense"] = self._dense.info()
+        if self._explain_state is not None and \
+                self._explain_state[0] is not None:
+            out["explain"] = self._explain_state[0].info()
         return out

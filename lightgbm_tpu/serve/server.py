@@ -118,6 +118,14 @@ def _predict_response_counter():
         labels=("code",))
 
 
+def _explain_response_counter():
+    # the explain lane's own series: /explain errors must not dilute
+    # (or hide inside) the /predict availability SLO's denominator
+    return default_registry().counter(
+        "serve_explain_responses_total",
+        "/explain responses by status code", labels=("code",))
+
+
 @register_metric_ensurer
 def _ensure_http_metrics(reg) -> None:
     """SLO-coverage ensurer for the counters the availability SLO above
@@ -129,6 +137,8 @@ def _ensure_http_metrics(reg) -> None:
                 "/predict responses by status code (the availability "
                 "SLO's series — monitoring-endpoint traffic excluded)",
                 labels=("code",))
+    reg.counter("serve_explain_responses_total",
+                "/explain responses by status code", labels=("code",))
 
 
 class PredictionServer:
@@ -164,12 +174,18 @@ class PredictionServer:
         self._max_queue_rows = int(max_queue_rows)
         self._deadline_ms = float(deadline_ms)  # 0 = no default deadline
         self._batchers: Dict[str, MicroBatcher] = {}
+        # /explain coalesces in its OWN batchers: phi batches are
+        # (rows, K*(F+1)) wide, so mixing them into the /predict queue
+        # would let a handful of explain rows starve the predict
+        # latency budget they share a window with
+        self._explain_batchers: Dict[str, MicroBatcher] = {}
         self._batchers_lock = threading.Lock()
         self._last_shed_t = 0.0
         self.slo_engine = slo_engine if slo_engine is not None \
             else default_engine()
         self._responses = _http_response_counter()
         self._predict_responses = _predict_response_counter()
+        self._explain_responses = _explain_response_counter()
         # drain bookkeeping: in-flight /predict handlers are counted so
         # a graceful shutdown can wait for their responses to be written
         self._active_cv = threading.Condition()
@@ -255,6 +271,55 @@ class PredictionServer:
         return batcher.predict(X, raw_score=raw_score, timeout_s=timeout_s,
                                request_id=request_id)
 
+    def _explain(self, name: Optional[str], X: np.ndarray,
+                 deadline_ms: Optional[float] = None,
+                 request_id: Optional[str] = None) -> np.ndarray:
+        """Dispatch one /explain request: per-row SHAP contributions in
+        the host ``pred_contrib`` layout.  Same admission machinery as
+        :meth:`_predict` but through the explain lane's own batchers and
+        latency series — the two lanes share a process, not a queue.
+
+        Zoo mode dispatches directly against the resident predictor:
+        stacked cross-model launches only fuse same-shape PREDICTION
+        programs, and a non-resident tenant gets 404 rather than a cold
+        load (an explain burst must never evict serving models)."""
+        if deadline_ms is None:
+            deadline_ms = self._deadline_ms
+        timeout_s = float(deadline_ms) / 1e3 if deadline_ms and \
+            deadline_ms > 0 else None
+        resolved_name = name
+        if self._zoo is not None and name is None:
+            resolved_name = self.registry.get(None).stats.model
+        pred = self.registry.get(resolved_name)
+        if not self._batching or self._zoo is not None:
+            t0 = time.monotonic()
+            out = pred.explain(X, request_ids=(request_id,) if request_id
+                               else ())
+            dt_ms = (time.monotonic() - t0) * 1e3
+            from ..models.tree import bucket_rows
+            pred.stats.record_explain_timing(
+                int(X.shape[0]), bucket_rows(int(X.shape[0]), pred.buckets),
+                queue_ms=0.0, device_ms=dt_ms, total_ms=dt_ms,
+                request_id=request_id)
+            return out
+        key = pred.stats.model
+        with self._batchers_lock:
+            batcher = self._explain_batchers.get(key)
+            if batcher is None:
+                batcher = MicroBatcher(
+                    lambda Xb, raw, request_ids=(), _n=key:
+                        self.registry.get(_n).explain(
+                            Xb, request_ids=request_ids),
+                    max_batch_rows=self._batch_opts[0],
+                    max_wait_ms=self._batch_opts[1],
+                    max_queue_rows=self._max_queue_rows,
+                    name=f"{key}:explain",
+                    stats=pred.stats.explain_timing_stats(),
+                    buckets=pred.buckets)
+                self._explain_batchers[key] = batcher
+        return batcher.predict(X, raw_score=False, timeout_s=timeout_s,
+                               request_id=request_id)
+
     def health(self) -> dict:
         """``/healthz`` payload: ``ok``, or ``degraded`` with reasons
         while traffic runs on the CPU fallback backend, admission
@@ -309,7 +374,8 @@ class PredictionServer:
         name, so the extra entry is inert to them."""
         out = self.registry.stats()
         with self._batchers_lock:
-            batchers = list(self._batchers.values())
+            batchers = list(self._batchers.values()) \
+                + list(self._explain_batchers.values())
         for b in batchers:
             entry = out.setdefault(b.name, {})
             entry["saturation"] = {
@@ -361,8 +427,10 @@ class PredictionServer:
             self._draining = True
         self._httpd.shutdown()   # no-op if serve_forever already returned
         with self._batchers_lock:
-            batchers, self._batchers = dict(self._batchers), {}
-        for b in batchers.values():
+            batchers = list(self._batchers.values()) \
+                + list(self._explain_batchers.values())
+            self._batchers, self._explain_batchers = {}, {}
+        for b in batchers:
             b.close()
         if self._zoo is not None:
             self._zoo.close()
@@ -478,6 +546,8 @@ def _make_handler(server: PredictionServer):
                 return
             if self.path == "/predict":
                 self._predict(req)
+            elif self.path == "/explain":
+                self._explain(req)
             elif self.path == "/models":
                 self._load_model(req)
             elif self.path.startswith("/models/") and \
@@ -566,6 +636,83 @@ def _make_handler(server: PredictionServer):
                         "predictions": np.asarray(out).tolist(),
                         "request_id": rid})
 
+        def _explain(self, req: dict) -> None:
+            """``POST /explain``: same body shape as /predict (``rows``
+            or ``row``, optional ``model``/``deadline_ms``), answers
+            per-row SHAP contributions — for each class, one value per
+            feature plus a trailing expected-value column (the host
+            ``pred_contrib`` layout).  Shares the drain gate and error
+            ladder with /predict but counts into its own response
+            series and latency SLO."""
+            rid = self.headers.get("X-Request-Id") or _gen_request_id()
+            rid_hdr = {"X-Request-Id": rid}
+
+            def reply(code: int, payload: dict,
+                      headers: Optional[Dict[str, str]] = None) -> None:
+                server._explain_responses.inc(1, code=str(int(code)))
+                self._reply(code, payload, headers or rid_hdr)
+
+            if not server._enter_predict():
+                reply(503, {"error": "server is draining"},
+                      {"Retry-After": "1", **rid_hdr})
+                return
+            try:
+                self._explain_admitted(req, reply, rid)
+            finally:
+                server._exit_predict()
+
+        def _explain_admitted(self, req: dict, reply, rid: str) -> None:
+            rid_hdr = {"X-Request-Id": rid}
+            name = req.get("model")
+            rows = req.get("rows")
+            if rows is None and "row" in req:
+                rows = [req["row"]]
+            if not isinstance(rows, list) or not rows:
+                reply(400, {"error": "body needs 'rows' (list of "
+                                     "feature lists) or 'row'"})
+                return
+            deadline_ms = req.get("deadline_ms")
+            if deadline_ms is not None:
+                if isinstance(deadline_ms, bool) or \
+                        not isinstance(deadline_ms, (int, float)):
+                    reply(400, {"error": "deadline_ms must be a "
+                                         "number of milliseconds"})
+                    return
+                deadline_ms = float(deadline_ms)
+            try:
+                X = np.asarray(rows, np.float32)
+                if X.ndim != 2:
+                    raise ValueError(f"rows must be 2-D, got shape {X.shape}")
+                out = server._explain(name, X, deadline_ms=deadline_ms,
+                                      request_id=rid)
+            except KeyError as exc:
+                reply(404, {"error": str(exc.args[0])})
+                return
+            except QueueFullError as exc:
+                server._last_shed_t = time.monotonic()
+                reply(503, {"error": str(exc),
+                            "retry_after_s": exc.retry_after},
+                      {"Retry-After":
+                       str(max(1, int(-(-exc.retry_after // 1)))),
+                       **rid_hdr})
+                return
+            except DeadlineExceeded as exc:
+                reply(504, {"error": str(exc)})
+                return
+            except ServerClosed as exc:
+                reply(503, {"error": str(exc)})
+                return
+            except Exception as exc:
+                try:
+                    server.registry.get(name).stats.record_error()
+                except KeyError:
+                    pass
+                reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+                return
+            reply(200, {"model": name, "num_rows": int(X.shape[0]),
+                        "contributions": np.asarray(out).tolist(),
+                        "request_id": rid})
+
         def _apply_delta(self, req: dict, name: str) -> None:
             """``POST /models/<name>/delta``: append a published delta's
             trees to the serving model without a full reload.  The wire
@@ -652,7 +799,9 @@ def main(argv: List[str]) -> int:
     max_queue_rows (0 = unbounded; over-limit requests are shed with 503
     + Retry-After), deadline_ms (0 = none; slow requests fail with 504),
     slo_latency_ms (re-declares the serve/latency_p99 threshold for this
-    deployment), num_iteration (-1: all), port_file (announce the bound
+    deployment), explain_slo_latency_ms (same for the /explain lane's
+    serve/explain_latency_p99), num_iteration (-1: all), port_file
+    (announce the bound
     port by atomic write — the fleet supervisor's discovery channel for
     port=0 workers).  Multiple model files register under their
     basenames.
@@ -694,6 +843,10 @@ def main(argv: List[str]) -> int:
         from ..telemetry.slo import set_latency_threshold
         set_latency_threshold("serve/latency_p99",
                               float(kv["slo_latency_ms"]))
+    if kv.get("explain_slo_latency_ms"):
+        from ..telemetry.slo import set_latency_threshold
+        set_latency_threshold("serve/explain_latency_p99",
+                              float(kv["explain_slo_latency_ms"]))
     registry = ModelRegistry()
     n_iter = int(kv.get("num_iteration", -1))
     zoo = None

@@ -32,8 +32,8 @@ from ..telemetry.metrics import (Counter, MetricsRegistry,
                                  percentile)
 from ..telemetry.slo import (ExemplarRing, register_metric_ensurer, slo)
 
-__all__ = ["ModelStats", "percentile", "request_exemplars",
-           "EXEMPLAR_CAPACITY"]
+__all__ = ["ModelStats", "ExplainTimingStats", "percentile",
+           "request_exemplars", "EXEMPLAR_CAPACITY"]
 
 # bounded ring of the slowest requests seen, dumped alongside SLO
 # breaches (/slo attaches it whenever something burns)
@@ -55,6 +55,16 @@ def request_exemplars() -> ExemplarRing:
 slo("serve/latency_p99", metric="serve_request_latency_ms", kind="latency",
     target=0.99, threshold_ms=500.0, min_events=20,
     note="99% of requests complete under threshold_ms, per shape bucket")
+
+# The explanation lane's tail objective: /explain requests land their
+# end-to-end latency in their OWN (model, bucket) histogram so TreeSHAP
+# traffic (a much heavier program: (T*L, D) path slots per row) never
+# dilutes — nor hides behind — the predict p99 above.  Threshold is an
+# environment knob, same as serve/latency_p99.
+slo("serve/explain_latency_p99", metric="serve_explain_latency_ms",
+    kind="latency", target=0.99, threshold_ms=2000.0, min_events=20,
+    note="99% of /explain requests complete under threshold_ms, per "
+         "shape bucket")
 
 
 class ModelStats:
@@ -79,6 +89,8 @@ class ModelStats:
         self._req_latency = fam.req_latency
         self._queue_wait = fam.queue_wait
         self._device = fam.device
+        self._explain_latency = fam.explain_latency
+        self._explain_requests = fam.explain_requests
         if prime:
             self.prime_series()
         self.last_recompile_requests: tuple = ()
@@ -152,6 +164,34 @@ class ModelStats:
                                   self.last_recompile_requests),
             })
 
+    def record_explain_timing(self, n_rows: int, bucket: int,
+                              queue_ms: float, device_ms: float,
+                              total_ms: float,
+                              request_id: Optional[str] = None) -> None:
+        """One /explain request's end-to-end latency, landed in the
+        dedicated ``serve_explain_latency_ms`` histogram (the
+        ``serve/explain_latency_p99`` SLO series) plus the shared
+        slowest-N exemplar ring with a ``lane: explain`` tag."""
+        m, b = self.model, str(int(bucket))
+        self._explain_requests.inc(1, model=m)
+        self._explain_latency.observe(total_ms, model=m, bucket=b)
+        if _exemplars.would_accept(total_ms):
+            _exemplars.offer(total_ms, {
+                "request_id": request_id or "-", "model": m,
+                "lane": "explain", "rows": int(n_rows),
+                "bucket": int(bucket),
+                "queue_ms": round(queue_ms, 4),
+                "device_ms": round(device_ms, 4),
+                "total_ms": round(total_ms, 4),
+            })
+
+    def explain_timing_stats(self) -> "ExplainTimingStats":
+        """A stats facade for the explain lane's micro-batcher: same
+        registry (the batcher's saturation gauges park next to this
+        model's series under a distinct label), but request timings land
+        in the explain histogram instead of the predict one."""
+        return ExplainTimingStats(self)
+
     def release(self) -> int:
         """Retire every ``model=<name>`` series this instance created in
         its registry (counters, per-bucket histograms, the batcher's
@@ -208,7 +248,35 @@ class ModelStats:
             "request_latency_ms": self._timing_summary(self._req_latency),
             "queue_wait_ms": self._timing_summary(self._queue_wait),
             "device_ms": self._timing_summary(self._device),
+            "explain_requests": int(self._explain_requests.value(model=m)),
+            "explain_latency_ms": self._timing_summary(
+                self._explain_latency),
         }
+
+
+class ExplainTimingStats:
+    """Duck-typed ``stats`` for the explain lane's ``MicroBatcher``:
+    exposes the same registry (saturation gauges) and model name, but
+    routes ``record_request_timing`` into the explain latency series so
+    the two lanes' p99 objectives stay independent."""
+
+    def __init__(self, base: ModelStats) -> None:
+        self._base = base
+        self.model = f"{base.model}#explain"
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._base.registry
+
+    def record_request(self, n_rows: int = 1) -> None:
+        pass  # request counting is the explain counter's job
+
+    def record_request_timing(self, n_rows: int, bucket: int,
+                              queue_ms: float, device_ms: float,
+                              total_ms: float,
+                              request_id: Optional[str] = None) -> None:
+        self._base.record_explain_timing(n_rows, bucket, queue_ms,
+                                         device_ms, total_ms, request_id)
 
 
 class _Family(NamedTuple):
@@ -222,6 +290,8 @@ class _Family(NamedTuple):
     req_latency: WindowedHistogram
     queue_wait: WindowedHistogram
     device: WindowedHistogram
+    explain_latency: WindowedHistogram
+    explain_requests: Counter
 
 
 def _metric_family(reg: MetricsRegistry) -> _Family:
@@ -263,6 +333,14 @@ def _metric_family(reg: MetricsRegistry) -> _Family:
             "serve_device_ms",
             "per-request share of the batched device call",
             labels=("model", "bucket"), window=ModelStats.WINDOW),
+        explain_latency=reg.histogram(
+            "serve_explain_latency_ms",
+            "per-request end-to-end /explain latency (queue + device + "
+            "copy)", labels=("model", "bucket"),
+            window=ModelStats.WINDOW),
+        explain_requests=reg.counter(
+            "serve_explain_requests_total",
+            "client-level explain calls", labels=("model",)),
     )
 
 

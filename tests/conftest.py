@@ -34,6 +34,12 @@ _cache_dir = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# ... and export the same cache to every subprocess tests spawn (gloo
+# worker pairs, CLI entrypoint runs, fleet serve workers): each of those
+# is a fresh jax that would otherwise recompile its whole program set
+# per run.  jax reads these env spellings at import.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.0")
 
 import numpy as np
 import pytest

@@ -239,6 +239,45 @@ def test_loadtest_e2e_verdict_from_scrapes():
 
 
 @pytest.mark.slow
+def test_explain_loadtest_verdict_from_scrapes():
+    """The CI --explain smoke: closed-loop /explain traffic with
+    interleaved /predict requests; pass requires a 5xx-free explain
+    response counter, the explain-latency SLO met on /slo, zero
+    dense->walk fallback batches, and a clean predict lane — all read
+    from the server's own telemetry."""
+    bench_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks")
+    sys.path.insert(0, bench_dir)
+    try:
+        import loadtest
+        report = loadtest.run_explain_loadtest(
+            duration_s=1.5, threads_n=2, rows_per_req=8, trees=5,
+            leaves=7, p99_threshold_ms=5000.0, scrape_interval_s=0.3)
+        assert report["schema"] == "explain-loadtest-report-v1"
+        assert report["verdict"] == "pass", report
+        assert report["verdict_source"] == "/metrics + /slo scrapes only"
+        assert report["availability"] == 1.0
+        assert report["dense_ok"] and report["fallback_batches"] == 0
+        assert report["volume_ok"] and report["explain_qps"] > 0
+        # additivity held across the HTTP boundary (context, not verdict)
+        assert report["additive_ok"]
+        # the explain SLO itself was evaluated, not just the global ok
+        assert report["explain_slo"].get("name") == \
+            "serve/explain_latency_p99"
+        assert report["explain_slo"].get("ok") is True
+        assert report["per_bucket"], report
+        rec = loadtest.explain_to_bench_matrix(report)
+        names = [r["name"] for r in rec["rows"]]
+        assert rec["schema"] == "bench-matrix-v1"
+        assert "explain_loadtest" in names
+        assert "explain_fallbacks" in names
+        assert "explain_verdict" in names
+        assert any(n.startswith("explain_loadtest_p99_b") for n in names)
+    finally:
+        sys.path.remove(bench_dir)
+
+
+@pytest.mark.slow
 @pytest.mark.chaos
 def test_fleet_chaos_harness_verdict_from_scrapes():
     """The CI fleet-chaos smoke: serve_crash_after_n kills one worker

@@ -202,7 +202,29 @@ def test_slo_coverage_clean_at_head():
     names = set(all_slos())
     assert {"serve/latency_p99", "serve/availability", "serve/shed_rate",
             "serve/compiler_fallback_rate", "fleet/workers_alive",
-            "fleet/retry_rate"} <= names
+            "fleet/retry_rate", "serve/explain_latency_p99"} <= names
+
+
+def test_explain_slo_covered_and_planted_violation_fails():
+    """The /explain lane's latency objective keys to a registered
+    WindowedHistogram (slo_cover validates it at head), and a planted
+    broken twin — the same threshold pointed at the lane's COUNTER —
+    fails coverage: the lint genuinely checks the explain series."""
+    from lightgbm_tpu.analysis.slo_cover import check_slo_coverage
+    rep = all_slos()["serve/explain_latency_p99"]
+    assert rep.metric == "serve_explain_latency_ms"
+    assert rep.kind == "latency" and rep.threshold_ms > 0
+    slo("test/explain_latency_on_counter",
+        metric="serve_explain_requests_total", kind="latency",
+        target=0.99, threshold_ms=2000.0)
+    try:
+        vs = check_slo_coverage()
+        assert any(v.site == "test/explain_latency_on_counter"
+                   for v in vs)
+        assert not any(v.site == "serve/explain_latency_p99" for v in vs)
+    finally:
+        remove_slo("test/explain_latency_on_counter")
+    assert check_slo_coverage() == []
 
 
 def test_planted_dangling_metric_fails_coverage():
