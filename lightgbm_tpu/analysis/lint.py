@@ -8,6 +8,9 @@ executed) program per supported training/serving shape:
 * ``dp_scatter`` — W-shard DP wave, feature-sliced reduce-scatter merge;
 * ``spec_ramp``  — DP wave + speculative ramp (the ceil(log2 W) budget);
 * ``multitrain`` — the vmapped model axis over the wave grower;
+* ``multitrain_mc`` — the same program at the multiclass (M, K) lane
+  grid (L = M*K lanes), checking the K-scaled memory budget and that
+  the wider lane count is retrace-stable;
 * ``serve``      — the ensemble predictor across the SHAPE_BUCKETS
   ladder (one program per bucket, hash-stable on re-trace);
 * ``serve_dense`` — the inference compiler's fused dense program
@@ -58,8 +61,8 @@ __all__ = ["MATRIX_CONFIGS", "Geometry", "TRACE_GEOMETRY", "MEM_GEOMETRY",
            "parse_kv_args", "run_lint", "main"]
 
 MATRIX_CONFIGS = ("serial", "wave", "dp_scatter", "spec_ramp", "voting",
-                  "multitrain", "serve", "serve_dense", "serve_zoo",
-                  "serve_explain", "ingest")
+                  "multitrain", "multitrain_mc", "serve", "serve_dense",
+                  "serve_zoo", "serve_explain", "ingest")
 
 # every rule the matrix runs: the six PR-10 program-contract rules plus
 # the SPMD-safety pair (collective-order, sharding-consistency)
@@ -332,7 +335,7 @@ def _mk_ingest_chunk(geom: Geometry):
     return build
 
 
-def _multitrain_builder(geom: Geometry):
+def _multitrain_builder(geom: Geometry, models: int = 3, classes: int = 1):
     def build(i: int):
         import jax
         import jax.numpy as jnp
@@ -340,13 +343,18 @@ def _multitrain_builder(geom: Geometry):
         grow = _mk_wave_grow(None, geom, quantized=False, spec=False)
         entry = _serial_entry(grow)
         # the model axis: per-lane grad/hess/mask over shared bins (the
-        # multitrain/batched.py vm_grow shape, M=3 lanes)
+        # multitrain/batched.py vm_grow shape).  Multiclass batches put
+        # L = models * classes lanes on the SAME axis (batched.py's
+        # (M, K) lane grid), so the multitrain_mc geometry is the same
+        # program at a wider lane count — the (M, K)-scaled
+        # MemoryBudget is what lint-mem checks.
+        lanes = models * classes
         vm = jax.vmap(entry,
                       in_axes=(None, 0, 0, 0) + (None,) * 6)
         args = _mk_train_args(i, pad_rows(geom.rows), geom)
-        stack = lambda a: jnp.stack([a, a * 0.5, a * 0.25])
+        stack = lambda a: jnp.stack([a * (0.5 ** m) for m in range(lanes)])
         vm_args = (args[0], stack(args[1]), stack(args[2]),
-                   jnp.stack([args[3]] * 3)) + args[4:]
+                   jnp.stack([args[3]] * lanes)) + args[4:]
         return vm, vm_args
 
     return build
@@ -641,6 +649,10 @@ def build_unit(name: str, nshards: int = 8,
     if name == "multitrain":
         return _unit_from_traces("multitrain", _multitrain_builder(geom),
                                  _base_ctx(geom, models=3))
+    if name == "multitrain_mc":
+        return _unit_from_traces(
+            "multitrain_mc", _multitrain_builder(geom, models=2, classes=3),
+            _base_ctx(geom, models=2, classes=3))
     if name == "serve":
         return _build_serve_unit(geom, _base_ctx(geom))
     if name == "serve_dense":
@@ -672,6 +684,8 @@ def build_callable(name: str, nshards: int = 8,
         return _serial_builder(geom, name == "wave")(0)
     if name == "multitrain":
         return _multitrain_builder(geom)(0)
+    if name == "multitrain_mc":
+        return _multitrain_builder(geom, models=2, classes=3)(0)
     if name == "ingest":
         return _mk_ingest_chunk(geom)(0)
     if name == "serve":

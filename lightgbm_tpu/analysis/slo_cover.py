@@ -41,6 +41,7 @@ def _import_declaring_modules() -> None:
     from ..explain import compiler as _explain_compiler  # noqa: F401
     from ..resilience import admission  # noqa: F401
     from ..serve import compiler, fleet, server, stats  # noqa: F401
+    from .. import multitrain  # noqa: F401  (multitrain/fallback_rate)
 
 
 def check_slo_coverage(registry: Optional[MetricsRegistry] = None

@@ -181,7 +181,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             str(cfg.tpu_ingest_mode) == "chunked":
         from .ingest.train import train_streamed
         unsupported = [nm for nm, v in (
-            ("valid_sets", valid_sets), ("fobj", fobj), ("feval", feval),
+            ("fobj", fobj), ("feval", feval),
             ("init_model", init_model), ("callbacks", callbacks)) if v]
         if unsupported:
             raise ValueError(
@@ -193,6 +193,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                              "bundle/directory path, not a loaded "
                              "Checkpoint object")
         return train_streamed(params, train_set, num_boost_round,
+                              valid_sets=valid_sets,
+                              valid_names=valid_names,
                               resume_from=(str(resume_from)
                                            if resume_from else None))
 

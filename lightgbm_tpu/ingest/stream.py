@@ -112,6 +112,9 @@ class StreamedDataset(Dataset):
         if self.constructed:
             return self
         cfg = config or Config(self.params)
+        ref = self.reference
+        if ref is not None and not ref.constructed:
+            ref.construct(cfg)
         if cfg.linear_tree:
             raise ValueError("linear_tree needs raw feature values resident "
                              "in memory; StreamedDataset does not keep them")
@@ -166,7 +169,7 @@ class StreamedDataset(Dataset):
                 m = chunk.X.shape[0]
                 lo = np.searchsorted(sample_idx, chunk.offset)
                 hi = np.searchsorted(sample_idx, chunk.offset + m)
-                if hi > lo:
+                if ref is None and hi > lo:
                     local = sample_idx[lo:hi] - chunk.offset
                     sketch.update(np.asarray(chunk.X, np.float64)[local])
                 if chunk.label is not None:
@@ -196,12 +199,28 @@ class StreamedDataset(Dataset):
             return max(1, int(cfg.min_data_in_leaf * sample_total /
                               max(1, n_total)))
 
-        self.bin_mappers = sketch.finalize(
-            max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
-            use_missing=cfg.use_missing,
-            zero_as_missing=cfg.zero_as_missing, forced_bins=forced_bins,
-            pre_filter_cnt_fn=_filt)
-        self._finalize_used_features(f)   # shared trivial-filter policy
+        if ref is not None:
+            # align bins with the reference dataset (dataset.h:304 — the
+            # in-core Dataset.construct reference path): a streamed valid
+            # set bins against the TRAIN mappers so tree thresholds
+            # transfer; no sketch finalize of its own
+            if getattr(ref, "efb", None) is not None:
+                raise ValueError(
+                    "StreamedDataset cannot bin against an EFB-bundled "
+                    "reference (the streamed binning pass has no bundle "
+                    "step); construct the reference with "
+                    "enable_bundle=false")
+            self.bin_mappers = ref.bin_mappers
+            self.used_feature_map = ref.used_feature_map
+            self.num_bins_per_feature = ref.num_bins_per_feature
+            self.efb = ref.efb
+        else:
+            self.bin_mappers = sketch.finalize(
+                max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
+                use_missing=cfg.use_missing,
+                zero_as_missing=cfg.zero_as_missing,
+                forced_bins=forced_bins, pre_filter_cnt_fn=_filt)
+            self._finalize_used_features(f)   # shared trivial-filter policy
         used_arr = self.used_feature_map
         mappers = [self.bin_mappers[j] for j in used_arr]
         used = [int(j) for j in used_arr]

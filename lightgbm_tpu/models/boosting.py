@@ -24,8 +24,11 @@ class GOSS(GBDT):
     skipped for the first 1/learning_rate iterations, :157).
 
     The reference samples an exact count with a per-thread RNG; here the
-    "rest" rows are sampled i.i.d. Bernoulli on device — same distribution,
-    fully vectorized, deterministic per (seed, iteration)."""
+    "rest" rows are sampled i.i.d. Bernoulli — same distribution,
+    deterministic per (seed, iteration).  The draw itself is HOST-side
+    (``gbdt.goss_sample_np``): one shared Philox stream serves this
+    trainer, the chunked streamed driver and the multi-model batcher, so
+    all three thin the same rows and stay bit-identical to each other."""
 
     name = "goss"
 
@@ -36,28 +39,16 @@ class GOSS(GBDT):
             log_warning("cannot use bagging in GOSS (ignored)")
 
     def _prepare_iter_sampling(self, grad, hess):
-        cfg = self.config
-        a, b = float(cfg.top_rate), float(cfg.other_rate)
-        n = self.num_data
-        warmup = int(1.0 / max(cfg.learning_rate, 1e-12))
-        if self.iter_ < warmup or a + b >= 1.0:
-            return grad, hess, jnp.ones(n, jnp.float32)
-        g2 = grad if grad.ndim == 1 else grad
-        h2 = hess if hess.ndim == 1 else hess
-        score = jnp.abs(g2 * h2)
-        if score.ndim == 2:  # multiclass: sum over classes (goss.hpp:118)
-            score = jnp.sum(score, axis=1)
-        top_k = max(1, int(n * a))
-        thr = jax.lax.top_k(score, top_k)[0][-1]
-        top_mask = score >= thr
-        key = jax.random.fold_in(jax.random.PRNGKey(cfg.bagging_seed), self.iter_)
-        rest_p = b / max(1.0 - a, 1e-12)
-        rest_mask = (jax.random.uniform(key, (n,)) < rest_p) & ~top_mask
-        amplify = (1.0 - a) / max(b, 1e-12)
-        scale = jnp.where(rest_mask, amplify, 1.0)
-        scale = scale if grad.ndim == 1 else scale[:, None]
-        mask = (top_mask | rest_mask).astype(jnp.float32)
-        return grad * scale, hess * scale, mask
+        from .gbdt import goss_sample_np
+        gm = goss_sample_np(self.config, jax.device_get(grad),
+                            jax.device_get(hess), self.iter_)
+        if gm is None:
+            return grad, hess, jnp.ones(self.num_data, jnp.float32)
+        mask, mult = gm
+        scale = jnp.asarray(mult)
+        if grad.ndim == 2:
+            scale = scale[:, None]
+        return grad * scale, hess * scale, jnp.asarray(mask)
 
 
 class DART(GBDT):

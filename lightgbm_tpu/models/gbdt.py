@@ -220,6 +220,56 @@ def bagging_mask_np(cfg, n: int, iteration: int,
     return mask
 
 
+def goss_sample_np(cfg, grad: np.ndarray, hess: np.ndarray, iteration: int,
+                   rows: Optional[np.ndarray] = None):
+    """Host GOSS draw (goss.hpp:103-152): keep the top ``top_rate`` rows by
+    |grad*hess|, Bernoulli-sample ``other_rate`` of the rest at b/(1-a) and
+    amplify the survivors' gradients by (1-a)/b; sampling is skipped for the
+    first 1/learning_rate iterations (goss.hpp:157).
+
+    Single-sourced for the standalone trainer (models/boosting.py), the
+    chunked streamed driver (ingest/train.py) and the multi-model trainer
+    (multitrain/batched.py): one Philox stream per (bagging_seed, iteration)
+    means all three paths thin exactly the same rows and the bit-identity
+    contracts hold across them.  ``rows`` restricts the draw to those row
+    indices (the masked-fold CV path): thresholds and Bernoulli draws are
+    computed over the compacted subset — exactly what a standalone run on
+    ``dataset[rows]`` would draw — and scattered back to full length.
+
+    Returns ``(mask, mult)`` float32 (n,) arrays — 0/1 survivorship and the
+    per-row gradient multiplier — or None when sampling is inactive this
+    iteration (warmup, or top_rate+other_rate >= 1)."""
+    a, b = float(cfg.top_rate), float(cfg.other_rate)
+    warmup = int(1.0 / max(float(cfg.learning_rate), 1e-12))
+    if iteration < warmup or a + b >= 1.0:
+        return None
+    grad = np.asarray(grad)
+    hess = np.asarray(hess)
+    score = np.abs(grad * hess)
+    if score.ndim == 2:  # multiclass: sum |g*h| over classes (goss.hpp:118)
+        score = score.sum(axis=1)
+    n = len(score)
+    sub = score if rows is None else score[rows]
+    nn = len(sub)
+    k = max(1, int(nn * a))
+    thr = np.partition(sub, nn - k)[nn - k]
+    top = sub >= thr
+    rng = host_rng(cfg.bagging_seed, iteration)
+    rest_p = b / max(1.0 - a, 1e-12)
+    keep_rest = (~top) & (rng.random(nn) < rest_p)
+    amp = (1.0 - a) / max(b, 1e-12)
+    sub_mask = (top | keep_rest).astype(np.float32)
+    sub_mult = np.where(keep_rest, np.float32(amp),
+                        np.float32(1.0)).astype(np.float32)
+    if rows is None:
+        return sub_mask, sub_mult
+    mask = np.zeros(n, np.float32)
+    mask[rows] = sub_mask
+    mult = np.ones(n, np.float32)
+    mult[rows] = sub_mult
+    return mask, mult
+
+
 def feature_mask_np(cfg, num_features: int,
                     iteration: int) -> Optional[np.ndarray]:
     """Per-iteration feature_fraction mask (ColSampler per-tree draw), or

@@ -34,6 +34,8 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import Dataset
+from ..telemetry.metrics import default_registry
+from ..telemetry.slo import register_metric_ensurer, slo as _slo
 from ..utils.log import log_info, log_warning
 from .batched import BatchTrainer, MultiTrainError
 from .variants import (HOST_SWEEP, SWEEPABLE, TRACED_SWEEP, group_variants,
@@ -41,6 +43,47 @@ from .variants import (HOST_SWEEP, SWEEPABLE, TRACED_SWEEP, group_variants,
 
 __all__ = ["train_many", "ManyBooster", "MultiTrainError",
            "GridSearchCVMany", "TRACED_SWEEP", "HOST_SWEEP", "SWEEPABLE"]
+
+
+# ---------------------------------------------------------------------------
+# fallback telemetry: never again a silently-sequential sweep
+# ---------------------------------------------------------------------------
+# ``multitrain_fallback_total`` fires once per model that dropped off the
+# vmapped axis, labeled with the bounded structural reason prefix (the
+# free-text detail after " (" is stripped so the series stays low-
+# cardinality).  The SLO reads it against every model REQUESTED through
+# train_many — with the PR-20 lifts (GOSS/DART/multiclass/ranking) the
+# fallback set is only the genuinely unstackable configs (RF, CEGB,
+# linear_tree, distributed learners, custom fobj), so a drifting ratio
+# means a lift regressed, exactly the serve_compiler_fallback shape.
+
+FALLBACK_COUNTER = "multitrain_fallback_total"
+REQUESTED_COUNTER = "multitrain_models_requested_total"
+
+_slo("multitrain/fallback_rate", metric=FALLBACK_COUNTER,
+     total_metric=REQUESTED_COUNTER, kind="ratio", target=0.95,
+     bad_labels={"reason": "*"}, min_events=20,
+     note="share of train_many models that fell off the vmapped model "
+          "axis to sequential train()")
+
+
+@register_metric_ensurer
+def _ensure_multitrain_metrics(reg) -> None:
+    reg.counter(FALLBACK_COUNTER,
+                "train_many models that fell back to sequential train(), "
+                "by structural reason", labels=("reason",))
+    reg.counter(REQUESTED_COUNTER,
+                "models requested through train_many (batched or not)")
+
+
+def _note_fallback(reason: str, count: int) -> None:
+    # bounded label: keep the structural prefix, drop the per-config
+    # free text ("boosting=rf (averaged-score training)" -> "boosting=rf")
+    short = reason.split(" (")[0].strip() or "unknown"
+    default_registry().counter(
+        FALLBACK_COUNTER,
+        "train_many models that fell back to sequential train(), "
+        "by structural reason", labels=("reason",)).inc(count, reason=short)
 
 
 class ManyBooster:
@@ -82,6 +125,7 @@ def train_many(params: Dict[str, Any], train_set: Dataset,
                valid_sets: Optional[List[Dataset]] = None,
                valid_names: Optional[List[str]] = None,
                allow_fallback: bool = True,
+               strict: bool = False,
                force_traced: bool = False,
                **kwargs: Any) -> ManyBooster:
     """Train M boosters in one traced program.
@@ -103,6 +147,10 @@ def train_many(params: Dict[str, Any], train_set: Dataset,
         early stopping runs against per-model scores).
       allow_fallback: False raises :class:`MultiTrainError` instead of
         training unsupported variants sequentially.
+      strict: alias for ``allow_fallback=False`` (the never-silent
+        contract: a sweep that silently went sequential is a perf
+        regression, not a convenience) — every fallback also bumps the
+        ``multitrain_fallback_total{reason}`` counter either way.
       force_traced: trace every sweepable hyperparameter even when it
         does not vary (testing hook: exercises the traced program).
 
@@ -112,6 +160,8 @@ def train_many(params: Dict[str, Any], train_set: Dataset,
     """
     params = dict(params or {})
     params.update(kwargs)
+    if strict:
+        allow_fallback = False
     if sample_masks is not None:
         sample_masks = np.asarray(sample_masks, np.float32)
         num_models = sample_masks.shape[0]
@@ -132,8 +182,12 @@ def train_many(params: Dict[str, Any], train_set: Dataset,
     groups = group_variants(vparams)
     result.num_groups = len(groups)
     cap = max(1, int(Config(params).tpu_multitrain_batch))
+    default_registry().counter(
+        REQUESTED_COUNTER,
+        "models requested through train_many (batched or not)").inc(M)
 
     def _fallback(indices: List[int], reason: str) -> None:
+        _note_fallback(reason, len(indices))
         if not allow_fallback:
             raise MultiTrainError(reason)
         log_warning(f"train_many: {len(indices)} variant(s) fall back to "

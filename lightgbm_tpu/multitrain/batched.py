@@ -16,23 +16,48 @@ This holds because
   under vmap (each model's lane runs the same reduction tree — asserted
   by tests/test_multitrain.py on the partition and wave paths);
 * host-side sampling draws are single-sourced
-  (models/gbdt.py ``bagging_mask_np`` / ``feature_mask_np``) and keyed
-  per model by the variant's own seeds;
+  (models/gbdt.py ``bagging_mask_np`` / ``feature_mask_np`` /
+  ``goss_sample_np``) and keyed per model by the variant's own seeds;
 * swept hyperparameters enter the traced program as per-model scalars
   that flow through the exact arithmetic the constant-folded standalone
   program runs (ops/split.py ``TRACEABLE_PARAMS``);
 * the per-iteration dispatch BOUNDARIES mirror the standalone loop
   (eager gradients, one jitted grower program, an eager
-  ``leaf_value * lr`` multiply, the jitted gather+add score update, the
-  jitted valid-set walk plus an eager add).  Fusing them into one
-  program is NOT value-safe: XLA contracts the multiply into the score
-  add as a single-rounding FMA — ``optimization_barrier`` does not stop
-  it on the CPU backend — and drifts 1 ulp off the standalone
-  trajectory.
+  ``leaf_value * shrinkage`` multiply, the jitted gather+add score
+  update, the jitted valid-set walk plus an eager add).  Fusing them
+  into one program is NOT value-safe: XLA contracts the multiply into
+  the score add as a single-rounding FMA — ``optimization_barrier``
+  does not stop it on the CPU backend — and drifts 1 ulp off the
+  standalone trajectory.
 
-The per-iteration host work is only mask refreshes and metric
-evaluation; the heavy lifting (histogram build + split scan for all M
-models) is the single vmapped grower program per iteration.
+Boosting/objective variants ride the same axis (the PR-20 lift):
+
+* **GOSS** (arXiv:1806.11248) — per-lane top-a%/random-b% draws come
+  from the shared host sampler (``gbdt.goss_sample_np``) applied to the
+  already-eager (M, N) gradient matrix; the amplified small-gradient
+  multipliers hit the stacked gradients in one eager elementwise
+  multiply and the 0/1 survivorship folds into the per-lane grower
+  mask, so every lane's inputs equal its standalone counterpart's.
+* **DART** — per-lane drop sets are ``utils/random.host_rng`` host
+  bookkeeping in ``_ModelState``; each iteration's raw per-tree
+  predictions are cached as ONE stacked (L, N) gather, and drop
+  subtraction / re-add / valid renormalization are batched
+  ``jnp.where``-masked axpys over all lanes, so lanes never
+  desynchronize the dispatch boundaries.  Tree shrink-factor replays
+  happen at finalize in standalone chronological order.
+* **multiclass** — an (M, K) lane grid flattened to L = M*K device
+  lanes: softmax/OVA gradients are vmapped per model on the (N, K)
+  score view, every class tree of an iteration grows in the same
+  vmapped program (the standalone class loop's trees are mutually
+  independent within an iteration), and extraction interleaves class
+  trees exactly like the standalone loop.
+* **ranking** — lambdarank/rank-xendcg gradients vectorize across lanes
+  over the one shared padded query-segment layout (per-lane scores in);
+  ``train_set.metadata.group`` is no longer a reject.
+
+The per-iteration host work is only mask refreshes, DART/GOSS draws and
+metric evaluation; the heavy lifting (histogram build + split scan for
+all M*K lanes) is the single vmapped grower program per iteration.
 """
 
 from __future__ import annotations
@@ -52,63 +77,67 @@ from ..learner.serial import GrownTree, SerialTreeLearner
 from ..metric import create_metrics
 from ..models.gbdt import (EPSILON, _grown_to_tree, _mappers_equal,
                            _update_score_by_leaf, bagging_mask_np,
-                           feature_mask_np, make_walk_fn)
+                           feature_mask_np, goss_sample_np, make_walk_fn)
 from ..objective import create_objective
 from ..resilience.checkpoint import reject_checkpointing
 from ..resilience.faults import faults
 from ..telemetry.metrics import default_registry
 from ..telemetry.train_record import TrainRecord, set_last_train_record
+from ..utils.random import host_rng
 from .variants import TRACED_SWEEP
 
 __all__ = ["MultiTrainError", "BatchTrainer", "batch_reject_reason"]
 
 
 def multitrain_hbm_bytes(ctx):
-    """Per-device HBM curve of the M-stacked vmapped grower program
+    """Per-device HBM curve of the stacked vmapped grower program
     (lint-mem enforced): every wave-grower working buffer except the
-    shared bin matrix picks up a leading M axis, so the footprint is
-    ~M x the standalone curve — the reason tpu_multitrain_batch caps a
-    structure group at 256 models and the model axis pmap-shards across
-    devices when M % ndev == 0 (each device then holds M/ndev lanes)."""
+    shared bin matrix picks up a leading lane axis of L = models *
+    classes (the multiclass (M, K) grid flattens onto the same vmap
+    axis), so the footprint is ~L x the standalone curve — the reason
+    tpu_multitrain_batch caps a structure group at 256 models and the
+    lane axis shard_map-shards across devices when L % ndev == 0 (each
+    device then holds L/ndev lanes)."""
     from ..learner.wave import wave_grow_hbm_bytes
     m = max(1, int(ctx.get("models", 1)))
+    k = max(1, int(ctx.get("classes", 1)))
     ndev = max(1, int(ctx.get("model_shards", 1)))
-    lanes = -(-m // ndev)
+    lanes = -(-(m * k) // ndev)
     per_model = wave_grow_hbm_bytes(ctx)
-    # 1.15: vmap stacks a few M-wide temporaries the standalone program
-    # frees between dispatches (measured at the lint-mem geometry)
+    # 1.15: vmap stacks a few lane-wide temporaries the standalone
+    # program frees between dispatches (measured at the lint-mem
+    # geometry)
     return int(1.15 * lanes * per_model)
 
 
-memory_budget("multitrain/stacked_state", ("multitrain",),
+memory_budget("multitrain/stacked_state", ("multitrain", "multitrain_mc"),
               multitrain_hbm_bytes,
-              note="M/ndev lanes x the wave-grower curve (shared bins)")
+              note="M*K/ndev lanes x the wave-grower curve (shared bins)")
 
 
 class MultiTrainError(ValueError):
     """The configuration cannot train on the vmapped model axis."""
 
 
-# objectives whose gradients are elementwise in the score (vmap-exact)
-# and whose leaf values need no host-side percentile refit
-_UNSUPPORTED_OBJECTIVES = ("lambdarank", "rank_xendcg", "none",
-                           "multiclass", "multiclassova", "softmax")
+# objectives the model axis cannot express: "none" means a custom fobj
+# whose host callback cannot stack
+_UNSUPPORTED_OBJECTIVES = ("none",)
 
 
 def batch_reject_reason(cfg: Config, train_set: Dataset) -> Optional[str]:
     """Why this config cannot ride the vmapped model axis (None = it can).
 
-    The excluded features either keep per-tree host state the batch
-    cannot stack (CEGB used-sets, linear-leaf refits, L1-style leaf
-    renewal, DART tree drops), need gradient-dependent host sampling
-    (GOSS), or change the traced program per model (multiclass,
-    distributed learners)."""
-    if cfg.boosting not in ("gbdt", ""):
-        return f"boosting={cfg.boosting} (per-iteration host state)"
+    The excluded features either keep cross-tree host state whose score
+    effects the batch cannot replay (RF's averaged scores, CEGB
+    used-sets, linear-leaf refits, L1-style leaf renewal), or change the
+    traced program per model (distributed learners).  GOSS, DART,
+    multiclass and ranking all batch (PR 20): their host state stacks in
+    ``_ModelState`` and their score adjustments are lane-masked device
+    ops."""
+    if cfg.boosting not in ("gbdt", "goss", "dart", ""):
+        return f"boosting={cfg.boosting} (averaged-score training)"
     if cfg.objective in _UNSUPPORTED_OBJECTIVES:
         return f"objective={cfg.objective}"
-    if int(cfg.num_class) > 1:
-        return "num_class>1 (per-class tree axis)"
     if cfg.tree_learner not in ("serial", ""):
         return f"tree_learner={cfg.tree_learner} (mesh collectives)"
     if cfg.linear_tree:
@@ -118,8 +147,6 @@ def batch_reject_reason(cfg: Config, train_set: Dataset) -> Optional[str]:
         return "CEGB penalties (cross-tree used-feature state)"
     if getattr(train_set, "distributed_rows", False):
         return "pre_partition-ed multi-process dataset"
-    if train_set.metadata.group is not None:
-        return "ranking/query data"
     return None
 
 
@@ -129,8 +156,6 @@ def _objective_reject_reason(objective) -> Optional[str]:
     if getattr(objective, "is_renew_tree_output", False):
         return (f"objective {type(objective).__name__} renews leaf values "
                 "host-side per tree")
-    if objective.num_model_per_iteration != 1:
-        return "multi-model-per-iteration objective"
     return None
 
 
@@ -154,18 +179,21 @@ def _subset_metadata(md: Metadata, rows: np.ndarray,
 
 
 class _ModelState:
-    """Host bookkeeping of one model lane."""
+    """Host bookkeeping of one model lane group (all K class lanes)."""
 
     __slots__ = ("cfg", "params", "rows", "mask_vals", "bias", "active",
                  "kept_iters", "best_iteration", "best_score", "stopper",
-                 "history", "metrics_per_valid", "stop_reason")
+                 "history", "metrics_per_valid", "stop_reason",
+                 # DART host state (per model, mirrors models/boosting.py)
+                 "weights", "sum_weight", "cur_shrinkage", "tree_shrink",
+                 "tree_factors")
 
     def __init__(self, cfg: Config, params: Dict[str, Any]) -> None:
         self.cfg = cfg
         self.params = params
         self.rows: Optional[np.ndarray] = None
         self.mask_vals: Optional[np.ndarray] = None
-        self.bias = 0.0
+        self.bias: Optional[np.ndarray] = None   # (K,) per-class init bias
         self.active = True
         self.kept_iters = 0
         self.best_iteration = -1
@@ -174,6 +202,11 @@ class _ModelState:
         self.history: Dict[str, Dict[str, List[float]]] = {}
         self.metrics_per_valid: List[list] = []
         self.stop_reason = ""
+        self.weights: List[float] = []       # DART per-tree current weight
+        self.sum_weight = 0.0
+        self.cur_shrinkage = float(cfg.learning_rate)
+        self.tree_shrink: List[float] = []   # shrinkage at creation time
+        self.tree_factors: List[List[float]] = []  # normalize replays
 
 
 class BatchTrainer:
@@ -181,7 +214,12 @@ class BatchTrainer:
 
     Drivers (``train_many``, the CV fast path, the sweep) construct it,
     call :meth:`run` or drive :meth:`step_once` themselves, then
-    :meth:`finalize` to extract per-model standalone ``Booster``s."""
+    :meth:`finalize` to extract per-model standalone ``Booster``s.
+
+    Multiclass objectives put K = num_class lanes per model on the vmap
+    axis (L = M*K device lanes, class-major within a model, matching the
+    standalone per-iteration class loop); all host bookkeeping stays at
+    model granularity and expands to lanes on upload."""
 
     def __init__(self, variant_params: List[Dict[str, Any]],
                  train_set: Dataset,
@@ -205,16 +243,28 @@ class BatchTrainer:
         self.train_set = train_set
         self.n = train_set.num_data()
         self.num_features = train_set.num_feature()
+        self.boosting = cfg.boosting or "gbdt"   # structural: whole batch
+        self._goss = self.boosting == "goss"
+        self._dart = self.boosting == "dart"
 
-        # the shared objective: gradients are elementwise per row, so one
-        # instance initialized on the FULL metadata serves every model
-        # (per-model row masks never reach gradient VALUES)
+        # the shared objective: gradients are per-row (elementwise, or
+        # row-local softmax / query-local lambdarank), so one instance
+        # initialized on the FULL metadata serves every model (per-model
+        # row masks never reach gradient VALUES)
         self.objective = (create_objective(cfg.objective, cfg)
                           if cfg.objective != "none" else None)
         reason = _objective_reject_reason(self.objective)
         if reason:
             raise MultiTrainError(reason)
         self.objective.init(train_set.metadata, self.n)
+        self.K = int(self.objective.num_model_per_iteration)
+        self.L = self.M * self.K
+        self._ranking = train_set.metadata.group is not None
+        if cfg.objective == "rank_xendcg" and \
+                len({int(c.seed) for c in self.cfgs}) > 1:
+            raise MultiTrainError(
+                "rank_xendcg seed sweep (the sampled-lambda stream is "
+                "shared across lanes)")
 
         # the learner: same selection path as GBDT._init_train
         from ..binning import MissingType
@@ -266,8 +316,9 @@ class BatchTrainer:
                 nz = np.nonzero(sample_masks[m] > 0)[0]
                 st.rows = nz
                 st.mask_vals = sample_masks[m][nz]
-        if any(st.rows is not None for st in self.states) and \
-                cfg.objective == "binary" and cfg.is_unbalance:
+        any_rows = any(st.rows is not None for st in self.states)
+        if any_rows and cfg.is_unbalance and \
+                cfg.objective in ("binary", "multiclassova"):
             # the shared objective derives is_unbalance's label_weight
             # from the FULL dataset's pos/neg counts; a fold/cohort
             # model's standalone counterpart derives it from ITS rows —
@@ -275,6 +326,13 @@ class BatchTrainer:
             raise MultiTrainError(
                 "is_unbalance with per-model sample masks (label_weight "
                 "depends on the fold's own pos/neg counts)")
+        if any_rows and self._ranking:
+            # a fold's standalone counterpart re-segments ITS rows into
+            # queries; the shared padded segment layout spans the full
+            # dataset and cannot express per-lane query subsets
+            raise MultiTrainError(
+                "ranking objectives with per-model sample masks (query "
+                "segments derive from the full dataset)")
 
         # swept hyperparameters -> traced (M, S) matrix; fields equal
         # across the batch stay static (max constant folding)
@@ -295,12 +353,15 @@ class BatchTrainer:
         self._build_step()
 
         self._grown: List[GrownTree] = []       # stacked per-iteration
-        self._leaves: List[Any] = []            # device (M,) per iteration
+        self._leaves: List[Any] = []            # device (L,) per iteration
+        self._dart_base: List[jnp.ndarray] = []  # per iter: raw (L, N) pred
+        self._dart_vb: List[List[jnp.ndarray]] = []  # per iter, per valid
         self._steps = 0
         self.record = TrainRecord(meta={
-            "boosting": "gbdt", "objective": str(cfg.objective),
+            "boosting": self.boosting, "objective": str(cfg.objective),
             "tree_learner": "serial",
             "multitrain_models": self.M,
+            "multitrain_classes": self.K,
             "num_leaves": int(cfg.num_leaves),
             "num_data": int(self.n),
             "num_features": int(self.num_features),
@@ -312,30 +373,44 @@ class BatchTrainer:
         reg.counter("multitrain_models_total",
                     "models trained on the vmapped model axis").inc(self.M)
 
+    # -- lane helpers --------------------------------------------------------
+    def _lanes(self, arr: np.ndarray) -> np.ndarray:
+        """(M, ...) host array -> (L, ...): repeat each model's row K times
+        (class-major lane order, lane = m*K + c)."""
+        return arr if self.K == 1 else np.repeat(arr, self.K, axis=0)
+
     # -- setup ---------------------------------------------------------------
     def _init_scores(self) -> None:
         md = self.train_set.metadata
-        score0 = np.zeros((self.M, self.n), np.float32)
+        K = self.K
+        score0 = np.zeros((self.L, self.n), np.float32)
         for m, st in enumerate(self.states):
+            st.bias = np.zeros(K)
             if md.init_score is not None:
-                score0[m] += md.init_score.reshape(self.n).astype(np.float32)
+                init = md.init_score.reshape(self.n, K) if K > 1 else \
+                    md.init_score.reshape(self.n)
+                for c in range(K):
+                    col = init[:, c] if K > 1 else init
+                    score0[m * K + c] += col.astype(np.float32)
             elif st.cfg.boost_from_average:
                 if st.rows is None:
-                    st.bias = self.objective.boost_from_score(0)
+                    obj = self.objective
                 else:
                     # fold/cohort models: the standalone counterpart
                     # computes its average over ITS rows only
                     obj = create_objective(st.cfg.objective, st.cfg)
                     obj.init(_subset_metadata(md, st.rows, st.mask_vals),
                              len(st.rows))
-                    st.bias = obj.boost_from_score(0)
-                score0[m] += np.float32(st.bias)
+                for c in range(K):
+                    st.bias[c] = obj.boost_from_score(c)
+                    score0[m * K + c] += np.float32(st.bias[c])
         self.score = jnp.asarray(score0)
 
     def _init_valid(self, valid_sets: List[Dataset],
                     valid_names: List[str]) -> None:
         self.valid_sets: List[Tuple[str, Dataset]] = []
         self.vbins: List[jnp.ndarray] = []
+        K = self.K
         vscores = []
         for i, vs in enumerate(valid_sets):
             if vs is self.train_set:
@@ -355,13 +430,17 @@ class BatchTrainer:
                     "cannot add validation data: it was constructed "
                     "without reference to the training Dataset")
             nv = vs.num_data()
-            v0 = np.zeros((self.M, nv), np.float32)
+            v0 = np.zeros((self.L, nv), np.float32)
             for m, st in enumerate(self.states):
                 if vs.metadata.init_score is not None:
-                    v0[m] += vs.metadata.init_score.reshape(nv).astype(
-                        np.float32)
+                    init = vs.metadata.init_score.reshape(nv, K) if K > 1 \
+                        else vs.metadata.init_score.reshape(nv)
+                    for c in range(K):
+                        col = init[:, c] if K > 1 else init
+                        v0[m * K + c] += col.astype(np.float32)
                 elif st.cfg.boost_from_average:
-                    v0[m] += np.float32(st.bias)
+                    for c in range(K):
+                        v0[m * K + c] += np.float32(st.bias[c])
             if "bins" not in vs._device_cache:
                 vs._device_cache["bins"] = jnp.asarray(vs.X_binned)
             self.valid_sets.append((name, vs))
@@ -380,20 +459,28 @@ class BatchTrainer:
         sp = lrn.split_params
         self._need_node_key = (sp.feature_fraction_bynode < 1.0 or
                                sp.extra_trees)
+        K = self.K
         if self._need_quant_key:
             self._quant_base = jnp.stack(
                 [jax.random.PRNGKey(int(st.cfg.seed))
-                 for st in self.states])
+                 for st in self.states for _ in range(K)])
         if self._need_node_key:
             self._node_base = jnp.stack([jnp.stack([
                 jax.random.PRNGKey(int(st.cfg.feature_fraction_seed)),
                 jax.random.PRNGKey(int(st.cfg.extra_seed))])
-                for st in self.states])
+                for st in self.states for _ in range(K)])
+        # per-lane fold values: the standalone key stream folds with
+        # it = iter_ * K + class_id (gbdt.py train_one_iter), so each
+        # class lane folds its own value
+        self._class_of_lane = np.tile(np.arange(K, dtype=np.int64), self.M)
         self._fold_one = jax.jit(jax.vmap(jax.random.fold_in,
-                                          in_axes=(0, None)))
+                                          in_axes=(0, 0)))
         self._fold_two = jax.jit(jax.vmap(jax.vmap(jax.random.fold_in,
                                                    in_axes=(0, None)),
-                                          in_axes=(0, None)))
+                                          in_axes=(0, 0)))
+
+    def _fold_vals(self, it: int) -> jnp.ndarray:
+        return jnp.asarray(it * self.K + self._class_of_lane)
 
     def _build_step(self) -> None:
         lrn = self.learner
@@ -475,20 +562,32 @@ class BatchTrainer:
         # get_gradients dispatches), the grower is ONE jitted program,
         # the score/valid updates ride the standalone's own jitted
         # helpers under eager vmap
-        self._vm_grad = jax.vmap(objective.get_gradients)
+        M, K, L, n = self.M, self.K, self.L, self.n
+        base_grad = jax.vmap(objective.get_gradients)
+        if K == 1:
+            self._vm_grad = base_grad
+        else:
+            # the standalone multiclass objective sees an (N, K) score;
+            # lanes are class-major, so the (L, N) state reshapes to the
+            # per-model (N, K) view, gradients vmap per MODEL, and the
+            # result flattens back — pure layout moves, no arithmetic
+            def _vm_grad_mc(score_lanes):
+                sc = jnp.swapaxes(score_lanes.reshape(M, K, n), 1, 2)
+                g, h = base_grad(sc)
+                return (jnp.swapaxes(g, 1, 2).reshape(L, n),
+                        jnp.swapaxes(h, 1, 2).reshape(L, n))
+            self._vm_grad = _vm_grad_mc
         vm_grow = jax.vmap(one_grow, in_axes=(None, 0, 0, 0, 0, 0, 0, 0))
-        # model-axis sharding: shard_map the vmapped grower over the
-        # GLOBAL device mesh so each device grows M/k model lanes
-        # concurrently (per-device model lanes; multi-host pods shard
-        # the lane axis across every host's devices — the pmap this
-        # replaces could only see local devices and forced a host-side
-        # (k, M/k) reshape round-trip per step).  Per-lane values are
-        # identical either way (a vmap lane's arithmetic is batch-width
+        # lane-axis sharding: shard_map the vmapped grower over the
+        # GLOBAL device mesh so each device grows L/k lanes concurrently
+        # (per-device model lanes; multi-host pods shard the lane axis
+        # across every host's devices).  Per-lane values are identical
+        # either way (a vmap lane's arithmetic is batch-width
         # independent — the bit-identity suite pins this), so sharding
         # is purely a throughput choice.
         ndev = jax.device_count()
         self._shard = (bool(self.cfg.tpu_multitrain_shard) and ndev > 1
-                       and self.M >= ndev and self.M % ndev == 0)
+                       and self.L >= ndev and self.L % ndev == 0)
         if self._shard:
             from jax.sharding import PartitionSpec as P
             from ..parallel.mesh import get_mesh, shard_map_compat
@@ -505,17 +604,33 @@ class BatchTrainer:
                                  in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0, 0))
         self._vm_upd = jax.vmap(_update_score_by_leaf,
                                 in_axes=(0, 0, 0, None))
-        self._lr_dev = jnp.asarray(self.lr)
-        self._sweep_dev = jnp.asarray(self.sweep)
+        # raw per-tree train predictions for DART's drop bookkeeping:
+        # one stacked eager gather, the vmap of the standalone's own
+        # `leaf_value[row_leaf]`
+        self._vm_base_pred = jax.vmap(lambda lv, rl: lv[rl])
+        self._lr_dev = jnp.asarray(self._lanes(self.lr))
+        # per-iteration shrinkage lanes: DART replaces this every
+        # iteration (lr/(1+k_dropped) per model); others keep lr
+        self._shrink_dev = self._lr_dev
+        self._sweep_dev = jnp.asarray(self._sweep_lanes())
+
+    def _sweep_lanes(self) -> np.ndarray:
+        return self._lanes(self.sweep) if self.sweep.size else \
+            np.zeros((self.L, 0), np.float32)
 
     # -- per-iteration host inputs ------------------------------------------
     def _masks_for_iter(self, it: int) -> Optional[np.ndarray]:
-        """(M, N) f32 training-row masks for this iteration, or None when
-        unchanged from the previous one (device array reused).  The bag
-        only moves at bagging-block boundaries (bagging_mask_np is a pure
-        function of the block), so off-boundary iterations skip the host
-        sampling AND the host->device transfer entirely."""
+        """(M, N) f32 training-row BASE masks for this iteration, or None
+        when unchanged from the previous one (device array reused).  The
+        bag only moves at bagging-block boundaries (bagging_mask_np is a
+        pure function of the block), so off-boundary iterations skip the
+        host sampling AND the host->device transfer entirely.  GOSS lanes
+        never bag (the standalone GOSS overrides sampling entirely); their
+        base mask is the static rows indicator and the per-iteration GOSS
+        survivorship multiplies on top in step_once."""
         def _bagged(st):
+            if self._goss:
+                return False
             c = st.cfg
             pos_neg = (c.objective == "binary" and
                        (c.pos_bagging_fraction < 1.0 or
@@ -532,8 +647,8 @@ class BatchTrainer:
             label = np.asarray(self.train_set.metadata.label)
         rows_out = []
         for st in self.states:
-            base = bagging_mask_np(st.cfg, self.n, it, label=label,
-                                   rows=st.rows)
+            base = None if self._goss else bagging_mask_np(
+                st.cfg, self.n, it, label=label, rows=st.rows)
             if base is None:
                 if st.rows is not None:
                     base = np.zeros(self.n, np.float32)
@@ -559,37 +674,226 @@ class BatchTrainer:
                 out[m] = fm
         return out
 
+    # -- GOSS (host draws over the eager gradient matrix) --------------------
+    def _apply_goss(self, it: int, grad, hess):
+        """Shared host GOSS draws per lane: multiplies the amplified
+        small-gradient weights into the stacked gradients (one eager
+        elementwise multiply — warmup/inactive lanes multiply by 1.0,
+        which is bit-exact) and records the 0/1 survivorship per model
+        for the grower mask."""
+        mult = None
+        gmask = None
+        K = self.K
+        # one host pull shared across lanes
+        gnp = np.asarray(grad)
+        hnp = np.asarray(hess)
+        for m, st in enumerate(self.states):
+            if not st.active:
+                continue
+            if K == 1:
+                gm = goss_sample_np(st.cfg, gnp[m], hnp[m], it, rows=st.rows)
+            else:
+                g2 = gnp[m * K:(m + 1) * K].T   # (N, K) per-model view
+                h2 = hnp[m * K:(m + 1) * K].T
+                gm = goss_sample_np(st.cfg, g2, h2, it, rows=st.rows)
+            if gm is None:
+                continue
+            if mult is None:
+                mult = np.ones((self.L, self.n), np.float32)
+                gmask = np.ones((self.M, self.n), np.float32)
+            mask_m, mult_m = gm
+            gmask[m] = mask_m
+            for c in range(K):
+                mult[m * K + c] = mult_m
+        if mult is None:
+            self._goss_mask = None
+            return grad, hess
+        self._goss_mask = gmask
+        mdev = jnp.asarray(mult)
+        return grad * mdev, hess * mdev
+
+    # -- DART (host drop bookkeeping + lane-masked device axpys) -------------
+    def _dart_pre(self, it: int) -> Dict[int, List[int]]:
+        """Per-model drop draws (the standalone DART.train_one_iter loop,
+        models/boosting.py) + batched dropped-tree score subtraction.
+        Sets the per-iteration shrinkage lanes."""
+        drops: Dict[int, List[int]] = {}
+        shrink = np.empty(self.M, np.float32)
+        for m, st in enumerate(self.states):
+            cfg = st.cfg
+            lr = float(cfg.learning_rate)
+            if not st.active:
+                st.cur_shrinkage = lr
+                shrink[m] = np.float32(lr)
+                continue
+            rng = host_rng(cfg.drop_seed, it)
+            t = it
+            drop: List[int] = []
+            if t > 0 and not (rng.random() < cfg.skip_drop):
+                if cfg.uniform_drop:
+                    p = cfg.drop_rate
+                    if cfg.max_drop > 0:
+                        p = min(p, cfg.max_drop / float(t))
+                    for i in range(t):
+                        if rng.random() < p:
+                            drop.append(i)
+                            if cfg.max_drop > 0 and len(drop) >= cfg.max_drop:
+                                break
+                else:
+                    inv_avg = t / max(st.sum_weight, 1e-12)
+                    p = cfg.drop_rate
+                    if cfg.max_drop > 0:
+                        p = min(p, cfg.max_drop * inv_avg /
+                                max(st.sum_weight, 1e-12))
+                    for i in range(t):
+                        if rng.random() < p * st.weights[i] * inv_avg:
+                            drop.append(i)
+                            if cfg.max_drop > 0 and len(drop) >= cfg.max_drop:
+                                break
+            if drop:
+                drops[m] = drop
+            kd = float(len(drop))
+            if cfg.xgboost_dart_mode:
+                st.cur_shrinkage = lr if not drop else lr / (lr + kd)
+            else:
+                st.cur_shrinkage = lr / (1.0 + kd)
+            shrink[m] = np.float32(st.cur_shrinkage)
+        self._shrink_dev = jnp.asarray(self._lanes(shrink))
+        # remove dropped trees from the TRAIN score (valid handled in
+        # normalize, like the reference): one where-masked axpy per
+        # distinct dropped tree index, all lanes in a shared dispatch
+        for d in sorted({i for dl in drops.values() for i in dl}):
+            wv = np.zeros(self.M, np.float32)
+            sel = np.zeros(self.M, bool)
+            for m, dl in drops.items():
+                if d in dl:
+                    wv[m] = np.float32(self.states[m].weights[d])
+                    sel[m] = True
+            sl = jnp.asarray(self._lanes(sel))
+            wl = jnp.asarray(self._lanes(wv))
+            self.score = jnp.where(
+                sl[:, None],
+                self.score - self._dart_base[d] * wl[:, None], self.score)
+        return drops
+
+    def _dart_normalize(self, drops: Dict[int, List[int]]) -> None:
+        """The standalone DART._normalize: dropped trees rescale to
+        weight*k/(k+1), the train score re-adds them at the new weight and
+        valid scores adjust by the weight delta — batched as lane-masked
+        axpys.  Tree shrink factors are recorded per model for the
+        finalize-time replay (the standalone shrinks host trees in
+        place)."""
+        if not drops:
+            return
+        new_w = {}
+        delta_w = {}
+        for m, dl in drops.items():
+            st = self.states[m]
+            cfg = st.cfg
+            kd = float(len(dl))
+            lr = float(cfg.learning_rate)
+            factor = kd / (kd + lr) if cfg.xgboost_dart_mode else \
+                kd / (kd + 1.0)
+            for d in dl:
+                old = st.weights[d]
+                new = old * factor
+                st.weights[d] = new
+                st.sum_weight -= old - new
+                st.tree_factors[d].append(factor)
+                new_w[(m, d)] = new
+                delta_w[(m, d)] = new - old
+        for d in sorted({i for dl in drops.values() for i in dl}):
+            nw = np.zeros(self.M, np.float32)
+            dw = np.zeros(self.M, np.float32)
+            sel = np.zeros(self.M, bool)
+            for m, dl in drops.items():
+                if d in dl:
+                    nw[m] = np.float32(new_w[(m, d)])
+                    dw[m] = np.float32(delta_w[(m, d)])
+                    sel[m] = True
+            sl = jnp.asarray(self._lanes(sel))
+            nwl = jnp.asarray(self._lanes(nw))
+            self.score = jnp.where(
+                sl[:, None],
+                self.score + self._dart_base[d] * nwl[:, None], self.score)
+            if self.vscores:
+                dwl = jnp.asarray(self._lanes(dw))
+                self.vscores = tuple(
+                    jnp.where(sl[:, None],
+                              vs + self._dart_vb[d][vi] * dwl[:, None], vs)
+                    for vi, vs in enumerate(self.vscores))
+
     def step_once(self, it: int) -> None:
         faults.check_train_iter(it)
         masks = self._masks_for_iter(it)
         if masks is not None:
-            self._mask_dev = jnp.asarray(masks)
+            self._base_masks_np = masks
+            self._mask_dev = jnp.asarray(self._lanes(masks))
+            if self._goss:
+                self._base_mask_dev = self._mask_dev
         fmask = self._fmask_for_iter(it)
         if fmask is not None:
-            self._fmask_dev = jnp.asarray(fmask)
-        qk = (self._fold_one(self._quant_base, it)
+            self._fmask_dev = jnp.asarray(self._lanes(fmask))
+        drops = self._dart_pre(it) if self._dart else None
+        qk = (self._fold_one(self._quant_base, self._fold_vals(it))
               if self._need_quant_key else self._dummy_qk())
-        nk = (self._fold_two(self._node_base, it)
+        nk = (self._fold_two(self._node_base, self._fold_vals(it))
               if self._need_node_key else self._dummy_nk())
         with self.record.phase("gradients"):
             grad, hess = self._vm_grad(self.score)
+            if self._goss:
+                grad, hess = self._apply_goss(it, grad, hess)
+                if self._goss_mask is not None:
+                    self._mask_dev = jnp.asarray(self._lanes(
+                        self._base_masks_np * self._goss_mask))
+                else:
+                    self._mask_dev = self._base_mask_dev
         with self.record.phase("grow"):
-            # sharded or not, one (M, ...) call: the shard_map lane
-            # split happens on-device (no host (k, M/k) reshape)
+            # sharded or not, one (L, ...) call: the shard_map lane
+            # split happens on-device (no host (k, L/k) reshape)
             grown = self._vm_grow(self._X_arg, grad, hess,
                                   self._mask_dev, self._fmask_dev,
                                   self._sweep_dev, qk, nk)
+        if self._dart:
+            # raw (unshrunk) per-tree train predictions, one stacked
+            # gather — the standalone's `leaf_value[row_leaf]`
+            self._dart_base.append(
+                self._vm_base_pred(grown.leaf_value, grown.row_leaf))
+            for st in self.states:
+                if st.active:
+                    st.weights.append(st.cur_shrinkage)
+                    st.sum_weight += st.cur_shrinkage
+                    st.tree_shrink.append(st.cur_shrinkage)
+                    st.tree_factors.append([])
+                else:
+                    # keep per-tree lists index-aligned with _dart_base
+                    st.tree_shrink.append(float(st.cfg.learning_rate))
+                    st.tree_factors.append([])
+                    st.weights.append(0.0)
         # eager multiply: its rounding is the standalone
         # `grown.leaf_value * shrinkage` dispatch's rounding
-        lv = grown.leaf_value * self._lr_dev[:, None]
+        shrink_dev = self._shrink_dev if self._dart else self._lr_dev
+        lv = grown.leaf_value * shrink_dev[:, None]
         self.score = self._vm_upd(self.score, grown.row_leaf, lv, 1.0)
-        self.vscores = tuple(
-            vs + self._vm_walk(vb, grown.split_feature, grown.threshold_bin,
+        new_vscores = []
+        vb_this = []
+        for vb, vs in zip(self.vbins, self.vscores):
+            dv = self._vm_walk(vb, grown.split_feature, grown.threshold_bin,
                                grown.nan_bin, grown.cat_member,
                                grown.decision_type, grown.left_child,
                                grown.right_child, lv, grown.num_leaves)
-            for vb, vs in zip(self.vbins, self.vscores))
-        grown = grown._replace(row_leaf=jnp.zeros((self.M, 0), jnp.int32))
+            nvs = vs + dv
+            if self._dart:
+                # the standalone's (after - before) / w valid base —
+                # NOT dv / w: the add rounds, and the base must replay
+                # exactly what the score absorbed
+                vb_this.append((nvs - vs) / shrink_dev[:, None])
+            new_vscores.append(nvs)
+        self.vscores = tuple(new_vscores)
+        if self._dart:
+            self._dart_vb.append(vb_this)
+            self._dart_normalize(drops or {})
+        grown = grown._replace(row_leaf=jnp.zeros((self.L, 0), jnp.int32))
         self._grown.append(grown)
         leaves = grown.num_leaves
         if hasattr(leaves, "copy_to_host_async"):
@@ -604,33 +908,61 @@ class BatchTrainer:
 
     def _dummy_qk(self):
         if not hasattr(self, "_qk0"):
-            self._qk0 = jnp.zeros((self.M, 2), jnp.uint32)
+            self._qk0 = jnp.zeros((self.L, 2), jnp.uint32)
         return self._qk0
 
     def _dummy_nk(self):
         if not hasattr(self, "_nk0"):
-            self._nk0 = jnp.zeros((self.M, 2, 2), jnp.uint32)
+            self._nk0 = jnp.zeros((self.L, 2, 2), jnp.uint32)
         return self._nk0
 
     # -- stump stop (lagged, like GBDT.train_one_iter) -----------------------
     def check_stumps(self, it: int) -> None:
         """Before stepping iteration ``it``: a model whose ENTIRE previous
         iteration grew no split stops (the standalone loop pops those
-        trees and breaks, gbdt.cpp:430-450)."""
+        trees and breaks, gbdt.cpp:430-450).  DART keeps the stump
+        iteration's trees — its non-deferred standalone path records them
+        before discovering the stop (models/boosting.py _defer_trees)."""
         if it < 1 or it - 1 >= len(self._leaves):
             return
         prev = np.asarray(jax.device_get(self._leaves[it - 1]))
+        K = self.K
         for m, st in enumerate(self.states):
-            if st.active and prev[m] <= 1:
+            if st.active and all(int(prev[m * K + c]) <= 1
+                                 for c in range(K)):
                 st.active = False
                 st.stop_reason = "no-split"
-                # the stump iteration's trees are popped unless they are
-                # the model's only iteration (they carry the init bias)
-                st.kept_iters = max(1, it - 1)
+                if self._dart:
+                    st.kept_iters = it
+                else:
+                    # the stump iteration's trees are popped unless they
+                    # are the model's only iteration (they carry the
+                    # init bias)
+                    st.kept_iters = max(1, it - 1)
 
     # -- evaluation / early stopping ----------------------------------------
     def _needs_eval(self) -> bool:
         return bool(self.valid_sets)
+
+    def _host_valid_score(self, host_vs: np.ndarray, m: int) -> np.ndarray:
+        """Model m's slice of a pulled (L, nv) valid score: (nv,) or the
+        standalone's (nv, K) layout for multiclass."""
+        if self.K == 1:
+            return host_vs[m]
+        return host_vs[m * self.K:(m + 1) * self.K].T
+
+    def host_lane_score(self, m: int, rows_dev=None) -> np.ndarray:
+        """Model m's current TRAIN score (optionally gathered at device
+        row indices): (n,)/(rows,) or (n, K)/(rows, K) for multiclass.
+        The CV fast path evaluates held-out metrics on this."""
+        if self.K == 1:
+            sc = self.score[m] if rows_dev is None else \
+                self.score[m][rows_dev]
+            return np.asarray(sc)
+        sc = self.score[m * self.K:(m + 1) * self.K]
+        if rows_dev is not None:
+            sc = sc[:, rows_dev]
+        return np.asarray(sc).T
 
     def eval_all(self, it: int, num_boost_round: int) -> None:
         if not self._needs_eval():
@@ -642,8 +974,9 @@ class BatchTrainer:
                     continue
                 rows = []
                 for vi, (vname, _) in enumerate(self.valid_sets):
+                    sc = self._host_valid_score(host_vs[vi], m)
                     for mt in st.metrics_per_valid[vi]:
-                        for name, val, hib in mt.eval(host_vs[vi][m]):
+                        for name, val, hib in mt.eval(sc):
                             rows.append((vname, name, val, hib))
                 for dn, en, val, _ in rows:
                     st.history.setdefault(dn, {}).setdefault(
@@ -684,35 +1017,55 @@ class BatchTrainer:
         with self.record.phase("record"):
             pulled = jax.device_get(self._grown)
             scores = self.score
+            K = self.K
             boosters = []
             for m, st in enumerate(self.states):
                 trees = []
-                shrink = float(st.cfg.learning_rate)
+                lr = float(st.cfg.learning_rate)
                 for t in range(st.kept_iters):
-                    g = GrownTree(*[np.asarray(f)[m] for f in pulled[t]])
-                    tree = _grown_to_tree(g, shrink, self.train_set)
-                    if t == 0 and abs(st.bias) > EPSILON:
-                        tree.add_bias(st.bias)
-                    trees.append(tree)
+                    shrink = st.tree_shrink[t] if self._dart else lr
+                    for c in range(K):
+                        lane = m * K + c
+                        g = GrownTree(*[np.asarray(f)[lane]
+                                        for f in pulled[t]])
+                        tree = _grown_to_tree(g, shrink, self.train_set)
+                        if t == 0 and abs(st.bias[c]) > EPSILON:
+                            tree.add_bias(st.bias[c])
+                        if self._dart:
+                            # normalize-time rescales, replayed in the
+                            # standalone's chronological order
+                            for f in st.tree_factors[t]:
+                                tree.shrink(f)
+                        trees.append(tree)
                 bst = Booster(params=st.params, train_set=self.train_set)
                 gb = bst._gbdt
                 gb.models = trees
                 gb.iter_ = st.kept_iters
-                gb.score = scores[m]
+                if K == 1:
+                    gb.score = scores[m]
+                else:
+                    gb.score = jnp.swapaxes(
+                        scores[m * K:(m + 1) * K], 0, 1)
+                if self._dart:
+                    kept = st.kept_iters
+                    gb._weights = list(st.weights[:kept])
+                    gb._sum_weight = float(sum(st.weights[:kept]))
+                    gb._cur_shrinkage = st.cur_shrinkage
                 bst.best_iteration = st.best_iteration
                 bst.best_score = st.best_score
                 rec = TrainRecord(meta={
-                    "boosting": "gbdt",
+                    "boosting": self.boosting,
                     "objective": str(st.cfg.objective),
                     "tree_learner": "serial",
                     "multitrain_model_index": m,
                     "multitrain_models": self.M,
+                    "multitrain_classes": K,
                     "num_leaves": int(st.cfg.num_leaves),
                     "num_data": int(self.n),
                     "num_features": int(self.num_features),
                 })
                 for t, tr in enumerate(trees):
-                    rec.add_tree(t, 0, 0, tr.num_leaves)
+                    rec.add_tree(t // K, t % K, 0, tr.num_leaves)
                 gb.train_record = rec
                 boosters.append(bst)
             return boosters

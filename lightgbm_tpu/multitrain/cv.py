@@ -112,13 +112,15 @@ def cv_many(params: Dict[str, Any], train_set: Dataset,
         agg = collections.defaultdict(list)
         hib_map: Dict[str, bool] = {}
         for k in range(nfold):
-            held_out = np.asarray(trainer.score[k][test_rows_dev[k]])
+            # host_lane_score hands back the standalone score layout —
+            # (rows,) or (rows, K) for multiclass fold batches
+            held_out = trainer.host_lane_score(k, test_rows_dev[k])
             for mt in valid_metrics[k]:
                 for name, val, hib in mt.eval(held_out):
                     agg[f"valid {name}"].append(val)
                     hib_map[f"valid {name}"] = hib
             if eval_train_metric:
-                in_fold = np.asarray(trainer.score[k][train_rows_dev[k]])
+                in_fold = trainer.host_lane_score(k, train_rows_dev[k])
                 for mt in train_metrics[k]:
                     for name, val, _ in mt.eval(in_fold):
                         agg[f"train {name}"].append(val)

@@ -18,8 +18,11 @@ max_depth, ...) group into one compiled batch per structure.
     gs.fit(X, y)
     gs.best_params_, gs.best_score_, gs.cv_results_["mean_test_score"]
 
-Combos the model axis cannot express (multiclass, dart, ...) fall back
-to sequential per-fold fits of the wrapped estimator.
+GOSS, DART, multiclass and ranking estimators all ride the model axis
+(PR 20); only combos it genuinely cannot express (RF, CEGB, linear
+trees, custom objectives, ...) fall back to sequential per-fold fits of
+the wrapped estimator — never silently: each bumps
+``multitrain_fallback_total{reason}``.
 """
 
 from __future__ import annotations

@@ -35,12 +35,18 @@ __all__ = ["TRACED_SWEEP", "HOST_SWEEP", "SWEEPABLE", "normalize_variants",
 # sweepable along the traced model axis (see ops/split.py)
 TRACED_SWEEP: Tuple[str, ...] = TRACEABLE_PARAMS
 
-# sweepable host-side (per-model masks / seeds / bookkeeping)
+# sweepable host-side (per-model masks / seeds / bookkeeping); the GOSS
+# rates and DART drop knobs are host draws too (gbdt.goss_sample_np /
+# the per-lane drop bookkeeping in batched._ModelState), so they sweep
+# inside one batch — boosting TYPE itself stays structural
 HOST_SWEEP: Tuple[str, ...] = (
     "learning_rate", "bagging_seed", "bagging_fraction",
     "pos_bagging_fraction", "neg_bagging_fraction", "feature_fraction",
     "feature_fraction_seed", "seed", "extra_seed",
     "early_stopping_round", "first_metric_only", "metric",
+    "top_rate", "other_rate",
+    "drop_rate", "max_drop", "skip_drop", "uniform_drop",
+    "xgboost_dart_mode", "drop_seed",
 )
 
 SWEEPABLE: Tuple[str, ...] = TRACED_SWEEP + HOST_SWEEP

@@ -15,7 +15,12 @@ def _data(n=3001, f=6, seed=7, task="binary"):
     rng = np.random.RandomState(seed)
     X = rng.randn(n, f)
     raw = X[:, 0] + 0.5 * X[:, 1] + rng.randn(n) * 0.5
-    y = (raw > 0).astype(np.float64) if task == "binary" else raw
+    if task == "binary":
+        y = (raw > 0).astype(np.float64)
+    elif task == "mc":
+        y = np.digitize(raw, [-0.5, 0.5]).astype(np.float64)
+    else:
+        y = raw
     return X, y
 
 
@@ -155,12 +160,12 @@ def test_engine_routes_chunked_mode():
     assert bst.model_to_string() == t1
 
 
-def test_engine_chunked_rejects_valid_sets():
+def test_engine_chunked_rejects_callbacks():
     X, y = _data(2048, 5, seed=2)
     p = dict(_PIN, objective="binary", tpu_ingest_mode="chunked")
     sd = StreamedDataset(ArraySource(X, y, chunk_rows=512), params=p)
-    with pytest.raises(ValueError, match="valid_sets"):
-        lgb.train(p, sd, num_boost_round=2, valid_sets=[sd])
+    with pytest.raises(ValueError, match="callbacks"):
+        lgb.train(p, sd, num_boost_round=2, callbacks=[lambda env: None])
 
 
 def test_envelope_errors():
@@ -172,9 +177,18 @@ def test_envelope_errors():
     with pytest.raises(StreamedEnvelopeError, match="monotone"):
         train_streamed(dict(_PIN, objective="binary",
                             monotone_constraints=[1, 0, 0, 0, 0]), sd, 2)
-    with pytest.raises(StreamedEnvelopeError, match="num_class"):
-        train_streamed({"objective": "multiclass", "num_class": 3,
+    # ranking stays in-core only (query segments are not chunk-sliceable);
+    # multiclassova's per-class label weights likewise
+    with pytest.raises(StreamedEnvelopeError, match="objective"):
+        train_streamed({"objective": "lambdarank", "verbosity": -1}, sd, 2)
+    with pytest.raises(StreamedEnvelopeError, match="objective"):
+        train_streamed({"objective": "multiclassova", "num_class": 3,
                         "verbosity": -1}, sd, 2)
+    # dart batches now, but not with checkpointing (drop weights are not
+    # reconstructible from model text)
+    with pytest.raises(StreamedEnvelopeError, match="checkpoint"):
+        train_streamed(dict(_PIN, objective="binary", boosting="dart",
+                            snapshot_freq=1), sd, 2)
 
 
 # ---------------------------------------------------------------------------
@@ -190,17 +204,141 @@ def test_chunked_bagging_feature_fraction_identity():
     assert b1.model_to_string() == b2.model_to_string()
 
 
-def test_chunked_goss_trains():
+def test_chunked_goss_bit_identity():
+    """GOSS rides the SHARED host sampler (models.gbdt.goss_sample_np):
+    the streamed run thins exactly the rows the in-core run thins,
+    warmup included, so the quantized model text matches byte for
+    byte."""
     X, y = _data(4096, 6)
     p = dict(_PIN, objective="binary", boosting="goss",
              use_quantized_grad=True, tpu_wave_size=4,
              learning_rate=0.5, top_rate=0.2, other_rate=0.1)
-    sd = StreamedDataset(ArraySource(X, y, chunk_rows=512), params=p)
-    bst = train_streamed(p, sd, num_boost_round=6)
-    pred = bst.predict(X)
-    # sane model: better than chance on the training data
+    b1, b2 = _both(p, X, y)
+    assert b1.model_to_string() == b2.model_to_string()
+    pred = b2.predict(X)
     acc = float(((pred > 0.5) == (y > 0)).mean())
     assert acc > 0.7
+
+
+@pytest.mark.parametrize("extra", [
+    {"uniform_drop": True},
+    {"uniform_drop": False, "xgboost_dart_mode": True, "max_drop": 3},
+])
+def test_chunked_dart_bit_identity(extra):
+    """DART's drop/Normalize bookkeeping replayed host-side (same
+    (drop_seed, iteration) streams, f32 axpys) == the in-core device
+    run, in both drop modes."""
+    X, y = _data()
+    p = dict(_PIN, objective="binary", boosting="dart",
+             use_quantized_grad=True, tpu_wave_size=4, drop_rate=0.5,
+             drop_seed=9)
+    p.update(extra)
+    b1, b2 = _both(p, X, y, rounds=8)
+    assert b1.model_to_string() == b2.model_to_string()
+    assert np.array_equal(b1.predict(X[:64]), b2.predict(X[:64]))
+
+
+def test_chunked_multiclass_bit_identity():
+    """Softmax gradients are rowwise -> chunk-sliceable; the K-tree
+    iteration grid matches the in-core class loop byte for byte."""
+    X, y = _data(task="mc")
+    p = dict(_PIN, objective="multiclass", num_class=3,
+             use_quantized_grad=True, tpu_wave_size=4)
+    b1, b2 = _both(p, X, y)
+    assert b1.model_to_string() == b2.model_to_string()
+    assert np.array_equal(b1.predict(X[:64]), b2.predict(X[:64]))
+
+
+@pytest.mark.slow
+def test_chunked_multiclass_bagging_feature_fraction_identity():
+    X, y = _data(task="mc")
+    p = dict(_PIN, objective="multiclass", num_class=3,
+             use_quantized_grad=True, tpu_wave_size=4,
+             bagging_fraction=0.7, bagging_freq=2, feature_fraction=0.8)
+    b1, b2 = _both(p, X, y)
+    assert b1.model_to_string() == b2.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# streamed validation + early stopping: same stop round as in-core
+# ---------------------------------------------------------------------------
+
+def _split(X, y, cut=3000):
+    return X[:cut], y[:cut], X[cut:], y[cut:]
+
+
+@pytest.mark.slow
+def test_chunked_early_stop_same_round():
+    X, y = _data(4096, 6)
+    Xtr, ytr, Xv, yv = _split(X, y)
+    p = dict(_PIN, objective="binary", use_quantized_grad=True,
+             tpu_wave_size=4, early_stopping_round=3)
+    ds = lgb.Dataset(Xtr.copy(), label=ytr.copy())
+    dv = lgb.Dataset(Xv.copy(), label=yv.copy(), reference=ds)
+    b1 = lgb.train(p, ds, num_boost_round=60, valid_sets=[dv],
+                   valid_names=["va"])
+    pc = dict(p, tpu_ingest_mode="chunked")
+    sd = StreamedDataset(ArraySource(Xtr, ytr, chunk_rows=512), params=pc)
+    sv = StreamedDataset(ArraySource(Xv, yv, chunk_rows=512), params=pc)
+    b2 = lgb.train(pc, sd, num_boost_round=60, valid_sets=[sv],
+                   valid_names=["va"])
+    assert b1.best_iteration == b2.best_iteration
+    # the streamed valid walk sees the same f32 scores -> same metric
+    assert b1.best_score == b2.best_score
+    assert b1.model_to_string() == b2.model_to_string()
+
+
+@pytest.mark.slow
+def test_chunked_early_stop_in_core_valid():
+    """An in-core Dataset as the valid of a chunked streamed run (mixed
+    types): binned against the streamed train's mappers via reference."""
+    X, y = _data(4096, 6)
+    Xtr, ytr, Xv, yv = _split(X, y)
+    p = dict(_PIN, objective="binary", use_quantized_grad=True,
+             tpu_wave_size=4, early_stopping_round=3,
+             tpu_ingest_mode="chunked")
+    sd = StreamedDataset(ArraySource(Xtr, ytr, chunk_rows=512), params=p)
+    dv = lgb.Dataset(Xv.copy(), label=yv.copy())
+    b = lgb.train(p, sd, num_boost_round=60, valid_sets=[dv])
+    assert b.best_iteration > 0
+    assert "valid_0" in b.best_score
+
+
+@pytest.mark.slow
+def test_chunked_dart_early_stop_same_round():
+    X, y = _data(4096, 6)
+    Xtr, ytr, Xv, yv = _split(X, y)
+    p = dict(_PIN, objective="binary", boosting="dart", drop_rate=0.5,
+             drop_seed=9, use_quantized_grad=True, tpu_wave_size=4,
+             early_stopping_round=4)
+    ds = lgb.Dataset(Xtr.copy(), label=ytr.copy())
+    dv = lgb.Dataset(Xv.copy(), label=yv.copy(), reference=ds)
+    b1 = lgb.train(p, ds, num_boost_round=25, valid_sets=[dv])
+    pc = dict(p, tpu_ingest_mode="chunked")
+    sd = StreamedDataset(ArraySource(Xtr, ytr, chunk_rows=512), params=pc)
+    sv = StreamedDataset(ArraySource(Xv, yv, chunk_rows=512), params=pc)
+    b2 = lgb.train(pc, sd, num_boost_round=25, valid_sets=[sv])
+    assert b1.best_iteration == b2.best_iteration
+    assert b1.model_to_string() == b2.model_to_string()
+
+
+@pytest.mark.slow
+def test_chunked_multiclass_goss_early_stop_same_round():
+    X, y = _data(4096, 6, task="mc")
+    Xtr, ytr, Xv, yv = _split(X, y)
+    p = dict(_PIN, objective="multiclass", num_class=3, boosting="goss",
+             learning_rate=0.5, top_rate=0.2, other_rate=0.1,
+             use_quantized_grad=True, tpu_wave_size=4,
+             early_stopping_round=3)
+    ds = lgb.Dataset(Xtr.copy(), label=ytr.copy())
+    dv = lgb.Dataset(Xv.copy(), label=yv.copy(), reference=ds)
+    b1 = lgb.train(p, ds, num_boost_round=40, valid_sets=[dv])
+    pc = dict(p, tpu_ingest_mode="chunked")
+    sd = StreamedDataset(ArraySource(Xtr, ytr, chunk_rows=512), params=pc)
+    sv = StreamedDataset(ArraySource(Xv, yv, chunk_rows=512), params=pc)
+    b2 = lgb.train(pc, sd, num_boost_round=40, valid_sets=[sv])
+    assert b1.best_iteration == b2.best_iteration
+    assert b1.model_to_string() == b2.model_to_string()
 
 
 # ---------------------------------------------------------------------------

@@ -155,6 +155,152 @@ def test_bit_identity_pmap_sharded_model_axis():
     _assert_bit_identical(mb, variants, X, y, 4)
 
 
+# -- PR-20 lifted variants: GOSS / DART / multiclass / ranking ---------------
+
+def _mc_data(seed=0, n=N, f=F):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    raw = X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.randn(n)
+    return X, np.digitize(raw, [-0.5, 0.5]).astype(np.float64)
+
+
+def _rank_data(seed=0, n=N, f=F, gsize=30):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    rel = np.clip((X[:, 0] + 0.5 * rng.randn(n)) + 2, 0, 4).astype(int)
+    groups = [gsize] * (n // gsize)
+    groups[-1] += n - sum(groups)
+    return X, rel.astype(np.float64), groups
+
+
+@pytest.mark.slow
+def test_bit_identity_goss_batch():
+    """GOSS batches (PR 20): the per-lane host sampler is the SHARED
+    goss_sample_np stream, so every lane's thinning equals its
+    standalone run — top/other rates sweep host-side in one batch."""
+    X, y = _data()
+    params = {**BASE, "boosting": "goss", "learning_rate": 0.5}
+    variants = [{"top_rate": 0.2, "other_rate": 0.1},
+                {"top_rate": 0.3, "other_rate": 0.2},
+                {"top_rate": 0.2, "other_rate": 0.1, "lambda_l1": 0.5}]
+    mb = train_many(params, lgb.Dataset(X, y), num_boost_round=6,
+                    variants=variants)
+    assert mb.fallback_indices == []
+    assert mb.num_groups == 1, "goss rate sweeps must share one batch"
+    base = {"boosting": "goss", "learning_rate": 0.5}
+    _assert_bit_identical(mb, [{**base, **v} for v in variants], X, y, 6)
+
+
+@pytest.mark.slow
+def test_bit_identity_dart_batch():
+    """DART batches (PR 20): per-lane drop sets from the standalone
+    (drop_seed, iteration) streams, Normalize as lane-masked axpys —
+    drop knobs sweep host-side in one batch."""
+    X, y = _data()
+    params = {**BASE, "boosting": "dart"}
+    variants = [{"drop_rate": 0.3, "drop_seed": 9},
+                {"drop_rate": 0.6, "drop_seed": 9},
+                {"drop_rate": 0.3, "drop_seed": 4,
+                 "xgboost_dart_mode": True}]
+    mb = train_many(params, lgb.Dataset(X, y), num_boost_round=7,
+                    variants=variants)
+    assert mb.fallback_indices == []
+    assert mb.num_groups == 1, "dart drop sweeps must share one batch"
+    _assert_bit_identical(mb, [{"boosting": "dart", **v}
+                               for v in variants], X, y, 7)
+
+
+@pytest.mark.slow
+def test_bit_identity_multiclass_batch():
+    """Multiclass batches (PR 20) as an (M, K) lane grid; composed with
+    bagging + feature_fraction the per-lane draws still equal the
+    standalone per-class streams."""
+    X, y = _mc_data()
+    params = {**BASE, "objective": "multiclass", "num_class": 3,
+              "bagging_fraction": 0.7, "bagging_freq": 2,
+              "feature_fraction": 0.8}
+    variants = [{"lambda_l2": 0.0}, {"lambda_l2": 3.0},
+                {"bagging_seed": 99}]
+    mb = train_many(params, lgb.Dataset(X, y), num_boost_round=5,
+                    variants=variants)
+    assert mb.fallback_indices == []
+    base = {k: v for k, v in params.items()
+            if k not in BASE or k == "objective"}
+    base["objective"] = "multiclass"
+    _assert_bit_identical(mb, [{**base, **v} for v in variants], X, y, 5)
+
+
+@pytest.mark.slow
+def test_bit_identity_multiclass_early_stopping():
+    X, y = _mc_data()
+    Xv, yv = _mc_data(seed=1, n=400)
+    params = {**BASE, "objective": "multiclass", "num_class": 3,
+              "early_stopping_round": 3}
+    variants = [{"learning_rate": 0.5}, {"learning_rate": 0.05}]
+    ds = lgb.Dataset(X, y)
+    mb = train_many(params, ds, num_boost_round=25, variants=variants,
+                    valid_sets=[lgb.Dataset(Xv, yv, reference=ds)],
+                    valid_names=["v0"])
+    for m, v in enumerate(variants):
+        p = {"objective": "multiclass", "num_class": 3,
+             "early_stopping_round": 3, **v}
+        ref = _fit_ref({**BASE, **p}, X, y, 25, valid=(Xv, yv))
+        assert mb[m].best_iteration == ref.best_iteration
+        assert ref.model_to_string() == mb[m].model_to_string()
+
+
+@pytest.mark.slow
+def test_ranking_structure_and_f32_parity():
+    """Ranking batches (PR 20): the per-group lambdarank pass is
+    lane-masked; trees match the standalone run structurally and
+    predictions agree to f32 tolerance (the batched gradient pass
+    reduces over the padded group axis in a different order)."""
+    X, y, groups = _rank_data()
+    params = {**BASE, "objective": "lambdarank",
+              "metric": "ndcg", "ndcg_eval_at": [5]}
+    variants = [{"lambda_l2": 0.0}, {"lambda_l2": 2.0}]
+    mb = train_many(params, lgb.Dataset(X, y, group=groups),
+                    num_boost_round=5, variants=variants)
+    assert mb.fallback_indices == []
+    for m, v in enumerate(variants):
+        p = {**BASE, "objective": "lambdarank", "metric": "ndcg",
+             "ndcg_eval_at": [5], **v}
+        ref = lgb.train(p, lgb.Dataset(X, y, group=groups), 5)
+        s_ref = [(t.split_feature.tolist(), t.threshold_bin.tolist())
+                 for t in ref._gbdt.models]
+        s_bat = [(t.split_feature.tolist(), t.threshold_bin.tolist())
+                 for t in mb[m]._gbdt.models]
+        assert s_ref == s_bat, f"ranking model {m} tree structure differs"
+        np.testing.assert_allclose(ref.predict(X[:128]),
+                                   mb[m].predict(X[:128]),
+                                   rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("lift", [
+    {"boosting": "goss", "learning_rate": 0.5},
+    {"boosting": "dart", "drop_rate": 0.4, "drop_seed": 9},
+    {"objective": "multiclass", "num_class": 3},
+], ids=["goss", "dart", "multiclass"])
+def test_cv_fold_parity_lifted(lift):
+    """engine.cv routes the lifted variants through the batched fold
+    driver; masked folds agree with the legacy compacted-subset loop to
+    f32 reduction tolerance (multiclass amplifies via softmax -> wider
+    rtol, same bar as the masked-subset parity test)."""
+    if lift.get("objective") == "multiclass":
+        X, y = _mc_data()
+    else:
+        X, y = _data()
+    P = {**BASE, **lift}
+    kw = dict(num_boost_round=5, nfold=3, seed=7)
+    fast = lgb.cv(P, lgb.Dataset(X, y), **kw)
+    slow = lgb.cv({**P, "tpu_cv_many": False}, lgb.Dataset(X, y), **kw)
+    assert sorted(fast) == sorted(slow)
+    for k in fast:
+        np.testing.assert_allclose(fast[k], slow[k], rtol=2e-4, atol=1e-6,
+                                   err_msg=k)
+
+
 # -- one compile for M models ------------------------------------------------
 
 def test_one_compile_for_m_models():
@@ -191,7 +337,7 @@ def test_traced_sweep_shares_structure_key():
 def test_structural_group_fallback_trains_everything():
     X, y = _data(n=600)
     variants = [{"lambda_l1": 0.5}, {"num_leaves": 7},
-                {"boosting": "dart"}]     # dart cannot batch -> fallback
+                {"cegb_penalty_split": 0.1}]  # CEGB cannot batch -> fallback
     mb = train_many(BASE, lgb.Dataset(X, y), num_boost_round=3,
                     variants=variants)
     assert sorted(mb.batched_indices) == [0, 1]
@@ -329,12 +475,61 @@ def test_reject_reasons():
     ds = lgb.Dataset(X, y)
     ds.construct(lgb.Config(BASE))
     assert batch_reject_reason(lgb.Config(BASE), ds) is None
-    assert "dart" in batch_reject_reason(
-        lgb.Config({**BASE, "boosting": "dart"}), ds)
-    assert "multiclass" in batch_reject_reason(
-        lgb.Config({**BASE, "objective": "multiclass", "num_class": 3}), ds)
+    # the PR-20 lifts: goss / dart / multiclass / ranking all batch now
+    for lifted in ({"boosting": "goss"}, {"boosting": "dart"},
+                   {"objective": "multiclass", "num_class": 3},
+                   {"objective": "lambdarank"}):
+        assert batch_reject_reason(lgb.Config({**BASE, **lifted}), ds) \
+            is None, f"{lifted} must no longer reject"
+    # every REMAINING reject string, hit explicitly (coverage: a new
+    # reject added without a test here is a lint failure by convention)
     assert "tree_learner" in batch_reject_reason(
         lgb.Config({**BASE, "tree_learner": "data"}), ds)
+    assert "boosting=rf" in batch_reject_reason(
+        lgb.Config({**BASE, "boosting": "rf", "bagging_freq": 1,
+                    "bagging_fraction": 0.5}), ds)
+    assert "objective=none" in batch_reject_reason(
+        lgb.Config({**BASE, "objective": "none"}), ds)
+    assert "linear_tree" in batch_reject_reason(
+        lgb.Config({**BASE, "linear_tree": True}), ds)
+    assert "CEGB" in batch_reject_reason(
+        lgb.Config({**BASE, "cegb_penalty_split": 0.1}), ds)
+
+
+def test_strict_mode_and_fallback_counter():
+    """The never-silent contract: strict=True raises instead of going
+    sequential, and EVERY fallback bumps
+    multitrain_fallback_total{reason} with the bounded reason prefix."""
+    from lightgbm_tpu.telemetry.metrics import default_registry
+    X, y = _data(n=400)
+    with pytest.raises(MultiTrainError, match="CEGB"):
+        train_many({**BASE, "cegb_penalty_split": 0.1}, lgb.Dataset(X, y),
+                   num_boost_round=2, strict=True)
+    reg = default_registry()
+    ctr = reg.counter("multitrain_fallback_total",
+                      "train_many models that fell back to sequential "
+                      "train(), by structural reason", labels=("reason",))
+    c0 = ctr.value(reason="CEGB penalties")
+    train_many({**BASE, "cegb_penalty_split": 0.1}, lgb.Dataset(X, y),
+               num_boost_round=2)
+    # bounded label: the free text after " (" is stripped
+    assert ctr.value(reason="CEGB penalties") == c0 + 1
+    req = reg.counter("multitrain_models_requested_total",
+                      "models requested through train_many "
+                      "(batched or not)")
+    assert req.value() >= 2
+
+
+def test_fallback_rate_slo_declared_and_covered():
+    """The multitrain/fallback_rate SLO keys to registered series (the
+    slo_cover lint runs this fleet-wide; asserted here so the contract
+    is local to the subsystem too)."""
+    from lightgbm_tpu.analysis.slo_cover import check_slo_coverage
+    from lightgbm_tpu.telemetry.slo import all_slos
+    assert "multitrain/fallback_rate" in all_slos()
+    bad = [v for v in check_slo_coverage()
+           if "multitrain" in v.site]
+    assert bad == []
 
 
 def test_masked_is_unbalance_rejected():
@@ -360,7 +555,7 @@ def test_masked_is_unbalance_rejected():
 def test_allow_fallback_false_raises():
     X, y = _data(n=400)
     with pytest.raises(MultiTrainError):
-        train_many({**BASE, "boosting": "dart"}, lgb.Dataset(X, y),
+        train_many({**BASE, "cegb_penalty_split": 0.1}, lgb.Dataset(X, y),
                    num_boost_round=2, allow_fallback=False)
 
 
